@@ -1,0 +1,29 @@
+"""kubeadmiral_tpu — a TPU-native multi-cluster federation framework.
+
+A from-scratch re-design of the capabilities of KubeAdmiral (the reference
+control plane surveyed in SURVEY.md): CRD-driven type federation, member
+cluster lifecycle, propagation/override policies, a pluggable
+Filter/Score/Select/Replicas scheduling pipeline, sync with field retention,
+status collection/aggregation, follower scheduling and auto-migration.
+
+The defining difference from the reference's in-process sequential Go
+scheduler (reference: pkg/controllers/scheduler): the replica-scheduling hot
+path is a batched tensor program — all pending FederatedObjects x member
+clusters are packed into dense arrays and pushed through a single jit/XLA
+pass per reconcile tick (see kubeadmiral_tpu.ops.pipeline).
+
+Layout:
+  models/      CRD-equivalent data model (FederatedTypeConfig, clusters,
+               policies, federated objects)
+  ops/         device kernels: planner, filters, scores, select, fused tick
+  parallel/    mesh construction + shardings for scaling B x C over chips
+  scheduler/   featurization (string world -> tensors), engine, controller
+  runtime/     reconcile workers, delaying deliverer, informers, pipeline
+               annotations, metrics
+  federation/  control-plane controllers (cluster, federate, sync, status,
+               override, follower, automigration, ...)
+  utils/       hashing, quantity parsing, label selectors, unstructured paths
+  testing/     in-memory apiserver (KWOK-analogue) + object builders
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,213 @@
+"""Sequential reference implementation of the replica planner.
+
+This is the semantic oracle for the batched TPU planner in
+``kubeadmiral_tpu.ops.planner``: a direct, readable statement of the
+reference algorithm (reference: pkg/controllers/util/planner/planner.go:83-366)
+used (a) in differential tests against the device kernel and (b) as the
+"in-process sequential scheduler" baseline that bench.py compares against.
+
+Semantics recap (all order-sensitive integer math):
+
+* clusters are processed in (weight desc, fnv32(cluster+key) asc) order;
+* a first pass hands every cluster ``min(minReplicas, remaining)``, capped
+  by estimated capacity (the clipped amount is recorded as overflow);
+* remaining replicas are distributed in rounds: each round snapshots the
+  remaining count D and hands cluster i ``ceil(D * w_i / sum_w)`` capped by
+  the *running* remainder, then by maxReplicas and capacity; clusters that
+  hit a cap drop out of later rounds; rounds repeat until nothing moves;
+* with ``avoid_disruption`` the result is re-derived from current replica
+  counts: only the delta between current and desired is moved, via a
+  recursive scale-up/scale-down distribution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeadmiral_tpu.utils.hashing import fnv32
+
+UNBOUNDED = None
+
+
+@dataclass
+class ClusterPref:
+    """Per-cluster scheduling preference (planner.go:30-41)."""
+
+    weight: int = 0
+    min_replicas: int = 0
+    max_replicas: int | None = UNBOUNDED
+
+
+@dataclass
+class PlanInput:
+    prefs: dict[str, ClusterPref]  # "*" entry = default for all clusters
+    total: int
+    clusters: list[str]
+    current: dict[str, int] = field(default_factory=dict)
+    capacity: dict[str, int] = field(default_factory=dict)
+    key: str = ""
+    avoid_disruption: bool = False
+    keep_unschedulable: bool = False
+
+
+def plan(inp: PlanInput) -> tuple[dict[str, int], dict[str, int]]:
+    """Returns (plan, overflow) maps, both keyed by cluster name."""
+    prefs: dict[str, ClusterPref] = {}
+    for name in inp.clusters:
+        if name in inp.prefs:
+            prefs[name] = inp.prefs[name]
+        elif "*" in inp.prefs:
+            prefs[name] = inp.prefs["*"]
+
+    order = _sorted_names(prefs, inp.key)
+
+    # Without avoid_disruption a reschedule would keep bouncing replicas
+    # that overflowed capacity, so unschedulable replicas are always kept.
+    keep = inp.keep_unschedulable or not inp.avoid_disruption
+
+    desired, overflow = _distribute(order, prefs, inp.capacity, inp.total, keep)
+    if not inp.avoid_disruption:
+        return desired, overflow
+
+    current = {}
+    for name in order:
+        replicas = inp.current.get(name, 0)
+        cap = inp.capacity.get(name)
+        current[name] = min(replicas, cap) if cap is not None else replicas
+
+    cur_total = sum(current.values())
+    want_total = sum(desired.values())
+    if cur_total == want_total:
+        return current, overflow
+    if cur_total > want_total:
+        return _scale_down(current, desired, cur_total - want_total, inp.key), overflow
+    return (
+        _scale_up(inp.prefs, current, desired, want_total - cur_total, inp.key),
+        overflow,
+    )
+
+
+def _sorted_names(prefs: dict[str, ClusterPref], key: str) -> list[str]:
+    # Ties between equal weights break on a per-object hash so that
+    # single-replica workloads don't all pile onto one lexicographically
+    # small cluster (planner.go:62-66).
+    return sorted(
+        prefs,
+        key=lambda name: (-prefs[name].weight, fnv32(name.encode() + key.encode())),
+    )
+
+
+def _distribute(
+    order: list[str],
+    prefs: dict[str, ClusterPref],
+    capacity: dict[str, int],
+    total: int,
+    keep_unschedulable: bool,
+) -> tuple[dict[str, int], dict[str, int]]:
+    remaining = total
+    out: dict[str, int] = {}
+    overflow: dict[str, int] = {}
+
+    # Pass 1: minimum replicas, oblivious to maxReplicas but capped by
+    # capacity; the clipped portion is remembered as overflow.
+    for name in order:
+        take = min(prefs[name].min_replicas, remaining)
+        cap = capacity.get(name)
+        if cap is not None and cap < take:
+            overflow[name] = take - cap
+            take = cap
+        remaining -= take
+        out[name] = take
+
+    # Pass 2: weighted rounds until a fixed point.
+    active = list(order)
+    moved = True
+    while moved and remaining > 0:
+        moved = False
+        weight_sum = sum(prefs[n].weight for n in active)
+        if weight_sum <= 0:
+            break
+        snapshot = remaining
+        survivors = []
+        for name in active:
+            start = out[name]
+            extra = (snapshot * prefs[name].weight + weight_sum - 1) // weight_sum
+            extra = min(extra, remaining)
+            total_n = start + extra
+
+            full = False
+            max_r = prefs[name].max_replicas
+            if max_r is not None and total_n > max_r:
+                total_n = max_r
+                full = True
+            cap = capacity.get(name)
+            if cap is not None and total_n > cap:
+                overflow[name] = overflow.get(name, 0) + total_n - cap
+                total_n = cap
+                full = True
+            if not full:
+                survivors.append(name)
+
+            remaining -= total_n - start
+            out[name] = total_n
+            if total_n > start:
+                moved = True
+        active = survivors
+
+    if keep_unschedulable:
+        return out, overflow
+
+    # Otherwise overflow only up to what could not be placed anywhere.
+    trimmed = {}
+    for name, value in overflow.items():
+        value = min(value, remaining)
+        if value > 0:
+            trimmed[name] = value
+    return out, trimmed
+
+
+def _scale_up(
+    rsp_prefs: dict[str, ClusterPref],
+    current: dict[str, int],
+    desired: dict[str, int],
+    count: int,
+    key: str,
+) -> dict[str, int]:
+    # Grow only clusters sitting below their desired share, weighted by the
+    # shortfall, so no replica has to move between clusters.
+    prefs: dict[str, ClusterPref] = {}
+    for name, want in desired.items():
+        have = current.get(name, 0)
+        if want > have:
+            pref = ClusterPref(weight=want - have)
+            orig = rsp_prefs.get(name)
+            if orig is not None and orig.max_replicas is not None:
+                pref.max_replicas = orig.max_replicas - have
+            prefs[name] = pref
+    order = _sorted_names(prefs, key)
+    grow, _ = _distribute(order, prefs, {}, count, keep_unschedulable=False)
+    result = dict(current)
+    for name, extra in grow.items():
+        result[name] = result.get(name, 0) + extra
+    return result
+
+
+def _scale_down(
+    current: dict[str, int],
+    desired: dict[str, int],
+    count: int,
+    key: str,
+) -> dict[str, int]:
+    # Shrink only clusters sitting above their desired share, weighted by
+    # the excess and never below zero.
+    prefs: dict[str, ClusterPref] = {}
+    for name, want in desired.items():
+        have = current.get(name, 0)
+        if want < have:
+            prefs[name] = ClusterPref(weight=have - want, max_replicas=have)
+    order = _sorted_names(prefs, key)
+    shrink, _ = _distribute(order, prefs, {}, count, keep_unschedulable=False)
+    result = dict(current)
+    for name, less in shrink.items():
+        result[name] = result.get(name, 0) - less
+    return result

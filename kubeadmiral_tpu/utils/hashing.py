@@ -1,0 +1,96 @@
+"""Hashing helpers compatible with the reference control plane's choices.
+
+The reference uses Go's ``hash/fnv`` in two places that shape scheduling
+semantics, so the exact bit patterns matter for parity:
+
+* the replica planner tie-breaks equal-weight clusters by ``fnv.New32()``
+  (FNV-1, 32-bit) over ``clusterName + replicaSetKey``
+  (reference: pkg/controllers/util/planner/planner.go:184-198), and
+* scheduling-trigger dedupe hashes a canonical JSON encoding
+  (reference: pkg/controllers/scheduler/schedulingtriggers.go:106-148).
+
+Both are implemented here in pure Python with numpy-vectorized batch
+variants used by the featurizer when hashing thousands of
+(cluster, object-key) pairs per tick.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+import numpy as np
+
+_FNV32_OFFSET = np.uint32(2166136261)
+_FNV32_PRIME = np.uint32(16777619)
+
+
+def fnv32(data: bytes) -> int:
+    """FNV-1 32-bit (multiply, then xor) — matches Go's ``fnv.New32()``."""
+    h = 2166136261
+    for b in data:
+        h = ((h * 16777619) & 0xFFFFFFFF) ^ b
+    return h
+
+
+def fnv32a(data: bytes) -> int:
+    """FNV-1a 32-bit (xor, then multiply) — matches Go's ``fnv.New32a()``."""
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def fnv32_batch(prefixes: Iterable[str], suffix: str) -> np.ndarray:
+    """FNV-1 of ``prefix + suffix`` for many prefixes, one suffix.
+
+    Used by the planner featurizer: one object key (suffix) against every
+    cluster name (prefix). Returns uint32[N].
+    """
+    prefs = list(prefixes)
+    out = np.empty(len(prefs), dtype=np.uint32)
+    suffix_b = suffix.encode()
+    for i, p in enumerate(prefs):
+        out[i] = fnv32(p.encode() + suffix_b)
+    return out
+
+
+def fnv32_extend(state: int | np.ndarray, data: bytes) -> int | np.ndarray:
+    """Continue an FNV-1 hash from a previous state over extra bytes.
+
+    FNV is a streaming hash, so ``fnv32(a + b) == fnv32_extend(fnv32(a), b)``.
+    This lets the featurizer hash every cluster name once and extend with
+    each object key, turning O(B*C*len) work into O(C*len + B*C*len(key)).
+    Accepts a scalar state or a uint32 ndarray of states (vectorized).
+    """
+    if isinstance(state, np.ndarray):
+        h = state.astype(np.uint32).copy()
+        with np.errstate(over="ignore"):
+            for b in data:
+                h = (h * _FNV32_PRIME) ^ np.uint32(b)
+        return h
+    h = int(state)
+    for b in data:
+        h = ((h * 16777619) & 0xFFFFFFFF) ^ b
+    return h
+
+
+def uint32_to_sortable_int32(h: np.ndarray) -> np.ndarray:
+    """Map uint32 to int32 preserving unsigned order (for device sorts).
+
+    TPU-side sorts run on int32; shifting by 2**31 keeps ``a < b`` iff the
+    unsigned values compare the same way.
+    """
+    return (h.astype(np.int64) - 2**31).astype(np.int32)
+
+
+def stable_json_hash(value: Any) -> int:
+    """FNV-1a over a canonical (sorted-key, compact) JSON encoding.
+
+    The trigger-hash analogue: the reference marshals a sorted struct to
+    JSON and hashes it so that reconciles with unchanged inputs can be
+    skipped (schedulingtriggers.go:106-148). Python dicts are sorted to
+    make the encoding deterministic.
+    """
+    enc = json.dumps(value, sort_keys=True, separators=(",", ":"), default=str)
+    return fnv32a(enc.encode())

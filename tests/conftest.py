@@ -1,0 +1,16 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU platform so that multi-chip sharding
+(jax.sharding.Mesh over objects x clusters) is exercised without TPU
+hardware, mirroring how the driver dry-runs the multichip path.  The env
+vars must be set before jax is first imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

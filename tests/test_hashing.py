@@ -1,0 +1,56 @@
+import numpy as np
+
+from kubeadmiral_tpu.utils.hashing import (
+    fnv32,
+    fnv32a,
+    fnv32_batch,
+    fnv32_extend,
+    stable_json_hash,
+    uint32_to_sortable_int32,
+)
+
+# Published FNV test vectors (Landon Curt Noll's reference tables).
+KNOWN_FNV1 = {b"": 0x811C9DC5, b"a": 0x050C5D7E, b"foobar": 0x31F0B262}
+KNOWN_FNV1A = {b"": 0x811C9DC5, b"a": 0xE40C292C, b"foobar": 0xBF9CF968}
+
+
+def test_fnv1_known_vectors():
+    for data, want in KNOWN_FNV1.items():
+        assert fnv32(data) == want, data
+
+
+def test_fnv1a_known_vectors():
+    for data, want in KNOWN_FNV1A.items():
+        assert fnv32a(data) == want, data
+
+
+def test_batch_matches_scalar():
+    names = ["cluster-1", "cluster-2", "zz"]
+    key = "ns/name"
+    got = fnv32_batch(names, key)
+    assert got.dtype == np.uint32
+    for i, n in enumerate(names):
+        assert int(got[i]) == fnv32((n + key).encode())
+
+
+def test_extend_is_streaming():
+    state = fnv32(b"abc")
+    assert fnv32_extend(state, b"def") == fnv32(b"abcdef")
+    states = np.array([fnv32(b"x"), fnv32(b"y")], dtype=np.uint32)
+    ext = fnv32_extend(states, b"suffix")
+    assert int(ext[0]) == fnv32(b"xsuffix")
+    assert int(ext[1]) == fnv32(b"ysuffix")
+
+
+def test_sortable_int32_preserves_order():
+    vals = np.array([0, 1, 2**31 - 1, 2**31, 2**32 - 1], dtype=np.uint32)
+    mapped = uint32_to_sortable_int32(vals)
+    assert mapped.dtype == np.int32
+    assert list(np.argsort(mapped, kind="stable")) == list(range(len(vals)))
+
+
+def test_stable_json_hash_order_independent():
+    a = stable_json_hash({"b": 1, "a": [1, 2]})
+    b = stable_json_hash({"a": [1, 2], "b": 1})
+    assert a == b
+    assert a != stable_json_hash({"a": [2, 1], "b": 1})

@@ -1,0 +1,44 @@
+import pytest
+
+from kubeadmiral_tpu.utils.quantity import Quantity, cpu_to_millis, parse_quantity, to_int_value
+
+
+def test_cpu_millis():
+    assert cpu_to_millis("100m") == 100
+    assert cpu_to_millis("1") == 1000
+    assert cpu_to_millis("2.5") == 2500
+    assert cpu_to_millis(2) == 2000
+    assert cpu_to_millis("1500m") == 1500
+
+
+def test_value_rounds_away_from_zero():
+    # Matches Go Quantity.Value(): "2500m" -> 3
+    assert parse_quantity("2500m").value() == 3
+    assert parse_quantity("-2500m").value() == -3
+    assert parse_quantity("2500m").milli_value() == 2500
+
+
+def test_binary_and_decimal_suffixes():
+    assert to_int_value("1Ki") == 1024
+    assert to_int_value("2Gi") == 2 * 1024**3
+    assert to_int_value("1G") == 10**9
+    assert to_int_value("128Mi") == 128 * 1024**2
+
+
+def test_scientific_notation():
+    assert to_int_value("1e3") == 1000
+    assert to_int_value("1.5e2") == 150
+    assert cpu_to_millis("1e-3") == 1
+
+
+def test_arithmetic_and_compare():
+    assert Quantity("1") + Quantity("500m") == Quantity("1500m")
+    assert Quantity("2Gi") - Quantity("1Gi") == Quantity("1Gi")
+    assert Quantity("100m") < Quantity("1")
+
+
+def test_invalid():
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+    with pytest.raises(ValueError):
+        parse_quantity("1X")
