@@ -1,0 +1,324 @@
+"""Batched replica planner as a single XLA program.
+
+Re-derivation of the reference's weighted fair distribution
+(reference: pkg/controllers/util/planner/planner.go:83-366) as dense tensor
+math over ``[B objects x C cluster slots]``, bit-compatible with the
+sequential oracle in :mod:`kubeadmiral_tpu.ops.planner_oracle`.
+
+The reference walks clusters one at a time, carrying a running remainder
+``rem`` and handing each cluster ``take_j = min(c_j, rem)`` for a per-cluster
+constant ``c_j``.  That recurrence is ``rem' = max(rem - c_j, 0)`` — and
+functions of the form ``r -> max(r - A, B)`` are closed under composition::
+
+    (A1,B1) then (A2,B2)  ==  (A1+A2, max(B1-A2, B2))
+
+so every sequential pass (the minReplicas pass and each weighted round)
+becomes one ``lax.associative_scan`` over the cluster axis: O(log C) depth
+on device instead of O(C) Python.  Rounds still iterate via
+``lax.while_loop`` (each round either finishes or saturates at least one
+cluster), which preserves the reference's exact rounding/tie-break
+semantics including:
+
+* (weight desc, fnv32(cluster+objectKey) asc) processing order,
+* ceil division ``(D*w + W - 1) // W`` against the round-start snapshot D,
+* capacity clipping recorded as overflow (re-counted every round),
+* negative "takes" when an earlier pass already exceeded a cap,
+* the avoid-disruption branch that rescales from current replica counts.
+
+Value contract (int32 device math): ``total * max(weight) + sum(weight)``
+must stay below 2**31.  The featurizer normalizes weights to sum<=1000
+(as the reference's RSP plugin does), which makes this hold for any
+realistic replica count; ``validate_ranges`` enforces it host-side.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT32_INF = np.int32(np.iinfo(np.int32).max)
+UNBOUNDED = INT32_INF  # sentinel for "no max replicas" / "no capacity estimate"
+
+
+class PlannerInputs(NamedTuple):
+    """One scheduling problem per row; cluster slots padded to C.
+
+    All int32.  ``UNBOUNDED`` marks absent max-replicas / capacity.
+    ``tiebreak`` is fnv32(clusterName + objectKey) shifted into sortable
+    int32 space (utils.hashing.uint32_to_sortable_int32).
+    ``scale_max`` is the max-replicas bound used by the avoid-disruption
+    scale-up pass: the reference resolves it from the *directly named*
+    preference only (planner.go:320-324), so a wildcard-provided max must
+    be UNBOUNDED here while still set in ``max_replicas``.
+    """
+
+    weight: jax.Array        # [B, C]
+    min_replicas: jax.Array  # [B, C]
+    max_replicas: jax.Array  # [B, C]
+    scale_max: jax.Array     # [B, C]
+    capacity: jax.Array      # [B, C]
+    tiebreak: jax.Array      # [B, C]
+    member: jax.Array        # [B, C] bool — cluster participates
+    total: jax.Array         # [B]
+    current: jax.Array       # [B, C]
+    avoid_disruption: jax.Array    # [B] bool
+    keep_unschedulable: jax.Array  # [B] bool
+
+
+class PlannerOutputs(NamedTuple):
+    plan: jax.Array      # [B, C]
+    overflow: jax.Array  # [B, C]
+
+
+def _running_remainder(r0: jax.Array, c: jax.Array) -> jax.Array:
+    """Remainder seen by each cluster in a sequential min-take pass.
+
+    Position j receives the value of ``rem`` after clusters 0..j-1 each took
+    ``min(c_i, rem)``, i.e. after applying ``r -> max(r - c_i, 0)`` in order.
+    Computed with an associative scan over (A, B) pairs representing
+    ``r -> max(r - A, B)``.
+    """
+    a = c
+    b = jnp.zeros_like(c)
+
+    def compose(x, y):
+        ax, bx = x
+        ay, by = y
+        return ax + ay, jnp.maximum(bx - ay, by)
+
+    a_s, b_s = jax.lax.associative_scan(compose, (a, b))
+    rem_after = jnp.maximum(r0 - a_s, b_s)
+    return jnp.concatenate([jnp.full((1,), r0, dtype=c.dtype), rem_after[:-1]])
+
+
+def _distribute(
+    weight: jax.Array,
+    min_replicas: jax.Array,
+    max_replicas: jax.Array,
+    capacity: jax.Array,
+    tiebreak: jax.Array,
+    member: jax.Array,
+    total: jax.Array,
+    keep_unschedulable: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """getDesiredPlan (planner.go:211-304) for one object. Returns
+    (plan, overflow, unplaced_remainder) in original cluster order."""
+    c_slots = weight.shape[0]
+
+    # Processing order: members first, weight desc, tiebreak hash asc.
+    sort_weight = jnp.where(member, -weight, INT32_INF)
+    perm = jnp.lexsort((tiebreak, sort_weight))
+    w = weight[perm]
+    min_r = min_replicas[perm]
+    max_r = max_replicas[perm]
+    cap = capacity[perm]
+    mem = member[perm]
+
+    # --- minReplicas pass (ignores max_replicas, clips at capacity) ---
+    want_min = jnp.where(mem, min_r, 0)
+    take_cap = jnp.minimum(want_min, cap)
+    rem_before = _running_remainder(total, take_cap)
+    plan = jnp.minimum(take_cap, rem_before)
+    # Overflow = the capacity-clipped part of what the pass tried to place.
+    wanted = jnp.minimum(want_min, rem_before)
+    overflow = jnp.where(mem, jnp.maximum(wanted - cap, 0), 0)
+    remaining = rem_before[c_slots - 1] - plan[c_slots - 1]
+
+    # --- weighted rounds until fixed point ---
+    def round_cond(state):
+        _, _, _, remaining, moved = state
+        return moved & (remaining > 0)
+
+    def round_body(state):
+        plan, overflow, active, remaining, _ = state
+        w_active = jnp.where(active, w, 0)
+        weight_sum = jnp.sum(w_active)
+        d = remaining  # round-start snapshot
+        safe_sum = jnp.maximum(weight_sum, 1)
+        quota = (d * w_active + safe_sum - 1) // safe_sum
+        quota = jnp.where(active & (weight_sum > 0), quota, 0)
+
+        allowed = jnp.minimum(max_r, cap) - plan  # may be negative
+        c_take = jnp.where(active, jnp.minimum(quota, allowed), 0)
+        rem_before = _running_remainder(d, c_take)
+        take = jnp.minimum(c_take, rem_before)
+        extra = jnp.minimum(quota, rem_before)
+
+        after_max = jnp.minimum(plan + extra, max_r)
+        overflow = overflow + jnp.where(
+            active, jnp.maximum(after_max - cap, 0), 0
+        )
+        full = active & ((plan + extra > max_r) | (after_max > cap))
+
+        plan = plan + jnp.where(active, take, 0)
+        remaining = d - jnp.sum(jnp.where(active, take, 0))
+        moved = jnp.any(jnp.where(active, take, 0) > 0) & (weight_sum > 0)
+        return plan, overflow, active & ~full, remaining, moved
+
+    plan, overflow, _, remaining, _ = jax.lax.while_loop(
+        round_cond,
+        round_body,
+        (plan, overflow, mem, remaining, jnp.asarray(True)),
+    )
+
+    # Without keep_unschedulable, overflow is trimmed to what could not be
+    # placed anywhere at all.
+    overflow = jnp.where(
+        keep_unschedulable,
+        overflow,
+        jnp.maximum(jnp.minimum(overflow, remaining), 0),
+    )
+
+    # Back to the caller's cluster order.
+    inv_plan = jnp.zeros_like(plan).at[perm].set(plan)
+    inv_overflow = jnp.zeros_like(overflow).at[perm].set(overflow)
+    return inv_plan, inv_overflow, remaining
+
+
+def _plan_one(inp: PlannerInputs) -> PlannerOutputs:
+    """Full planner for a single object (vmapped over the batch)."""
+    zeros = jnp.zeros_like(inp.weight)
+    no_cap = jnp.full_like(inp.weight, INT32_INF)
+
+    # A reschedule would keep bouncing capacity-overflowed replicas if they
+    # were dropped while disruption is allowed (planner.go:108-118).
+    keep = inp.keep_unschedulable | ~inp.avoid_disruption
+
+    desired, overflow, _ = _distribute(
+        inp.weight,
+        inp.min_replicas,
+        inp.max_replicas,
+        inp.capacity,
+        inp.tiebreak,
+        inp.member,
+        inp.total,
+        keep,
+    )
+
+    # --- avoid-disruption: move only the delta from current replicas ---
+    current_ok = jnp.where(
+        inp.member, jnp.minimum(inp.current, inp.capacity), 0
+    )
+    current_total = jnp.sum(current_ok)
+    desired_total = jnp.sum(desired)
+
+    # Scale up: clusters below their desired share grow, weighted by the
+    # shortfall, bounded by the directly-named max minus current.
+    up_member = inp.member & (desired > current_ok)
+    up_weight = jnp.where(up_member, desired - current_ok, 0)
+    up_max = jnp.where(
+        inp.scale_max == INT32_INF, INT32_INF, inp.scale_max - current_ok
+    )
+    grow, _, _ = _distribute(
+        up_weight,
+        zeros,
+        up_max,
+        no_cap,
+        inp.tiebreak,
+        up_member,
+        jnp.maximum(desired_total - current_total, 0),
+        jnp.asarray(False),
+    )
+
+    # Scale down: clusters above their desired share shrink, weighted by
+    # the excess, never below zero.
+    down_member = inp.member & (desired < current_ok)
+    down_weight = jnp.where(down_member, current_ok - desired, 0)
+    shrink, _, _ = _distribute(
+        down_weight,
+        zeros,
+        jnp.where(down_member, current_ok, INT32_INF),
+        no_cap,
+        inp.tiebreak,
+        down_member,
+        jnp.maximum(current_total - desired_total, 0),
+        jnp.asarray(False),
+    )
+
+    steady = jnp.where(
+        current_total == desired_total,
+        current_ok,
+        jnp.where(
+            current_total > desired_total,
+            current_ok - shrink,
+            current_ok + grow,
+        ),
+    )
+    plan = jnp.where(inp.avoid_disruption, steady, desired)
+    return PlannerOutputs(plan=plan, overflow=overflow)
+
+
+@jax.jit
+def plan_batch_jit(inp: PlannerInputs) -> PlannerOutputs:
+    """Plan every object in the batch in one XLA dispatch (no host checks).
+
+    Callers must have enforced the int32 value contract already (the fused
+    scheduler pipeline validates once when packing tensors).
+    """
+    return jax.vmap(_plan_one)(inp)
+
+
+def plan_batch(inp: PlannerInputs, *, validate: bool = True) -> PlannerOutputs:
+    """Plan every object in the batch; validates the int32 contract first."""
+    if validate:
+        validate_ranges(np.asarray(inp.total), np.asarray(inp.weight))
+    return plan_batch_jit(inp)
+
+
+def validate_ranges(total: np.ndarray, weight: np.ndarray) -> None:
+    """Host-side guard for the int32 value contract."""
+    max_w = int(weight.max(initial=0))
+    max_t = int(total.max(initial=0))
+    w_sum = int(weight.sum(axis=-1).max(initial=0))
+    if max_t * max_w + w_sum >= 2**31:
+        raise OverflowError(
+            f"planner int32 contract violated: total={max_t} * weight={max_w} "
+            f"+ weight_sum={w_sum} >= 2**31; normalize weights first"
+        )
+
+
+def make_inputs(
+    batch: int,
+    clusters: int,
+    total: "np.ndarray | int",
+    weight: np.ndarray,
+    *,
+    min_replicas: np.ndarray | None = None,
+    max_replicas: np.ndarray | None = None,
+    scale_max: np.ndarray | None = None,
+    capacity: np.ndarray | None = None,
+    tiebreak: np.ndarray | None = None,
+    member: np.ndarray | None = None,
+    current: np.ndarray | None = None,
+    avoid_disruption: np.ndarray | bool = False,
+    keep_unschedulable: np.ndarray | bool = False,
+) -> PlannerInputs:
+    """Convenience builder filling sentinel defaults (host-side, numpy)."""
+
+    def arr(x, fill, dtype=np.int32, shape=(batch, clusters)):
+        if x is None:
+            return np.full(shape, fill, dtype=dtype)
+        return np.broadcast_to(np.asarray(x, dtype=dtype), shape).copy()
+
+    max_r = arr(max_replicas, INT32_INF)
+    return PlannerInputs(
+        weight=arr(weight, 0),
+        min_replicas=arr(min_replicas, 0),
+        max_replicas=max_r,
+        scale_max=max_r.copy() if scale_max is None else arr(scale_max, INT32_INF),
+        capacity=arr(capacity, INT32_INF),
+        tiebreak=arr(tiebreak, 0),
+        member=arr(member, True, dtype=bool),
+        total=np.broadcast_to(np.asarray(total, np.int32), (batch,)).copy(),
+        current=arr(current, 0),
+        avoid_disruption=np.broadcast_to(
+            np.asarray(avoid_disruption, bool), (batch,)
+        ).copy(),
+        keep_unschedulable=np.broadcast_to(
+            np.asarray(keep_unschedulable, bool), (batch,)
+        ).copy(),
+    )

@@ -89,8 +89,20 @@ def stable_json_hash(value: Any) -> int:
 
     The trigger-hash analogue: the reference marshals a sorted struct to
     JSON and hashes it so that reconciles with unchanged inputs can be
-    skipped (schedulingtriggers.go:106-148). Python dicts are sorted to
-    make the encoding deterministic.
+    skipped (schedulingtriggers.go:106-148). Python dicts are sorted and
+    sets canonicalized so the encoding never depends on iteration order
+    or PYTHONHASHSEED; other non-JSON types raise rather than hash
+    unstably.
     """
-    enc = json.dumps(value, sort_keys=True, separators=(",", ":"), default=str)
+
+    def canonical(v: Any) -> Any:
+        # json.dumps only consults this hook for non-JSON types; nested
+        # non-JSON elements inside the returned value are routed back here.
+        if isinstance(v, (set, frozenset)):
+            return sorted(v, key=lambda x: json.dumps(x, sort_keys=True, default=canonical))
+        if isinstance(v, tuple):
+            return list(v)
+        raise TypeError(f"unhashable trigger value of type {type(v).__name__}")
+
+    enc = json.dumps(value, sort_keys=True, separators=(",", ":"), default=canonical)
     return fnv32a(enc.encode())

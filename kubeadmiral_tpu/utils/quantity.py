@@ -18,6 +18,8 @@ from fractions import Fraction
 
 _SUFFIXES: dict[str, Fraction] = {
     "": Fraction(1),
+    "n": Fraction(1, 1000**3),
+    "u": Fraction(1, 1000**2),
     "m": Fraction(1, 1000),
     "k": Fraction(1000),
     "M": Fraction(1000**2),
@@ -36,7 +38,7 @@ _SUFFIXES: dict[str, Fraction] = {
 _QUANTITY_RE = re.compile(
     r"^(?P<sign>[+-]?)(?P<num>[0-9]+(?:\.[0-9]*)?|\.[0-9]+)"
     r"(?:[eE](?P<exp>[+-]?[0-9]+))?"
-    r"(?P<suffix>m|k|M|G|T|P|E|Ki|Mi|Gi|Ti|Pi|Ei)?$"
+    r"(?P<suffix>n|u|m|k|M|G|T|P|E|Ki|Mi|Gi|Ti|Pi|Ei)?$"
 )
 
 

@@ -54,3 +54,16 @@ def test_stable_json_hash_order_independent():
     b = stable_json_hash({"a": [1, 2], "b": 1})
     assert a == b
     assert a != stable_json_hash({"a": [2, 1], "b": 1})
+
+
+def test_stable_json_hash_sets_canonicalized():
+    a = stable_json_hash({"s": {"b", "a", "c"}})
+    b = stable_json_hash({"s": {"c", "a", "b"}})
+    assert a == b == stable_json_hash({"s": ["a", "b", "c"]})
+
+
+def test_stable_json_hash_rejects_unstable_types():
+    import pytest
+
+    with pytest.raises(TypeError):
+        stable_json_hash({"x": object()})

@@ -42,3 +42,10 @@ def test_invalid():
         parse_quantity("abc")
     with pytest.raises(ValueError):
         parse_quantity("1X")
+
+
+def test_nano_micro_suffixes():
+    assert cpu_to_millis("100n") == 1  # rounds up at milli precision
+    assert cpu_to_millis("500u") == 1
+    assert parse_quantity("1500000n").milli_value() == 2
+    assert parse_quantity("2u").raw == parse_quantity("2000n").raw
