@@ -1,0 +1,26 @@
+# kubeadmiral_tpu developer targets.
+#
+# Tests run on a virtual 8-device CPU mesh, fully decoupled from the TPU
+# tunnel: PALLAS_AXON_POOL_IPS is unset so the axon PJRT plugin is never
+# registered (the plugin serializes on the single chip and two concurrent
+# processes wedge each other).  Only `make bench` touches the real TPU.
+
+PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
+
+.PHONY: test test-fast bench graft-check graft-dryrun
+
+test:
+	$(PYTEST_ENV) python -m pytest tests/ -q
+
+test-fast:
+	$(PYTEST_ENV) python -m pytest tests/ -q -x -m "not slow"
+
+bench:
+	python bench.py
+
+graft-check:
+	python -c "import __graft_entry__ as g; fn, args = g.entry(); fn(*args); print('entry ok')"
+
+graft-dryrun:
+	$(PYTEST_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -26,4 +26,15 @@ Layout:
   testing/     in-memory apiserver (KWOK-analogue) + object builders
 """
 
+import jax as _jax
+
+# The scheduling engine does byte-exact resource arithmetic (memory in
+# bytes, cluster-aggregate allocatable can exceed 2**53 nowhere but 2**31
+# easily), so int64 must be real on device. The planner's hot loops stay
+# explicitly int32. This framework owns its process (it is a control
+# plane, not an embeddable ML library), so setting the global flag here
+# is deliberate.
+_jax.config.update("jax_enable_x64", True)
+
 __version__ = "0.1.0"
+

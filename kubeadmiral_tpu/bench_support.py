@@ -1,0 +1,142 @@
+"""Sequential one-object-at-a-time scheduling, used as the bench baseline.
+
+This walks the same logical pipeline as the reference's in-process
+scheduler (reference: pkg/controllers/scheduler/core/generic_scheduler.go
+via framework/runtime/framework.go plugin loops): for each object, match
+every cluster through the filter plugins, score, select and plan — no
+batching, no dedup, no device.  bench.py measures it on a sample to set
+``vs_baseline``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.ops.pipeline_oracle import OracleProblem, schedule_one
+from kubeadmiral_tpu.utils import labels as L
+
+
+def _canonical_row(res: dict, scalars: Sequence[str]) -> list[int]:
+    return [res.get("cpu", 0), res.get("memory", 0)] + [
+        res.get(s, 0) for s in scalars
+    ]
+
+
+def sequential_schedule(
+    units: Sequence[T.SchedulingUnit], clusters: Sequence[T.ClusterState]
+) -> list[dict[int, "int | None"]]:
+    scalars = sorted(
+        {
+            r
+            for su in units
+            for r in su.resource_request
+            if r not in ("cpu", "memory", "ephemeral-storage")
+        }
+    )
+    names = [c.name for c in clusters]
+    index = {n: j for j, n in enumerate(names)}
+    alloc = [_canonical_row(c.allocatable, scalars) for c in clusters]
+    avail = [_canonical_row(c.available, scalars) for c in clusters]
+    used = [[a - v for a, v in zip(ar, vr)] for ar, vr in zip(alloc, avail)]
+    cpu_alloc = [-(-c.allocatable.get("cpu", 0) // 1000) for c in clusters]
+    cpu_avail = [-(-c.available.get("cpu", 0) // 1000) for c in clusters]
+
+    results = []
+    for su in units:
+        filters = su.enabled_filters if su.enabled_filters is not None else T.DEFAULT_FILTERS
+        scores = su.enabled_scores if su.enabled_scores is not None else T.DEFAULT_SCORES
+        filter_enabled = [
+            T.APIRESOURCES in filters,
+            T.TAINT_TOLERATION in filters,
+            T.CLUSTER_RESOURCES_FIT in filters,
+            T.PLACEMENT_FILTER in filters,
+            T.CLUSTER_AFFINITY in filters,
+        ]
+        score_enabled = [
+            T.TAINT_TOLERATION in scores,
+            T.CLUSTER_RESOURCES_BALANCED in scores,
+            T.CLUSTER_RESOURCES_LEAST in scores,
+            T.CLUSTER_AFFINITY in scores,
+            T.CLUSTER_RESOURCES_MOST in scores,
+        ]
+
+        def tolerated(cl: T.ClusterState, effects) -> bool:
+            for taint in cl.taints:
+                if taint.effect in effects and not any(
+                    t.tolerates(taint) for t in su.tolerations
+                ):
+                    return False
+            return True
+
+        prefer_tols = [
+            t
+            for t in su.tolerations
+            if not t.effect or t.effect == T.PREFER_NO_SCHEDULE
+        ]
+        capacity = {}
+        keep = False
+        if su.auto_migration is not None:
+            keep = su.auto_migration.keep_unschedulable_replicas
+            for cname, cap in su.auto_migration.estimated_capacity.items():
+                if cname in index and cap >= 0:
+                    capacity[index[cname]] = cap
+
+        problem = OracleProblem(
+            n_clusters=len(clusters),
+            filter_enabled=filter_enabled,
+            score_enabled=score_enabled,
+            api_ok=[su.gvk in c.api_resources for c in clusters],
+            taint_ok_new=[
+                tolerated(c, (T.NO_SCHEDULE, T.NO_EXECUTE)) for c in clusters
+            ],
+            taint_ok_cur=[tolerated(c, (T.NO_EXECUTE,)) for c in clusters],
+            selector_ok=[
+                L.cluster_feasible(c.labels, c.name, su.cluster_selector, su.affinity)
+                for c in clusters
+            ],
+            placement_ok=[c.name in su.cluster_names for c in clusters],
+            placement_has=len(su.cluster_names) > 0,
+            request=_canonical_row(su.resource_request, scalars),
+            alloc=alloc,
+            used=used,
+            taint_counts=[
+                sum(
+                    1
+                    for taint in c.taints
+                    if taint.effect == T.PREFER_NO_SCHEDULE
+                    and not any(t.tolerates(taint) for t in prefer_tols)
+                )
+                for c in clusters
+            ],
+            affinity_scores=[
+                L.preferred_score(c.labels, c.name, su.affinity) for c in clusters
+            ],
+            max_clusters=su.max_clusters,
+            mode_divide=su.scheduling_mode == T.MODE_DIVIDE,
+            sticky=su.sticky_cluster,
+            current={
+                index[n]: reps
+                for n, reps in su.current_clusters.items()
+                if n in index
+            },
+            total=su.desired_replicas or 0,
+            weights={index[n]: w for n, w in su.weights.items() if n in index}
+            if su.weights
+            else None,
+            min_replicas={
+                index[n]: v for n, v in su.min_replicas.items() if n in index
+            },
+            max_replicas={
+                index[n]: v for n, v in su.max_replicas.items() if n in index
+            },
+            capacity=capacity,
+            keep_unschedulable=keep,
+            avoid_disruption=su.avoid_disruption,
+            cluster_names=names,
+            key=su.key,
+            cpu_alloc=cpu_alloc,
+            cpu_avail=cpu_avail,
+        )
+        results.append(schedule_one(problem))
+    return results

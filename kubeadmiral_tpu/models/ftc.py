@@ -1,0 +1,170 @@
+"""FederatedTypeConfig: the CRD-driven type registry.
+
+The FTC tells the control plane which source types are federated, what
+the federated companion type is called, where replicas/status live in the
+object, and which controller pipeline processes it (reference:
+pkg/apis/core/v1alpha1/types_federatedtypeconfig.go:63-182).
+
+Resource addressing convention: "<group>/<version>/<plural>" (core group
+has an empty group segment collapsed, e.g. "v1/configmaps").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def resource_key(group: str, version: str, plural: str) -> str:
+    return f"{group}/{version}/{plural}" if group else f"{version}/{plural}"
+
+
+def gvk_key(group: str, version: str, kind: str) -> str:
+    return f"{group}/{version}/{kind}" if group else f"{version}/{kind}"
+
+
+@dataclass(frozen=True)
+class TypeRef:
+    group: str
+    version: str
+    kind: str
+    plural: str
+
+    @property
+    def resource(self) -> str:
+        return resource_key(self.group, self.version, self.plural)
+
+    @property
+    def gvk(self) -> str:
+        return gvk_key(self.group, self.version, self.kind)
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+
+@dataclass(frozen=True)
+class PathDefinition:
+    """Dotted paths into the source/target object
+    (types_federatedtypeconfig.go:146-182)."""
+
+    replicas_spec: str = ""
+    replicas_status: str = ""
+    available_replicas_status: str = ""
+    ready_replicas_status: str = ""
+    label_selector: str = ""
+
+
+@dataclass(frozen=True)
+class FederatedTypeConfig:
+    name: str
+    source: TypeRef
+    federated: TypeRef
+    status: Optional[TypeRef] = None
+    path: PathDefinition = PathDefinition()
+    # Ordered controller pipeline groups (spec.controllers).
+    controllers: tuple[tuple[str, ...], ...] = (
+        ("kubeadmiral.io/global-scheduler",),
+        ("kubeadmiral.io/overridepolicy-controller",),
+    )
+    status_collection: bool = False
+    status_aggregation: bool = False
+    revision_history: bool = False
+    rollout_plan: bool = False
+    auto_migration: bool = False
+
+    @property
+    def controller_groups(self) -> list[list[str]]:
+        return [list(g) for g in self.controllers]
+
+
+def federated_ref(source: TypeRef) -> TypeRef:
+    """Default federated companion naming: FederatedX in the kubeadmiral
+    types group."""
+    return TypeRef(
+        group="types.kubeadmiral.io",
+        version="v1alpha1",
+        kind=f"Federated{source.kind}",
+        plural=f"federated{source.plural}",
+    )
+
+
+def make_ftc(
+    name: str,
+    group: str,
+    version: str,
+    kind: str,
+    plural: str,
+    **kw,
+) -> FederatedTypeConfig:
+    src = TypeRef(group, version, kind, plural)
+    return FederatedTypeConfig(
+        name=name, source=src, federated=federated_ref(src), **kw
+    )
+
+
+WORKLOAD_PATH = PathDefinition(
+    replicas_spec="spec.replicas",
+    replicas_status="status.replicas",
+    available_replicas_status="status.availableReplicas",
+    ready_replicas_status="status.readyReplicas",
+    label_selector="spec.selector.matchLabels",
+)
+
+
+def default_ftcs() -> list[FederatedTypeConfig]:
+    """The sample set the reference ships (config/sample/host/01-ftc.yaml),
+    trimmed to the types the tests/bench exercise; more are added by
+    simply registering additional FTC objects."""
+    return [
+        make_ftc(
+            "deployments.apps",
+            "apps",
+            "v1",
+            "Deployment",
+            "deployments",
+            path=WORKLOAD_PATH,
+            status_collection=True,
+            status_aggregation=True,
+            revision_history=True,
+            auto_migration=True,
+        ),
+        make_ftc(
+            "statefulsets.apps",
+            "apps",
+            "v1",
+            "StatefulSet",
+            "statefulsets",
+            path=WORKLOAD_PATH,
+            status_collection=True,
+        ),
+        make_ftc(
+            "daemonsets.apps", "apps", "v1", "DaemonSet", "daemonsets",
+            status_collection=True,
+        ),
+        make_ftc("configmaps", "", "v1", "ConfigMap", "configmaps"),
+        make_ftc("secrets", "", "v1", "Secret", "secrets"),
+        make_ftc("services", "", "v1", "Service", "services"),
+        make_ftc("serviceaccounts", "", "v1", "ServiceAccount", "serviceaccounts"),
+        make_ftc("namespaces", "", "v1", "Namespace", "namespaces"),
+        make_ftc(
+            "jobs.batch", "batch", "v1", "Job", "jobs",
+            path=PathDefinition(replicas_spec="spec.parallelism"),
+            status_collection=True,
+        ),
+        make_ftc("cronjobs.batch", "batch", "v1", "CronJob", "cronjobs"),
+        make_ftc(
+            "ingresses.networking.k8s.io",
+            "networking.k8s.io",
+            "v1",
+            "Ingress",
+            "ingresses",
+        ),
+        make_ftc(
+            "persistentvolumeclaims",
+            "",
+            "v1",
+            "PersistentVolumeClaim",
+            "persistentvolumeclaims",
+        ),
+    ]

@@ -1,0 +1,73 @@
+"""Filter stage: feasibility masks over [B objects x C clusters].
+
+Each reference filter plugin (reference: pkg/controllers/scheduler/framework/
+plugins/*) becomes a boolean mask; a disabled plugin contributes all-True.
+String-world plugins (API resources, taints, selectors/affinity) are
+pre-matched host-side by the featurizer into per-(object,cluster) booleans
+via set-dedup + gather, so this module only combines masks and does the
+numeric resource-fit math.
+
+Filter plugin indices (column order of ``filter_enabled``):
+  0 APIResources, 1 TaintToleration, 2 ClusterResourcesFit,
+  3 PlacementFilter, 4 ClusterAffinity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+F_API_RESOURCES = 0
+F_TAINT_TOLERATION = 1
+F_RESOURCES_FIT = 2
+F_PLACEMENT = 3
+F_CLUSTER_AFFINITY = 4
+NUM_FILTER_PLUGINS = 5
+
+# Resource tensor column layout (shared with scores): fixed columns then
+# dynamically discovered scalar/extended resources.
+R_CPU = 0  # millicores
+R_MEM = 1  # bytes
+NUM_FIXED_RESOURCES = 2
+
+
+def resources_fit(request, alloc, used):
+    """ClusterResourcesFit (reference: plugins/clusterresources/fit.go:47-131).
+
+    request: i64[B, R]; alloc/used: i64[C, R].  CPU and memory are always
+    checked once any resource is requested; scalar columns only where the
+    request is positive.  An all-zero request fits everywhere.
+    """
+    free_ok = alloc[None, :, :] >= request[:, None, :] + used[None, :, :]
+    scalar_req = request[:, None, NUM_FIXED_RESOURCES:] > 0
+    scalar_ok = jnp.where(scalar_req, free_ok[:, :, NUM_FIXED_RESOURCES:], True)
+    fixed_ok = free_ok[:, :, R_CPU] & free_ok[:, :, R_MEM]
+    ok = fixed_ok & jnp.all(scalar_ok, axis=-1)
+    no_request = jnp.all(request <= 0, axis=-1)
+    return no_request[:, None] | ok
+
+
+def combine_filters(
+    filter_enabled,  # bool[B, 5]
+    api_ok,          # bool[B, C]
+    taint_ok_new,    # bool[B, C] tolerated for a not-yet-placed object
+    taint_ok_cur,    # bool[B, C] tolerated when already placed (NoExecute only)
+    current_mask,    # bool[B, C]
+    fit_ok,          # bool[B, C]
+    placement_has,   # bool[B] explicit placement list is non-empty
+    placement_ok,    # bool[B, C]
+    selector_ok,     # bool[B, C] labels selector AND required affinity
+):
+    """Conjunction of enabled filter plugins -> feasible[B, C]."""
+
+    def gate(idx, ok):
+        return ~filter_enabled[:, idx, None] | ok
+
+    taint_ok = jnp.where(current_mask, taint_ok_cur, taint_ok_new)
+    placement = ~placement_has[:, None] | placement_ok
+    return (
+        gate(F_API_RESOURCES, api_ok)
+        & gate(F_TAINT_TOLERATION, taint_ok)
+        & gate(F_RESOURCES_FIT, fit_ok)
+        & gate(F_PLACEMENT, placement)
+        & gate(F_CLUSTER_AFFINITY, selector_ok)
+    )

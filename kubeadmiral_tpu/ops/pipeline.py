@@ -1,0 +1,183 @@
+"""The fused scheduling tick: one XLA program per reconcile batch.
+
+Composes the stages of the reference's generic scheduler (reference:
+pkg/controllers/scheduler/core/generic_scheduler.go:92-150) over the whole
+pending batch at once:
+
+    feasible = AND(enabled filter masks)            # Filter, O(B*C)
+    scores   = sum(enabled normalized score plugins)# Score + Normalize
+    selected = top-K(scores)                        # Select (MaxCluster)
+    replicas = planner(weights, mins, maxes, caps)  # Replicas (RSP)
+
+with the per-object special cases folded in as masks: sticky-cluster
+short-circuit, Duplicate vs Divide mode, static vs dynamic RSP weights.
+
+The featurizer (kubeadmiral_tpu.scheduler.featurize) is responsible for
+producing TickInputs from API objects; this module is pure tensor math and
+is jit-compiled once per (B, C, R) shape bucket.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeadmiral_tpu.ops import filters as F
+from kubeadmiral_tpu.ops import scores as S
+from kubeadmiral_tpu.ops.planner import INT32_INF, PlannerInputs, plan_batch_jit
+from kubeadmiral_tpu.ops.select import select_topk
+from kubeadmiral_tpu.ops.weights import dynamic_weights
+
+NIL_REPLICAS = np.int64(-1)  # "no replica count" (Duplicate-mode placement)
+
+
+class TickInputs(NamedTuple):
+    """One scheduling problem per row. See featurize.py for construction."""
+
+    # --- filter stage ---
+    filter_enabled: jax.Array  # bool[B,5] (ops.filters.F_* order)
+    api_ok: jax.Array          # bool[B,C]
+    taint_ok_new: jax.Array    # bool[B,C]
+    taint_ok_cur: jax.Array    # bool[B,C]
+    selector_ok: jax.Array     # bool[B,C]
+    placement_has: jax.Array   # bool[B]
+    placement_ok: jax.Array    # bool[B,C]
+    request: jax.Array         # i64[B,R]
+    alloc: jax.Array           # i64[C,R]
+    used: jax.Array            # i64[C,R]
+    # --- score stage ---
+    score_enabled: jax.Array   # bool[B,5] (ops.scores.S_* order)
+    taint_counts: jax.Array    # i64[B,C]
+    affinity_scores: jax.Array # i64[B,C]
+    # --- select stage ---
+    max_clusters: jax.Array    # i32[B]; INT32_INF = unlimited, <0 = none
+    # --- replicas stage ---
+    mode_divide: jax.Array     # bool[B]
+    sticky: jax.Array          # bool[B]
+    current_mask: jax.Array    # bool[B,C]
+    current_replicas: jax.Array  # i64[B,C]; NIL_REPLICAS = nil entry
+    total: jax.Array           # i32[B]
+    weights_given: jax.Array   # bool[B]
+    weights: jax.Array         # i32[B,C] static policy weights
+    min_replicas: jax.Array    # i32[B,C]
+    max_replicas: jax.Array    # i32[B,C]; INT32_INF = unbounded
+    scale_max: jax.Array       # i32[B,C]; INT32_INF = unbounded
+    capacity: jax.Array        # i32[B,C]; INT32_INF = no estimate
+    keep_unschedulable: jax.Array  # bool[B]
+    avoid_disruption: jax.Array    # bool[B]
+    tiebreak: jax.Array        # i32[B,C]
+    # --- dynamic weights ---
+    cpu_alloc: jax.Array       # i64[C] Quantity.Value() cores
+    cpu_avail: jax.Array       # i64[C]
+    # --- padding ---
+    cluster_valid: jax.Array   # bool[C]; False marks padded cluster slots
+
+
+class TickOutputs(NamedTuple):
+    selected: jax.Array   # bool[B,C] final placements
+    replicas: jax.Array   # i64[B,C]; meaningful only where counted
+    counted: jax.Array    # bool[B,C]; False = placement carries no replica
+                          # count (Duplicate mode / nil sticky entries)
+    feasible: jax.Array   # bool[B,C] post-filter (introspection)
+    scores: jax.Array     # i64[B,C] post-normalize totals (introspection)
+
+
+@jax.jit
+def schedule_tick(inp: TickInputs) -> TickOutputs:
+    # --- Filter ---
+    fit_ok = F.resources_fit(inp.request, inp.alloc, inp.used)
+    feasible = F.combine_filters(
+        inp.filter_enabled,
+        inp.api_ok,
+        inp.taint_ok_new,
+        inp.taint_ok_cur,
+        inp.current_mask,
+        fit_ok,
+        inp.placement_has,
+        inp.placement_ok,
+        inp.selector_ok,
+    )
+    feasible = feasible & inp.cluster_valid[None, :]
+
+    # --- Score + Normalize ---
+    totals = S.total_scores(
+        inp.score_enabled,
+        feasible,
+        inp.request,
+        inp.alloc,
+        inp.used,
+        inp.taint_counts,
+        inp.affinity_scores,
+    )
+
+    # --- Select ---
+    selected = select_topk(totals, feasible, inp.max_clusters)
+
+    # --- Replicas (Divide mode) ---
+    dyn_w = dynamic_weights(selected, inp.cpu_alloc, inp.cpu_avail)
+    weights = jnp.where(
+        inp.weights_given[:, None], inp.weights, dyn_w
+    ).astype(jnp.int32)
+    weights = jnp.where(selected, weights, 0)
+
+    total64 = inp.total.astype(jnp.int64)
+    current = jnp.where(
+        inp.current_mask,
+        jnp.where(inp.current_replicas == NIL_REPLICAS, total64[:, None], inp.current_replicas),
+        0,
+    ).astype(jnp.int32)
+
+    plan_out = plan_batch_jit(
+        PlannerInputs(
+            weight=weights,
+            min_replicas=jnp.where(selected, inp.min_replicas, 0),
+            max_replicas=inp.max_replicas,
+            scale_max=inp.scale_max,
+            capacity=inp.capacity,
+            tiebreak=inp.tiebreak,
+            member=selected,
+            total=inp.total,
+            current=current,
+            avoid_disruption=inp.avoid_disruption,
+            keep_unschedulable=inp.keep_unschedulable,
+        )
+    )
+    # The RSP merges capacity overflow back into the result as
+    # "nice to schedule" replicas (rsp.go:158-177) and drops zero entries.
+    divide_replicas = (plan_out.plan + plan_out.overflow).astype(jnp.int64)
+    # Zero entries are dropped; negative entries (pathological min>max
+    # policies) are preserved, as the reference's merge does.
+    divide_selected = selected & (divide_replicas != 0)
+
+    mode_divide = inp.mode_divide[:, None]
+    out_selected = jnp.where(mode_divide, divide_selected, selected)
+    out_replicas = jnp.where(
+        mode_divide, jnp.where(divide_selected, divide_replicas, 0), NIL_REPLICAS
+    )
+    out_counted = mode_divide & divide_selected
+
+    # --- Sticky-cluster short-circuit (generic_scheduler.go:103-107) ---
+    sticky_active = (inp.sticky & jnp.any(inp.current_mask, axis=-1))[:, None]
+    out_selected = jnp.where(sticky_active, inp.current_mask, out_selected)
+    out_replicas = jnp.where(
+        sticky_active,
+        jnp.where(inp.current_mask, inp.current_replicas, 0),
+        out_replicas,
+    )
+    out_counted = jnp.where(
+        sticky_active,
+        inp.current_mask & (inp.current_replicas != NIL_REPLICAS),
+        out_counted,
+    )
+    out_replicas = jnp.where(out_selected, out_replicas, 0)
+
+    return TickOutputs(
+        selected=out_selected,
+        replicas=out_replicas,
+        counted=out_counted & out_selected,
+        feasible=feasible,
+        scores=totals,
+    )

@@ -1,0 +1,231 @@
+"""Sequential per-object oracle for the fused scheduling tick.
+
+Mirrors the reference generic scheduler's control flow one object at a
+time in plain Python (reference: pkg/controllers/scheduler/core/
+generic_scheduler.go, framework/plugins/*), over the same featurized
+inputs that TickInputs carries.  Used as the differential-test oracle for
+ops.pipeline.schedule_tick and as bench.py's "in-process sequential
+scheduler" baseline.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from kubeadmiral_tpu.ops.planner_oracle import ClusterPref, PlanInput, plan as planner
+
+NIL = -1
+MAX_SCORE = 100
+
+
+@dataclass
+class OracleProblem:
+    """Featurized single-object scheduling problem over C clusters."""
+
+    n_clusters: int
+    filter_enabled: list[bool]  # 5 entries, ops.filters order
+    score_enabled: list[bool]   # 5 entries, ops.scores order
+    api_ok: list[bool]
+    taint_ok_new: list[bool]
+    taint_ok_cur: list[bool]
+    selector_ok: list[bool]
+    placement_ok: list[bool]
+    placement_has: bool
+    request: list[int]          # [R]
+    alloc: list[list[int]]      # [C][R]
+    used: list[list[int]]       # [C][R]
+    taint_counts: list[int]
+    affinity_scores: list[int]
+    max_clusters: int | None
+    mode_divide: bool
+    sticky: bool
+    current: dict[int, int | None]  # cluster idx -> replicas (None = nil)
+    total: int
+    weights: dict[int, int] | None  # static policy weights; None = dynamic
+    min_replicas: dict[int, int] = field(default_factory=dict)
+    max_replicas: dict[int, int] = field(default_factory=dict)
+    capacity: dict[int, int] = field(default_factory=dict)
+    keep_unschedulable: bool = False
+    avoid_disruption: bool = False
+    cluster_names: list[str] = field(default_factory=list)
+    key: str = ""
+    cpu_alloc: list[int] = field(default_factory=list)
+    cpu_avail: list[int] = field(default_factory=list)
+
+
+def _fits(p: OracleProblem, c: int) -> bool:
+    if all(r <= 0 for r in p.request):
+        return True
+    for r in range(len(p.request)):
+        if r >= 2 and p.request[r] <= 0:
+            continue
+        if p.alloc[c][r] < p.request[r] + p.used[c][r]:
+            return False
+    return True
+
+
+def _balanced(p: OracleProblem, c: int) -> int:
+    def frac(req, cap):
+        return 1.0 if cap == 0 else req / cap
+
+    f_cpu = frac(p.used[c][0] + p.request[0], p.alloc[c][0])
+    f_mem = frac(p.used[c][1] + p.request[1], p.alloc[c][1])
+    if f_cpu >= 1 or f_mem >= 1:
+        return 0
+    return int((1 - abs(f_cpu - f_mem)) * MAX_SCORE)
+
+
+def _ratio(p: OracleProblem, c: int, least: bool) -> int:
+    total = 0
+    for r in (0, 1):
+        cap = p.alloc[c][r]
+        req = p.used[c][r] + p.request[r]
+        if cap == 0 or req > cap:
+            s = 0
+        elif least:
+            s = (cap - req) * MAX_SCORE // cap
+        else:
+            s = req * MAX_SCORE // cap
+        total += s
+    return total // 2
+
+
+def _normalize(scores: dict[int, int], reverse: bool) -> dict[int, int]:
+    max_count = max(scores.values(), default=0)
+    if max_count == 0:
+        if reverse:
+            return {c: MAX_SCORE for c in scores}
+        return dict(scores)
+    out = {}
+    for c, s in scores.items():
+        s = MAX_SCORE * s // max_count
+        out[c] = MAX_SCORE - s if reverse else s
+    return out
+
+
+def _dynamic_weights(p: OracleProblem, selected: list[int]) -> dict[int, int]:
+    """rsp.go CalcWeightLimit + AvailableToPercentage over the selection."""
+    n = len(selected)
+    alloc_sum = sum(p.cpu_alloc[c] for c in selected)
+    if alloc_sum == 0:
+        limit = {c: round_half(1000 / n) for c in selected}
+    else:
+        limit = {
+            c: round_half(p.cpu_alloc[c] / alloc_sum * 1000 * 1.4) for c in selected
+        }
+    avail_sum = sum(p.cpu_avail[c] for c in selected if p.cpu_avail[c] > 0)
+    if avail_sum == 0:
+        tmp = {c: round_half(1000 / n) for c in selected}
+    else:
+        tmp = {
+            c: min(round_half(max(p.cpu_avail[c], 0) / avail_sum * 1000), limit[c])
+            for c in selected
+        }
+    tmp_sum = sum(tmp.values())
+    if tmp_sum <= 0:
+        return {c: 0 for c in selected}
+    weights = {}
+    max_w, max_c, other = 0, None, 0
+    for c in selected:  # deterministic first-max (Go iterates a map here)
+        w = round_half(tmp[c] / tmp_sum * 1000)
+        if w > max_w:
+            max_w, max_c = w, c
+        weights[c] = w
+        other += w
+    if max_c is not None:
+        weights[max_c] += 1000 - other
+    return weights
+
+
+def round_half(x: float) -> int:
+    return int(math.copysign(math.floor(abs(x) + 0.5), x))
+
+
+def schedule_one(p: OracleProblem) -> dict[int, int | None]:
+    """Returns {cluster_idx: replicas-or-None} like ScheduleResult."""
+    if p.sticky and p.current:
+        return dict(p.current)
+
+    # Filter.
+    feasible = []
+    for c in range(p.n_clusters):
+        ok = True
+        if p.filter_enabled[0]:
+            ok &= p.api_ok[c]
+        if p.filter_enabled[1]:
+            ok &= p.taint_ok_cur[c] if c in p.current else p.taint_ok_new[c]
+        if p.filter_enabled[2]:
+            ok &= _fits(p, c)
+        if p.filter_enabled[3] and p.placement_has:
+            ok &= p.placement_ok[c]
+        if p.filter_enabled[4]:
+            ok &= p.selector_ok[c]
+        if ok:
+            feasible.append(c)
+    if not feasible:
+        return {}
+
+    # Score + normalize + sum.
+    totals = {c: 0 for c in feasible}
+    if p.score_enabled[0]:
+        for c, s in _normalize({c: p.taint_counts[c] for c in feasible}, True).items():
+            totals[c] += s
+    if p.score_enabled[1]:
+        for c in feasible:
+            totals[c] += _balanced(p, c)
+    if p.score_enabled[2]:
+        for c in feasible:
+            totals[c] += _ratio(p, c, True)
+    if p.score_enabled[3]:
+        for c, s in _normalize(
+            {c: p.affinity_scores[c] for c in feasible}, False
+        ).items():
+            totals[c] += s
+    if p.score_enabled[4]:
+        for c in feasible:
+            totals[c] += _ratio(p, c, False)
+
+    # Select: top-K by (score desc, index asc).
+    if p.max_clusters is not None and p.max_clusters < 0:
+        return {}
+    ranked = sorted(feasible, key=lambda c: (-totals[c], c))
+    k = len(ranked) if p.max_clusters is None else min(p.max_clusters, len(ranked))
+    selected = ranked[:k]
+
+    if not p.mode_divide:
+        return {c: None for c in selected}
+
+    # Replicas via the planner oracle.
+    weights = p.weights if p.weights is not None else _dynamic_weights(p, selected)
+    prefs = {}
+    for c in selected:
+        prefs[p.cluster_names[c]] = ClusterPref(
+            weight=weights.get(c, 0),
+            min_replicas=p.min_replicas.get(c, 0),
+            max_replicas=p.max_replicas.get(c),
+        )
+    current = {}
+    for c, reps in p.current.items():
+        current[p.cluster_names[c]] = p.total if reps is None else reps
+    plan_map, overflow = planner(
+        PlanInput(
+            prefs=prefs,
+            total=p.total,
+            clusters=[p.cluster_names[c] for c in selected],
+            current=current,
+            capacity={p.cluster_names[c]: cap for c, cap in p.capacity.items()},
+            key=p.key,
+            avoid_disruption=p.avoid_disruption,
+            keep_unschedulable=p.keep_unschedulable,
+        )
+    )
+    merged: dict[str, int] = dict(plan_map)
+    for name, extra in overflow.items():
+        merged[name] = merged.get(name, 0) + extra
+    by_name = {p.cluster_names[c]: c for c in selected}
+    return {
+        by_name[name]: reps
+        for name, reps in merged.items()
+        if reps != 0 and name in by_name
+    }

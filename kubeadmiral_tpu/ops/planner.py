@@ -136,7 +136,7 @@ def _distribute(
     def round_body(state):
         plan, overflow, active, remaining, _ = state
         w_active = jnp.where(active, w, 0)
-        weight_sum = jnp.sum(w_active)
+        weight_sum = jnp.sum(w_active, dtype=jnp.int32)
         d = remaining  # round-start snapshot
         safe_sum = jnp.maximum(weight_sum, 1)
         quota = (d * w_active + safe_sum - 1) // safe_sum
@@ -155,7 +155,7 @@ def _distribute(
         full = active & ((plan + extra > max_r) | (after_max > cap))
 
         plan = plan + jnp.where(active, take, 0)
-        remaining = d - jnp.sum(jnp.where(active, take, 0))
+        remaining = d - jnp.sum(jnp.where(active, take, 0), dtype=jnp.int32)
         moved = jnp.any(jnp.where(active, take, 0) > 0) & (weight_sum > 0)
         return plan, overflow, active & ~full, remaining, moved
 
@@ -203,8 +203,8 @@ def _plan_one(inp: PlannerInputs) -> PlannerOutputs:
     current_ok = jnp.where(
         inp.member, jnp.minimum(inp.current, inp.capacity), 0
     )
-    current_total = jnp.sum(current_ok)
-    desired_total = jnp.sum(desired)
+    current_total = jnp.sum(current_ok, dtype=jnp.int32)
+    desired_total = jnp.sum(desired, dtype=jnp.int32)
 
     # Scale up: clusters below their desired share grow, weighted by the
     # shortfall, bounded by the directly-named max minus current.

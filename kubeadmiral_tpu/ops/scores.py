@@ -1,0 +1,112 @@
+"""Score stage: per-(object, cluster) int scores + normalization.
+
+Tensor re-statements of the reference score plugins (reference:
+pkg/controllers/scheduler/framework/plugins/...), masked to feasible
+clusters, summed per the generic scheduler (core/generic_scheduler.go:171-192).
+
+Score plugin indices (column order of ``score_enabled``):
+  0 TaintToleration, 1 ClusterResourcesBalancedAllocation,
+  2 ClusterResourcesLeastAllocated, 3 ClusterAffinity,
+  4 ClusterResourcesMostAllocated.
+
+Integer-division truncation matches Go exactly (all operands are
+non-negative); the balanced-allocation plugin is float math in the
+reference too and is computed in f64.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubeadmiral_tpu.ops.filters import R_CPU, R_MEM
+
+S_TAINT = 0
+S_BALANCED = 1
+S_LEAST = 2
+S_AFFINITY = 3
+S_MOST = 4
+NUM_SCORE_PLUGINS = 5
+
+MAX_CLUSTER_SCORE = 100
+
+
+def _requested_totals(request, alloc, used):
+    """Per-pair (allocatable, requested-including-this-object) for cpu+mem.
+
+    Mirrors calculateResourceAllocatableRequest (fit.go:160-183): the
+    cluster's in-use request is (alloc - available) plus this object's own
+    request.
+    """
+    req_cpu = used[None, :, R_CPU] + request[:, None, R_CPU]
+    req_mem = used[None, :, R_MEM] + request[:, None, R_MEM]
+    alloc_cpu = jnp.broadcast_to(alloc[None, :, R_CPU], req_cpu.shape)
+    alloc_mem = jnp.broadcast_to(alloc[None, :, R_MEM], req_mem.shape)
+    return alloc_cpu, alloc_mem, req_cpu, req_mem
+
+
+def balanced_allocation_score(request, alloc, used):
+    """(1 - |cpuFraction - memFraction|) * 100, 0 if either fraction >= 1
+    (balanced_allocation.go:45-78); fraction of zero capacity counts as 1."""
+    alloc_cpu, alloc_mem, req_cpu, req_mem = _requested_totals(request, alloc, used)
+    f_cpu = jnp.where(alloc_cpu == 0, 1.0, req_cpu / jnp.maximum(alloc_cpu, 1))
+    f_mem = jnp.where(alloc_mem == 0, 1.0, req_mem / jnp.maximum(alloc_mem, 1))
+    diff = jnp.abs(f_cpu - f_mem)
+    score = ((1.0 - diff) * MAX_CLUSTER_SCORE).astype(jnp.int64)
+    return jnp.where((f_cpu >= 1.0) | (f_mem >= 1.0), 0, score)
+
+
+def _ratio_score(req, alloc, least: bool):
+    zero = alloc == 0
+    over = req > alloc
+    free = jnp.where(least, alloc - req, req)
+    score = free * MAX_CLUSTER_SCORE // jnp.maximum(alloc, 1)
+    return jnp.where(zero | over, 0, score)
+
+
+def least_allocated_score(request, alloc, used):
+    """((cap-req)*100//cap per resource, cpu+mem averaged) — least_allocated.go:42-93."""
+    alloc_cpu, alloc_mem, req_cpu, req_mem = _requested_totals(request, alloc, used)
+    s = _ratio_score(req_cpu, alloc_cpu, True) + _ratio_score(req_mem, alloc_mem, True)
+    return s // 2
+
+
+def most_allocated_score(request, alloc, used):
+    """(req*100//cap per resource, cpu+mem averaged) — most_allocated.go:42-93."""
+    alloc_cpu, alloc_mem, req_cpu, req_mem = _requested_totals(request, alloc, used)
+    s = _ratio_score(req_cpu, alloc_cpu, False) + _ratio_score(req_mem, alloc_mem, False)
+    return s // 2
+
+
+def normalize(scores, feasible, reverse: bool):
+    """DefaultNormalizeScore (framework/util.go:455-482) over feasible
+    clusters of each object: scale to [0,100] by the per-object max; if the
+    max is 0 -> all 100 when reversed, else left as-is."""
+    masked = jnp.where(feasible, scores, 0)
+    max_count = jnp.max(masked, axis=-1, keepdims=True)
+    scaled = MAX_CLUSTER_SCORE * masked // jnp.maximum(max_count, 1)
+    scaled = jnp.where(reverse, MAX_CLUSTER_SCORE - scaled, scaled)
+    untouched = jnp.where(reverse, jnp.full_like(masked, MAX_CLUSTER_SCORE), masked)
+    return jnp.where(max_count == 0, untouched, scaled)
+
+
+def total_scores(
+    score_enabled,   # bool[B, 5]
+    feasible,        # bool[B, C]
+    request, alloc, used,
+    taint_counts,    # i64[B, C] intolerable PreferNoSchedule taints
+    affinity_scores, # i64[B, C] preferred-term weight sums
+):
+    """Sum of enabled, normalized plugin scores; 0 on infeasible clusters."""
+    taint = normalize(taint_counts, feasible, reverse=True)
+    affinity = normalize(affinity_scores, feasible, reverse=False)
+    plugin_scores = (
+        (S_TAINT, taint),
+        (S_BALANCED, balanced_allocation_score(request, alloc, used)),
+        (S_LEAST, least_allocated_score(request, alloc, used)),
+        (S_AFFINITY, affinity),
+        (S_MOST, most_allocated_score(request, alloc, used)),
+    )
+    total = jnp.zeros_like(feasible, dtype=jnp.int64)
+    for idx, s in plugin_scores:
+        total = total + jnp.where(score_enabled[:, idx, None], s, 0)
+    return jnp.where(feasible, total, 0)

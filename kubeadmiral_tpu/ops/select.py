@@ -1,0 +1,29 @@
+"""Select stage: MaxCluster top-K (reference: plugins/maxcluster/max_cluster.go).
+
+The reference sorts feasible clusters by score (unstable Go sort) and keeps
+the first K = min(maxClusters, len).  Here ties break deterministically by
+cluster index (the reference's tie order is unspecified), a negative
+maxClusters selects nothing (reference returns Unschedulable), and the
+sentinel INT32_INF means "no limit".
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubeadmiral_tpu.ops.planner import INT32_INF
+
+
+def select_topk(scores, feasible, max_clusters):
+    """scores i64[B,C], feasible bool[B,C], max_clusters i32[B] -> bool[B,C]."""
+    c = scores.shape[-1]
+    # Rank feasible clusters by score desc, index asc; infeasible last.
+    sort_key = jnp.where(feasible, -scores, jnp.iinfo(jnp.int64).max)
+    order = jnp.argsort(sort_key, axis=-1, stable=True)
+    rank = jnp.argsort(order, axis=-1, stable=True)  # rank[b,c] = position of c
+    k = jnp.where(
+        max_clusters < 0,
+        0,
+        jnp.minimum(max_clusters.astype(jnp.int64), c),
+    )
+    return feasible & (rank < k[:, None])

@@ -1,0 +1,73 @@
+"""Dynamic cluster weights for replica scheduling.
+
+Tensor form of the reference RSP plugin's weight derivation (reference:
+pkg/controllers/scheduler/framework/plugins/rsp/rsp.go:183-272): when the
+policy provides no static weights, each object's selected clusters are
+weighted by their share of available CPU, clamped by an allocatable-share
+limit (x1.4), then re-normalized to sum to 1000 with the rounding residual
+handed to the heaviest cluster.
+
+All rounding is "half away from zero" (Go math.Round), computed in f64.
+CPU values here are Quantity.Value() cores (ceiling), as in the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+SUM_WEIGHT = 1000.0
+SUPPLY_LIMIT = 1.4
+
+
+def _round_half_away(x):
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+def dynamic_weights(selected, cpu_alloc, cpu_avail):
+    """selected bool[B,C]; cpu_alloc/cpu_avail i64[C] -> i32[B,C] weights.
+
+    Weights are zero outside the selection mask.
+    """
+    sel = selected
+    n = jnp.maximum(jnp.sum(sel, axis=-1, keepdims=True), 1).astype(jnp.float64)
+
+    # CalcWeightLimit: allocatable-CPU share * 1000 * 1.4 (rsp.go:183-213).
+    alloc = jnp.where(sel, cpu_alloc[None, :], 0).astype(jnp.float64)
+    alloc_sum = jnp.sum(alloc, axis=-1, keepdims=True)
+    equal = _round_half_away(SUM_WEIGHT / n)
+    limit = jnp.where(
+        alloc_sum == 0,
+        equal,
+        _round_half_away(alloc / jnp.maximum(alloc_sum, 1.0) * SUM_WEIGHT * SUPPLY_LIMIT),
+    )
+
+    # AvailableToPercentage (rsp.go:215-272): available-CPU share, clamped.
+    avail = jnp.where(sel, cpu_avail[None, :], 0).astype(jnp.float64)
+    avail_pos = jnp.maximum(avail, 0.0)
+    avail_sum = jnp.sum(avail_pos, axis=-1, keepdims=True)
+    tmp = jnp.where(
+        avail_sum == 0,
+        equal,
+        jnp.minimum(
+            _round_half_away(avail_pos / jnp.maximum(avail_sum, 1.0) * SUM_WEIGHT),
+            limit,
+        ),
+    )
+    tmp = jnp.where(sel, tmp, 0.0)
+    tmp_sum = jnp.sum(tmp, axis=-1, keepdims=True)
+    weight = jnp.where(
+        tmp_sum > 0,
+        _round_half_away(tmp / jnp.maximum(tmp_sum, 1.0) * SUM_WEIGHT),
+        0.0,
+    )
+    weight = jnp.where(sel, weight, 0.0)
+
+    # Residual of the second rounding pass goes to the heaviest cluster
+    # (first index on ties; the reference's pick is map-order dependent).
+    residual = SUM_WEIGHT - jnp.sum(weight, axis=-1, keepdims=True)
+    max_w = jnp.max(weight, axis=-1, keepdims=True)
+    is_first_max = (
+        jnp.cumsum((weight == max_w) & sel, axis=-1) == 1
+    ) & (weight == max_w) & sel
+    weight = jnp.where(is_first_max & (max_w > 0), weight + residual, weight)
+    return weight.astype(jnp.int32)

@@ -1,0 +1,120 @@
+"""Informer: cached LIST+WATCH over one resource of one apiserver.
+
+The thin analogue of the reference's shared informers and
+FederatedInformer (reference: pkg/controllers/util/federatedinformer.go):
+a local object cache kept in sync by watch events, with handler fan-out
+and a federated variant that multiplexes per-cluster stores
+(FederatedReadOnlyStore semantics: GetFromAllClusters / ClustersSynced).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+from kubeadmiral_tpu.testing.fakekube import ADDED, DELETED, FakeKube, obj_key
+
+Handler = Callable[[str, dict], None]
+
+
+class Informer:
+    def __init__(self, kube: FakeKube, resource: str):
+        self.kube = kube
+        self.resource = resource
+        self._lock = threading.RLock()
+        self._cache: dict[str, dict] = {}
+        self._handlers: list[Handler] = []
+        kube.watch(resource, self._on_event, replay=True)
+
+    def close(self) -> None:
+        """Detach from the apiserver; no further events are delivered."""
+        self.kube.unwatch(self.resource, self._on_event)
+        with self._lock:
+            self._handlers.clear()
+            self._cache.clear()
+
+    def _on_event(self, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        with self._lock:
+            if event == DELETED:
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = obj
+            handlers = list(self._handlers)
+        for h in handlers:
+            h(event, obj)
+
+    def add_handler(self, handler: Handler, replay: bool = True) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+            snapshot = list(self._cache.values()) if replay else ()
+        for obj in snapshot:
+            handler(ADDED, obj)
+
+    def get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            return self._cache.get(key)
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return list(self._cache.values())
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._cache)
+
+
+class FederatedInformer:
+    """Per-ready-cluster informers for one target resource."""
+
+    def __init__(self, resource: str):
+        self.resource = resource
+        self._lock = threading.RLock()
+        self._informers: dict[str, Informer] = {}
+        self._handlers: list[Callable[[str, str, dict], None]] = []  # (cluster, event, obj)
+
+    def add_cluster(self, name: str, kube: FakeKube) -> None:
+        with self._lock:
+            if name in self._informers:
+                return
+            informer = Informer(kube, self.resource)
+            self._informers[name] = informer
+            informer.add_handler(
+                lambda event, obj, _n=name: self._fanout(_n, event, obj),
+                replay=True,
+            )
+
+    def remove_cluster(self, name: str) -> None:
+        with self._lock:
+            informer = self._informers.pop(name, None)
+        if informer is not None:
+            informer.close()
+
+    def _fanout(self, cluster: str, event: str, obj: dict) -> None:
+        with self._lock:
+            handlers = list(self._handlers)
+        for h in handlers:
+            h(cluster, event, obj)
+
+    def add_handler(self, handler: Callable[[str, str, dict], None]) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+
+    def clusters(self) -> list[str]:
+        with self._lock:
+            return list(self._informers)
+
+    def get(self, cluster: str, key: str) -> Optional[dict]:
+        with self._lock:
+            informer = self._informers.get(cluster)
+        return informer.get(key) if informer else None
+
+    def get_from_all(self, key: str) -> dict[str, dict]:
+        out = {}
+        with self._lock:
+            items = list(self._informers.items())
+        for name, informer in items:
+            obj = informer.get(key)
+            if obj is not None:
+                out[name] = obj
+        return out

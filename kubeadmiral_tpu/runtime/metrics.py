@@ -1,0 +1,48 @@
+"""Metrics interface (reference: pkg/stats/stats.go:33-103).
+
+The reference defines {Store, Counter, Rate, Timer, Duration} with a
+log-backed default; this keeps the same surface with an in-memory
+implementation that tests and the monitor controller can read back.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = defaultdict(float)
+        self.stores: dict[str, float] = {}
+        self.durations: dict[str, list[float]] = defaultdict(list)
+
+    def counter(self, name: str, value: float = 1, **tags) -> None:
+        with self._lock:
+            self.counters[name] += value
+
+    def rate(self, name: str, value: float = 1, **tags) -> None:
+        self.counter(name, value, **tags)
+
+    def store(self, name: str, value: float, **tags) -> None:
+        with self._lock:
+            self.stores[name] = value
+
+    def duration(self, name: str, seconds: float, **tags) -> None:
+        with self._lock:
+            self.durations[name].append(seconds)
+
+    @contextmanager
+    def timer(self, name: str, **tags):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.duration(name, time.perf_counter() - start, **tags)
+
+
+def null_metrics() -> Metrics:
+    return Metrics()

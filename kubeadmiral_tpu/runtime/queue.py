@@ -1,0 +1,100 @@
+"""Keyed work queues with delay + backoff.
+
+Merges the reference's two queue layers (reference:
+pkg/controllers/util/delayingdeliver/delaying_deliverer.go — a min-heap
+timer queue with latest-wins per key — and pkg/controllers/util/worker/
+worker.go — per-key exponential backoff, 5s initial / 1m max) into one
+structure tuned for the tick architecture: controllers *drain everything
+due at once* so the scheduler can batch the whole pending set into a
+single device dispatch, instead of popping one key per goroutine.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass(order=True)
+class _Entry:
+    due: float
+    seq: int
+    key: str = field(compare=False)
+
+
+class Backoff:
+    """Per-key exponential backoff (worker.go:86-91, 146-155)."""
+
+    def __init__(self, initial: float = 5.0, maximum: float = 60.0):
+        self.initial = initial
+        self.maximum = maximum
+        self._delays: dict[str, float] = {}
+
+    def next_delay(self, key: str) -> float:
+        delay = self._delays.get(key, self.initial)
+        self._delays[key] = min(delay * 2, self.maximum)
+        return delay
+
+    def reset(self, key: str) -> None:
+        self._delays.pop(key, None)
+
+
+class DirtyQueue:
+    """Thread-safe delayed queue; at most one pending entry per key
+    (latest-wins, like DelayingDeliverer's key map)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._heap: list[_Entry] = []
+        self._pending: dict[str, _Entry] = {}
+        self._seq = 0
+        self._wakeup = threading.Condition(self._lock)
+
+    def add(self, key: str, delay: float = 0.0) -> None:
+        due = self._clock() + delay
+        with self._wakeup:
+            cur = self._pending.get(key)
+            if cur is not None:
+                if cur.due <= due:
+                    return  # an earlier delivery is already scheduled
+                cur.key = _TOMBSTONE  # lazy-delete the later one
+            self._seq += 1
+            entry = _Entry(due, self._seq, key)
+            self._pending[key] = entry
+            heapq.heappush(self._heap, entry)
+            self._wakeup.notify()
+
+    def drain_due(self) -> list[str]:
+        """Pop every key whose delivery time has arrived."""
+        now = self._clock()
+        out: list[str] = []
+        with self._lock:
+            while self._heap and self._heap[0].due <= now:
+                entry = heapq.heappop(self._heap)
+                if entry.key is _TOMBSTONE:
+                    continue
+                del self._pending[entry.key]
+                out.append(entry.key)
+        return out
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Block until something may be due (new entry or head deadline)."""
+        with self._wakeup:
+            head = self._heap[0].due if self._heap else None
+            now = self._clock()
+            if head is not None and head <= now:
+                return
+            delay = None if head is None else head - now
+            if timeout is not None:
+                delay = timeout if delay is None else min(delay, timeout)
+            self._wakeup.wait(delay)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+_TOMBSTONE: str = "\x00tombstone\x00"

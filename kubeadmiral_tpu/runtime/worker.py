@@ -1,0 +1,157 @@
+"""Reconcile workers.
+
+The reference's universal engine is ReconcileWorker: a queue feeding N
+goroutines that call ``reconcile(key) -> Result`` with per-key backoff
+(reference: pkg/controllers/util/worker/worker.go:37-174).  Two variants
+here:
+
+* :class:`Worker` — the direct analogue for per-object controllers
+  (sync, federate, status, ...), stepped explicitly (``step()``) or in a
+  thread loop (``run()``).
+* :class:`BatchWorker` — the tick-native variant: drains *all* due keys
+  and hands them to one callback, which is how the scheduler amortizes a
+  whole pending set into one XLA dispatch.
+
+Results mirror worker.Result: success resets backoff; ``backoff=True``
+requeues with exponential delay; ``requeue_after`` schedules a fixed
+revisit.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+from kubeadmiral_tpu.runtime.queue import Backoff, DirtyQueue
+from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
+
+
+@dataclass
+class Result:
+    success: bool = True
+    requeue_after: Optional[float] = None
+    backoff: bool = False
+
+    @staticmethod
+    def ok() -> "Result":
+        return Result()
+
+    @staticmethod
+    def retry() -> "Result":
+        return Result(success=False, backoff=True)
+
+    @staticmethod
+    def after(seconds: float) -> "Result":
+        return Result(success=True, requeue_after=seconds)
+
+
+class _WorkerBase:
+    def __init__(self, name: str, metrics: Optional[Metrics] = None, clock=None):
+        self.name = name
+        self.queue = DirtyQueue() if clock is None else DirtyQueue(clock)
+        self.backoff = Backoff()
+        self.metrics = metrics or null_metrics()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def enqueue(self, key: str, delay: float = 0.0) -> None:
+        self.queue.add(key, delay)
+
+    def enqueue_all(self, keys: Iterable[str], delay: float = 0.0) -> None:
+        for k in keys:
+            self.queue.add(k, delay)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self.queue._wakeup:
+            self.queue._wakeup.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def run(self, workers: int = 1) -> None:
+        for i in range(workers):
+            t = threading.Thread(
+                target=self._loop, name=f"{self.name}-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.step():
+                self.queue.wait(timeout=0.5)
+
+    def step(self) -> bool:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class Worker(_WorkerBase):
+    """One key per reconcile call."""
+
+    def __init__(self, name, reconcile: Callable[[str], Result], **kw):
+        super().__init__(name, **kw)
+        self._reconcile = reconcile
+
+    def step(self) -> bool:
+        keys = self.queue.drain_due()
+        if not keys:
+            return False
+        for key in keys:
+            self._dispatch(key)
+        return True
+
+    def _dispatch(self, key: str) -> None:
+        try:
+            with self.metrics.timer(f"{self.name}.latency"):
+                result = self._reconcile(key)
+        except Exception:
+            self.metrics.counter(f"{self.name}.panic")
+            traceback.print_exc()
+            result = Result.retry()
+        self.metrics.counter(f"{self.name}.throughput")
+        self._requeue(key, result)
+
+    def _requeue(self, key: str, result: Result) -> None:
+        if result.success:
+            self.backoff.reset(key)
+            if result.requeue_after is not None:
+                self.queue.add(key, result.requeue_after)
+        elif result.backoff:
+            self.queue.add(key, self.backoff.next_delay(key))
+
+
+class BatchWorker(_WorkerBase):
+    """All due keys -> one callback (the batching tick)."""
+
+    def __init__(
+        self,
+        name,
+        reconcile_batch: Callable[[list[str]], dict[str, Result]],
+        **kw,
+    ):
+        super().__init__(name, **kw)
+        self._reconcile_batch = reconcile_batch
+
+    def step(self) -> bool:
+        keys = self.queue.drain_due()
+        if not keys:
+            return False
+        try:
+            with self.metrics.timer(f"{self.name}.tick_latency"):
+                results = self._reconcile_batch(keys)
+        except Exception:
+            self.metrics.counter(f"{self.name}.panic")
+            traceback.print_exc()
+            results = {k: Result.retry() for k in keys}
+        self.metrics.counter(f"{self.name}.throughput", len(keys))
+        for key in keys:
+            result = results.get(key, Result.ok())
+            if result.success:
+                self.backoff.reset(key)
+                if result.requeue_after is not None:
+                    self.queue.add(key, result.requeue_after)
+            elif result.backoff:
+                self.queue.add(key, self.backoff.next_delay(key))
+        return True

@@ -1,0 +1,243 @@
+"""The scheduling engine: batch in, placements out.
+
+Public entry point for the control plane: take every pending
+SchedulingUnit, featurize against the current member clusters, run the
+fused XLA tick (chunked over the object axis to bound device memory and
+shape-bucketed to bound recompiles), and decode placements.
+
+Where the reference schedules one object at a time inside worker
+goroutines (reference: pkg/controllers/scheduler/scheduler.go:246-521),
+this engine schedules the whole pending set per tick in O(B/chunk)
+device dispatches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.ops.pipeline import NIL_REPLICAS, TickInputs, schedule_tick
+from kubeadmiral_tpu.scheduler.featurize import ClusterView, FeaturizedBatch, featurize
+
+# Duplicate-mode placements carry no replica count.
+DUPLICATE = None
+
+
+@dataclass
+class ScheduleResult:
+    """Placement decision for one object: cluster -> replicas (None in
+    Duplicate mode), mirroring core.ScheduleResult.SuggestedClusters."""
+
+    clusters: dict[str, Optional[int]]
+
+    @property
+    def cluster_set(self) -> set[str]:
+        return set(self.clusters)
+
+
+def _round_up(n: int, step: int) -> int:
+    return max(step, ((n + step - 1) // step) * step)
+
+
+def _pad_batch(inputs: TickInputs, b_pad: int) -> TickInputs:
+    """Pad the object axis with inert rows (no members, Duplicate mode)."""
+    b = inputs.total.shape[0]
+    if b == b_pad:
+        return inputs
+    extra = b_pad - b
+
+    def pad(x, fill):
+        shape = (extra,) + x.shape[1:]
+        return np.concatenate([x, np.full(shape, fill, x.dtype)])
+
+    per_object_fill = {
+        "filter_enabled": False,
+        "api_ok": False,
+        "taint_ok_new": False,
+        "taint_ok_cur": False,
+        "selector_ok": False,
+        "placement_has": False,
+        "placement_ok": False,
+        "request": 0,
+        "score_enabled": False,
+        "taint_counts": 0,
+        "affinity_scores": 0,
+        "max_clusters": 0,
+        "mode_divide": False,
+        "sticky": False,
+        "current_mask": False,
+        "current_replicas": NIL_REPLICAS,
+        "total": 0,
+        "weights_given": True,
+        "weights": 0,
+        "min_replicas": 0,
+        "max_replicas": np.iinfo(np.int32).max,
+        "scale_max": np.iinfo(np.int32).max,
+        "capacity": np.iinfo(np.int32).max,
+        "keep_unschedulable": False,
+        "avoid_disruption": False,
+        "tiebreak": 0,
+    }
+    fields = {}
+    for name, arr in inputs._asdict().items():
+        if name in per_object_fill:
+            fields[name] = pad(np.asarray(arr), per_object_fill[name])
+        else:
+            fields[name] = arr  # cluster-axis tensors are shared
+    return TickInputs(**fields)
+
+
+# Fill values for padded cluster slots, per [.., C, ..] field.
+_CLUSTER_AXIS_FILL = {
+    "api_ok": False,
+    "taint_ok_new": False,
+    "taint_ok_cur": False,
+    "selector_ok": False,
+    "placement_ok": False,
+    "taint_counts": 0,
+    "affinity_scores": 0,
+    "current_mask": False,
+    "current_replicas": NIL_REPLICAS,
+    "weights": 0,
+    "min_replicas": 0,
+    "max_replicas": np.iinfo(np.int32).max,
+    "scale_max": np.iinfo(np.int32).max,
+    "capacity": np.iinfo(np.int32).max,
+    "tiebreak": 0,
+    "alloc": 0,
+    "used": 0,
+    "cpu_alloc": 0,
+    "cpu_avail": 0,
+    "cluster_valid": False,
+}
+
+
+def _pad_clusters(inputs: TickInputs, c_pad: int) -> TickInputs:
+    """Pad the cluster axis with invalid slots (cluster_valid=False)."""
+    c = inputs.cluster_valid.shape[0]
+    if c == c_pad:
+        return inputs
+    extra = c_pad - c
+    fields = {}
+    for name, arr in inputs._asdict().items():
+        fill = _CLUSTER_AXIS_FILL.get(name)
+        if fill is None:
+            fields[name] = arr
+            continue
+        arr = np.asarray(arr)
+        # The cluster axis is the first axis for [C]/[C,R] tensors and the
+        # second for [B,C] tensors.
+        axis = 0 if name in ("alloc", "used", "cpu_alloc", "cpu_avail", "cluster_valid") else 1
+        pad_shape = list(arr.shape)
+        pad_shape[axis] = extra
+        fields[name] = np.concatenate(
+            [arr, np.full(pad_shape, fill, arr.dtype)], axis=axis
+        )
+    return TickInputs(**fields)
+
+
+def _pow2_bucket(n: int, minimum: int, cap: int) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, max(cap, minimum))
+
+
+class SchedulerEngine:
+    """Chunked, shape-bucketed driver around ops.pipeline.schedule_tick."""
+
+    def __init__(self, chunk_size: int = 4096, min_bucket: int = 64, min_cluster_bucket: int = 8):
+        self.chunk_size = chunk_size
+        self.min_bucket = min_bucket
+        self.min_cluster_bucket = min_cluster_bucket
+        self._view_cache: tuple[Optional[tuple], Optional[ClusterView]] = (None, None)
+
+    @staticmethod
+    def _cluster_fingerprint(clusters, scalar_resources: tuple) -> tuple:
+        return (
+            tuple(
+                (
+                    c.name,
+                    tuple(sorted(c.labels.items())),
+                    c.taints,
+                    tuple(sorted(c.allocatable.items())),
+                    tuple(sorted(c.available.items())),
+                    c.api_resources,
+                )
+                for c in clusters
+            ),
+            scalar_resources,
+        )
+
+    def _cached_view(self, units, clusters) -> ClusterView:
+        """Reuse the per-tick cluster tensors (and the tie-break hash cache,
+        which is the expensive part) while cluster state is unchanged."""
+        scalars = tuple(
+            sorted(
+                {
+                    r
+                    for su in units
+                    for r in su.resource_request
+                    if r not in ("cpu", "memory", "ephemeral-storage")
+                }
+            )
+        )
+        fp = self._cluster_fingerprint(clusters, scalars)
+        cached_fp, cached_view = self._view_cache
+        if cached_fp == fp and cached_view is not None:
+            return cached_view
+        from kubeadmiral_tpu.scheduler.featurize import _build_cluster_view
+
+        view = _build_cluster_view(clusters, units)
+        # Tie-break hashes depend only on the cluster-name list, which
+        # changes far less often than resource usage: carry the FNV cache
+        # across view rebuilds so steady-state resource updates don't
+        # re-hash every (object, cluster) pair.
+        if cached_view is not None and cached_view.names == view.names:
+            view._tiebreak_cache = cached_view._tiebreak_cache
+        self._view_cache = (fp, view)
+        return view
+
+    def _bucket(self, n: int) -> int:
+        """Next power-of-two bucket (caps recompiles at log2 distinct B)."""
+        return _pow2_bucket(n, self.min_bucket, self.chunk_size)
+
+    def schedule(
+        self,
+        units: Sequence[T.SchedulingUnit],
+        clusters: Sequence[T.ClusterState],
+        view: Optional[ClusterView] = None,
+    ) -> list[ScheduleResult]:
+        units = list(units)
+        if not units:
+            return []
+        if view is None:
+            view = self._cached_view(units, clusters)
+        results: list[ScheduleResult] = []
+        for start in range(0, len(units), self.chunk_size):
+            chunk = units[start : start + self.chunk_size]
+            fb = featurize(chunk, clusters, view=view)
+            padded = _pad_batch(fb.inputs, self._bucket(len(chunk)))
+            n_clusters = padded.cluster_valid.shape[0]
+            padded = _pad_clusters(
+                padded, _pow2_bucket(n_clusters, self.min_cluster_bucket, 1 << 30)
+            )
+            out = schedule_tick(padded)
+            selected = np.asarray(out.selected)[: len(chunk)]
+            replicas = np.asarray(out.replicas)[: len(chunk)]
+            counted = np.asarray(out.counted)[: len(chunk)]
+            names = fb.view.names
+            # Vectorized decode: one nonzero over the whole chunk.
+            rows, cols = np.nonzero(selected)
+            reps_sel = replicas[rows, cols]
+            counted_sel = counted[rows, cols]
+            placed_lists: list[dict[str, Optional[int]]] = [dict() for _ in chunk]
+            for r, c, reps, has_count in zip(
+                rows.tolist(), cols.tolist(), reps_sel.tolist(), counted_sel.tolist()
+            ):
+                placed_lists[r][names[c]] = reps if has_count else DUPLICATE
+            results.extend(ScheduleResult(clusters=p) for p in placed_lists)
+        return results

@@ -1,0 +1,221 @@
+"""In-memory apiserver with watch semantics — the test/bench cluster farm.
+
+Plays the role KWOK clusters play in the reference's e2e suite
+(reference: test/e2e/framework/clusterprovider/kwokprovider.go): a cheap
+stand-in for a real apiserver that preserves the semantics the control
+plane depends on — optimistic concurrency via resourceVersion, finalizer-
+gated deletion with deletionTimestamp, generation bumps on spec changes,
+label-selector lists, and synchronous ADDED/MODIFIED/DELETED watch events.
+
+Objects are unstructured dicts ({apiVersion, kind, metadata, spec, ...});
+resources are addressed by a plural-ish resource key like
+"apps/v1/deployments" (helpers in models.ftc derive these from type
+configs).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Iterable, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+Handler = Callable[[str, dict], None]
+
+
+class Conflict(Exception):
+    """resourceVersion mismatch on update (optimistic concurrency)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+def obj_key(obj: dict) -> str:
+    meta = obj.get("metadata", {})
+    ns = meta.get("namespace", "")
+    return f"{ns}/{meta['name']}" if ns else meta["name"]
+
+
+def split_key(key: str) -> tuple[str, str]:
+    if "/" in key:
+        ns, name = key.split("/", 1)
+        return ns, name
+    return "", key
+
+
+class FakeKube:
+    """One apiserver (host or member cluster)."""
+
+    def __init__(self, name: str = "host"):
+        self.name = name
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict[str, dict]] = {}  # resource -> key -> obj
+        self._watchers: dict[str, list[Handler]] = {}
+        self._rv = 0
+
+    # -- helpers ---------------------------------------------------------
+    def _bump(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _store(self, resource: str) -> dict[str, dict]:
+        return self._objects.setdefault(resource, {})
+
+    def _notify(self, resource: str, event: str, obj: dict) -> None:
+        for handler in list(self._watchers.get(resource, ())) + list(
+            self._watchers.get("*", ())
+        ):
+            handler(event, copy.deepcopy(obj))
+
+    # -- CRUD ------------------------------------------------------------
+    def create(self, resource: str, obj: dict) -> dict:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            key = obj_key(obj)
+            store = self._store(resource)
+            if key in store:
+                raise AlreadyExists(f"{resource} {key}")
+            meta["resourceVersion"] = self._bump()
+            meta.setdefault("generation", 1)
+            meta.setdefault("uid", f"{self.name}-{resource}-{key}-{self._rv}")
+            store[key] = obj
+            self._notify(resource, ADDED, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, resource: str, key: str) -> dict:
+        with self._lock:
+            store = self._store(resource)
+            if key not in store:
+                raise NotFound(f"{resource} {key} in {self.name}")
+            return copy.deepcopy(store[key])
+
+    def try_get(self, resource: str, key: str) -> Optional[dict]:
+        try:
+            return self.get(resource, key)
+        except NotFound:
+            return None
+
+    def update(self, resource: str, obj: dict) -> dict:
+        """Full-object update with optimistic concurrency; removing the
+        last finalizer of a deleting object completes the deletion."""
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            key = obj_key(obj)
+            store = self._store(resource)
+            if key not in store:
+                raise NotFound(f"{resource} {key} in {self.name}")
+            old = store[key]
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != old["metadata"]["resourceVersion"]:
+                raise Conflict(f"{resource} {key}: {sent_rv} != {old['metadata']['resourceVersion']}")
+            meta = obj.setdefault("metadata", {})
+            meta["uid"] = old["metadata"].get("uid")
+            meta["resourceVersion"] = self._bump()
+            old_gen = old["metadata"].get("generation", 1)
+            spec_changed = obj.get("spec") != old.get("spec")
+            meta["generation"] = old_gen + 1 if spec_changed else old_gen
+            if old["metadata"].get("deletionTimestamp"):
+                meta.setdefault("deletionTimestamp", old["metadata"]["deletionTimestamp"])
+                if not meta.get("finalizers"):
+                    del store[key]
+                    self._notify(resource, DELETED, obj)
+                    return copy.deepcopy(obj)
+            store[key] = obj
+            self._notify(resource, MODIFIED, obj)
+            return copy.deepcopy(obj)
+
+    def update_status(self, resource: str, obj: dict) -> dict:
+        """Status-subresource style update: only .status is applied."""
+        with self._lock:
+            key = obj_key(obj)
+            store = self._store(resource)
+            if key not in store:
+                raise NotFound(f"{resource} {key} in {self.name}")
+            cur = copy.deepcopy(store[key])
+            cur["status"] = copy.deepcopy(obj.get("status"))
+            cur["metadata"]["resourceVersion"] = self._bump()
+            store[key] = cur
+            self._notify(resource, MODIFIED, cur)
+            return copy.deepcopy(cur)
+
+    def delete(self, resource: str, key: str) -> None:
+        with self._lock:
+            store = self._store(resource)
+            if key not in store:
+                raise NotFound(f"{resource} {key} in {self.name}")
+            obj = store[key]
+            if obj["metadata"].get("finalizers"):
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = "now"
+                    obj["metadata"]["resourceVersion"] = self._bump()
+                    self._notify(resource, MODIFIED, obj)
+                return
+            del store[key]
+            self._notify(resource, DELETED, obj)
+
+    def list(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[dict]:
+        with self._lock:
+            out = []
+            for key, obj in self._store(resource).items():
+                if namespace is not None:
+                    if obj["metadata"].get("namespace", "") != namespace:
+                        continue
+                if label_selector:
+                    labels = obj["metadata"].get("labels", {})
+                    if any(labels.get(k) != v for k, v in label_selector.items()):
+                        continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def keys(self, resource: str) -> list[str]:
+        with self._lock:
+            return list(self._store(resource))
+
+    # -- watch -----------------------------------------------------------
+    def watch(self, resource: str, handler: Handler, replay: bool = True) -> None:
+        """Register a handler; with replay, existing objects are delivered
+        as ADDED first (LIST+WATCH)."""
+        with self._lock:
+            self._watchers.setdefault(resource, []).append(handler)
+            if replay:
+                for obj in self._store(resource).values():
+                    handler(ADDED, copy.deepcopy(obj))
+
+    def unwatch(self, resource: str, handler: Handler) -> None:
+        with self._lock:
+            handlers = self._watchers.get(resource, [])
+            if handler in handlers:
+                handlers.remove(handler)
+
+
+class ClusterFleet:
+    """Host + member apiservers — the FederatedClientFactory analogue
+    (reference: pkg/controllers/util/federatedclient/client.go)."""
+
+    def __init__(self):
+        self.host = FakeKube("host")
+        self.members: dict[str, FakeKube] = {}
+
+    def add_member(self, name: str) -> FakeKube:
+        kube = FakeKube(name)
+        self.members[name] = kube
+        return kube
+
+    def member(self, name: str) -> FakeKube:
+        if name not in self.members:
+            raise NotFound(f"cluster {name}")
+        return self.members[name]

@@ -1,0 +1,44 @@
+"""Dotted-path access into unstructured objects.
+
+The FTC pathDefinition addresses replicas/status fields with dotted
+paths like "spec.replicas" (reference: unstructured helpers in
+pkg/controllers/util and types_federatedtypeconfig.go pathDefinition).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def get_path(obj: dict, path: str, default: Any = None) -> Any:
+    if not path:
+        return default
+    cur: Any = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def set_path(obj: dict, path: str, value: Any) -> None:
+    parts = path.split(".")
+    cur = obj
+    for part in parts[:-1]:
+        nxt = cur.get(part)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[part] = nxt
+        cur = nxt
+    cur[parts[-1]] = value
+
+
+def delete_path(obj: dict, path: str) -> None:
+    parts = path.split(".")
+    cur: Optional[dict] = obj
+    for part in parts[:-1]:
+        if not isinstance(cur, dict):
+            return
+        cur = cur.get(part)  # type: ignore[assignment]
+    if isinstance(cur, dict):
+        cur.pop(parts[-1], None)

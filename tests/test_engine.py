@@ -1,0 +1,228 @@
+"""End-to-end scheduler engine tests at the API-object level."""
+
+import numpy as np
+
+from kubeadmiral_tpu.models.types import (
+    AutoMigrationSpec,
+    ClusterAffinity,
+    ClusterState,
+    MODE_DIVIDE,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
+    SelectorTerm,
+    SchedulingUnit,
+    Taint,
+    Toleration,
+    parse_resources,
+)
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+GVK = "apps/v1/Deployment"
+
+
+def mk_cluster(name, cpu="100", mem="100Gi", cpu_free=None, mem_free=None, **kw):
+    alloc = parse_resources({"cpu": cpu, "memory": mem})
+    avail = parse_resources(
+        {"cpu": cpu_free if cpu_free is not None else cpu,
+         "memory": mem_free if mem_free is not None else mem}
+    )
+    return ClusterState(
+        name=name,
+        allocatable=alloc,
+        available=avail,
+        api_resources=frozenset({GVK}),
+        **kw,
+    )
+
+
+def mk_unit(name, **kw):
+    kw.setdefault("gvk", GVK)
+    kw.setdefault("namespace", "default")
+    return SchedulingUnit(name=name, **kw)
+
+
+ENGINE = SchedulerEngine()
+
+
+def test_duplicate_mode_selects_all_feasible():
+    clusters = [mk_cluster("a"), mk_cluster("b"), mk_cluster("c")]
+    [res] = ENGINE.schedule([mk_unit("web")], clusters)
+    assert res.clusters == {"a": None, "b": None, "c": None}
+
+
+def test_placement_filter():
+    clusters = [mk_cluster("a"), mk_cluster("b"), mk_cluster("c")]
+    [res] = ENGINE.schedule(
+        [mk_unit("web", cluster_names=frozenset({"a", "c"}))], clusters
+    )
+    assert res.cluster_set == {"a", "c"}
+
+
+def test_api_resources_filter():
+    missing = mk_cluster("b")
+    missing.api_resources = frozenset({"batch/v1/Job"})
+    [res] = ENGINE.schedule([mk_unit("web")], [mk_cluster("a"), missing])
+    assert res.cluster_set == {"a"}
+
+
+def test_taints_and_tolerations():
+    tainted = mk_cluster("b", taints=(Taint("dedicated", "infra", "NoSchedule"),))
+    clusters = [mk_cluster("a"), tainted]
+    [plain] = ENGINE.schedule([mk_unit("web")], clusters)
+    assert plain.cluster_set == {"a"}
+    [tolerant] = ENGINE.schedule(
+        [mk_unit("web", tolerations=(Toleration(key="dedicated", operator="Exists"),))],
+        clusters,
+    )
+    assert tolerant.cluster_set == {"a", "b"}
+
+
+def test_required_affinity():
+    eu = mk_cluster("eu-1", labels={"region": "eu"})
+    us = mk_cluster("us-1", labels={"region": "us"})
+    aff = ClusterAffinity(
+        required=(
+            SelectorTerm(
+                match_expressions=(SelectorRequirement("region", "In", ("eu",)),)
+            ),
+        )
+    )
+    [res] = ENGINE.schedule([mk_unit("web", affinity=aff)], [eu, us])
+    assert res.cluster_set == {"eu-1"}
+
+
+def test_preferred_affinity_orders_selection():
+    fast = mk_cluster("fast", labels={"tier": "gold"})
+    slow = mk_cluster("slow", labels={"tier": "bronze"})
+    aff = ClusterAffinity(
+        preferred=(
+            PreferredSchedulingTerm(
+                weight=50,
+                preference=SelectorTerm(
+                    match_expressions=(SelectorRequirement("tier", "In", ("gold",)),)
+                ),
+            ),
+        )
+    )
+    [res] = ENGINE.schedule(
+        [mk_unit("web", affinity=aff, max_clusters=1)], [slow, fast]
+    )
+    assert res.cluster_set == {"fast"}
+
+
+def test_resource_fit():
+    small = mk_cluster("small", cpu="1", mem="1Gi")
+    big = mk_cluster("big", cpu="64", mem="256Gi")
+    su = mk_unit(
+        "heavy", resource_request=parse_resources({"cpu": "8", "memory": "32Gi"})
+    )
+    [res] = ENGINE.schedule([su], [small, big])
+    assert res.cluster_set == {"big"}
+
+
+def test_divide_static_weights():
+    clusters = [mk_cluster("a"), mk_cluster("b")]
+    su = mk_unit(
+        "api",
+        scheduling_mode=MODE_DIVIDE,
+        desired_replicas=10,
+        weights={"a": 3, "b": 1},
+        avoid_disruption=False,
+    )
+    [res] = ENGINE.schedule([su], clusters)
+    assert sum(res.clusters.values()) == 10
+    assert res.clusters["a"] > res.clusters["b"]
+
+
+def test_divide_dynamic_weights_follow_available_cpu():
+    # b has far more free CPU; dynamic weights should favor it.
+    a = mk_cluster("a", cpu="100", cpu_free="10")
+    b = mk_cluster("b", cpu="100", cpu_free="90")
+    su = mk_unit(
+        "api",
+        scheduling_mode=MODE_DIVIDE,
+        desired_replicas=10,
+        avoid_disruption=False,
+    )
+    [res] = ENGINE.schedule([su], [a, b])
+    assert sum(res.clusters.values()) == 10
+    assert res.clusters.get("b", 0) > res.clusters.get("a", 0)
+
+
+def test_sticky_cluster_short_circuits():
+    clusters = [mk_cluster("a"), mk_cluster("b")]
+    su = mk_unit(
+        "db",
+        sticky_cluster=True,
+        current_clusters={"a": 5},
+        scheduling_mode=MODE_DIVIDE,
+        desired_replicas=9,
+    )
+    [res] = ENGINE.schedule([su], clusters)
+    assert res.clusters == {"a": 5}
+
+
+def test_automigration_capacity_spills_replicas():
+    clusters = [mk_cluster("a"), mk_cluster("b")]
+    su = mk_unit(
+        "api",
+        scheduling_mode=MODE_DIVIDE,
+        desired_replicas=10,
+        weights={"a": 1000, "b": 1},
+        avoid_disruption=False,
+        auto_migration=AutoMigrationSpec(estimated_capacity={"a": 3}),
+    )
+    [res] = ENGINE.schedule([su], clusters)
+    # a is capped at 3; the rest lands on b. keep_unschedulable defaults to
+    # False but avoid_disruption=False forces keep, so the overflow stays
+    # attached to a as "nice to have" replicas.
+    assert res.clusters["b"] >= 7
+    assert res.clusters["a"] >= 3
+
+
+def test_chunking_large_batch():
+    clusters = [mk_cluster(f"c{i}") for i in range(7)]
+    engine = SchedulerEngine(chunk_size=16, min_bucket=8)
+    units = [
+        mk_unit(
+            f"obj-{i}",
+            scheduling_mode=MODE_DIVIDE,
+            desired_replicas=i % 13,
+            avoid_disruption=False,
+        )
+        for i in range(50)
+    ]
+    results = engine.schedule(units, clusters)
+    assert len(results) == 50
+    for i, res in enumerate(results):
+        assert sum(res.clusters.values()) == i % 13
+
+
+def test_empty_inputs():
+    assert ENGINE.schedule([], [mk_cluster("a")]) == []
+    [res] = ENGINE.schedule([mk_unit("web")], [])
+    assert res.clusters == {}
+
+
+def test_dynamic_weight_total_overflow_rejected():
+    import pytest
+
+    clusters = [mk_cluster("a"), mk_cluster("b")]
+    su = mk_unit(
+        "huge", scheduling_mode=MODE_DIVIDE, desired_replicas=5_000_000,
+        avoid_disruption=False,
+    )
+    with pytest.raises(OverflowError):
+        ENGINE.schedule([su], clusters)
+
+
+def test_divide_negative_current_nil_entry_counted_correctly():
+    # A sticky object whose current entries are nil keeps nil (None) in the
+    # result rather than a fake count.
+    clusters = [mk_cluster("a")]
+    su = mk_unit(
+        "st", sticky_cluster=True, current_clusters={"a": None},
+        scheduling_mode=MODE_DIVIDE, desired_replicas=4,
+    )
+    [res] = ENGINE.schedule([su], clusters)
+    assert res.clusters == {"a": None}

@@ -1,0 +1,165 @@
+"""Full-stack differential test: engine (featurize + XLA tick) vs the
+sequential per-object baseline over randomized API objects."""
+
+import numpy as np
+
+from kubeadmiral_tpu.bench_support import sequential_schedule
+from kubeadmiral_tpu.models.types import (
+    AutoMigrationSpec,
+    ClusterAffinity,
+    ClusterState,
+    MODE_DIVIDE,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
+    SelectorTerm,
+    SchedulingUnit,
+    Taint,
+    Toleration,
+    parse_resources,
+)
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+GVKS = ("apps/v1/Deployment", "batch/v1/Job")
+REGIONS = ("us", "eu", "ap")
+
+
+def random_cluster(rng, j):
+    taints = []
+    if rng.random() < 0.25:
+        taints.append(
+            Taint("dedicated", str(rng.choice(["infra", "batch"])), "NoSchedule")
+        )
+    if rng.random() < 0.15:
+        taints.append(Taint("maint", "", "PreferNoSchedule"))
+    if rng.random() < 0.1:
+        taints.append(Taint("evict", "", "NoExecute"))
+    cpu = int(rng.integers(1, 64))
+    free = float(rng.uniform(0, 1))
+    return ClusterState(
+        name=f"m-{j:03d}",
+        labels={"region": str(rng.choice(REGIONS)), "idx": str(j % 5)},
+        taints=tuple(taints),
+        allocatable=parse_resources({"cpu": cpu, "memory": f"{cpu * 4}Gi"}),
+        available=parse_resources(
+            {"cpu": f"{int(cpu * free * 1000)}m", "memory": f"{int(cpu * 4 * free)}Gi"}
+        ),
+        api_resources=frozenset(GVKS if j % 7 else GVKS[:1]),
+    )
+
+
+def random_unit(rng, i, cluster_names):
+    affinity = None
+    if rng.random() < 0.4:
+        required = None
+        if rng.random() < 0.6:
+            required = (
+                SelectorTerm(
+                    match_expressions=(
+                        SelectorRequirement(
+                            "region", "In", tuple(rng.choice(REGIONS, 2).tolist())
+                        ),
+                    )
+                ),
+            )
+        preferred = ()
+        if rng.random() < 0.6:
+            preferred = (
+                PreferredSchedulingTerm(
+                    weight=int(rng.integers(1, 100)),
+                    preference=SelectorTerm(
+                        match_expressions=(
+                            SelectorRequirement("idx", "NotIn", ("0", "3")),
+                        )
+                    ),
+                ),
+            )
+        affinity = ClusterAffinity(required=required, preferred=preferred)
+
+    tolerations = []
+    if rng.random() < 0.5:
+        tolerations.append(Toleration(key="dedicated", operator="Exists"))
+    if rng.random() < 0.3:
+        tolerations.append(Toleration(key="maint", operator="Exists"))
+    if rng.random() < 0.2:
+        tolerations.append(Toleration())  # tolerate-nothing-specific corner
+
+    current = {}
+    if rng.random() < 0.4:
+        for n in rng.choice(cluster_names, rng.integers(1, 4), replace=False):
+            current[str(n)] = None if rng.random() < 0.3 else int(rng.integers(0, 9))
+
+    divide = rng.random() < 0.7
+    weights = {}
+    if divide and rng.random() < 0.5:
+        for n in cluster_names:
+            if rng.random() < 0.7:
+                weights[n] = int(rng.integers(0, 30))
+
+    auto = None
+    if rng.random() < 0.3:
+        auto = AutoMigrationSpec(
+            keep_unschedulable_replicas=bool(rng.random() < 0.5),
+            estimated_capacity={
+                str(n): int(rng.integers(0, 12))
+                for n in rng.choice(cluster_names, 2, replace=False)
+            },
+        )
+
+    return SchedulingUnit(
+        gvk=GVKS[i % 2],
+        namespace=f"ns-{i % 5}",
+        name=f"wl-{i}",
+        scheduling_mode=MODE_DIVIDE if divide else "Duplicate",
+        desired_replicas=int(rng.integers(0, 60)) if divide else None,
+        resource_request=parse_resources(
+            {"cpu": f"{int(rng.integers(0, 6)) * 500}m", "memory": f"{int(rng.integers(0, 6))}Gi"}
+        )
+        if rng.random() < 0.8
+        else {},
+        cluster_selector={"region": str(rng.choice(REGIONS))}
+        if rng.random() < 0.2
+        else {},
+        cluster_names=frozenset(
+            str(n) for n in rng.choice(cluster_names, 5, replace=False)
+        )
+        if rng.random() < 0.3
+        else frozenset(),
+        affinity=affinity,
+        tolerations=tuple(tolerations),
+        max_clusters=int(rng.integers(0, 9)) if rng.random() < 0.3 else None,
+        min_replicas={
+            str(n): int(rng.integers(0, 5))
+            for n in rng.choice(cluster_names, 2, replace=False)
+        }
+        if rng.random() < 0.25
+        else {},
+        max_replicas={
+            str(n): int(rng.integers(0, 15))
+            for n in rng.choice(cluster_names, 2, replace=False)
+        }
+        if rng.random() < 0.25
+        else {},
+        weights=weights,
+        sticky_cluster=bool(rng.random() < 0.15),
+        avoid_disruption=bool(rng.random() < 0.5),
+        current_clusters=current,
+        auto_migration=auto,
+    )
+
+
+def test_engine_matches_sequential_reference():
+    rng = np.random.default_rng(424242)
+    clusters = [random_cluster(rng, j) for j in range(24)]
+    names = [c.name for c in clusters]
+    units = [random_unit(rng, i, names) for i in range(120)]
+
+    engine = SchedulerEngine(chunk_size=64, min_bucket=32, min_cluster_bucket=8)
+    got = engine.schedule(units, clusters)
+    want = sequential_schedule(units, clusters)
+
+    for i, (g, w) in enumerate(zip(got, want)):
+        w_named = {names[j]: reps for j, reps in w.items()}
+        assert g.clusters == w_named, (
+            f"object {i} ({units[i].name}): engine={g.clusters} "
+            f"sequential={w_named}\nunit={units[i]}"
+        )

@@ -1,0 +1,56 @@
+import pytest
+
+from kubeadmiral_tpu.utils.jsonpatch import PatchError, apply_patch
+
+
+def test_add_replace_remove_dict():
+    doc = {"spec": {"replicas": 1}}
+    out = apply_patch(doc, [
+        {"op": "replace", "path": "/spec/replicas", "value": 5},
+        {"op": "add", "path": "/spec/paused", "value": True},
+        {"op": "remove", "path": "/spec/paused"},
+    ])
+    assert out == {"spec": {"replicas": 5}}
+    assert doc == {"spec": {"replicas": 1}}  # input untouched
+
+
+def test_replace_array_element_overwrites():
+    doc = {"containers": [{"name": "a"}, {"name": "b"}]}
+    out = apply_patch(doc, [{"op": "replace", "path": "/containers/0", "value": {"name": "X"}}])
+    assert out == {"containers": [{"name": "X"}, {"name": "b"}]}
+
+
+def test_add_array_inserts_and_appends():
+    doc = {"xs": [1, 3]}
+    out = apply_patch(doc, [
+        {"op": "add", "path": "/xs/1", "value": 2},
+        {"op": "add", "path": "/xs/-", "value": 4},
+    ])
+    assert out == {"xs": [1, 2, 3, 4]}
+
+
+def test_move_copy_test_ops():
+    doc = {"a": {"x": 1}, "b": {}}
+    out = apply_patch(doc, [
+        {"op": "copy", "from": "/a/x", "path": "/b/y"},
+        {"op": "move", "from": "/a/x", "path": "/b/z"},
+        {"op": "test", "path": "/b/y", "value": 1},
+    ])
+    assert out == {"a": {}, "b": {"y": 1, "z": 1}}
+
+
+def test_escaping():
+    doc = {"a/b": {"c~d": 1}}
+    out = apply_patch(doc, [{"op": "replace", "path": "/a~1b/c~0d", "value": 2}])
+    assert out == {"a/b": {"c~d": 2}}
+
+
+def test_errors():
+    with pytest.raises(PatchError):
+        apply_patch({}, [{"op": "replace", "path": "/missing", "value": 1}])
+    with pytest.raises(PatchError):
+        apply_patch({}, [{"op": "nope", "path": "/x"}])
+    with pytest.raises(PatchError):
+        apply_patch({"xs": [1]}, [{"op": "add", "path": "/xs/9", "value": 1}])
+    with pytest.raises(PatchError):
+        apply_patch({"a": 1}, [{"op": "test", "path": "/a", "value": 2}])

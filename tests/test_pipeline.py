@@ -1,0 +1,176 @@
+"""Differential tests: fused schedule_tick vs the per-object oracle."""
+
+import numpy as np
+import pytest
+
+from kubeadmiral_tpu.ops import pipeline as dev
+from kubeadmiral_tpu.ops.pipeline_oracle import NIL, OracleProblem, schedule_one
+from kubeadmiral_tpu.ops.planner import INT32_INF
+from kubeadmiral_tpu.utils.hashing import fnv32_batch, uint32_to_sortable_int32
+
+INF = int(INT32_INF)
+R = 4  # cpu, mem, 2 scalar resources
+
+
+def random_problem(rng, c, key, names):
+    divide = bool(rng.random() < 0.7)
+    current_n = int(rng.integers(0, c + 1)) if rng.random() < 0.5 else 0
+    current_idx = rng.choice(c, size=current_n, replace=False) if current_n else []
+    current = {}
+    for idx in current_idx:
+        current[int(idx)] = None if rng.random() < 0.3 else int(rng.integers(0, 10))
+
+    static_weights = None
+    if rng.random() < 0.5:
+        static_weights = {
+            int(j): int(rng.integers(0, 20)) for j in range(c) if rng.random() < 0.8
+        }
+
+    maxc = None
+    roll = rng.random()
+    if roll < 0.25:
+        maxc = int(rng.integers(0, c + 2))
+    elif roll < 0.3:
+        maxc = -1
+
+    return OracleProblem(
+        n_clusters=c,
+        filter_enabled=[bool(rng.random() < 0.8) for _ in range(5)],
+        score_enabled=[bool(rng.random() < 0.8) for _ in range(5)],
+        api_ok=[bool(rng.random() < 0.9) for _ in range(c)],
+        taint_ok_new=[bool(rng.random() < 0.85) for _ in range(c)],
+        taint_ok_cur=[bool(rng.random() < 0.95) for _ in range(c)],
+        selector_ok=[bool(rng.random() < 0.9) for _ in range(c)],
+        placement_ok=[bool(rng.random() < 0.7) for _ in range(c)],
+        placement_has=bool(rng.random() < 0.4),
+        request=[int(x) for x in rng.integers(0, 8, R)]
+        if rng.random() < 0.8
+        else [0] * R,
+        alloc=[[int(x) for x in rng.integers(5, 50, R)] for _ in range(c)],
+        used=[[int(x) for x in rng.integers(0, 40, R)] for _ in range(c)],
+        taint_counts=[int(x) for x in rng.integers(0, 4, c)],
+        affinity_scores=[int(x) for x in rng.integers(0, 60, c)],
+        max_clusters=maxc,
+        mode_divide=divide,
+        sticky=bool(rng.random() < 0.15),
+        current=current,
+        total=int(rng.integers(0, 30)),
+        weights=static_weights,
+        min_replicas={
+            int(j): int(rng.integers(0, 4)) for j in range(c) if rng.random() < 0.2
+        },
+        max_replicas={
+            int(j): int(rng.integers(0, 10)) for j in range(c) if rng.random() < 0.2
+        },
+        capacity={
+            int(j): int(rng.integers(0, 8)) for j in range(c) if rng.random() < 0.2
+        },
+        keep_unschedulable=bool(rng.random() < 0.5),
+        avoid_disruption=bool(rng.random() < 0.5),
+        cluster_names=names,
+        key=key,
+        cpu_alloc=[int(x) for x in rng.integers(0, 30, c)],
+        cpu_avail=[int(x) for x in rng.integers(-3, 25, c)],
+    )
+
+
+def to_tick_inputs(problems, c):
+    b = len(problems)
+    names = problems[0].cluster_names
+
+    def grid(get, dtype, fill=0):
+        out = np.full((b, c), fill, dtype=dtype)
+        for i, p in enumerate(problems):
+            row = get(p)
+            for j, v in row.items() if isinstance(row, dict) else enumerate(row):
+                out[i, j] = v
+        return out
+
+    tiebreak = np.stack(
+        [
+            uint32_to_sortable_int32(fnv32_batch(names, p.key)).astype(np.int32)
+            for p in problems
+        ]
+    )
+    current_mask = np.zeros((b, c), bool)
+    current_replicas = np.full((b, c), dev.NIL_REPLICAS, np.int64)
+    for i, p in enumerate(problems):
+        for j, v in p.current.items():
+            current_mask[i, j] = True
+            current_replicas[i, j] = dev.NIL_REPLICAS if v is None else v
+
+    weights_given = np.array([p.weights is not None for p in problems])
+    weights = grid(lambda p: p.weights or {}, np.int32)
+
+    return dev.TickInputs(
+        filter_enabled=np.array([p.filter_enabled for p in problems]),
+        api_ok=grid(lambda p: p.api_ok, bool),
+        taint_ok_new=grid(lambda p: p.taint_ok_new, bool),
+        taint_ok_cur=grid(lambda p: p.taint_ok_cur, bool),
+        selector_ok=grid(lambda p: p.selector_ok, bool),
+        placement_has=np.array([p.placement_has for p in problems]),
+        placement_ok=grid(lambda p: p.placement_ok, bool),
+        request=np.array([p.request for p in problems], np.int64),
+        alloc=np.array(problems[0].alloc, np.int64),
+        used=np.array(problems[0].used, np.int64),
+        score_enabled=np.array([p.score_enabled for p in problems]),
+        taint_counts=grid(lambda p: p.taint_counts, np.int64),
+        affinity_scores=grid(lambda p: p.affinity_scores, np.int64),
+        max_clusters=np.array(
+            [INF if p.max_clusters is None else p.max_clusters for p in problems],
+            np.int32,
+        ),
+        mode_divide=np.array([p.mode_divide for p in problems]),
+        sticky=np.array([p.sticky for p in problems]),
+        current_mask=current_mask,
+        current_replicas=current_replicas,
+        total=np.array([p.total for p in problems], np.int32),
+        weights_given=weights_given,
+        weights=weights,
+        min_replicas=grid(lambda p: p.min_replicas, np.int32),
+        max_replicas=grid(lambda p: p.max_replicas, np.int32, INF),
+        scale_max=grid(lambda p: p.max_replicas, np.int32, INF),
+        capacity=grid(lambda p: p.capacity, np.int32, INF),
+        keep_unschedulable=np.array([p.keep_unschedulable for p in problems]),
+        avoid_disruption=np.array([p.avoid_disruption for p in problems]),
+        tiebreak=tiebreak,
+        cpu_alloc=np.array(problems[0].cpu_alloc, np.int64),
+        cpu_avail=np.array(problems[0].cpu_avail, np.int64),
+        cluster_valid=np.ones(c, bool),
+    )
+
+
+@pytest.mark.parametrize("c", [3, 8, 19])
+def test_tick_matches_oracle(c):
+    rng = np.random.default_rng(99 + c)
+    names = [f"member-{j}" for j in range(c)]
+    problems = []
+    # Cluster-level state is shared across the batch (as in a real tick).
+    shared_alloc = [[int(x) for x in rng.integers(5, 50, R)] for _ in range(c)]
+    shared_used = [[int(x) for x in rng.integers(0, 40, R)] for _ in range(c)]
+    shared_cpu_a = [int(x) for x in rng.integers(0, 30, c)]
+    shared_cpu_v = [int(x) for x in rng.integers(-3, 25, c)]
+    for i in range(80):
+        p = random_problem(rng, c, f"ns-{i}/workload-{i}", names)
+        p.alloc, p.used = shared_alloc, shared_used
+        p.cpu_alloc, p.cpu_avail = shared_cpu_a, shared_cpu_v
+        problems.append(p)
+
+    out = dev.schedule_tick(to_tick_inputs(problems, c))
+    selected = np.asarray(out.selected)
+    replicas = np.asarray(out.replicas)
+
+    for i, p in enumerate(problems):
+        want = schedule_one(p)
+        got_idx = set(np.nonzero(selected[i])[0].tolist())
+        assert got_idx == set(want.keys()), (
+            f"case {i}: selected {sorted(got_idx)} != {sorted(want)}\n{p}\n"
+            f"scores={np.asarray(out.scores)[i]} feasible={np.asarray(out.feasible)[i]}"
+        )
+        for j in got_idx:
+            w = want[j]
+            g = int(replicas[i, j])
+            if w is None:
+                assert g == NIL, f"case {i} cluster {j}: {g} != nil\n{p}"
+            else:
+                assert g == w, f"case {i} cluster {j}: {g} != {w}\n{p}\n{want}"

@@ -1,0 +1,230 @@
+"""Runtime kernel tests: queues, workers, pending pipeline, informers, fakekube."""
+
+import threading
+import time
+
+import pytest
+
+from kubeadmiral_tpu.runtime.informer import FederatedInformer, Informer
+from kubeadmiral_tpu.runtime.pending import (
+    dependencies_fulfilled,
+    get_pending,
+    set_pending,
+    update_pending,
+)
+from kubeadmiral_tpu.runtime.queue import Backoff, DirtyQueue
+from kubeadmiral_tpu.runtime.worker import BatchWorker, Result, Worker
+from kubeadmiral_tpu.testing.fakekube import (
+    ADDED,
+    Conflict,
+    DELETED,
+    MODIFIED,
+    ClusterFleet,
+    FakeKube,
+    NotFound,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_dirty_queue_dedups_and_delays():
+    clock = FakeClock()
+    q = DirtyQueue(clock)
+    q.add("a")
+    q.add("a")
+    q.add("b", delay=5)
+    assert q.drain_due() == ["a"]
+    assert q.drain_due() == []
+    clock.now = 5
+    assert q.drain_due() == ["b"]
+
+
+def test_dirty_queue_earliest_wins():
+    clock = FakeClock()
+    q = DirtyQueue(clock)
+    q.add("a", delay=10)
+    q.add("a", delay=2)  # earlier delivery replaces the later one
+    clock.now = 2
+    assert q.drain_due() == ["a"]
+    clock.now = 10
+    assert q.drain_due() == []
+
+
+def test_backoff_doubles_and_resets():
+    b = Backoff(initial=5, maximum=60)
+    assert b.next_delay("k") == 5
+    assert b.next_delay("k") == 10
+    assert b.next_delay("k") == 20
+    b.reset("k")
+    assert b.next_delay("k") == 5
+    assert b.next_delay("other") == 5
+
+
+def test_worker_retry_uses_backoff():
+    clock = FakeClock()
+    calls = []
+
+    def reconcile(key):
+        calls.append(key)
+        return Result.retry() if len(calls) < 3 else Result.ok()
+
+    w = Worker("test", reconcile, clock=clock)
+    w.enqueue("obj")
+    assert w.step()
+    assert calls == ["obj"]
+    clock.now = 5  # first backoff delay
+    assert w.step()
+    clock.now = 15  # second backoff (10s)
+    assert w.step()
+    assert calls == ["obj", "obj", "obj"]
+    assert not w.step()
+
+
+def test_batch_worker_drains_everything_due():
+    clock = FakeClock()
+    batches = []
+
+    def tick(keys):
+        batches.append(sorted(keys))
+        return {}
+
+    w = BatchWorker("tick", tick, clock=clock)
+    for k in ("a", "b", "c"):
+        w.enqueue(k)
+    w.enqueue("later", delay=60)
+    w.step()
+    assert batches == [["a", "b", "c"]]
+    clock.now = 61
+    w.step()
+    assert batches == [["a", "b", "c"], ["later"]]
+
+
+def test_pending_controllers_pipeline():
+    obj = {"metadata": {}}
+    groups = [["scheduler"], ["override"], ["sync"]]
+    set_pending(obj, groups)
+    assert dependencies_fulfilled(obj, "scheduler")
+    assert not dependencies_fulfilled(obj, "override")
+
+    # Scheduler acts and re-arms downstream.
+    assert update_pending(obj, "scheduler", True, groups)
+    assert get_pending(obj) == [["override"], ["sync"]]
+    assert dependencies_fulfilled(obj, "override")
+
+    # Override acts without changes: removes itself only.
+    update_pending(obj, "override", False, groups)
+    assert get_pending(obj) == [["sync"]]
+    update_pending(obj, "sync", False, groups)
+    assert get_pending(obj) == []
+    assert dependencies_fulfilled(obj, "anything")
+
+
+def test_pending_missing_annotation_raises():
+    with pytest.raises(KeyError):
+        get_pending({"metadata": {}})
+
+
+def mk(ns, name, spec=None, **meta):
+    return {
+        "apiVersion": "v1",
+        "kind": "Thing",
+        "metadata": {"namespace": ns, "name": name, **meta},
+        "spec": spec or {},
+    }
+
+
+def test_fakekube_crud_and_conflict():
+    kube = FakeKube()
+    created = kube.create("things", mk("ns", "a", {"x": 1}))
+    assert created["metadata"]["resourceVersion"] == "1"
+    assert created["metadata"]["generation"] == 1
+
+    stale = dict(created, spec={"x": 2})
+    updated = kube.update("things", stale)
+    assert updated["metadata"]["generation"] == 2
+
+    with pytest.raises(Conflict):
+        kube.update("things", created)  # stale resourceVersion
+
+    with pytest.raises(NotFound):
+        kube.get("things", "ns/missing")
+
+
+def test_fakekube_finalizers_gate_deletion():
+    kube = FakeKube()
+    obj = kube.create("things", mk("ns", "a", finalizers=["keep"]))
+    events = []
+    kube.watch("things", lambda e, o: events.append(e), replay=False)
+
+    kube.delete("things", "ns/a")
+    got = kube.get("things", "ns/a")
+    assert got["metadata"]["deletionTimestamp"]
+    assert events == [MODIFIED]
+
+    got["metadata"]["finalizers"] = []
+    kube.update("things", got)
+    assert kube.try_get("things", "ns/a") is None
+    assert events == [MODIFIED, DELETED]
+
+
+def test_fakekube_list_filters():
+    kube = FakeKube()
+    kube.create("things", mk("ns1", "a", labels={"app": "x"}))
+    kube.create("things", mk("ns2", "b", labels={"app": "y"}))
+    assert len(kube.list("things")) == 2
+    assert len(kube.list("things", namespace="ns1")) == 1
+    assert len(kube.list("things", label_selector={"app": "y"})) == 1
+
+
+def test_informer_cache_and_handlers():
+    kube = FakeKube()
+    kube.create("things", mk("ns", "pre"))
+    informer = Informer(kube, "things")
+    assert informer.get("ns/pre") is not None
+
+    seen = []
+    informer.add_handler(lambda e, o: seen.append((e, o["metadata"]["name"])))
+    assert seen == [(ADDED, "pre")]
+
+    kube.create("things", mk("ns", "new"))
+    kube.delete("things", "ns/new")
+    assert (ADDED, "new") in seen and (DELETED, "new") in seen
+    assert informer.get("ns/new") is None
+
+
+def test_federated_informer_multiplexes():
+    fleet = ClusterFleet()
+    c1, c2 = fleet.add_member("c1"), fleet.add_member("c2")
+    fi = FederatedInformer("things")
+    fi.add_cluster("c1", c1)
+    fi.add_cluster("c2", c2)
+
+    c1.create("things", mk("ns", "obj"))
+    c2.create("things", mk("ns", "obj"))
+    found = fi.get_from_all("ns/obj")
+    assert set(found) == {"c1", "c2"}
+    fi.remove_cluster("c2")
+    assert set(fi.get_from_all("ns/obj")) == {"c1"}
+
+
+def test_informer_close_detaches_watch():
+    kube = FakeKube()
+    informer = Informer(kube, "things")
+    seen = []
+    informer.add_handler(lambda e, o: seen.append(e), replay=False)
+    informer.close()
+    kube.create("things", mk("ns", "after-close"))
+    assert seen == []
+    fi = FederatedInformer("things")
+    fi.add_cluster("c1", kube)
+    events = []
+    fi.add_handler(lambda cl, e, o: events.append((cl, e)))
+    fi.remove_cluster("c1")
+    kube.create("things", mk("ns", "x"))
+    assert events == []
