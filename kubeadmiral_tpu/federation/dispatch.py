@@ -1,0 +1,286 @@
+"""Per-cluster write dispatch for the sync controller.
+
+The reference fans member-cluster writes out to per-cluster goroutines
+with a shared timeout and collects a per-cluster propagation status +
+version map (reference: pkg/controllers/sync/dispatch/operation.go:102-123,
+managed.go:108-655, unmanaged.go).  Here: a bounded thread pool shared by
+a sync controller, one task per (cluster, operation), with the same
+status/version collection.
+
+Statuses mirror fedtypesv1a1.PropagationStatus values.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation import retain
+from kubeadmiral_tpu.federation.resource import (
+    FederatedResource,
+    has_managed_label,
+    is_explicitly_unmanaged,
+    object_needs_update,
+    object_version,
+)
+from kubeadmiral_tpu.testing.fakekube import (
+    AlreadyExists,
+    Conflict,
+    FakeKube,
+    NotFound,
+)
+
+# PropagationStatus values (reference: pkg/apis/types/v1alpha1/types_status.go).
+OK = "OK"
+WAITING = "Waiting"
+CLUSTER_NOT_READY = "ClusterNotReady"
+CLUSTER_TERMINATING = "ClusterTerminating"
+CACHED_RETRIEVAL_FAILED = "CachedRetrievalFailed"
+COMPUTE_RESOURCE_FAILED = "ComputeResourceFailed"
+APPLY_OVERRIDES_FAILED = "ApplyOverridesFailed"
+FIELD_RETENTION_FAILED = "FieldRetentionFailed"
+CREATION_FAILED = "CreationFailed"
+UPDATE_FAILED = "UpdateFailed"
+DELETION_FAILED = "DeletionFailed"
+ALREADY_EXISTS = "AlreadyExists"
+WAITING_FOR_REMOVAL = "WaitingForRemoval"
+DELETION_TIMED_OUT = "DeletionTimedOut"
+CREATION_TIMED_OUT = "CreationTimedOut"
+UPDATE_TIMED_OUT = "UpdateTimedOut"
+MANAGED_LABEL_FALSE = "ManagedLabelFalse"
+FINALIZER_CHECK_FAILED = "FinalizerCheckFailed"
+
+ADOPTED_ANNOTATION = C.PREFIX + "adopted"
+
+
+class ManagedDispatcher:
+    """One sync round's write fan-out (managed.go:77-126).
+
+    ``client_for_cluster`` returns the member apiserver handle; failures
+    of individual operations are recorded per cluster, never raised."""
+
+    def __init__(
+        self,
+        client_for_cluster: Callable[[str], FakeKube],
+        fed_resource: FederatedResource,
+        resource: str,
+        replicas_path: str = "",
+        skip_adopting: bool = True,
+        pool: Optional[ThreadPoolExecutor] = None,
+        timeout: float = 30.0,
+        rollout_overrides: Optional[Callable[[str], list]] = None,
+    ):
+        self.client_for_cluster = client_for_cluster
+        self.fed = fed_resource
+        self.resource = resource
+        self.replicas_path = replicas_path
+        self.skip_adopting = skip_adopting
+        self.timeout = timeout
+        self.rollout_overrides = rollout_overrides
+        self._pool = pool
+        self._own_pool = pool is None
+        self._futures: list[Future] = []
+        self._lock = threading.Lock()
+        self._status: dict[str, str] = {}
+        self._versions: dict[str, str] = {}
+        self._errors: dict[str, str] = {}
+        self._resources_updated = False
+
+    # -- bookkeeping -----------------------------------------------------
+    def record_status(self, cluster: str, status: str) -> None:
+        with self._lock:
+            self._status[cluster] = status
+
+    def record_error(self, cluster: str, status: str, err: str) -> None:
+        with self._lock:
+            self._status[cluster] = status
+            self._errors[cluster] = err
+
+    def _record_version(self, cluster: str, version: str) -> None:
+        with self._lock:
+            self._versions[cluster] = version
+            self._status[cluster] = OK
+
+    def _submit(self, fn: Callable[[], None]) -> None:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=8)
+        self._futures.append(self._pool.submit(fn))
+
+    def wait(self) -> bool:
+        """Block until every operation finishes (managed.go:126-159);
+        returns False when any cluster ended in a non-OK, non-waiting
+        state."""
+        for f in self._futures:
+            try:
+                f.result(timeout=self.timeout)
+            except Exception:  # timeout statuses were pre-recorded
+                pass
+        self._futures.clear()
+        if self._own_pool and self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        with self._lock:
+            return all(
+                s in (OK, WAITING_FOR_REMOVAL, WAITING)
+                for s in self._status.values()
+            )
+
+    @property
+    def version_map(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._versions)
+
+    @property
+    def status_map(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._status)
+
+    @property
+    def resources_updated(self) -> bool:
+        return self._resources_updated
+
+    # -- desired-object assembly ----------------------------------------
+    def _desired(self, cluster: str) -> dict:
+        obj = self.fed.object_for_cluster(cluster)
+        extra = self.rollout_overrides(cluster) if self.rollout_overrides else None
+        obj = self.fed.apply_overrides(obj, cluster, extra)
+        retain.record_propagated_keys(obj)
+        return obj
+
+    # -- operations ------------------------------------------------------
+    def create(self, cluster: str) -> None:
+        """Create, falling back to adoption-aware update on AlreadyExists
+        (managed.go:325-400)."""
+        self.record_status(cluster, CREATION_TIMED_OUT)
+
+        def run() -> None:
+            try:
+                obj = self._desired(cluster)
+            except Exception as e:
+                return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
+            client = self.client_for_cluster(cluster)
+            try:
+                created = client.create(self.resource, obj)
+                self._resources_updated = True
+                self._record_version(cluster, object_version(created))
+                return
+            except AlreadyExists:
+                pass
+            except Exception as e:
+                return self.record_error(cluster, CREATION_FAILED, str(e))
+            try:
+                existing = client.get(self.resource, self.fed.key)
+            except NotFound as e:
+                return self.record_error(cluster, CREATION_FAILED, str(e))
+            if self.skip_adopting:
+                return self.record_error(
+                    cluster, ALREADY_EXISTS, "resource pre-exists in cluster"
+                )
+            if not has_managed_label(existing):
+                existing.setdefault("metadata", {}).setdefault("annotations", {})[
+                    ADOPTED_ANNOTATION
+                ] = "true"
+            self._update_inner(cluster, existing, adopting=True)
+
+        self._submit(run)
+
+    def update(self, cluster: str, cluster_obj: dict, recorded_version: str = "") -> None:
+        self.record_status(cluster, UPDATE_TIMED_OUT)
+        self._submit(
+            lambda: self._update_inner(cluster, cluster_obj, recorded_version=recorded_version)
+        )
+
+    def _update_inner(
+        self,
+        cluster: str,
+        cluster_obj: dict,
+        recorded_version: str = "",
+        adopting: bool = False,
+    ) -> None:
+        """(managed.go:402-476): retention, version-based skip, write."""
+        if is_explicitly_unmanaged(cluster_obj):
+            return self.record_error(
+                cluster,
+                MANAGED_LABEL_FALSE,
+                f"object has label {C.MANAGED_LABEL}=false",
+            )
+        try:
+            obj = self._desired(cluster)
+        except Exception as e:
+            return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
+        if adopting:
+            ann = cluster_obj.get("metadata", {}).get("annotations", {})
+            if ann.get(ADOPTED_ANNOTATION):
+                obj.setdefault("metadata", {}).setdefault("annotations", {})[
+                    ADOPTED_ANNOTATION
+                ] = "true"
+        try:
+            retain.retain_cluster_fields(self.fed.target_kind, obj, cluster_obj)
+            retain.retain_replicas(obj, cluster_obj, self.fed.obj, self.replicas_path)
+        except Exception as e:
+            return self.record_error(cluster, FIELD_RETENTION_FAILED, str(e))
+
+        if recorded_version and not object_needs_update(
+            obj, cluster_obj, recorded_version, self.replicas_path
+        ):
+            # Current: still record the version so status reflects it.
+            self._record_version(cluster, recorded_version)
+            return
+
+        client = self.client_for_cluster(cluster)
+        try:
+            updated = client.update(self.resource, obj)
+        except (Conflict, NotFound) as e:
+            return self.record_error(cluster, UPDATE_FAILED, str(e))
+        except Exception as e:
+            return self.record_error(cluster, UPDATE_FAILED, str(e))
+        self._resources_updated = True
+        self._record_version(cluster, object_version(updated))
+
+    def delete(self, cluster: str) -> None:
+        """Delete from a member cluster (unmanaged.go Delete): the object
+        stays WAITING_FOR_REMOVAL until the member confirms it gone."""
+        self.record_status(cluster, DELETION_TIMED_OUT)
+
+        def run() -> None:
+            client = self.client_for_cluster(cluster)
+            try:
+                client.delete(self.resource, self.fed.key)
+            except NotFound:
+                with self._lock:
+                    self._status.pop(cluster, None)
+                return
+            except Exception as e:
+                return self.record_error(cluster, DELETION_FAILED, str(e))
+            self._resources_updated = True
+            if client.try_get(self.resource, self.fed.key) is None:
+                with self._lock:
+                    self._status.pop(cluster, None)
+            else:
+                self.record_status(cluster, WAITING_FOR_REMOVAL)
+
+        self._submit(run)
+
+    def remove_managed_label(self, cluster: str, cluster_obj: dict) -> None:
+        """Orphaning: strip the managed label + adopted annotation instead
+        of deleting (unmanaged.go RemoveManagedLabel)."""
+        self.record_status(cluster, UPDATE_TIMED_OUT)
+
+        def run() -> None:
+            obj = dict(cluster_obj)
+            labels = obj.get("metadata", {}).get("labels", {})
+            labels.pop(C.MANAGED_LABEL, None)
+            obj.get("metadata", {}).get("annotations", {}).pop(
+                ADOPTED_ANNOTATION, None
+            )
+            client = self.client_for_cluster(cluster)
+            try:
+                client.update(self.resource, obj)
+            except Exception as e:
+                return self.record_error(cluster, UPDATE_FAILED, str(e))
+            with self._lock:
+                self._status.pop(cluster, None)
+
+        self._submit(run)

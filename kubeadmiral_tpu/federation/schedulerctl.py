@@ -14,6 +14,8 @@ featurized into one batch and pushed through the XLA engine
 
 from __future__ import annotations
 
+import json as _json
+
 from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
@@ -112,13 +114,19 @@ class SchedulerController:
 
     def _on_policy_event(self, event: str, obj: dict) -> None:
         # Re-enqueue every federated object bound to this policy
-        # (schedulingtriggers.go enqueueFederatedObjectsForPolicy).
+        # (schedulingtriggers.go enqueueFederatedObjectsForPolicy).  Scan
+        # without deep-copying: at 100k objects a full copying LIST per
+        # policy event would stall the store.
         pname = obj["metadata"]["name"]
         pns = obj["metadata"].get("namespace", "")
-        for fed in self.host.list(self._resource):
-            key = P.matched_policy_key(fed)
-            if key == (pns, pname):
-                self.worker.enqueue(obj_key(fed))
+        matched: list[str] = []
+
+        def check(fed: dict) -> None:
+            if P.matched_policy_key(fed) == (pns, pname):
+                matched.append(obj_key(fed))
+
+        self.host.scan(self._resource, check)
+        self.worker.enqueue_all(matched)
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         # Cluster changes can change every placement
@@ -202,8 +210,6 @@ class SchedulerController:
 
         auto = None
         if policy.auto_migration_enabled:
-            import json as _json
-
             info_raw = ann.get(C.AUTO_MIGRATION_INFO)
             estimated = {}
             if info_raw:
@@ -216,8 +222,6 @@ class SchedulerController:
         sticky = ann.get(A_STICKY_CLUSTER, "").lower() == "true" or (
             A_STICKY_CLUSTER not in ann and policy.sticky_cluster
         )
-
-        import json as _json
 
         # Per-object annotation overrides of the policy's cluster set and
         # preferences (schedulingunit.go getters: placements annotation is
@@ -273,32 +277,41 @@ class SchedulerController:
         clusters = self._clusters()
 
         to_schedule: list[tuple[str, dict, P.PolicySpec, str]] = []
+        units = []
         for key in keys:
-            fed_obj = self.host.try_get(self._resource, key)
-            if fed_obj is None or fed_obj["metadata"].get("deletionTimestamp"):
-                results[key] = Result.ok()
-                continue
+            # Per-object isolation: one malformed object (bad annotation
+            # JSON, bad override value) must not poison the whole batch —
+            # it alone backs off, matching the reference's per-object
+            # worker semantics.
             try:
-                if not pending.dependencies_fulfilled(fed_obj, self.name):
+                fed_obj = self.host.try_get(self._resource, key)
+                if fed_obj is None or fed_obj["metadata"].get("deletionTimestamp"):
                     results[key] = Result.ok()
                     continue
-            except KeyError:
-                results[key] = Result.ok()  # not yet initialized by federate
-                continue
-            policy = self._policy_for(fed_obj)
-            if policy is None:
-                results[key] = Result.ok()
-                continue
-            trigger = self._trigger_hash(fed_obj, policy, clusters)
-            if fed_obj["metadata"].get("annotations", {}).get(C.SCHEDULING_TRIGGER_HASH) == trigger:
-                results[key] = Result.ok()
+                try:
+                    if not pending.dependencies_fulfilled(fed_obj, self.name):
+                        results[key] = Result.ok()
+                        continue
+                except KeyError:
+                    results[key] = Result.ok()  # not yet initialized by federate
+                    continue
+                policy = self._policy_for(fed_obj)
+                if policy is None:
+                    results[key] = Result.ok()
+                    continue
+                trigger = self._trigger_hash(fed_obj, policy, clusters)
+                if fed_obj["metadata"].get("annotations", {}).get(C.SCHEDULING_TRIGGER_HASH) == trigger:
+                    results[key] = Result.ok()
+                    continue
+                units.append(self._scheduling_unit(fed_obj, policy))
+            except Exception:
+                self.metrics.counter(f"scheduler-{self.ftc.name}.unit_errors")
+                results[key] = Result.retry()
                 continue
             to_schedule.append((key, fed_obj, policy, trigger))
 
         if not to_schedule:
             return results
-
-        units = [self._scheduling_unit(obj, pol) for _, obj, pol, _ in to_schedule]
         with self.metrics.timer(f"scheduler-{self.ftc.name}.engine_latency"):
             outcomes = self.engine.schedule(units, clusters)
         self.metrics.counter(f"scheduler-{self.ftc.name}.scheduled", len(units))

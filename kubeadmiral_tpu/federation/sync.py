@@ -1,0 +1,403 @@
+"""The sync controller: propagate federated objects to member clusters.
+
+The propagation engine (reference: pkg/controllers/sync/controller.go):
+for each federated object, compute placement ∩ joined clusters, dispatch
+parallel create/update/delete against member apiservers, record per-
+cluster propagation status and object versions, and handle deletion with
+finalizers, orphaning annotations and cluster cascading-delete.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation import dispatch as D
+from kubeadmiral_tpu.federation.resource import (
+    FederatedResource,
+    should_adopt_preexisting,
+)
+from kubeadmiral_tpu.federation.version import VersionManager
+from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.worker import Result, Worker
+from kubeadmiral_tpu.testing.fakekube import (
+    ClusterFleet,
+    Conflict,
+    FakeKube,
+    NotFound,
+    obj_key,
+)
+
+FEDERATED_CLUSTERS = "core.kubeadmiral.io/v1alpha1/federatedclusters"
+
+# Cascading-delete opt-in annotation on FederatedCluster
+# (reference: util/cascadingdeleteannotation.go:24-37).
+CASCADING_DELETE = C.PREFIX + "cascading-delete"
+
+ORPHAN_ALL = "all"
+ORPHAN_ADOPTED = "adopted"
+
+# AggregateReason values surfaced in the Propagation condition
+# (reference: pkg/apis/types/v1alpha1/types_status.go AggregateReason).
+AGGREGATE_SUCCESS = "AggregateSuccess"
+CHECK_CLUSTERS = "CheckClusters"
+
+
+def is_cluster_joined(cluster_obj: dict) -> bool:
+    conds = {
+        c.get("type"): c.get("status")
+        for c in cluster_obj.get("status", {}).get("conditions", [])
+    }
+    return conds.get("Joined") == "True"
+
+
+def is_cluster_ready(cluster_obj: dict) -> bool:
+    conds = {
+        c.get("type"): c.get("status")
+        for c in cluster_obj.get("status", {}).get("conditions", [])
+    }
+    return conds.get("Ready") == "True"
+
+
+def is_cascading_delete_enabled(cluster_obj: dict) -> bool:
+    return CASCADING_DELETE in cluster_obj.get("metadata", {}).get("annotations", {})
+
+
+class SyncController:
+    """Per-FTC propagation controller (sync/controller.go:90-135)."""
+
+    name = "sync-controller"
+
+    def __init__(
+        self,
+        fleet: ClusterFleet,
+        ftc: FederatedTypeConfig,
+        metrics: Optional[Metrics] = None,
+        max_dispatch_workers: int = 16,
+        clock=None,
+    ):
+        self.fleet = fleet
+        self.host = fleet.host
+        self.ftc = ftc
+        self.metrics = metrics or Metrics()
+        self._fed_resource = ftc.federated.resource
+        self._target_resource = ftc.source.resource
+        self.versions = VersionManager(self.host, ftc.source.kind, ftc.namespaced)
+        self.pool = ThreadPoolExecutor(max_workers=max_dispatch_workers)
+        self.worker = Worker(
+            f"sync-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        )
+        self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
+        self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+
+    # -- event fan-in ----------------------------------------------------
+    def _on_fed_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
+    def _on_cluster_event(self, event: str, obj: dict) -> None:
+        # Cluster lifecycle re-enqueues everything (controller.go:244-260).
+        self.worker.enqueue_all(self.host.keys(self._fed_resource))
+
+    def _member_client(self, cluster: str) -> FakeKube:
+        return self.fleet.member(cluster)
+
+    # -- reconcile -------------------------------------------------------
+    def reconcile(self, key: str) -> Result:
+        fed_obj = self.host.try_get(self._fed_resource, key)
+        if fed_obj is None:
+            return Result.ok()
+        fed = FederatedResource(fed_obj, self.ftc)
+
+        if fed_obj["metadata"].get("deletionTimestamp"):
+            return self._ensure_deletion(fed)
+
+        # Wait until upstream pipeline controllers have run
+        # (controller.go:380-388: any pending controller defers sync).
+        try:
+            if pending.get_pending(fed_obj):
+                return Result.ok()
+        except KeyError:
+            return Result.ok()  # not initialized by federate yet
+
+        if self._ensure_finalizer(fed_obj) is None:
+            return Result.retry()  # conflict adding finalizer
+
+        return self._sync_to_clusters(fed)
+
+    def _ensure_finalizer(self, fed_obj: dict) -> Optional[dict]:
+        fins = fed_obj["metadata"].setdefault("finalizers", [])
+        if C.SYNC_FINALIZER in fins:
+            return fed_obj
+        fins.append(C.SYNC_FINALIZER)
+        try:
+            updated = self.host.update(self._fed_resource, fed_obj)
+        except Conflict:
+            return None
+        except NotFound:
+            return None
+        fed_obj["metadata"]["resourceVersion"] = updated["metadata"]["resourceVersion"]
+        return fed_obj
+
+    # -- the propagation round (controller.go:425-596) -------------------
+    def _sync_to_clusters(self, fed: FederatedResource) -> Result:
+        clusters = self.host.list(FEDERATED_CLUSTERS)
+        joined = [c for c in clusters if is_cluster_joined(c)]
+        selected = fed.compute_placement([c["metadata"]["name"] for c in joined])
+
+        recorded = self.versions.get(
+            fed.namespace, fed.name, fed.template_version(), fed.override_version()
+        )
+        dispatcher = D.ManagedDispatcher(
+            self._member_client,
+            fed,
+            self._target_resource,
+            replicas_path=self.ftc.path.replicas_spec,
+            skip_adopting=not should_adopt_preexisting(fed.obj),
+            pool=self.pool,
+        )
+
+        recheck = False
+        for cluster in joined:
+            cname = cluster["metadata"]["name"]
+            terminating = bool(cluster["metadata"].get("deletionTimestamp"))
+            cascading = terminating and is_cascading_delete_enabled(cluster)
+            should_be_deleted = cname not in selected or cascading
+
+            if not is_cluster_ready(cluster):
+                if not should_be_deleted:
+                    dispatcher.record_error(
+                        cname, D.CLUSTER_NOT_READY, "cluster not ready"
+                    )
+                continue
+            try:
+                cluster_obj = self._member_client(cname).try_get(
+                    self._target_resource, fed.key
+                )
+            except NotFound:
+                dispatcher.record_error(
+                    cname, D.CACHED_RETRIEVAL_FAILED, "cluster unavailable"
+                )
+                continue
+            if cluster_obj is not None and C.MANAGED_LABEL not in cluster_obj[
+                "metadata"
+            ].get("labels", {}):
+                # Unmanaged member objects are invisible to the sync view
+                # (federatedinformer.go:678-680): a pre-existing object is
+                # "absent", so Create runs and the AlreadyExists fallback
+                # decides adoption.
+                cluster_obj = None
+
+            if should_be_deleted:
+                if cluster_obj is None:
+                    continue
+                if cluster_obj["metadata"].get("deletionTimestamp"):
+                    dispatcher.record_status(cname, D.WAITING_FOR_REMOVAL)
+                    continue
+                if terminating and not cascading:
+                    # Preserve member objects of a non-cascading
+                    # terminating cluster (controller.go:498-506).
+                    continue
+                # Orphaning is only respected during cascading deletion,
+                # not when migrating between clusters (controller.go:508).
+                self._delete_one(dispatcher, cname, fed, cluster_obj, cascading)
+                continue
+
+            if terminating:
+                dispatcher.record_error(
+                    cname, D.CLUSTER_TERMINATING, "cluster terminating"
+                )
+                continue
+            if cluster_obj is None:
+                dispatcher.create(cname)
+            else:
+                dispatcher.update(cname, cluster_obj, recorded.get(cname, ""))
+
+        ok = dispatcher.wait()
+
+        # Record versions (an optimization; failures tolerated —
+        # controller.go:568-576).
+        self.versions.update(
+            fed.namespace,
+            fed.name,
+            fed.template_version(),
+            fed.override_version(),
+            sorted(selected),
+            dispatcher.version_map,
+        )
+
+        status_map = dispatcher.status_map
+        reason = AGGREGATE_SUCCESS if ok else CHECK_CLUSTERS
+        status_result = self._set_federated_status(fed, reason, status_map)
+        if not status_result.success:
+            return status_result
+        if not ok:
+            return Result.retry()
+        if recheck or D.WAITING_FOR_REMOVAL in status_map.values():
+            # A member object is finalizer-gated mid-removal; no host
+            # event will fire when it finishes, so revisit on a timer
+            # (controller.go recheckAfterDispatchDelay).
+            return Result.after(10.0)
+        return Result.ok()
+
+    def _delete_one(
+        self,
+        dispatcher: D.ManagedDispatcher,
+        cluster: str,
+        fed: FederatedResource,
+        cluster_obj: dict,
+        respect_orphaning: bool,
+    ) -> None:
+        """(controller.go:821-845 deleteFromCluster)."""
+        if respect_orphaning:
+            ann = fed.obj.get("metadata", {}).get("annotations", {})
+            behavior = ann.get(C.ORPHAN_MODE, "")
+            adopted = cluster_obj.get("metadata", {}).get("annotations", {}).get(
+                D.ADOPTED_ANNOTATION
+            )
+            if behavior == ORPHAN_ALL or (behavior == ORPHAN_ADOPTED and adopted):
+                dispatcher.remove_managed_label(cluster, cluster_obj)
+                return
+        dispatcher.delete(cluster)
+
+    # -- status ----------------------------------------------------------
+    def _set_federated_status(
+        self, fed: FederatedResource, reason: str, status_map: dict[str, str]
+    ) -> Result:
+        """Write status.clusters + the Propagated condition via the status
+        subresource, with conflict-retry (controller.go:637-721)."""
+        desired_clusters = [
+            {"cluster": c, "status": s} for c, s in sorted(status_map.items())
+        ]
+        for _ in range(5):
+            obj = self.host.try_get(self._fed_resource, fed.key)
+            if obj is None:
+                return Result.ok()
+            status = obj.setdefault("status", {})
+            old_conditions = {
+                c.get("type"): c for c in status.get("conditions", [])
+            }
+            prop = old_conditions.get("Propagation", {})
+            new_status = "True" if reason == AGGREGATE_SUCCESS else "False"
+            changed = (
+                status.get("clusters") != desired_clusters
+                or prop.get("reason") != reason
+                or prop.get("status") != new_status
+            )
+            if not changed:
+                return Result.ok()
+            status["clusters"] = desired_clusters
+            status["conditions"] = [
+                c for t, c in sorted(old_conditions.items()) if t != "Propagation"
+            ] + [{"type": "Propagation", "status": new_status, "reason": reason}]
+            try:
+                self.host.update_status(self._fed_resource, obj)
+                return Result.ok()
+            except NotFound:
+                return Result.ok()
+            except Conflict:
+                continue
+        return Result.retry()
+
+    # -- deletion (controller.go:723-819) --------------------------------
+    def _ensure_deletion(self, fed: FederatedResource) -> Result:
+        self.versions.delete(fed.namespace, fed.name)
+        fins = fed.obj["metadata"].get("finalizers", [])
+        if C.SYNC_FINALIZER not in fins:
+            return Result.ok()
+
+        ann = fed.obj.get("metadata", {}).get("annotations", {})
+        if ann.get(C.ORPHAN_MODE) == ORPHAN_ALL:
+            # Orphan everywhere: strip managed labels, drop finalizer.
+            if not self._remove_managed_labels_everywhere(fed):
+                return Result.retry()
+            return self._remove_finalizer(fed)
+
+        remaining = self._delete_from_clusters(fed)
+        if remaining is None:
+            return Result.retry()
+        if remaining:
+            return Result(success=True, requeue_after=2.0)
+        return self._remove_finalizer(fed)
+
+    def _ready_members(self) -> list[str]:
+        return [
+            c["metadata"]["name"]
+            for c in self.host.list(FEDERATED_CLUSTERS)
+            if is_cluster_joined(c) and is_cluster_ready(c)
+        ]
+
+    def _delete_from_clusters(self, fed: FederatedResource) -> Optional[list[str]]:
+        """Returns clusters still holding the object, or None on failure
+        (controller.go:846-887)."""
+        dispatcher = D.ManagedDispatcher(
+            self._member_client,
+            fed,
+            self._target_resource,
+            replicas_path=self.ftc.path.replicas_spec,
+            pool=self.pool,
+        )
+        remaining: list[str] = []
+        for cname in self._ready_members():
+            try:
+                cluster_obj = self._member_client(cname).try_get(
+                    self._target_resource, fed.key
+                )
+            except NotFound:
+                continue  # cluster client gone mid-leave; nothing to delete
+            if cluster_obj is None:
+                continue
+            remaining.append(cname)
+            if cluster_obj["metadata"].get("deletionTimestamp"):
+                dispatcher.record_status(cname, D.WAITING_FOR_REMOVAL)
+                continue
+            self._delete_one(dispatcher, cname, fed, cluster_obj, True)
+        if not dispatcher.wait():
+            return None
+        # Re-check what actually remains after the dispatch round; an
+        # orphaned (label-stripped) object no longer counts as managed.
+        still = []
+        for c in remaining:
+            try:
+                obj = self._member_client(c).try_get(self._target_resource, fed.key)
+            except NotFound:
+                continue
+            if obj is None:
+                continue
+            if C.MANAGED_LABEL not in obj.get("metadata", {}).get("labels", {}):
+                continue
+            still.append(c)
+        return still
+
+    def _remove_managed_labels_everywhere(self, fed: FederatedResource) -> bool:
+        dispatcher = D.ManagedDispatcher(
+            self._member_client, fed, self._target_resource, pool=self.pool
+        )
+        for cname in self._ready_members():
+            try:
+                cluster_obj = self._member_client(cname).try_get(
+                    self._target_resource, fed.key
+                )
+            except NotFound:
+                continue
+            if cluster_obj is None or cluster_obj["metadata"].get("deletionTimestamp"):
+                continue
+            dispatcher.remove_managed_label(cname, cluster_obj)
+        return dispatcher.wait()
+
+    def _remove_finalizer(self, fed: FederatedResource) -> Result:
+        obj = self.host.try_get(self._fed_resource, fed.key)
+        if obj is None:
+            return Result.ok()
+        fins = obj["metadata"].get("finalizers", [])
+        if C.SYNC_FINALIZER in fins:
+            fins.remove(C.SYNC_FINALIZER)
+            try:
+                self.host.update(self._fed_resource, obj)
+            except Conflict:
+                return Result.retry()
+            except NotFound:
+                pass
+        return Result.ok()

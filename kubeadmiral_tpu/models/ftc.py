@@ -68,10 +68,14 @@ class FederatedTypeConfig:
         ("kubeadmiral.io/overridepolicy-controller",),
     )
     status_collection: bool = False
+    # Dotted paths collected from member objects into the status CR
+    # (types_federatedtypeconfig.go StatusCollection.Fields).
+    status_collection_fields: tuple[str, ...] = ("status",)
     status_aggregation: bool = False
     revision_history: bool = False
     rollout_plan: bool = False
     auto_migration: bool = False
+    namespaced: bool = True  # target scope (drives PropagatedVersion kind)
 
     @property
     def controller_groups(self) -> list[list[str]]:
@@ -146,7 +150,7 @@ def default_ftcs() -> list[FederatedTypeConfig]:
         make_ftc("secrets", "", "v1", "Secret", "secrets"),
         make_ftc("services", "", "v1", "Service", "services"),
         make_ftc("serviceaccounts", "", "v1", "ServiceAccount", "serviceaccounts"),
-        make_ftc("namespaces", "", "v1", "Namespace", "namespaces"),
+        make_ftc("namespaces", "", "v1", "Namespace", "namespaces", namespaced=False),
         make_ftc(
             "jobs.batch", "batch", "v1", "Job", "jobs",
             path=PathDefinition(replicas_spec="spec.parallelism"),

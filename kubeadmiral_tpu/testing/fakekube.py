@@ -185,6 +185,14 @@ class FakeKube:
         with self._lock:
             return list(self._store(resource))
 
+    def scan(self, resource: str, fn: Callable[[dict], None]) -> None:
+        """Read-only visit of every object WITHOUT deep-copying — the
+        cheap path for large fan-out scans (e.g. policy -> bound objects).
+        ``fn`` must not mutate or retain the dicts it is handed."""
+        with self._lock:
+            for obj in self._store(resource).values():
+                fn(obj)
+
     # -- watch -----------------------------------------------------------
     def watch(self, resource: str, handler: Handler, replay: bool = True) -> None:
         """Register a handler; with replay, existing objects are delivered

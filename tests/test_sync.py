@@ -1,0 +1,437 @@
+"""Sync-controller stack tests: retention, dispatch, propagation,
+deletion — modeled on the reference's retain_test.go and the
+resourcepropagation e2e flow."""
+
+from __future__ import annotations
+
+import json
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation import retain
+from kubeadmiral_tpu.federation.resource import (
+    FederatedResource,
+    object_needs_update,
+    object_version,
+)
+from kubeadmiral_tpu.federation.sync import FEDERATED_CLUSTERS, SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+
+def deployment_ftc():
+    return next(f for f in default_ftcs() if f.name == "deployments.apps")
+
+
+def make_cluster(name: str, joined=True, ready=True, **meta):
+    conditions = []
+    if joined:
+        conditions.append({"type": "Joined", "status": "True"})
+    conditions.append({"type": "Ready", "status": "True" if ready else "False"})
+    obj = {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedCluster",
+        "metadata": {"name": name, **meta},
+        "spec": {},
+        "status": {"conditions": conditions},
+    }
+    return obj
+
+
+def make_fed_deployment(name="web", namespace="default", clusters=("c1", "c2"), replicas=3):
+    fed = {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedDeployment",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "annotations": {
+                pending.PENDING_CONTROLLERS: json.dumps([]),
+            },
+        },
+        "spec": {
+            "template": {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {
+                    "name": name,
+                    "namespace": namespace,
+                    "labels": {"app": name},
+                },
+                "spec": {
+                    "replicas": replicas,
+                    "selector": {"matchLabels": {"app": name}},
+                    "template": {
+                        "metadata": {"labels": {"app": name}},
+                        "spec": {"containers": [{"name": "app", "image": "nginx"}]},
+                    },
+                },
+            },
+            "placements": [
+                {
+                    "controller": C.SCHEDULER,
+                    "placement": [{"cluster": c} for c in clusters],
+                }
+            ],
+        },
+    }
+    return fed
+
+
+def fleet_with(n=2, names=None):
+    fleet = ClusterFleet()
+    names = names or [f"c{i + 1}" for i in range(n)]
+    for name in names:
+        fleet.add_member(name)
+        fleet.host.create(FEDERATED_CLUSTERS, make_cluster(name))
+    return fleet
+
+
+def run_sync(ctl, rounds=5):
+    for _ in range(rounds):
+        if not ctl.worker.step():
+            break
+
+
+# -- retention ----------------------------------------------------------
+
+class TestRetention:
+    def test_merge_labels_with_tombstones(self):
+        desired = {"metadata": {"labels": {"a": "1"}, "annotations": {}}}
+        retain.record_propagated_keys(desired)
+        # Simulate previous propagation of labels {a, gone}; cluster also
+        # has its own label "hpa".
+        cluster = {
+            "metadata": {
+                "labels": {"a": "0", "gone": "x", "hpa": "y"},
+                "annotations": {
+                    retain.PROPAGATED_LABEL_KEYS: "a,gone",
+                    retain.PROPAGATED_ANNOTATION_KEYS: "",
+                },
+                "resourceVersion": "7",
+            }
+        }
+        retain.retain_cluster_fields("Deployment", desired, cluster)
+        labels = desired["metadata"]["labels"]
+        assert labels["a"] == "1"  # template wins
+        assert "gone" not in labels  # tombstoned: removed from template
+        assert labels["hpa"] == "y"  # cluster-owned survives
+        assert desired["metadata"]["resourceVersion"] == "7"
+
+    def test_service_retains_cluster_ip_and_node_ports(self):
+        desired = {
+            "metadata": {},
+            "spec": {"ports": [{"name": "http", "protocol": "TCP", "port": 80}]},
+        }
+        cluster = {
+            "metadata": {"resourceVersion": "1"},
+            "spec": {
+                "clusterIP": "10.0.0.7",
+                "ports": [
+                    {"name": "http", "protocol": "TCP", "port": 80, "nodePort": 31234}
+                ],
+            },
+        }
+        retain.retain_cluster_fields("Service", desired, cluster)
+        assert desired["spec"]["clusterIP"] == "10.0.0.7"
+        assert desired["spec"]["ports"][0]["nodePort"] == 31234
+
+    def test_serviceaccount_retains_generated_secrets(self):
+        desired = {"metadata": {}}
+        cluster = {
+            "metadata": {"resourceVersion": "1"},
+            "secrets": [{"name": "sa-token-xyz"}],
+        }
+        retain.retain_cluster_fields("ServiceAccount", desired, cluster)
+        assert desired["secrets"] == [{"name": "sa-token-xyz"}]
+
+    def test_job_retains_generated_selector(self):
+        desired = {
+            "metadata": {},
+            "spec": {"template": {"metadata": {"labels": {"app": "x"}}}},
+        }
+        cluster = {
+            "metadata": {"resourceVersion": "1"},
+            "spec": {
+                "selector": {"matchLabels": {"controller-uid": "u1"}},
+                "template": {"metadata": {"labels": {"controller-uid": "u1"}}},
+            },
+        }
+        retain.retain_cluster_fields("Job", desired, cluster)
+        assert desired["spec"]["selector"]["matchLabels"]["controller-uid"] == "u1"
+        assert (
+            desired["spec"]["template"]["metadata"]["labels"]["controller-uid"] == "u1"
+        )
+
+    def test_job_manual_selector_not_retained(self):
+        desired = {"metadata": {}, "spec": {"manualSelector": True, "selector": {"matchLabels": {"app": "x"}}}}
+        cluster = {
+            "metadata": {"resourceVersion": "1"},
+            "spec": {"selector": {"matchLabels": {"controller-uid": "u1"}}},
+        }
+        retain.retain_cluster_fields("Job", desired, cluster)
+        assert desired["spec"]["selector"] == {"matchLabels": {"app": "x"}}
+
+    def test_pod_retains_sa_volume_and_defaults(self):
+        desired = {
+            "metadata": {},
+            "spec": {
+                "containers": [{"name": "app", "volumeMounts": []}],
+                "volumes": [],
+            },
+        }
+        cluster = {
+            "metadata": {"resourceVersion": "1"},
+            "spec": {
+                "serviceAccountName": "default",
+                "nodeName": "node-1",
+                "volumes": [{"name": "kube-api-access-abcde", "projected": {}}],
+                "containers": [
+                    {
+                        "name": "app",
+                        "volumeMounts": [
+                            {
+                                "name": "kube-api-access-abcde",
+                                "mountPath": "/var/run/secrets/kubernetes.io/serviceaccount",
+                            }
+                        ],
+                    }
+                ],
+            },
+        }
+        retain.retain_cluster_fields("Pod", desired, cluster)
+        assert desired["spec"]["serviceAccountName"] == "default"
+        assert desired["spec"]["nodeName"] == "node-1"
+        assert desired["spec"]["volumes"][0]["name"] == "kube-api-access-abcde"
+        assert desired["spec"]["containers"][0]["volumeMounts"][0]["name"] == (
+            "kube-api-access-abcde"
+        )
+
+    def test_retain_replicas_when_requested(self):
+        desired = {"spec": {"replicas": 3}}
+        cluster = {"spec": {"replicas": 7}}
+        fed = {"spec": {"retainReplicas": True}}
+        retain.retain_replicas(desired, cluster, fed, "spec.replicas")
+        assert desired["spec"]["replicas"] == 7
+        fed2 = {"spec": {}}
+        desired2 = {"spec": {"replicas": 3}}
+        retain.retain_replicas(desired2, cluster, fed2, "spec.replicas")
+        assert desired2["spec"]["replicas"] == 3
+
+
+# -- FederatedResource ---------------------------------------------------
+
+class TestFederatedResource:
+    def test_object_for_cluster_stamps_identity(self):
+        fed = make_fed_deployment()
+        res = FederatedResource(fed, deployment_ftc())
+        obj = res.object_for_cluster("c1")
+        assert obj["kind"] == "Deployment"
+        assert obj["metadata"]["name"] == "web"
+        assert obj["metadata"]["namespace"] == "default"
+        assert C.SOURCE_GENERATION in obj["metadata"]["annotations"]
+
+    def test_apply_overrides_orders_by_pipeline_and_adds_managed_label(self):
+        fed = make_fed_deployment()
+        # override entries listed sync-first but pipeline order is
+        # scheduler -> override; scheduler's patch must land first.
+        fed["spec"]["overrides"] = [
+            {
+                "controller": C.OVERRIDE_CONTROLLER,
+                "clusters": [
+                    {
+                        "cluster": "c1",
+                        "patches": [
+                            {"op": "replace", "path": "/spec/replicas", "value": 9}
+                        ],
+                    }
+                ],
+            },
+            {
+                "controller": C.SCHEDULER,
+                "clusters": [
+                    {
+                        "cluster": "c1",
+                        "patches": [
+                            {"op": "replace", "path": "/spec/replicas", "value": 5}
+                        ],
+                    }
+                ],
+            },
+        ]
+        res = FederatedResource(fed, deployment_ftc())
+        obj = res.apply_overrides(res.object_for_cluster("c1"), "c1")
+        assert obj["spec"]["replicas"] == 9  # later controller wins
+        assert obj["metadata"]["labels"][C.MANAGED_LABEL] == "true"
+
+    def test_object_version_and_needs_update(self):
+        obj = {"metadata": {"generation": 4, "resourceVersion": "44"}}
+        assert object_version(obj) == "gen:4"
+        desired = {"spec": {"replicas": 2}}
+        cluster = {"metadata": {"generation": 4}, "spec": {"replicas": 2}}
+        assert not object_needs_update(desired, cluster, "gen:4", "spec.replicas")
+        assert object_needs_update(desired, cluster, "gen:3", "spec.replicas")
+        cluster2 = {"metadata": {"generation": 4}, "spec": {"replicas": 5}}
+        assert object_needs_update(desired, cluster2, "gen:4", "spec.replicas")
+
+
+# -- end-to-end propagation ----------------------------------------------
+
+class TestSyncController:
+    def test_propagates_to_placed_clusters(self):
+        fleet = fleet_with(3)
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1", "c2"))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+
+        assert fleet.member("c1").try_get("apps/v1/deployments", "default/web")
+        assert fleet.member("c2").try_get("apps/v1/deployments", "default/web")
+        assert not fleet.member("c3").try_get("apps/v1/deployments", "default/web")
+        obj = fleet.member("c1").get("apps/v1/deployments", "default/web")
+        assert obj["metadata"]["labels"][C.MANAGED_LABEL] == "true"
+
+        fed_after = fleet.host.get(ctl._fed_resource, "default/web")
+        status = {
+            c["cluster"]: c["status"] for c in fed_after["status"]["clusters"]
+        }
+        assert status == {"c1": "OK", "c2": "OK"}
+        cond = {c["type"]: c for c in fed_after["status"]["conditions"]}
+        assert cond["Propagation"]["status"] == "True"
+
+    def test_version_skip_avoids_member_writes(self):
+        fleet = fleet_with(1, names=["c1"])
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1",))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        rv1 = fleet.member("c1").get("apps/v1/deployments", "default/web")[
+            "metadata"
+        ]["resourceVersion"]
+        # Re-trigger with no template change: no member write.
+        ctl.worker.enqueue("default/web")
+        run_sync(ctl)
+        rv2 = fleet.member("c1").get("apps/v1/deployments", "default/web")[
+            "metadata"
+        ]["resourceVersion"]
+        assert rv1 == rv2
+
+    def test_template_change_propagates(self):
+        fleet = fleet_with(1, names=["c1"])
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1",))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        cur = fleet.host.get(ctl._fed_resource, "default/web")
+        cur["spec"]["template"]["spec"]["replicas"] = 11
+        fleet.host.update(ctl._fed_resource, cur)
+        run_sync(ctl)
+        obj = fleet.member("c1").get("apps/v1/deployments", "default/web")
+        assert obj["spec"]["replicas"] == 11
+
+    def test_migration_deletes_from_removed_cluster(self):
+        fleet = fleet_with(2)
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1", "c2"))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        cur = fleet.host.get(ctl._fed_resource, "default/web")
+        cur["spec"]["placements"] = [
+            {"controller": C.SCHEDULER, "placement": [{"cluster": "c2"}]}
+        ]
+        fleet.host.update(ctl._fed_resource, cur)
+        run_sync(ctl)
+        assert fleet.member("c1").try_get("apps/v1/deployments", "default/web") is None
+        assert fleet.member("c2").try_get("apps/v1/deployments", "default/web")
+
+    def test_deletion_cascades_and_removes_finalizer(self):
+        fleet = fleet_with(2)
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1", "c2"))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        fleet.host.delete(ctl._fed_resource, "default/web")
+        run_sync(ctl, rounds=10)
+        assert fleet.member("c1").try_get("apps/v1/deployments", "default/web") is None
+        assert fleet.host.try_get(ctl._fed_resource, "default/web") is None
+
+    def test_orphan_all_keeps_member_objects(self):
+        fleet = fleet_with(1, names=["c1"])
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1",))
+        fed["metadata"]["annotations"][C.ORPHAN_MODE] = "all"
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        fleet.host.delete(ctl._fed_resource, "default/web")
+        run_sync(ctl, rounds=10)
+        obj = fleet.member("c1").try_get("apps/v1/deployments", "default/web")
+        assert obj is not None
+        assert C.MANAGED_LABEL not in obj["metadata"].get("labels", {})
+        assert fleet.host.try_get(ctl._fed_resource, "default/web") is None
+
+    def test_adoption_of_preexisting_resource(self):
+        fleet = fleet_with(1, names=["c1"])
+        # Pre-existing unmanaged member object.
+        fleet.member("c1").create(
+            "apps/v1/deployments",
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 1},
+            },
+        )
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1",))
+        fed["metadata"]["annotations"][C.CONFLICT_RESOLUTION] = "adopt"
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        obj = fleet.member("c1").get("apps/v1/deployments", "default/web")
+        assert obj["metadata"]["labels"][C.MANAGED_LABEL] == "true"
+        assert obj["metadata"]["annotations"]["kubeadmiral.io/adopted"] == "true"
+        assert obj["spec"]["replicas"] == 3  # template took over
+
+    def test_no_adoption_without_annotation(self):
+        fleet = fleet_with(1, names=["c1"])
+        fleet.member("c1").create(
+            "apps/v1/deployments",
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 1},
+            },
+        )
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1",))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        obj = fleet.member("c1").get("apps/v1/deployments", "default/web")
+        assert C.MANAGED_LABEL not in obj["metadata"].get("labels", {})
+        fed_after = fleet.host.get(ctl._fed_resource, "default/web")
+        status = {c["cluster"]: c["status"] for c in fed_after["status"]["clusters"]}
+        assert status["c1"] == "AlreadyExists"
+
+    def test_unready_cluster_reported_not_synced(self):
+        fleet = ClusterFleet()
+        fleet.add_member("c1")
+        fleet.host.create(FEDERATED_CLUSTERS, make_cluster("c1", ready=False))
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1",))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        assert fleet.member("c1").try_get("apps/v1/deployments", "default/web") is None
+        fed_after = fleet.host.get(ctl._fed_resource, "default/web")
+        status = {c["cluster"]: c["status"] for c in fed_after["status"]["clusters"]}
+        assert status["c1"] == "ClusterNotReady"
+
+    def test_pending_upstream_controllers_defer_sync(self):
+        fleet = fleet_with(1, names=["c1"])
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1",))
+        fed["metadata"]["annotations"][pending.PENDING_CONTROLLERS] = json.dumps(
+            [[C.SCHEDULER]]
+        )
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        assert fleet.member("c1").try_get("apps/v1/deployments", "default/web") is None
