@@ -56,6 +56,15 @@ FOLLOWER_CONTROLLER = PREFIX + "follower-controller"
 SYNC_FINALIZER = PREFIX + "sync-controller"
 CLUSTER_FINALIZER = PREFIX + "cluster-controller"
 
+# Host-apiserver resource keys for the core CRDs.
+FEDERATED_CLUSTERS = "core.kubeadmiral.io/v1alpha1/federatedclusters"
+
+
+def compact_json(value) -> str:
+    import json
+
+    return json.dumps(value, separators=(",", ":"), sort_keys=True)
+
 
 def meta(obj: dict) -> dict:
     return obj.setdefault("metadata", {})

@@ -13,6 +13,7 @@ Statuses mirror fedtypesv1a1.PropagationStatus values.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Optional
 
@@ -109,12 +110,13 @@ class ManagedDispatcher:
         self._futures.append(self._pool.submit(fn))
 
     def wait(self) -> bool:
-        """Block until every operation finishes (managed.go:126-159);
-        returns False when any cluster ended in a non-OK, non-waiting
-        state."""
+        """Block until every operation finishes or the shared deadline
+        passes (managed.go:126-159); returns False when any cluster ended
+        in a non-OK, non-waiting state."""
+        deadline = time.monotonic() + self.timeout
         for f in self._futures:
             try:
-                f.result(timeout=self.timeout)
+                f.result(timeout=max(0.0, deadline - time.monotonic()))
             except Exception:  # timeout statuses were pre-recorded
                 pass
         self._futures.clear()

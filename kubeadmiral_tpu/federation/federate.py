@@ -1,0 +1,385 @@
+"""The federate controller: source object → federated companion object.
+
+For every source object (e.g. a Deployment) this controller maintains the
+federated wrapper (FederatedDeployment) whose ``spec.template`` is the
+pruned source, classifying source labels/annotations into ones that ride
+on the federated object itself versus ones that stay in the template, and
+recording bookkeeping annotations (observed key sets, a JSON merge patch
+reconstructing the template generator).  Source deletion is propagated by
+deleting the federated object first, gated by a finalizer on the source
+(reference: pkg/controllers/federate/controller.go:95-567, util.go).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.worker import Result, Worker
+from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_key
+from kubeadmiral_tpu.utils.jsonpatch import create_merge_patch
+from kubeadmiral_tpu.utils.unstructured import get_path, set_path
+
+FEDERATE_FINALIZER = C.PREFIX + "federate-controller"
+NO_FEDERATED_RESOURCE = C.PREFIX + "no-federated-resource"
+
+# Bookkeeping annotations on the federated object
+# (reference: pkg/controllers/common/constants.go).
+FEDERATED_OBJECT = C.PREFIX + "federated-object"
+OBSERVED_ANNOTATION_KEYS = C.PREFIX + "observed-annotation-keys"
+OBSERVED_LABEL_KEYS = C.PREFIX + "observed-label-keys"
+TEMPLATE_GENERATOR_MERGE_PATCH = C.PREFIX + "template-generator-merge-patch"
+NO_SCHEDULING = C.PREFIX + "no-scheduling"
+REVISION_HISTORY_LIMIT = C.PREFIX + "revision-history-limit"
+
+# Annotations copied onto the federated object rather than the template
+# (reference: federate/util.go federatedAnnotationSet).
+FEDERATED_ANNOTATIONS = frozenset(
+    {
+        C.PREFIX + "scheduling-mode",
+        C.PREFIX + "sticky-cluster",
+        C.CONFLICT_RESOLUTION,
+        C.PREFIX + "no-auto-propagation",
+        C.ORPHAN_MODE,
+        C.PREFIX + "tolerations",
+        C.PREFIX + "placements",
+        C.PREFIX + "cluster-selector",
+        C.PREFIX + "affinity",
+        C.PREFIX + "max-clusters",
+        NO_SCHEDULING,
+        C.FOLLOWS_OBJECT,
+        C.PREFIX + "followers",
+    }
+)
+
+# Source annotations never copied anywhere (internal / feedback keys;
+# reference: federate/util.go ignoredAnnotationSet).
+IGNORED_ANNOTATIONS = frozenset(
+    {
+        C.RETAIN_REPLICAS,
+        C.LATEST_REPLICASET_DIGESTS,
+        C.SOURCE_FEEDBACK_SCHEDULING,
+        C.SOURCE_FEEDBACK_SYNCING,
+        C.SOURCE_FEEDBACK_STATUS,
+        C.CONFLICT_RESOLUTION + ".internal",
+        C.ORPHAN_MODE + ".internal",
+        C.PREFIX + "enable-follower-scheduling",
+    }
+)
+
+# Labels that ride on the federated object (policy bindings; reference:
+# federate/util.go federatedLabelSet).
+FEDERATED_LABELS = frozenset(
+    {
+        "kubeadmiral.io/propagation-policy-name",
+        "kubeadmiral.io/cluster-propagation-policy-name",
+        "kubeadmiral.io/override-policy-name",
+        "kubeadmiral.io/cluster-override-policy-name",
+    }
+)
+
+# metadata fields pruned from the template (reference:
+# federate/util.go templateForSourceObject).
+_PRUNED_META = (
+    "selfLink",
+    "uid",
+    "resourceVersion",
+    "generation",
+    "creationTimestamp",
+    "deletionTimestamp",
+    "ownerReferences",
+    "finalizers",
+    "managedFields",
+)
+
+
+def classify_annotations(src: dict) -> tuple[dict, dict]:
+    """Split source annotations into (federated, template) maps."""
+    federated, template = {}, {}
+    for key, value in (src or {}).items():
+        if key in IGNORED_ANNOTATIONS:
+            continue
+        (federated if key in FEDERATED_ANNOTATIONS else template)[key] = value
+    federated[FEDERATED_OBJECT] = "1"
+    return federated, template
+
+
+def classify_labels(src: dict) -> tuple[dict, dict]:
+    federated, template = {}, {}
+    for key, value in (src or {}).items():
+        (federated if key in FEDERATED_LABELS else template)[key] = value
+    return federated, template
+
+
+def observed_keys(source_map: dict, federated_map: dict) -> str:
+    """``fedKeys|otherKeys`` bookkeeping so later syncs know which source
+    keys were observed (federate/util.go generateObservedKeys)."""
+    if not source_map:
+        return ""
+    fed = sorted(k for k in source_map if k in federated_map)
+    non = sorted(k for k in source_map if k not in federated_map)
+    return ",".join(fed) + "|" + ",".join(non)
+
+
+def template_for_source(source: dict, annotations: dict, labels: dict) -> dict:
+    template = copy.deepcopy(source)
+    meta = template.setdefault("metadata", {})
+    for field in _PRUNED_META:
+        meta.pop(field, None)
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    else:
+        meta.pop("annotations", None)
+    if labels:
+        meta["labels"] = dict(labels)
+    else:
+        meta.pop("labels", None)
+    template.pop("status", None)
+    return template
+
+
+def _is_deployment(ftc: FederatedTypeConfig) -> bool:
+    return ftc.source.group == "apps" and ftc.source.kind == "Deployment"
+
+
+def _ensure_deployment_fields(source: dict, fed_obj: dict) -> bool:
+    """spec.retainReplicas + spec.revisionHistoryLimit from source
+    annotations (federate/controller.go ensureDeploymentFields)."""
+    anno = source.get("metadata", {}).get("annotations", {}) or {}
+    changed = False
+
+    retain = anno.get(C.RETAIN_REPLICAS) == "true"
+    if get_path(fed_obj, "spec.retainReplicas") != retain:
+        set_path(fed_obj, "spec.retainReplicas", retain)
+        changed = True
+
+    limit = int(anno.get(REVISION_HISTORY_LIMIT, "1") or 1)
+    if get_path(fed_obj, "spec.revisionHistoryLimit") != limit:
+        set_path(fed_obj, "spec.revisionHistoryLimit", limit)
+        changed = True
+    return changed
+
+
+def new_federated_object(ftc: FederatedTypeConfig, source: dict) -> dict:
+    src_meta = source.get("metadata", {})
+    fed_labels, tmpl_labels = classify_labels(src_meta.get("labels", {}))
+    fed_anno, tmpl_anno = classify_annotations(src_meta.get("annotations", {}))
+    template = template_for_source(source, tmpl_anno, tmpl_labels)
+
+    fed_anno[OBSERVED_ANNOTATION_KEYS] = observed_keys(
+        src_meta.get("annotations", {}) or {}, fed_anno
+    )
+    fed_anno[OBSERVED_LABEL_KEYS] = observed_keys(
+        src_meta.get("labels", {}) or {}, fed_labels
+    )
+    fed_anno[TEMPLATE_GENERATOR_MERGE_PATCH] = C.compact_json(
+        create_merge_patch(source, template)
+    )
+
+    fed_obj = {
+        "apiVersion": ftc.federated.api_version,
+        "kind": ftc.federated.kind,
+        "metadata": {
+            "name": src_meta.get("name"),
+            "annotations": fed_anno,
+        },
+        "spec": {"template": template},
+    }
+    if src_meta.get("namespace"):
+        fed_obj["metadata"]["namespace"] = src_meta["namespace"]
+    if fed_labels:
+        fed_obj["metadata"]["labels"] = fed_labels
+    if _is_deployment(ftc):
+        _ensure_deployment_fields(source, fed_obj)
+    pending.set_pending(fed_obj, ftc.controller_groups)
+    return fed_obj
+
+
+def update_federated_object(
+    fed_obj: dict, ftc: FederatedTypeConfig, source: dict
+) -> bool:
+    """Reconcile an existing federated object against the source; returns
+    True when it changed (federate/util.go
+    updateFederatedObjectForSourceObject)."""
+    changed = False
+    src_meta = source.get("metadata", {})
+    fed_meta = fed_obj.setdefault("metadata", {})
+
+    fed_labels, tmpl_labels = classify_labels(src_meta.get("labels", {}))
+    fed_anno, tmpl_anno = classify_annotations(src_meta.get("annotations", {}))
+
+    if (fed_meta.get("labels") or {}) != fed_labels:
+        if fed_labels:
+            fed_meta["labels"] = fed_labels
+        else:
+            fed_meta.pop("labels", None)
+        changed = True
+
+    template = template_for_source(source, tmpl_anno, tmpl_labels)
+    if get_path(fed_obj, "spec.template") != template:
+        set_path(fed_obj, "spec.template", template)
+        changed = True
+
+    # Merge federated annotations into the existing set: other
+    # controllers annotate the federated object too, so only keys this
+    # controller owns are overwritten/removed.
+    existing = dict(fed_meta.get("annotations", {}) or {})
+    merged = dict(existing)
+    for key in list(merged):
+        if key in FEDERATED_ANNOTATIONS and key not in fed_anno:
+            del merged[key]
+    merged.update(fed_anno)
+    merged[OBSERVED_ANNOTATION_KEYS] = observed_keys(
+        src_meta.get("annotations", {}) or {}, fed_anno
+    )
+    merged[OBSERVED_LABEL_KEYS] = observed_keys(
+        src_meta.get("labels", {}) or {}, fed_labels
+    )
+    merged[TEMPLATE_GENERATOR_MERGE_PATCH] = C.compact_json(
+        create_merge_patch(source, template)
+    )
+    if merged != existing:
+        fed_meta["annotations"] = merged
+        changed = True
+
+    if _is_deployment(ftc):
+        changed = _ensure_deployment_fields(source, fed_obj) or changed
+
+    if changed:
+        # A template change restarts the controller pipeline
+        # (federate/util.go:208-213).
+        pending.set_pending(fed_obj, ftc.controller_groups)
+    return changed
+
+
+class FederateController:
+    """Per-FTC controller keeping FederatedX in step with X."""
+
+    name = "federate-controller"
+
+    def __init__(
+        self,
+        host: FakeKube,
+        ftc: FederatedTypeConfig,
+        metrics: Optional[Metrics] = None,
+        clock=None,
+    ):
+        self.host = host
+        self.ftc = ftc
+        self.metrics = metrics or Metrics()
+        self._source_resource = ftc.source.resource
+        self._fed_resource = ftc.federated.resource
+        self.worker = Worker(
+            f"federate-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        )
+        host.watch(self._source_resource, self._on_event, replay=True)
+        host.watch(self._fed_resource, self._on_event, replay=True)
+
+    def _on_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
+    def run_until_idle(self) -> None:
+        while self.worker.step():
+            pass
+
+    # -- reconcile (federate/controller.go:194-341) ----------------------
+    def reconcile(self, key: str) -> Result:
+        self.metrics.counter("federate.throughput")
+        source = self.host.try_get(self._source_resource, key)
+        fed_obj = self.host.try_get(self._fed_resource, key)
+
+        if source is None:
+            return Result.ok()
+
+        if source["metadata"].get("deletionTimestamp"):
+            return self._handle_terminating_source(source, fed_obj)
+
+        if source["metadata"].get("annotations", {}).get(NO_FEDERATED_RESOURCE):
+            return Result.ok()
+
+        source = self._ensure_finalizer(source)
+        if source is None:
+            return Result.retry()
+
+        if fed_obj is None:
+            return self._create(source)
+        return self._update(source, fed_obj)
+
+    def _ensure_finalizer(self, source: dict) -> Optional[dict]:
+        fins = source["metadata"].setdefault("finalizers", [])
+        if FEDERATE_FINALIZER in fins:
+            return source
+        fins.append(FEDERATE_FINALIZER)
+        try:
+            updated = self.host.update(self._source_resource, source)
+        except (Conflict, NotFound):
+            return None
+        source["metadata"]["resourceVersion"] = updated["metadata"]["resourceVersion"]
+        return source
+
+    def _handle_terminating_source(
+        self, source: dict, fed_obj: Optional[dict]
+    ) -> Result:
+        if fed_obj is None:
+            # Federated object gone: release the source
+            # (federate/controller.go handleTerminatingSourceObject).
+            fins = source["metadata"].get("finalizers", [])
+            if FEDERATE_FINALIZER in fins:
+                source["metadata"]["finalizers"] = [
+                    f for f in fins if f != FEDERATE_FINALIZER
+                ]
+                try:
+                    self.host.update(self._source_resource, source)
+                except (Conflict, NotFound):
+                    return Result.retry()
+            return Result.ok()
+        if not fed_obj["metadata"].get("deletionTimestamp"):
+            try:
+                self.host.delete(self._fed_resource, obj_key(fed_obj))
+            except NotFound:
+                pass
+        # Requeue until the federated object finishes terminating.
+        return Result.after(1.0)
+
+    def _create(self, source: dict) -> Result:
+        fed_obj = new_federated_object(self.ftc, source)
+        try:
+            self.host.create(self._fed_resource, fed_obj)
+        except Conflict:
+            return Result.retry()
+        except Exception:
+            return Result.retry()
+        return Result.ok()
+
+    def _update(self, source: dict, fed_obj: dict) -> Result:
+        if not update_federated_object(fed_obj, self.ftc, source):
+            return self._sync_feedback(source, fed_obj)
+        try:
+            self.host.update(self._fed_resource, fed_obj)
+        except (Conflict, NotFound):
+            return Result.retry()
+        return Result.ok()
+
+    def _sync_feedback(self, source: dict, fed_obj: dict) -> Result:
+        """Copy scheduling/syncing feedback annotations from the federated
+        object back onto the source (federate/controller.go
+        updateFeedbackAnnotations; sourcefeedback/*.go)."""
+        fed_anno = fed_obj["metadata"].get("annotations", {}) or {}
+        changed = False
+        src_anno = source["metadata"].setdefault("annotations", {})
+        for key in (C.SOURCE_FEEDBACK_SCHEDULING, C.SOURCE_FEEDBACK_SYNCING):
+            value = fed_anno.get(key)
+            if value is not None and src_anno.get(key) != value:
+                src_anno[key] = value
+                changed = True
+        if not changed:
+            return Result.ok()
+        try:
+            self.host.update(self._source_resource, source)
+        except (Conflict, NotFound):
+            return Result.retry()
+        return Result.ok()

@@ -73,7 +73,9 @@ class FederatedResource:
         obj.setdefault("apiVersion", self.ftc.source.api_version)
 
         ann = meta.setdefault("annotations", {})
-        ann[C.SOURCE_GENERATION] = str(meta.get("generation", 1))
+        ann[C.SOURCE_GENERATION] = str(
+            self.obj["metadata"].get("generation", 1)
+        )
         meta.pop("generation", None)
         meta.pop("resourceVersion", None)
 

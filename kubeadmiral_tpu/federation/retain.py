@@ -181,7 +181,7 @@ def _retain_pod(desired: dict, cluster_obj: dict) -> None:
     if eph is not None:
         set_path(desired, "spec.ephemeralContainers", eph)
     for field in ("serviceAccountName", "serviceAccount", "nodeName", "priority"):
-        if not get_path(desired, f"spec.{field}"):
+        if get_path(desired, f"spec.{field}") is None:
             val = get_path(cluster_obj, f"spec.{field}")
             if val is not None:
                 set_path(desired, f"spec.{field}", val)

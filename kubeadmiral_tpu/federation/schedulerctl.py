@@ -31,7 +31,7 @@ from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_k
 from kubeadmiral_tpu.utils.hashing import stable_json_hash
 from kubeadmiral_tpu.utils.unstructured import get_path
 
-FEDERATED_CLUSTERS = "core.kubeadmiral.io/v1alpha1/federatedclusters"
+FEDERATED_CLUSTERS = C.FEDERATED_CLUSTERS
 
 # Annotations the scheduler owns (reference: common constants +
 # scheduler.go applySchedulingResult).

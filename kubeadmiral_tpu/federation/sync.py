@@ -31,7 +31,7 @@ from kubeadmiral_tpu.testing.fakekube import (
     obj_key,
 )
 
-FEDERATED_CLUSTERS = "core.kubeadmiral.io/v1alpha1/federatedclusters"
+FEDERATED_CLUSTERS = C.FEDERATED_CLUSTERS
 
 # Cascading-delete opt-in annotation on FederatedCluster
 # (reference: util/cascadingdeleteannotation.go:24-37).
@@ -39,6 +39,11 @@ CASCADING_DELETE = C.PREFIX + "cascading-delete"
 
 ORPHAN_ALL = "all"
 ORPHAN_ADOPTED = "adopted"
+
+# Worker-queue namespace for FederatedCluster reconciles (the reference
+# runs a second ReconcileWorker, clusterWorker; one queue with a key
+# prefix keeps ordering here).
+_CLUSTER_KEY_PREFIX = "cluster::"
 
 # AggregateReason values surfaced in the Propagation condition
 # (reference: pkg/apis/types/v1alpha1/types_status.go AggregateReason).
@@ -90,15 +95,20 @@ class SyncController:
         self.worker = Worker(
             f"sync-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
         )
+        # Per-FTC cascading-delete finalizer held on FederatedCluster
+        # objects (controller.go:216 cascadingDeleteFinalizer).
+        self.cluster_finalizer = C.PREFIX + "cascading-delete-" + ftc.name
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
-        self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+        self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=True)
 
     # -- event fan-in ----------------------------------------------------
     def _on_fed_event(self, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
-        # Cluster lifecycle re-enqueues everything (controller.go:244-260).
+        # Cluster lifecycle re-enqueues everything (controller.go:244-260)
+        # and reconciles the per-cluster cascading-delete finalizer.
+        self.worker.enqueue(_CLUSTER_KEY_PREFIX + obj["metadata"]["name"])
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
     def _member_client(self, cluster: str) -> FakeKube:
@@ -106,6 +116,8 @@ class SyncController:
 
     # -- reconcile -------------------------------------------------------
     def reconcile(self, key: str) -> Result:
+        if key.startswith(_CLUSTER_KEY_PREFIX):
+            return self._reconcile_cluster(key[len(_CLUSTER_KEY_PREFIX) :])
         fed_obj = self.host.try_get(self._fed_resource, key)
         if fed_obj is None:
             return Result.ok()
@@ -126,6 +138,59 @@ class SyncController:
             return Result.retry()  # conflict adding finalizer
 
         return self._sync_to_clusters(fed)
+
+    # -- cluster cascading-delete finalizer (controller.go:1050-1196) ----
+    def _reconcile_cluster(self, name: str) -> Result:
+        cluster = self.host.try_get(FEDERATED_CLUSTERS, name)
+        if cluster is None:
+            return Result.ok()
+
+        if not cluster["metadata"].get("deletionTimestamp"):
+            fins = cluster["metadata"].setdefault("finalizers", [])
+            if self.cluster_finalizer in fins:
+                return Result.ok()
+            fins.append(self.cluster_finalizer)
+            try:
+                self.host.update(FEDERATED_CLUSTERS, cluster)
+            except Conflict:
+                return Result.retry()
+            except NotFound:
+                pass
+            return Result.ok()
+
+        if is_cluster_joined(cluster) and is_cascading_delete_enabled(cluster):
+            # Wait until no managed target objects remain in the member.
+            try:
+                member = self._member_client(name)
+            except NotFound:
+                member = None
+            if member is not None:
+                held = []
+
+                def check(obj: dict) -> None:
+                    if C.MANAGED_LABEL in obj.get("metadata", {}).get("labels", {}):
+                        held.append(obj_key(obj))
+
+                member.scan(self._target_resource, check)
+                if held:
+                    return Result.after(2.0)
+
+        return self._remove_cluster_finalizer(cluster)
+
+    def _remove_cluster_finalizer(self, cluster: dict) -> Result:
+        fins = cluster["metadata"].get("finalizers", [])
+        if self.cluster_finalizer not in fins:
+            return Result.ok()
+        cluster["metadata"]["finalizers"] = [
+            f for f in fins if f != self.cluster_finalizer
+        ]
+        try:
+            self.host.update(FEDERATED_CLUSTERS, cluster)
+        except Conflict:
+            return Result.retry()
+        except NotFound:
+            pass
+        return Result.ok()
 
     def _ensure_finalizer(self, fed_obj: dict) -> Optional[dict]:
         fins = fed_obj["metadata"].setdefault("finalizers", [])
@@ -159,7 +224,6 @@ class SyncController:
             pool=self.pool,
         )
 
-        recheck = False
         for cluster in joined:
             cname = cluster["metadata"]["name"]
             terminating = bool(cluster["metadata"].get("deletionTimestamp"))
@@ -235,7 +299,7 @@ class SyncController:
             return status_result
         if not ok:
             return Result.retry()
-        if recheck or D.WAITING_FOR_REMOVAL in status_map.values():
+        if D.WAITING_FOR_REMOVAL in status_map.values():
             # A member object is finalizer-gated mid-removal; no host
             # event will fire when it finishes, so revisit on a timer
             # (controller.go recheckAfterDispatchDelay).
@@ -322,12 +386,8 @@ class SyncController:
             return Result(success=True, requeue_after=2.0)
         return self._remove_finalizer(fed)
 
-    def _ready_members(self) -> list[str]:
-        return [
-            c["metadata"]["name"]
-            for c in self.host.list(FEDERATED_CLUSTERS)
-            if is_cluster_joined(c) and is_cluster_ready(c)
-        ]
+    def _joined_members(self) -> list[dict]:
+        return [c for c in self.host.list(FEDERATED_CLUSTERS) if is_cluster_joined(c)]
 
     def _delete_from_clusters(self, fed: FederatedResource) -> Optional[list[str]]:
         """Returns clusters still holding the object, or None on failure
@@ -340,7 +400,16 @@ class SyncController:
             pool=self.pool,
         )
         remaining: list[str] = []
-        for cname in self._ready_members():
+        unreachable: list[str] = []
+        for cluster in self._joined_members():
+            cname = cluster["metadata"]["name"]
+            if not is_cluster_ready(cluster):
+                # Cannot confirm removal from an unready cluster; block
+                # finalizer removal until it is reachable again
+                # (controller.go:846-887 errs when a cluster store is
+                # unavailable, keeping the finalizer in place).
+                unreachable.append(cname)
+                continue
             try:
                 cluster_obj = self._member_client(cname).try_get(
                     self._target_resource, fed.key
@@ -348,6 +417,10 @@ class SyncController:
             except NotFound:
                 continue  # cluster client gone mid-leave; nothing to delete
             if cluster_obj is None:
+                continue
+            if C.MANAGED_LABEL not in cluster_obj["metadata"].get("labels", {}):
+                # Never delete objects this control plane doesn't manage
+                # (pre-existing, non-adopted — federatedinformer.go:678).
                 continue
             remaining.append(cname)
             if cluster_obj["metadata"].get("deletionTimestamp"):
@@ -369,13 +442,18 @@ class SyncController:
             if C.MANAGED_LABEL not in obj.get("metadata", {}).get("labels", {}):
                 continue
             still.append(c)
-        return still
+        return still + unreachable
 
     def _remove_managed_labels_everywhere(self, fed: FederatedResource) -> bool:
         dispatcher = D.ManagedDispatcher(
             self._member_client, fed, self._target_resource, pool=self.pool
         )
-        for cname in self._ready_members():
+        all_reachable = True
+        for cluster in self._joined_members():
+            cname = cluster["metadata"]["name"]
+            if not is_cluster_ready(cluster):
+                all_reachable = False  # cannot strip labels there yet
+                continue
             try:
                 cluster_obj = self._member_client(cname).try_get(
                     self._target_resource, fed.key
@@ -384,8 +462,10 @@ class SyncController:
                 continue
             if cluster_obj is None or cluster_obj["metadata"].get("deletionTimestamp"):
                 continue
+            if C.MANAGED_LABEL not in cluster_obj["metadata"].get("labels", {}):
+                continue
             dispatcher.remove_managed_label(cname, cluster_obj)
-        return dispatcher.wait()
+        return dispatcher.wait() and all_reachable
 
     def _remove_finalizer(self, fed: FederatedResource) -> Result:
         obj = self.host.try_get(self._fed_resource, fed.key)

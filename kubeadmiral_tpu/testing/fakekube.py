@@ -54,6 +54,9 @@ def split_key(key: str) -> tuple[str, str]:
 class FakeKube:
     """One apiserver (host or member cluster)."""
 
+    # Tests flip this to simulate a failing /healthz probe.
+    healthy: bool = True
+
     def __init__(self, name: str = "host"):
         self.name = name
         self._lock = threading.RLock()
@@ -85,7 +88,11 @@ class FakeKube:
             if key in store:
                 raise AlreadyExists(f"{resource} {key}")
             meta["resourceVersion"] = self._bump()
-            meta.setdefault("generation", 1)
+            # Like the real apiserver, only spec-bearing kinds carry a
+            # generation; data-only kinds (ConfigMap, Secret) must fall
+            # back to resourceVersion-based drift detection.
+            if "spec" in obj:
+                meta.setdefault("generation", 1)
             meta.setdefault("uid", f"{self.name}-{resource}-{key}-{self._rv}")
             store[key] = obj
             self._notify(resource, ADDED, obj)
@@ -120,9 +127,12 @@ class FakeKube:
             meta = obj.setdefault("metadata", {})
             meta["uid"] = old["metadata"].get("uid")
             meta["resourceVersion"] = self._bump()
-            old_gen = old["metadata"].get("generation", 1)
-            spec_changed = obj.get("spec") != old.get("spec")
-            meta["generation"] = old_gen + 1 if spec_changed else old_gen
+            if "spec" in old or "spec" in obj:
+                old_gen = old["metadata"].get("generation", 1)
+                spec_changed = obj.get("spec") != old.get("spec")
+                meta["generation"] = old_gen + 1 if spec_changed else old_gen
+            else:
+                meta.pop("generation", None)
             if old["metadata"].get("deletionTimestamp"):
                 meta.setdefault("deletionTimestamp", old["metadata"]["deletionTimestamp"])
                 if not meta.get("finalizers"):

@@ -136,3 +136,37 @@ def apply_patch(obj: dict, patches: list[dict]) -> dict:
         else:
             raise PatchError(f"unknown op {op!r}")
     return doc
+
+
+def create_merge_patch(source: Any, target: Any) -> Any:
+    """RFC 7386 JSON merge patch turning ``source`` into ``target``.
+
+    The federate controller records this on the federated object so the
+    template generator is reconstructible (reference:
+    pkg/controllers/federate/util.go:330-349 CreateMergePatch).
+    """
+    if not isinstance(source, dict) or not isinstance(target, dict):
+        return copy.deepcopy(target)
+    patch: dict = {}
+    for key, src_val in source.items():
+        if key not in target:
+            patch[key] = None
+        elif src_val != target[key]:
+            patch[key] = create_merge_patch(src_val, target[key])
+    for key, tgt_val in target.items():
+        if key not in source:
+            patch[key] = copy.deepcopy(tgt_val)
+    return patch
+
+
+def apply_merge_patch(doc: Any, patch: Any) -> Any:
+    """Apply an RFC 7386 merge patch (null deletes keys)."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    result = copy.deepcopy(doc) if isinstance(doc, dict) else {}
+    for key, val in patch.items():
+        if val is None:
+            result.pop(key, None)
+        else:
+            result[key] = apply_merge_patch(result.get(key), val)
+    return result

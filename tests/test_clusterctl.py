@@ -1,0 +1,276 @@
+"""FederatedCluster controller: join handshake, heartbeat, resource
+aggregation, removal — mirrors reference
+pkg/controllers/federatedcluster behaviors."""
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    CLUSTER_UID_ANNOTATION,
+    FED_SYSTEM_NAMESPACE,
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    JOINED,
+    NAMESPACES,
+    NODES,
+    OFFLINE,
+    PODS,
+    READY,
+    SECRETS,
+    aggregate_resources,
+    get_condition,
+    pod_resource_requests,
+)
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+
+def make_cluster_obj(name):
+    return {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedCluster",
+        "metadata": {"name": name},
+        "spec": {"apiEndpoint": f"https://{name}", "secretRef": {"name": f"{name}-secret"}},
+    }
+
+
+def make_node(name, cpu="8", memory="32Gi", ready=True, unschedulable=False, taints=()):
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name},
+        "spec": {
+            "unschedulable": unschedulable,
+            "taints": [dict(t) for t in taints],
+        },
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory, "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True" if ready else "False"}],
+        },
+    }
+
+
+def make_pod(name, cpu="500m", memory="1Gi", phase="Running", init_cpu=None):
+    spec = {
+        "containers": [
+            {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": memory}}}
+        ]
+    }
+    if init_cpu:
+        spec["initContainers"] = [
+            {"name": "i", "resources": {"requests": {"cpu": init_cpu}}}
+        ]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+        "status": {"phase": phase},
+    }
+
+
+class TestAggregation:
+    def test_sums_schedulable_nodes_only(self):
+        nodes = [
+            make_node("n1", cpu="4"),
+            make_node("n2", cpu="4", unschedulable=True),
+            make_node("n3", cpu="4", ready=False),
+            make_node("n4", cpu="4", taints=({"key": "k", "effect": "NoSchedule"},)),
+        ]
+        alloc, avail, count = aggregate_resources(nodes, [])
+        assert count == 1
+        assert alloc["cpu"] == 4000
+        assert "pods" not in alloc
+        assert avail == alloc
+
+    def test_available_subtracts_running_pod_requests(self):
+        nodes = [make_node("n1", cpu="4", memory="8Gi")]
+        pods = [
+            make_pod("p1", cpu="1"),
+            make_pod("p2", cpu="500m", phase="Succeeded"),  # not counted
+        ]
+        alloc, avail, _ = aggregate_resources(nodes, pods)
+        assert avail["cpu"] == 3000
+        assert alloc["cpu"] == 4000
+
+    def test_init_container_max_semantics(self):
+        # request = max(sum(containers), initContainers)
+        pod = make_pod("p", cpu="250m", init_cpu="2")
+        reqs = pod_resource_requests(pod)
+        assert reqs["cpu"] == 2000
+
+
+class TestJoinAndHeartbeat:
+    def setup_method(self):
+        self.fleet = ClusterFleet()
+        self.ctl = FederatedClusterController(
+            self.fleet, api_resource_probe=["apps/v1/Deployment"]
+        )
+
+    def test_join_creates_member_artifacts(self):
+        member = self.fleet.add_member("c1")
+        self.fleet.host.create(FEDERATED_CLUSTERS, make_cluster_obj("c1"))
+        self.ctl.run_until_idle()
+
+        cluster = self.fleet.host.get(FEDERATED_CLUSTERS, "c1")
+        assert get_condition(cluster, JOINED)["status"] == "True"
+        ns = member.get(NAMESPACES, FED_SYSTEM_NAMESPACE)
+        assert ns["metadata"]["annotations"][CLUSTER_UID_ANNOTATION] == (
+            cluster["metadata"]["uid"]
+        )
+        secret = self.fleet.host.get(
+            SECRETS, f"{FED_SYSTEM_NAMESPACE}/c1-secret"
+        )
+        assert secret["data"]["token"]
+
+    def test_unjoinable_when_owned_by_other_control_plane(self):
+        member = self.fleet.add_member("c1")
+        member.create(
+            NAMESPACES,
+            {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {
+                    "name": FED_SYSTEM_NAMESPACE,
+                    "annotations": {CLUSTER_UID_ANNOTATION: "someone-else"},
+                },
+            },
+        )
+        self.fleet.host.create(FEDERATED_CLUSTERS, make_cluster_obj("c1"))
+        self.ctl.run_until_idle()
+        cluster = self.fleet.host.get(FEDERATED_CLUSTERS, "c1")
+        cond = get_condition(cluster, JOINED)
+        assert cond["status"] == "False"
+        assert cond["reason"] == "ClusterUnjoinable"
+
+    def test_heartbeat_collects_resources(self):
+        member = self.fleet.add_member("c1")
+        member.create(NODES, make_node("n1", cpu="16", memory="64Gi"))
+        member.create(PODS, make_pod("p1", cpu="2"))
+        self.fleet.host.create(FEDERATED_CLUSTERS, make_cluster_obj("c1"))
+        self.ctl.run_until_idle()
+
+        cluster = self.fleet.host.get(FEDERATED_CLUSTERS, "c1")
+        assert get_condition(cluster, READY)["status"] == "True"
+        assert get_condition(cluster, OFFLINE)["status"] == "False"
+        res = cluster["status"]["resources"]
+        assert res["schedulableNodes"] == 1
+        assert res["allocatable"]["cpu"] == "16000m"
+        assert res["available"]["cpu"] == "14000m"
+        assert cluster["status"]["apiResourceTypes"] == ["apps/v1/Deployment"]
+
+    def test_unhealthy_member_goes_not_ready(self):
+        member = self.fleet.add_member("c1")
+        self.fleet.host.create(FEDERATED_CLUSTERS, make_cluster_obj("c1"))
+        self.ctl.run_until_idle()
+        member.healthy = False
+        self.ctl.worker.enqueue("c1")
+        self.ctl.run_until_idle()
+        cluster = self.fleet.host.get(FEDERATED_CLUSTERS, "c1")
+        assert get_condition(cluster, READY)["status"] == "False"
+        assert get_condition(cluster, OFFLINE)["status"] == "False"
+
+    def test_unreachable_member_goes_offline(self):
+        # Joined once, then the member disappears entirely.
+        self.fleet.add_member("c1")
+        self.fleet.host.create(FEDERATED_CLUSTERS, make_cluster_obj("c1"))
+        self.ctl.run_until_idle()
+        del self.fleet.members["c1"]
+        self.ctl.worker.enqueue("c1")
+        self.ctl.run_until_idle()
+        cluster = self.fleet.host.get(FEDERATED_CLUSTERS, "c1")
+        assert get_condition(cluster, OFFLINE)["status"] == "True"
+        assert get_condition(cluster, READY)["status"] == "Unknown"
+
+    def test_removal_cleans_member_and_releases(self):
+        member = self.fleet.add_member("c1")
+        self.fleet.host.create(FEDERATED_CLUSTERS, make_cluster_obj("c1"))
+        self.ctl.run_until_idle()
+        assert member.try_get(NAMESPACES, FED_SYSTEM_NAMESPACE)
+
+        self.fleet.host.delete(FEDERATED_CLUSTERS, "c1")
+        self.ctl.run_until_idle()
+        assert self.fleet.host.try_get(FEDERATED_CLUSTERS, "c1") is None
+        assert member.try_get(NAMESPACES, FED_SYSTEM_NAMESPACE) is None
+
+
+class TestSyncClusterFinalizer:
+    def test_finalizer_added_and_cascading_delete_waits(self):
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        fleet = ClusterFleet()
+        member = fleet.add_member("c1")
+        clusterctl = FederatedClusterController(fleet)
+        sync = SyncController(fleet, ftc)
+        fleet.host.create(
+            FEDERATED_CLUSTERS,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "FederatedCluster",
+                "metadata": {
+                    "name": "c1",
+                    "annotations": {C.PREFIX + "cascading-delete": ""},
+                },
+                "spec": {},
+            },
+        )
+        clusterctl.run_until_idle()
+        for _ in range(5):
+            if not sync.worker.step():
+                break
+        cluster = fleet.host.get(FEDERATED_CLUSTERS, "c1")
+        assert sync.cluster_finalizer in cluster["metadata"]["finalizers"]
+
+        # A managed object lives in the member; deletion must wait for it.
+        member.create(
+            ftc.source.resource,
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {
+                    "name": "web",
+                    "namespace": "default",
+                    "labels": {C.MANAGED_LABEL: "true"},
+                },
+                "spec": {},
+            },
+        )
+        fleet.host.delete(FEDERATED_CLUSTERS, "c1")
+        for _ in range(5):
+            sync.worker.step()
+            clusterctl.worker.step()
+        assert fleet.host.try_get(FEDERATED_CLUSTERS, "c1") is not None
+
+        # Managed object removed -> sync finalizer released -> cluster
+        # controller finishes the removal.
+        member.delete(ftc.source.resource, "default/web")
+        sync.worker.enqueue("cluster::c1")
+        for _ in range(10):
+            sync.worker.step()
+            clusterctl.worker.step()
+        assert fleet.host.try_get(FEDERATED_CLUSTERS, "c1") is None
+
+
+class TestJoinTimeout:
+    def test_join_failure_becomes_terminal_after_timeout(self):
+        fleet = ClusterFleet()  # member never appears
+        now = [0.0]
+        ctl = FederatedClusterController(
+            fleet, join_timeout=5.0, clock=lambda: now[0]
+        )
+        fleet.host.create(FEDERATED_CLUSTERS, make_cluster_obj("ghost"))
+        ctl.run_until_idle()
+        cluster = fleet.host.get(FEDERATED_CLUSTERS, "ghost")
+        assert get_condition(cluster, JOINED)["reason"] == "TokenNotObtained"
+
+        now[0] = 10.0  # past the timeout; retry lands terminal
+        ctl.worker.enqueue("ghost")
+        ctl.run_until_idle()
+        cluster = fleet.host.get(FEDERATED_CLUSTERS, "ghost")
+        cond = get_condition(cluster, JOINED)
+        assert cond["status"] == "False"
+        assert cond["reason"] == "JoinTimeoutExceeded"
+
+        # Terminal: no further retries enqueue work.
+        ctl.worker.enqueue("ghost")
+        ctl.run_until_idle()
+        cluster2 = fleet.host.get(FEDERATED_CLUSTERS, "ghost")
+        assert get_condition(cluster2, JOINED)["reason"] == "JoinTimeoutExceeded"

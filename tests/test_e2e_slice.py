@@ -1,0 +1,179 @@
+"""The minimum end-to-end slice (SURVEY.md §7 step 3), driven the way the
+reference's e2e resourcepropagation suite drives a real control plane
+(reference: test/e2e/resourcepropagation/framework.go:91): create member
+clusters + a source Deployment + a PropagationPolicy, run every
+controller, and observe propagation, replica distribution and status.
+"""
+
+import dataclasses
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    NODES,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+
+def deployment_ftc(pipeline=None):
+    ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+    if pipeline is not None:
+        ftc = dataclasses.replace(ftc, controllers=pipeline)
+    return ftc
+
+
+def make_node(name, cpu, memory):
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name},
+        "spec": {},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def make_deployment(name="web", replicas=9, labels=None):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": labels or {"kubeadmiral.io/propagation-policy-name": "pp"},
+        },
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "nginx",
+                            "resources": {"requests": {"cpu": "100m"}},
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+def settle(*controllers, rounds=20):
+    for _ in range(rounds):
+        progressed = False
+        for c in controllers:
+            progressed |= c.worker.step()
+        if not progressed:
+            return
+
+
+class TestEndToEndSlice:
+    def setup_method(self):
+        # Scheduler-only pipeline: the override controller doesn't run in
+        # this slice, so it must not gate sync.
+        self.ftc = deployment_ftc(
+            pipeline=(("kubeadmiral.io/global-scheduler",),)
+        )
+        self.fleet = ClusterFleet()
+        gvk = "apps/v1/Deployment"
+        self.clusterctl = FederatedClusterController(
+            self.fleet, api_resource_probe=[gvk]
+        )
+        self.federate = FederateController(self.fleet.host, self.ftc)
+        self.scheduler = SchedulerController(self.fleet.host, self.ftc)
+        self.sync = SyncController(self.fleet, self.ftc)
+
+        for name, cpu in (("c1", "64"), ("c2", "32"), ("c3", "32")):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", cpu, "128Gi"))
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                },
+            )
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {"schedulingMode": "Divide"},
+            },
+        )
+
+    def everything(self):
+        return (self.clusterctl, self.federate, self.scheduler, self.sync)
+
+    def test_deployment_propagates_with_divided_replicas(self):
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.everything())
+
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        placed = C.get_placement(fed, C.SCHEDULER)
+        assert placed == {"c1", "c2", "c3"}
+
+        total = 0
+        for name in ("c1", "c2", "c3"):
+            obj = self.fleet.member(name).get(
+                self.ftc.source.resource, "default/web"
+            )
+            assert obj["metadata"]["labels"][C.MANAGED_LABEL] == "true"
+            total += obj["spec"]["replicas"]
+        assert total == 9
+
+        status = {c["cluster"]: c["status"] for c in fed["status"]["clusters"]}
+        assert status == {"c1": "OK", "c2": "OK", "c3": "OK"}
+
+    def test_source_update_rolls_through(self):
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.everything())
+        src = self.fleet.host.get(self.ftc.source.resource, "default/web")
+        src["spec"]["replicas"] = 15
+        src["spec"]["template"]["spec"]["containers"][0]["image"] = "nginx:2"
+        self.fleet.host.update(self.ftc.source.resource, src)
+        settle(*self.everything())
+
+        total = 0
+        for name in ("c1", "c2", "c3"):
+            obj = self.fleet.member(name).get(
+                self.ftc.source.resource, "default/web"
+            )
+            assert obj["spec"]["template"]["spec"]["containers"][0]["image"] == (
+                "nginx:2"
+            )
+            total += obj["spec"]["replicas"]
+        assert total == 15
+
+    def test_source_delete_cascades_everywhere(self):
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.everything())
+        self.fleet.host.delete(self.ftc.source.resource, "default/web")
+        settle(*self.everything(), rounds=40)
+
+        assert self.fleet.host.try_get(self.ftc.source.resource, "default/web") is None
+        assert (
+            self.fleet.host.try_get(self.ftc.federated.resource, "default/web")
+            is None
+        )
+        for name in ("c1", "c2", "c3"):
+            assert (
+                self.fleet.member(name).try_get(
+                    self.ftc.source.resource, "default/web"
+                )
+                is None
+            )

@@ -1,0 +1,236 @@
+"""Federate controller: source object -> federated object lifecycle.
+
+Mirrors the behaviors of reference pkg/controllers/federate:
+creation with template pruning + annotation/label classification,
+idempotent updates, merge-patch bookkeeping, deletion propagation
+gated by the source finalizer, and the no-federated-resource opt-out.
+"""
+
+import json
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.federate import (
+    FEDERATE_FINALIZER,
+    FederateController,
+    NO_FEDERATED_RESOURCE,
+    OBSERVED_ANNOTATION_KEYS,
+    OBSERVED_LABEL_KEYS,
+    TEMPLATE_GENERATOR_MERGE_PATCH,
+    new_federated_object,
+    observed_keys,
+    update_federated_object,
+)
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+from kubeadmiral_tpu.utils.jsonpatch import apply_merge_patch
+
+
+def deployment_ftc():
+    return next(f for f in default_ftcs() if f.name == "deployments.apps")
+
+
+def make_deployment(name="web", namespace="default", replicas=3, **meta_kw):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": namespace, **meta_kw},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+            },
+        },
+    }
+
+
+class TestNewFederatedObject:
+    def test_template_is_pruned_source(self):
+        ftc = deployment_ftc()
+        src = make_deployment()
+        src["metadata"].update(
+            {
+                "uid": "u-123",
+                "resourceVersion": "42",
+                "generation": 7,
+                "creationTimestamp": "2026-01-01T00:00:00Z",
+                "managedFields": [{"manager": "kubectl"}],
+                "finalizers": ["some.io/fin"],
+            }
+        )
+        src["status"] = {"replicas": 3}
+        fed = new_federated_object(ftc, src)
+        tmpl = fed["spec"]["template"]
+        assert tmpl["metadata"] == {"name": "web", "namespace": "default"}
+        assert "status" not in tmpl
+        assert fed["kind"] == "FederatedDeployment"
+        assert fed["apiVersion"] == "types.kubeadmiral.io/v1alpha1"
+        assert fed["metadata"]["name"] == "web"
+        assert fed["metadata"]["namespace"] == "default"
+
+    def test_annotation_classification(self):
+        ftc = deployment_ftc()
+        src = make_deployment(
+            annotations={
+                C.PREFIX + "scheduling-mode": "Divide",  # federated
+                "team": "infra",  # template
+                C.SOURCE_FEEDBACK_SYNCING: "x",  # ignored
+            }
+        )
+        fed = new_federated_object(ftc, src)
+        fa = fed["metadata"]["annotations"]
+        assert fa[C.PREFIX + "scheduling-mode"] == "Divide"
+        assert "team" not in fa
+        assert C.SOURCE_FEEDBACK_SYNCING not in fa
+        tmpl_anno = fed["spec"]["template"]["metadata"]["annotations"]
+        assert tmpl_anno == {"team": "infra"}
+        # observed-keys bookkeeping: fed keys | other keys
+        assert fa[OBSERVED_ANNOTATION_KEYS] == (
+            C.PREFIX + "scheduling-mode" + "|" + C.SOURCE_FEEDBACK_SYNCING + ",team"
+        )
+
+    def test_label_classification(self):
+        ftc = deployment_ftc()
+        src = make_deployment(
+            labels={
+                "kubeadmiral.io/propagation-policy-name": "pp-1",
+                "app": "web",
+            }
+        )
+        fed = new_federated_object(ftc, src)
+        assert fed["metadata"]["labels"] == {
+            "kubeadmiral.io/propagation-policy-name": "pp-1"
+        }
+        assert fed["spec"]["template"]["metadata"]["labels"] == {"app": "web"}
+        assert fed["metadata"]["annotations"][OBSERVED_LABEL_KEYS] == (
+            "kubeadmiral.io/propagation-policy-name|app"
+        )
+
+    def test_merge_patch_reconstructs_template(self):
+        ftc = deployment_ftc()
+        src = make_deployment()
+        src["metadata"]["uid"] = "u-1"
+        src["status"] = {"replicas": 1}
+        fed = new_federated_object(ftc, src)
+        patch = json.loads(
+            fed["metadata"]["annotations"][TEMPLATE_GENERATOR_MERGE_PATCH]
+        )
+        assert apply_merge_patch(src, patch) == fed["spec"]["template"]
+
+    def test_pending_controllers_initialized(self):
+        ftc = deployment_ftc()
+        fed = new_federated_object(ftc, make_deployment())
+        assert pending.get_pending(fed) == ftc.controller_groups
+
+    def test_deployment_fields(self):
+        ftc = deployment_ftc()
+        src = make_deployment(annotations={C.RETAIN_REPLICAS: "true"})
+        fed = new_federated_object(ftc, src)
+        assert fed["spec"]["retainReplicas"] is True
+        assert fed["spec"]["revisionHistoryLimit"] == 1
+
+
+class TestUpdateFederatedObject:
+    def test_noop_when_unchanged(self):
+        ftc = deployment_ftc()
+        src = make_deployment()
+        fed = new_federated_object(ftc, src)
+        assert update_federated_object(fed, ftc, src) is False
+
+    def test_template_change_restarts_pipeline(self):
+        ftc = deployment_ftc()
+        src = make_deployment()
+        fed = new_federated_object(ftc, src)
+        # downstream consumed the pipeline
+        pending.update_pending(fed, C.SCHEDULER, True, ftc.controller_groups)
+        src["spec"]["replicas"] = 9
+        assert update_federated_object(fed, ftc, src) is True
+        assert fed["spec"]["template"]["spec"]["replicas"] == 9
+        assert pending.get_pending(fed) == ftc.controller_groups
+
+    def test_preserves_foreign_annotations(self):
+        ftc = deployment_ftc()
+        src = make_deployment()
+        fed = new_federated_object(ftc, src)
+        fed["metadata"]["annotations"]["other.io/note"] = "keep-me"
+        src["spec"]["replicas"] = 5
+        update_federated_object(fed, ftc, src)
+        assert fed["metadata"]["annotations"]["other.io/note"] == "keep-me"
+
+    def test_removes_stale_federated_annotations(self):
+        ftc = deployment_ftc()
+        src = make_deployment(annotations={C.PREFIX + "max-clusters": "2"})
+        fed = new_federated_object(ftc, src)
+        del src["metadata"]["annotations"][C.PREFIX + "max-clusters"]
+        assert update_federated_object(fed, ftc, src) is True
+        assert C.PREFIX + "max-clusters" not in fed["metadata"]["annotations"]
+
+
+class TestObservedKeys:
+    def test_empty(self):
+        assert observed_keys({}, {}) == ""
+
+    def test_sorted_partition(self):
+        src = {"b": "1", "a": "2", "z": "3"}
+        fed = {"z": "3"}
+        assert observed_keys(src, fed) == "z|a,b"
+
+
+class TestFederateController:
+    def setup_method(self):
+        self.kube = FakeKube()
+        self.ftc = deployment_ftc()
+        self.ctl = FederateController(self.kube, self.ftc)
+        self.src_res = self.ftc.source.resource
+        self.fed_res = self.ftc.federated.resource
+
+    def test_creates_federated_object(self):
+        self.kube.create(self.src_res, make_deployment())
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        assert fed["kind"] == "FederatedDeployment"
+        src = self.kube.get(self.src_res, "default/web")
+        assert FEDERATE_FINALIZER in src["metadata"]["finalizers"]
+
+    def test_source_update_propagates(self):
+        self.kube.create(self.src_res, make_deployment(replicas=1))
+        self.ctl.run_until_idle()
+        src = self.kube.get(self.src_res, "default/web")
+        src["spec"]["replicas"] = 8
+        self.kube.update(self.src_res, src)
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        assert fed["spec"]["template"]["spec"]["replicas"] == 8
+
+    def test_no_federated_resource_annotation_skips(self):
+        self.kube.create(
+            self.src_res,
+            make_deployment(annotations={NO_FEDERATED_RESOURCE: "1"}),
+        )
+        self.ctl.run_until_idle()
+        assert self.kube.try_get(self.fed_res, "default/web") is None
+
+    def test_source_deletion_cascades(self):
+        self.kube.create(self.src_res, make_deployment())
+        self.ctl.run_until_idle()
+        # deletion is finalizer-gated on the source
+        self.kube.delete(self.src_res, "default/web")
+        self.ctl.run_until_idle()
+        # federated object deleted (no finalizers on it in this test)
+        assert self.kube.try_get(self.fed_res, "default/web") is None
+        # source released once the federated object is gone
+        assert self.kube.try_get(self.src_res, "default/web") is None
+
+    def test_feedback_annotations_flow_back(self):
+        self.kube.create(self.src_res, make_deployment())
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        fed["metadata"]["annotations"][C.SOURCE_FEEDBACK_SYNCING] = '{"ok":true}'
+        self.kube.update(self.fed_res, fed)
+        self.ctl.run_until_idle()
+        src = self.kube.get(self.src_res, "default/web")
+        assert src["metadata"]["annotations"][C.SOURCE_FEEDBACK_SYNCING] == (
+            '{"ok":true}'
+        )

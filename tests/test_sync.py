@@ -435,3 +435,80 @@ class TestSyncController:
         fleet.host.create(ctl._fed_resource, fed)
         run_sync(ctl)
         assert fleet.member("c1").try_get("apps/v1/deployments", "default/web") is None
+
+    def test_deletion_blocked_by_unready_cluster(self):
+        # A joined-but-unready cluster that may hold the object must keep
+        # the finalizer in place (no silent member-object leak).
+        fleet = fleet_with(2)
+        ctl = SyncController(fleet, deployment_ftc())
+        fed = make_fed_deployment(clusters=("c1", "c2"))
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        assert fleet.member("c2").try_get("apps/v1/deployments", "default/web")
+
+        # c2 goes unready, then the federated object is deleted.
+        c2 = fleet.host.get(FEDERATED_CLUSTERS, "c2")
+        c2["status"]["conditions"] = [
+            {"type": "Joined", "status": "True"},
+            {"type": "Ready", "status": "False"},
+        ]
+        fleet.host.update_status(FEDERATED_CLUSTERS, c2)
+        fleet.host.delete(ctl._fed_resource, "default/web")
+        run_sync(ctl, rounds=10)
+
+        # Finalizer still held; member object not leaked.
+        assert fleet.host.try_get(ctl._fed_resource, "default/web") is not None
+        assert fleet.member("c2").try_get("apps/v1/deployments", "default/web")
+
+        # Cluster recovers -> deletion completes.
+        c2 = fleet.host.get(FEDERATED_CLUSTERS, "c2")
+        c2["status"]["conditions"] = [
+            {"type": "Joined", "status": "True"},
+            {"type": "Ready", "status": "True"},
+        ]
+        fleet.host.update_status(FEDERATED_CLUSTERS, c2)
+        ctl.worker.enqueue("default/web")
+        run_sync(ctl, rounds=10)
+        assert fleet.member("c2").try_get("apps/v1/deployments", "default/web") is None
+        assert fleet.host.try_get(ctl._fed_resource, "default/web") is None
+
+
+class TestConfigMapDrift:
+    def test_member_data_drift_is_repaired(self):
+        # ConfigMaps carry no generation; drift detection must fall back
+        # to resourceVersion so out-of-band member edits are reverted.
+        ftc = next(f for f in default_ftcs() if f.name == "configmaps")
+        fleet = fleet_with(1, names=["c1"])
+        ctl = SyncController(fleet, ftc)
+        fed = {
+            "apiVersion": "types.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedConfigMap",
+            "metadata": {
+                "name": "cm",
+                "namespace": "default",
+                "annotations": {pending.PENDING_CONTROLLERS: json.dumps([])},
+            },
+            "spec": {
+                "template": {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": "cm", "namespace": "default"},
+                    "data": {"k": "v"},
+                },
+                "placements": [
+                    {"controller": C.SCHEDULER, "placement": [{"cluster": "c1"}]}
+                ],
+            },
+        }
+        fleet.host.create(ctl._fed_resource, fed)
+        run_sync(ctl)
+        obj = fleet.member("c1").get("v1/configmaps", "default/cm")
+        assert obj["data"] == {"k": "v"}
+
+        # Out-of-band member edit.
+        obj["data"] = {"k": "tampered"}
+        fleet.member("c1").update("v1/configmaps", obj)
+        ctl.worker.enqueue("default/cm")
+        run_sync(ctl)
+        obj = fleet.member("c1").get("v1/configmaps", "default/cm")
+        assert obj["data"] == {"k": "v"}
