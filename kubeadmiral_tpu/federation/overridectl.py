@@ -1,0 +1,233 @@
+"""The override-policy controller: per-cluster JSONPatch resolution.
+
+Matches Override/ClusterOverridePolicies to federated objects via the
+policy-name labels, resolves each placed cluster's ordered patch list
+from the policies' overrideRules (cluster name / selector / affinity
+criteria ANDed per rule), writes the result into ``spec.overrides`` under
+this controller's name, and flips the pending-controllers pipeline
+(reference: pkg/controllers/override/overridepolicy_controller.go:109-427,
+util.go:45-230).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.models import policy as P
+from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.worker import Result, Worker
+from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_key
+from kubeadmiral_tpu.utils.labels import match_terms, matches_selector_set
+
+OVERRIDE_POLICIES = "core.kubeadmiral.io/v1alpha1/overridepolicies"
+CLUSTER_OVERRIDE_POLICIES = "core.kubeadmiral.io/v1alpha1/clusteroverridepolicies"
+
+OVERRIDE_POLICY_NAME_LABEL = C.PREFIX + "override-policy-name"
+CLUSTER_OVERRIDE_POLICY_NAME_LABEL = C.PREFIX + "cluster-override-policy-name"
+
+
+class PolicyResolutionError(Exception):
+    """Matched policy missing or malformed (terminal until it changes)."""
+
+
+def is_cluster_matched(target_clusters: Optional[dict], cluster: dict) -> bool:
+    """A rule's targetClusters vs one FederatedCluster; the three criteria
+    are ANDed and each empty criterion matches everything
+    (override/util.go:154-222)."""
+    if not target_clusters:
+        return True
+    name = cluster["metadata"]["name"]
+    labels = cluster["metadata"].get("labels", {}) or {}
+
+    names = target_clusters.get("clusters") or []
+    if names and name not in names:
+        return False
+
+    selector = target_clusters.get("clusterSelector") or {}
+    if selector and not matches_selector_set(labels, selector):
+        return False
+
+    affinity = target_clusters.get("clusterAffinity") or []
+    if affinity:
+        terms = [P.parse_selector_term(t) for t in affinity]
+        if not match_terms(labels, {"metadata.name": name}, terms):
+            return False
+    return True
+
+
+def parse_overrides(policy_obj: dict, clusters: list[dict]) -> dict[str, list]:
+    """One policy × placed clusters -> {cluster: [RFC6902 patches]}
+    (override/util.go:99-141 parseOverrides)."""
+    out: dict[str, list] = {}
+    rules = policy_obj.get("spec", {}).get("overrideRules", []) or []
+    for cluster in clusters:
+        patches: list[dict] = []
+        for rule in rules:
+            if not is_cluster_matched(rule.get("targetClusters"), cluster):
+                continue
+            for overrider in rule.get("overriders", {}).get("jsonpatch", []) or []:
+                patch = {
+                    "op": overrider.get("operator", "replace"),
+                    "path": overrider.get("path", ""),
+                }
+                if "value" in overrider:
+                    patch["value"] = overrider["value"]
+                patches.append(patch)
+        if patches:
+            out[cluster["metadata"]["name"]] = patches
+    return out
+
+
+def merge_overrides(dest: dict[str, list], src: dict[str, list]) -> dict[str, list]:
+    for cluster, patches in src.items():
+        dest.setdefault(cluster, []).extend(patches)
+    return dest
+
+
+class OverrideController:
+    """Per-FTC controller resolving override policies into
+    ``spec.overrides`` (overridepolicy_controller.go)."""
+
+    name = C.OVERRIDE_CONTROLLER
+
+    def __init__(
+        self,
+        host: FakeKube,
+        ftc: FederatedTypeConfig,
+        metrics: Optional[Metrics] = None,
+        clock=None,
+    ):
+        self.host = host
+        self.ftc = ftc
+        self.metrics = metrics or Metrics()
+        self._fed_resource = ftc.federated.resource
+        self.worker = Worker(
+            f"override-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        )
+        host.watch(self._fed_resource, self._on_object_event, replay=True)
+        host.watch(OVERRIDE_POLICIES, self._on_policy_event, replay=False)
+        host.watch(CLUSTER_OVERRIDE_POLICIES, self._on_policy_event, replay=False)
+        host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+
+    # -- event fan-in (controller.go:226-252) ----------------------------
+    def _on_object_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
+    def _on_policy_event(self, event: str, obj: dict) -> None:
+        pname = obj["metadata"]["name"]
+        cluster_scoped = not obj["metadata"].get("namespace")
+        label = (
+            CLUSTER_OVERRIDE_POLICY_NAME_LABEL
+            if cluster_scoped
+            else OVERRIDE_POLICY_NAME_LABEL
+        )
+        matched: list[str] = []
+
+        def check(fed: dict) -> None:
+            if fed["metadata"].get("labels", {}).get(label) != pname:
+                return
+            if not cluster_scoped and fed["metadata"].get("namespace") != obj[
+                "metadata"
+            ].get("namespace"):
+                return
+            matched.append(obj_key(fed))
+
+        self.host.scan(self._fed_resource, check)
+        self.worker.enqueue_all(matched)
+
+    def _on_cluster_event(self, event: str, obj: dict) -> None:
+        matched: list[str] = []
+
+        def check(fed: dict) -> None:
+            labels = fed["metadata"].get("labels", {}) or {}
+            if labels.get(OVERRIDE_POLICY_NAME_LABEL) or labels.get(
+                CLUSTER_OVERRIDE_POLICY_NAME_LABEL
+            ):
+                matched.append(obj_key(fed))
+
+        self.host.scan(self._fed_resource, check)
+        self.worker.enqueue_all(matched)
+
+    def run_until_idle(self) -> None:
+        while self.worker.step():
+            pass
+
+    # -- policy lookup (util.go:45-97) -----------------------------------
+    def _matched_policies(self, fed_obj: dict) -> list[dict]:
+        labels = fed_obj["metadata"].get("labels", {}) or {}
+        policies = []
+
+        cname = labels.get(CLUSTER_OVERRIDE_POLICY_NAME_LABEL)
+        if cname is not None:
+            if not cname:
+                raise PolicyResolutionError("policy name cannot be empty")
+            obj = self.host.try_get(CLUSTER_OVERRIDE_POLICIES, cname)
+            if obj is None:
+                raise PolicyResolutionError(
+                    f"ClusterOverridePolicy {cname} not found"
+                )
+            policies.append(obj)
+
+        name = labels.get(OVERRIDE_POLICY_NAME_LABEL)
+        if self.ftc.namespaced and name is not None:
+            if not name:
+                raise PolicyResolutionError("policy name cannot be empty")
+            key = fed_obj["metadata"].get("namespace", "") + "/" + name
+            obj = self.host.try_get(OVERRIDE_POLICIES, key)
+            if obj is None:
+                raise PolicyResolutionError(f"OverridePolicy {key} not found")
+            policies.append(obj)
+        return policies
+
+    def _placed_clusters(self, fed_obj: dict) -> list[dict]:
+        placed = C.all_placement_clusters(fed_obj)
+        return [
+            c
+            for c in self.host.list(C.FEDERATED_CLUSTERS)
+            if c["metadata"]["name"] in placed
+        ]
+
+    # -- reconcile (controller.go:254-377) -------------------------------
+    def reconcile(self, key: str) -> Result:
+        self.metrics.counter("override.throughput")
+        fed_obj = self.host.try_get(self._fed_resource, key)
+        if fed_obj is None or fed_obj["metadata"].get("deletionTimestamp"):
+            return Result.ok()
+
+        try:
+            if not pending.dependencies_fulfilled(fed_obj, self.name):
+                return Result.ok()
+        except KeyError:
+            return Result.ok()  # not initialized by federate yet
+
+        try:
+            policies = self._matched_policies(fed_obj)
+        except PolicyResolutionError:
+            # A dangling policy reference: nothing to do until the policy
+            # appears (its creation re-enqueues us).
+            return Result.ok()
+
+        clusters = self._placed_clusters(fed_obj)
+        overrides: dict[str, list] = {}
+        for policy in policies:
+            merge_overrides(overrides, parse_overrides(policy, clusters))
+
+        current = C.get_overrides(fed_obj, self.name)
+        needs_update = current != overrides
+        if needs_update:
+            C.set_overrides(fed_obj, self.name, overrides)
+
+        pending_updated = pending.update_pending(
+            fed_obj, self.name, needs_update, self.ftc.controller_groups
+        )
+        if needs_update or pending_updated:
+            try:
+                self.host.update(self._fed_resource, fed_obj)
+            except Conflict:
+                return Result.retry()
+            except NotFound:
+                return Result.ok()
+        return Result.ok()

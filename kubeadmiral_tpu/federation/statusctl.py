@@ -1,0 +1,434 @@
+"""Status collection and aggregation.
+
+Two per-FTC controllers close the feedback loop from member clusters back
+to the user:
+
+* :class:`StatusController` — collects the FTC's ``statusCollection``
+  dotted fields from each placed member object into a companion status CR
+  (``FederatedXStatus`` with ``clusterStatus: [{clusterName, ...fields}]``),
+  owned by the federated object (reference:
+  pkg/controllers/status/controller.go:126-686).
+* :class:`StatusAggregator` — folds member statuses back onto the
+  **source** object via per-kind plugins: Deployments get summed
+  replica counts on the status subresource; other kinds get the
+  sourcefeedback annotation (reference:
+  pkg/controllers/statusaggregator/controller.go:110-399, plugins/).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.worker import Result, Worker
+from kubeadmiral_tpu.testing.fakekube import (
+    AlreadyExists,
+    ClusterFleet,
+    Conflict,
+    NotFound,
+    obj_key,
+)
+from kubeadmiral_tpu.utils.unstructured import get_path, set_path
+
+class StatusController:
+    """Collects member-object fields into the status CR."""
+
+    name = "status-controller"
+
+    def __init__(
+        self,
+        fleet: ClusterFleet,
+        ftc: FederatedTypeConfig,
+        metrics: Optional[Metrics] = None,
+        clock=None,
+    ):
+        if ftc.status is None:
+            raise ValueError(f"FTC {ftc.name} has no status type")
+        self.fleet = fleet
+        self.host = fleet.host
+        self.ftc = ftc
+        self.metrics = metrics or Metrics()
+        self._fed_resource = ftc.federated.resource
+        self._target_resource = ftc.source.resource
+        self._status_resource = ftc.status.resource
+        self.worker = Worker(
+            f"status-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        )
+        self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
+        self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+        self._reattach = fleet.watch_members(
+            self._target_resource, self._on_member_event
+        )
+
+    def _on_fed_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
+    def _on_member_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
+    def _on_cluster_event(self, event: str, obj: dict) -> None:
+        self._reattach()
+        self.worker.enqueue_all(self.host.keys(self._fed_resource))
+
+    def run_until_idle(self) -> None:
+        while self.worker.step():
+            pass
+
+    # -- reconcile (status/controller.go:291-450) ------------------------
+    def reconcile(self, key: str) -> Result:
+        self.metrics.counter("status.throughput")
+        fed_obj = self.host.try_get(self._fed_resource, key)
+
+        if fed_obj is None or fed_obj["metadata"].get("deletionTimestamp"):
+            # Federated object gone: drop the status CR.
+            try:
+                self.host.delete(self._status_resource, key)
+            except NotFound:
+                pass
+            return Result.ok()
+
+        cluster_status = self._cluster_statuses(fed_obj, key)
+        desired = {
+            "apiVersion": self.ftc.status.api_version,
+            "kind": self.ftc.status.kind,
+            "metadata": {
+                "name": fed_obj["metadata"]["name"],
+                "labels": dict(fed_obj["metadata"].get("labels", {}) or {}),
+            },
+            "clusterStatus": cluster_status,
+        }
+        if fed_obj["metadata"].get("namespace"):
+            desired["metadata"]["namespace"] = fed_obj["metadata"]["namespace"]
+
+        existing = self.host.try_get(self._status_resource, key)
+        if existing is None:
+            try:
+                self.host.create(self._status_resource, desired)
+            except AlreadyExists:
+                return Result.retry()
+            return Result.ok()
+
+        if (
+            existing.get("clusterStatus") != cluster_status
+            or (existing["metadata"].get("labels") or {})
+            != desired["metadata"]["labels"]
+        ):
+            existing["clusterStatus"] = cluster_status
+            existing["metadata"]["labels"] = desired["metadata"]["labels"]
+            try:
+                self.host.update(self._status_resource, existing)
+            except Conflict:
+                return Result.retry()
+            except NotFound:
+                return Result.retry()
+        return Result.ok()
+
+    def _cluster_statuses(self, fed_obj: dict, key: str) -> list[dict]:
+        """Per placed cluster, the collected dotted fields
+        (status/controller.go:491-560 clusterStatuses)."""
+        placed = sorted(C.all_placement_clusters(fed_obj))
+        out = []
+        for cname in placed:
+            entry: dict = {"clusterName": cname}
+            try:
+                member = self.fleet.member(cname)
+            except NotFound:
+                entry["error"] = "cluster unavailable"
+                out.append(entry)
+                continue
+            obj = member.try_get(self._target_resource, key)
+            if obj is None:
+                continue  # not propagated yet: skip silently
+            collected: dict = {}
+            for field in self.ftc.status_collection_fields:
+                value = get_path(obj, field)
+                if value is None:
+                    continue
+                set_path(collected, field, value)
+            entry["collectedFields"] = collected
+            out.append(entry)
+        return out
+
+
+# -- aggregation plugins (statusaggregator/plugins/) ---------------------
+
+_SUMMED_DEPLOYMENT_FIELDS = (
+    "replicas",
+    "updatedReplicas",
+    "readyReplicas",
+    "availableReplicas",
+    "unavailableReplicas",
+)
+
+
+def aggregate_workload_status(
+    source: dict, cluster_objs: dict[str, dict], up_to_date: bool
+) -> Optional[dict]:
+    """Deployment-family aggregation: sum the replica counters across
+    clusters; bump observedGeneration to the source's generation only
+    when every member status reflects the latest sync
+    (plugins/deployment.go:42-160)."""
+    agg = {f: 0 for f in _SUMMED_DEPLOYMENT_FIELDS}
+    if not cluster_objs:
+        up_to_date = False
+    for obj in cluster_objs.values():
+        status = obj.get("status")
+        if not status:
+            up_to_date = False
+            continue
+        for f in _SUMMED_DEPLOYMENT_FIELDS:
+            agg[f] += int(status.get(f, 0) or 0)
+    new_status = {f: v for f, v in agg.items() if v}
+    if up_to_date:
+        new_status["observedGeneration"] = source["metadata"].get("generation", 1)
+    else:
+        old = (source.get("status") or {}).get("observedGeneration")
+        if old is not None:
+            new_status["observedGeneration"] = old
+    return new_status
+
+
+def aggregate_single_cluster(
+    source: dict, cluster_objs: dict[str, dict], up_to_date: bool
+) -> Optional[dict]:
+    """Adopt the lone member's status verbatim; ambiguous with more than
+    one placement (plugins/single_cluster_plugin.go)."""
+    if len(cluster_objs) != 1:
+        return None
+    (obj,) = cluster_objs.values()
+    return obj.get("status")
+
+
+def _job_finished_failed(status: dict) -> bool:
+    return any(
+        c.get("type") == "Failed" and c.get("status") == "True"
+        for c in status.get("conditions", []) or []
+    )
+
+
+def aggregate_job_status(
+    source: dict, cluster_objs: dict[str, dict], up_to_date: bool
+) -> Optional[dict]:
+    """Jobs: sum active/succeeded/failed, min startTime; once every
+    cluster's job finished, a federation-level Complete/Failed condition
+    summarizes where it completed vs failed (plugins/job.go:43-140).
+    Timestamps are RFC3339 strings, so lexicographic min/max is
+    chronological."""
+    agg: dict = {"active": 0, "succeeded": 0, "failed": 0}
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    finished = 0
+    completed_in: list[str] = []
+    failed_in: list[str] = []
+    for cname, obj in sorted(cluster_objs.items()):
+        status = obj.get("status")
+        if not status:
+            continue
+        st = status.get("startTime")
+        if st and (start_time is None or st < start_time):
+            start_time = st
+        ct = status.get("completionTime")
+        if ct:
+            finished += 1
+            completed_in.append(cname)
+            if completion_time is None or ct > completion_time:
+                completion_time = ct
+        elif _job_finished_failed(status):
+            finished += 1
+            failed_in.append(cname)
+        for f in ("active", "succeeded", "failed"):
+            agg[f] += int(status.get(f, 0) or 0)
+
+    new_status = {f: v for f, v in agg.items() if v}
+    if start_time is not None:
+        new_status["startTime"] = start_time
+    if finished > 0 and finished == len(cluster_objs):
+        if completed_in and failed_in:
+            cond = {
+                "type": "Failed",
+                "status": "True",
+                "reason": "Mixed",
+                "message": (
+                    f"Job completed in clusters {completed_in} "
+                    f"and failed in clusters {failed_in}"
+                ),
+            }
+        elif completed_in:
+            cond = {
+                "type": "Complete",
+                "status": "True",
+                "reason": "Completed",
+                "message": f"Job completed in clusters {completed_in}",
+            }
+            if completion_time is not None:
+                new_status["completionTime"] = completion_time
+        else:
+            cond = {
+                "type": "Failed",
+                "status": "True",
+                "reason": "Failed",
+                "message": f"Job failed in clusters {failed_in}",
+            }
+        new_status["conditions"] = [cond]
+    return new_status
+
+
+# Phase precedence: any failure dominates, then pending, running, and only
+# all-succeeded reads Succeeded (plugins/pod.go:101-130).
+_POD_PHASE_ORDER = ("Failed", "Pending", "Running", "Succeeded")
+
+
+def aggregate_pod_status(
+    source: dict, cluster_objs: dict[str, dict], up_to_date: bool
+) -> Optional[dict]:
+    """Pods: federation-level phase by precedence, min startTime, member
+    container statuses concatenated with the cluster name suffixed
+    (plugins/pod.go:41-130)."""
+    phases: dict[str, list[str]] = {p: [] for p in _POD_PHASE_ORDER}
+    new_status: dict = {}
+    start_time: Optional[str] = None
+    containers: list[dict] = []
+    init_containers: list[dict] = []
+    for cname, obj in sorted(cluster_objs.items()):
+        status = obj.get("status") or {}
+        phase = status.get("phase") or "Pending"
+        if phase in phases:
+            phases[phase].append(cname)
+        st = status.get("startTime")
+        if st and (start_time is None or st < start_time):
+            start_time = st
+        for cs in status.get("initContainerStatuses", []) or []:
+            cs = dict(cs)
+            cs["name"] = f"{cs.get('name')} ({cname})"
+            init_containers.append(cs)
+        for cs in status.get("containerStatuses", []) or []:
+            cs = dict(cs)
+            cs["name"] = f"{cs.get('name')} ({cname})"
+            containers.append(cs)
+
+    messages = []
+    for phase in _POD_PHASE_ORDER:
+        if not phases[phase]:
+            continue
+        new_status.setdefault("phase", phase)
+        messages.append(f"pod is {phase} in clusters {sorted(phases[phase])}")
+    if messages:
+        new_status["message"] = "; ".join(messages)
+    if start_time is not None:
+        new_status["startTime"] = start_time
+    if init_containers:
+        new_status["initContainerStatuses"] = init_containers
+    if containers:
+        new_status["containerStatuses"] = containers
+    return new_status
+
+
+# GVK -> plugin, mirroring the reference registry (plugins/plugin.go:42-47:
+# Deployment summed, StatefulSet single-cluster, Job merged, Pod phased).
+AGGREGATION_PLUGINS: dict[str, Callable] = {
+    "apps/v1/Deployment": aggregate_workload_status,
+    "apps/v1/StatefulSet": aggregate_single_cluster,
+    "batch/v1/Job": aggregate_job_status,
+    "v1/Pod": aggregate_pod_status,
+}
+
+
+class StatusAggregator:
+    """Folds member statuses back onto the source object."""
+
+    name = "status-aggregator"
+
+    def __init__(
+        self,
+        fleet: ClusterFleet,
+        ftc: FederatedTypeConfig,
+        metrics: Optional[Metrics] = None,
+        clock=None,
+    ):
+        self.fleet = fleet
+        self.host = fleet.host
+        self.ftc = ftc
+        self.metrics = metrics or Metrics()
+        self._fed_resource = ftc.federated.resource
+        self._target_resource = ftc.source.resource
+        self.plugin = AGGREGATION_PLUGINS.get(ftc.source.gvk)
+        self.worker = Worker(
+            f"statusagg-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        )
+        self.host.watch(self._fed_resource, self._on_event, replay=True)
+        self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+        self._reattach = fleet.watch_members(self._target_resource, self._on_event)
+
+    def _on_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
+    def _on_cluster_event(self, event: str, obj: dict) -> None:
+        self._reattach()
+        self.worker.enqueue_all(self.host.keys(self._fed_resource))
+
+    def run_until_idle(self) -> None:
+        while self.worker.step():
+            pass
+
+    # -- reconcile (statusaggregator/controller.go:291-399) --------------
+    def reconcile(self, key: str) -> Result:
+        self.metrics.counter("statusagg.throughput")
+        source = self.host.try_get(self._target_resource, key)
+        fed_obj = self.host.try_get(self._fed_resource, key)
+        if source is None or fed_obj is None:
+            return Result.ok()
+        if source["metadata"].get("deletionTimestamp"):
+            return Result.ok()
+
+        cluster_objs: dict[str, dict] = {}
+        up_to_date = True
+        synced = {
+            c.get("cluster"): c.get("status")
+            for c in (fed_obj.get("status", {}) or {}).get("clusters", [])
+        }
+        for cname in sorted(C.all_placement_clusters(fed_obj)):
+            try:
+                member = self.fleet.member(cname)
+            except NotFound:
+                up_to_date = False
+                continue
+            obj = member.try_get(self._target_resource, key)
+            if obj is None:
+                up_to_date = False
+                continue
+            if synced.get(cname) != "OK":
+                up_to_date = False
+            cluster_objs[cname] = obj
+
+        plugin = self.plugin
+        if plugin is not None:
+            new_status = plugin(source, cluster_objs, up_to_date)
+            if new_status is not None and new_status != source.get("status"):
+                source["status"] = new_status
+                try:
+                    self.host.update_status(self._target_resource, source)
+                except (Conflict, NotFound):
+                    return Result.retry()
+            return Result.ok()
+
+        # No plugin: record statuses in the sourcefeedback annotation
+        # (sourcefeedback/status.go).
+        feedback = C.compact_json(
+            {
+                "clusters": [
+                    {"name": c, "status": o.get("status")}
+                    for c, o in sorted(cluster_objs.items())
+                    if o.get("status") is not None
+                ]
+            }
+        )
+        ann = source["metadata"].setdefault("annotations", {})
+        if ann.get(C.SOURCE_FEEDBACK_STATUS) != feedback:
+            ann[C.SOURCE_FEEDBACK_STATUS] = feedback
+            try:
+                self.host.update(self._target_resource, source)
+            except (Conflict, NotFound):
+                return Result.retry()
+        return Result.ok()

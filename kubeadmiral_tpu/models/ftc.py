@@ -93,6 +93,17 @@ def federated_ref(source: TypeRef) -> TypeRef:
     )
 
 
+def status_ref(source: TypeRef) -> TypeRef:
+    """Default status-CR naming: FederatedXStatus in the kubeadmiral types
+    group (types_federatedtypeconfig.go StatusType)."""
+    return TypeRef(
+        group="types.kubeadmiral.io",
+        version="v1alpha1",
+        kind=f"Federated{source.kind}Status",
+        plural=f"federated{source.plural}statuses",
+    )
+
+
 def make_ftc(
     name: str,
     group: str,
@@ -102,6 +113,8 @@ def make_ftc(
     **kw,
 ) -> FederatedTypeConfig:
     src = TypeRef(group, version, kind, plural)
+    if kw.get("status_collection") and "status" not in kw:
+        kw["status"] = status_ref(src)
     return FederatedTypeConfig(
         name=name, source=src, federated=federated_ref(src), **kw
     )

@@ -237,3 +237,18 @@ class ClusterFleet:
         if name not in self.members:
             raise NotFound(f"cluster {name}")
         return self.members[name]
+
+    def watch_members(self, resource: str, handler: Handler) -> Callable[[], None]:
+        """Watch ``resource`` in every current member and return a
+        re-attach callable for members added later — the
+        FederatedInformer lifecycle (federatedinformer.go:151-250)."""
+        attached: set[str] = set()
+
+        def attach() -> None:
+            for name, kube in list(self.members.items()):
+                if name not in attached:
+                    attached.add(name)
+                    kube.watch(resource, handler, replay=False)
+
+        attach()
+        return attach

@@ -1,0 +1,277 @@
+"""Auto-migration controller: unschedulable counting, capacity
+estimation, and the 3-controller feedback loop with the scheduler
+(reference: pkg/controllers/automigration + SURVEY.md §3.5)."""
+
+import json
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.automigration import (
+    PODS,
+    AutoMigrationController,
+    count_unschedulable_pods,
+)
+from kubeadmiral_tpu.federation.schedulerctl import (
+    POD_UNSCHEDULABLE_THRESHOLD,
+    SchedulerController,
+)
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+
+def deployment_ftc():
+    return next(f for f in default_ftcs() if f.name == "deployments.apps")
+
+
+def make_pod(name, unschedulable_since=None, deleting=False, labels=None):
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": labels or {"app": "web"},
+        },
+        "spec": {},
+        "status": {"phase": "Pending", "conditions": []},
+    }
+    if unschedulable_since is not None:
+        pod["status"]["conditions"].append(
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "Unschedulable",
+                "lastTransitionTime": unschedulable_since,
+            }
+        )
+    if deleting:
+        pod["metadata"]["deletionTimestamp"] = "now"
+    return pod
+
+
+class TestCounting:
+    def test_counts_pods_past_threshold(self):
+        pods = [
+            make_pod("p1", unschedulable_since=0.0),
+            make_pod("p2", unschedulable_since=95.0),
+            make_pod("p3"),  # schedulable
+            make_pod("p4", unschedulable_since=0.0, deleting=True),
+        ]
+        count, next_cross = count_unschedulable_pods(pods, now=100.0, threshold=30.0)
+        assert count == 1  # only p1 crossed (0 + 30 <= 100)
+        assert next_cross == 25.0  # p2 crosses at 125
+
+
+def make_member_deployment(replicas, ready):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": "web",
+            "namespace": "default",
+            "labels": {C.MANAGED_LABEL: "true"},
+        },
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": "web"}},
+        },
+        "status": {"replicas": replicas, "readyReplicas": ready},
+    }
+
+
+class TestAutoMigrationController:
+    def setup_method(self):
+        self.fleet = ClusterFleet()
+        self.ftc = deployment_ftc()
+        self.now = [1000.0]
+        self.ctl = AutoMigrationController(
+            self.fleet, self.ftc, clock=lambda: self.now[0]
+        )
+        for name in ("c1", "c2"):
+            self.fleet.add_member(name)
+            self.fleet.host.create(
+                C.FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                    "status": {
+                        "conditions": [
+                            {"type": "Joined", "status": "True"},
+                            {"type": "Ready", "status": "True"},
+                        ]
+                    },
+                },
+            )
+
+    def make_fed(self, threshold="30s"):
+        ann = {pending.PENDING_CONTROLLERS: json.dumps([])}
+        if threshold:
+            ann[POD_UNSCHEDULABLE_THRESHOLD] = threshold
+        return {
+            "apiVersion": "types.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedDeployment",
+            "metadata": {"name": "web", "namespace": "default", "annotations": ann},
+            "spec": {
+                "template": {"apiVersion": "apps/v1", "kind": "Deployment"},
+                "placements": [
+                    {
+                        "controller": C.SCHEDULER,
+                        "placement": [{"cluster": "c1"}, {"cluster": "c2"}],
+                    }
+                ],
+            },
+        }
+
+    def test_writes_estimated_capacity(self):
+        # c1: 3 desired, 2 pods stuck unschedulable past threshold.
+        m1 = self.fleet.member("c1")
+        m1.create(self.ftc.source.resource, make_member_deployment(3, 1))
+        m1.create(PODS, make_pod("p1", unschedulable_since=0.0))
+        m1.create(PODS, make_pod("p2", unschedulable_since=0.0))
+        m1.create(PODS, make_pod("p3"))
+        # c2 healthy.
+        m2 = self.fleet.member("c2")
+        m2.create(self.ftc.source.resource, make_member_deployment(2, 2))
+
+        self.fleet.host.create(self.ftc.federated.resource, self.make_fed())
+        self.ctl.run_until_idle()
+
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        info = json.loads(fed["metadata"]["annotations"][C.AUTO_MIGRATION_INFO])
+        assert info["estimatedCapacity"] == {"c1": 1}
+
+    def test_disabled_cleans_annotation(self):
+        fed = self.make_fed(threshold=None)
+        fed["metadata"]["annotations"][C.AUTO_MIGRATION_INFO] = '{"estimatedCapacity":{"c1":0}}'
+        self.fleet.host.create(self.ftc.federated.resource, fed)
+        self.ctl.run_until_idle()
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        assert C.AUTO_MIGRATION_INFO not in fed["metadata"]["annotations"]
+
+    def test_healthy_clusters_write_nothing(self):
+        m1 = self.fleet.member("c1")
+        m1.create(self.ftc.source.resource, make_member_deployment(3, 3))
+        self.fleet.host.create(self.ftc.federated.resource, self.make_fed())
+        self.ctl.run_until_idle()
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        assert C.AUTO_MIGRATION_INFO not in fed["metadata"]["annotations"]
+
+
+class TestFeedbackLoop:
+    """Scheduler → auto-migration → scheduler (SURVEY.md §3.5)."""
+
+    def test_capacity_feedback_moves_replicas(self):
+        fleet = ClusterFleet()
+        ftc = deployment_ftc()
+        import dataclasses
+
+        ftc = dataclasses.replace(
+            ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
+        )
+        now = [1000.0]
+        scheduler = SchedulerController(fleet.host, ftc)
+        automigration = AutoMigrationController(fleet, ftc, clock=lambda: now[0])
+
+        for name in ("c1", "c2"):
+            fleet.add_member(name)
+            fleet.host.create(
+                C.FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                    "status": {
+                        "conditions": [
+                            {"type": "Joined", "status": "True"},
+                            {"type": "Ready", "status": "True"},
+                        ],
+                        "resources": {
+                            "allocatable": {"cpu": "64", "memory": "256Gi"},
+                            "available": {"cpu": "32", "memory": "128Gi"},
+                        },
+                        "apiResourceTypes": ["apps/v1/Deployment"],
+                    },
+                },
+            )
+        fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {
+                    "schedulingMode": "Divide",
+                    "autoMigration": {"when": {"podUnschedulableFor": "30s"}},
+                },
+            },
+        )
+        fleet.host.create(
+            ftc.federated.resource,
+            {
+                "apiVersion": "types.kubeadmiral.io/v1alpha1",
+                "kind": "FederatedDeployment",
+                "metadata": {
+                    "name": "web",
+                    "namespace": "default",
+                    "labels": {"kubeadmiral.io/propagation-policy-name": "pp"},
+                    "annotations": {
+                        pending.PENDING_CONTROLLERS: json.dumps(
+                            [["kubeadmiral.io/global-scheduler"]]
+                        )
+                    },
+                },
+                "spec": {
+                    "template": {
+                        "apiVersion": "apps/v1",
+                        "kind": "Deployment",
+                        "metadata": {"name": "web", "namespace": "default"},
+                        "spec": {
+                            "replicas": 6,
+                            "selector": {"matchLabels": {"app": "web"}},
+                        },
+                    }
+                },
+            },
+        )
+
+        def settle():
+            for _ in range(10):
+                if not (scheduler.worker.step() | automigration.worker.step()):
+                    break
+
+        settle()
+        fed = fleet.host.get(ftc.federated.resource, "default/web")
+        first = {
+            cl: patches[0]["value"]
+            for cl, patches in C.get_overrides(fed, C.SCHEDULER).items()
+        }
+        assert sum(first.values()) == 6
+        assert fed["metadata"]["annotations"][POD_UNSCHEDULABLE_THRESHOLD] == "30s"
+        c1_share = first.get("c1", 0)
+        assert c1_share > 0
+
+        # c1 develops stuck pods: only 1 of its replicas fits.
+        m1 = fleet.member("c1")
+        m1.create(
+            ftc.source.resource, make_member_deployment(c1_share, 1)
+        )
+        for i in range(c1_share - 1):
+            m1.create(PODS, make_pod(f"p{i}", unschedulable_since=0.0))
+        m1.create(PODS, make_pod("ok", labels={"app": "web"}))
+
+        settle()
+        fed = fleet.host.get(ftc.federated.resource, "default/web")
+        info = json.loads(fed["metadata"]["annotations"][C.AUTO_MIGRATION_INFO])
+        assert info["estimatedCapacity"]["c1"] == 1
+
+        second = {
+            cl: patches[0]["value"]
+            for cl, patches in C.get_overrides(fed, C.SCHEDULER).items()
+        }
+        assert sum(second.values()) == 6
+        assert second["c1"] == 1  # capped at estimated capacity
+        assert second["c2"] == 5  # overflow moved

@@ -1,0 +1,259 @@
+"""Override controller: policy matching, per-cluster JSONPatch
+resolution, pipeline hand-off (reference: pkg/controllers/override)."""
+
+import json
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.overridectl import (
+    CLUSTER_OVERRIDE_POLICIES,
+    CLUSTER_OVERRIDE_POLICY_NAME_LABEL,
+    OVERRIDE_POLICIES,
+    OVERRIDE_POLICY_NAME_LABEL,
+    OverrideController,
+    is_cluster_matched,
+    parse_overrides,
+)
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+
+def deployment_ftc():
+    return next(f for f in default_ftcs() if f.name == "deployments.apps")
+
+
+def make_cluster(name, labels=None):
+    return {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedCluster",
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {},
+    }
+
+
+def make_fed(name="web", labels=None, clusters=("c1", "c2")):
+    ftc = deployment_ftc()
+    return {
+        "apiVersion": ftc.federated.api_version,
+        "kind": ftc.federated.kind,
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": labels or {},
+            "annotations": {
+                pending.PENDING_CONTROLLERS: json.dumps(
+                    [[C.OVERRIDE_CONTROLLER]]
+                )
+            },
+        },
+        "spec": {
+            "template": {"apiVersion": "apps/v1", "kind": "Deployment"},
+            "placements": [
+                {
+                    "controller": C.SCHEDULER,
+                    "placement": [{"cluster": c} for c in clusters],
+                }
+            ],
+        },
+    }
+
+
+def make_policy(name, rules, namespace="default"):
+    obj = {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "OverridePolicy" if namespace else "ClusterOverridePolicy",
+        "metadata": {"name": name},
+        "spec": {"overrideRules": rules},
+    }
+    if namespace:
+        obj["metadata"]["namespace"] = namespace
+    return obj
+
+
+IMAGE_PATCH = {
+    "operator": "replace",
+    "path": "/spec/template/spec/containers/0/image",
+    "value": "registry.cn/nginx",
+}
+
+
+class TestClusterMatching:
+    def test_empty_target_matches_all(self):
+        assert is_cluster_matched(None, make_cluster("c1"))
+        assert is_cluster_matched({}, make_cluster("c1"))
+
+    def test_names_selector_affinity_are_anded(self):
+        cluster = make_cluster("c1", labels={"region": "us"})
+        assert is_cluster_matched(
+            {"clusters": ["c1"], "clusterSelector": {"region": "us"}}, cluster
+        )
+        assert not is_cluster_matched(
+            {"clusters": ["c2"], "clusterSelector": {"region": "us"}}, cluster
+        )
+        assert not is_cluster_matched(
+            {"clusters": ["c1"], "clusterSelector": {"region": "eu"}}, cluster
+        )
+
+    def test_affinity_terms(self):
+        cluster = make_cluster("c1", labels={"tier": "1"})
+        target = {
+            "clusterAffinity": [
+                {
+                    "matchExpressions": [
+                        {"key": "tier", "operator": "In", "values": ["1", "2"]}
+                    ]
+                }
+            ]
+        }
+        assert is_cluster_matched(target, cluster)
+        cluster2 = make_cluster("c2", labels={"tier": "9"})
+        assert not is_cluster_matched(target, cluster2)
+
+
+class TestParseOverrides:
+    def test_per_cluster_patches(self):
+        policy = make_policy(
+            "p",
+            [
+                {
+                    "targetClusters": {"clusters": ["c1"]},
+                    "overriders": {"jsonpatch": [IMAGE_PATCH]},
+                }
+            ],
+        )
+        out = parse_overrides(policy, [make_cluster("c1"), make_cluster("c2")])
+        assert set(out) == {"c1"}
+        assert out["c1"] == [
+            {
+                "op": "replace",
+                "path": "/spec/template/spec/containers/0/image",
+                "value": "registry.cn/nginx",
+            }
+        ]
+
+
+class TestOverrideController:
+    def setup_method(self):
+        self.kube = FakeKube()
+        self.ftc = deployment_ftc()
+        self.ctl = OverrideController(self.kube, self.ftc)
+        self.fed_res = self.ftc.federated.resource
+        for name, labels in (("c1", {"region": "us"}), ("c2", {"region": "eu"})):
+            self.kube.create(C.FEDERATED_CLUSTERS, make_cluster(name, labels))
+
+    def test_writes_overrides_and_flips_pipeline(self):
+        self.kube.create(
+            OVERRIDE_POLICIES,
+            make_policy(
+                "op-1",
+                [
+                    {
+                        "targetClusters": {"clusterSelector": {"region": "us"}},
+                        "overriders": {"jsonpatch": [IMAGE_PATCH]},
+                    }
+                ],
+            ),
+        )
+        self.kube.create(
+            self.fed_res, make_fed(labels={OVERRIDE_POLICY_NAME_LABEL: "op-1"})
+        )
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        overrides = C.get_overrides(fed, C.OVERRIDE_CONTROLLER)
+        assert set(overrides) == {"c1"}
+        assert pending.get_pending(fed) == []
+
+    def test_no_policy_label_clears_and_advances(self):
+        self.kube.create(self.fed_res, make_fed())
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        assert C.get_overrides(fed, C.OVERRIDE_CONTROLLER) == {}
+        assert pending.get_pending(fed) == []
+
+    def test_cluster_and_namespaced_policies_stack_in_order(self):
+        self.kube.create(
+            CLUSTER_OVERRIDE_POLICIES,
+            make_policy(
+                "cop-1",
+                [
+                    {
+                        "overriders": {
+                            "jsonpatch": [
+                                {
+                                    "operator": "add",
+                                    "path": "/metadata/annotations/a",
+                                    "value": "cluster-wide",
+                                }
+                            ]
+                        }
+                    }
+                ],
+                namespace=None,
+            ),
+        )
+        self.kube.create(
+            OVERRIDE_POLICIES,
+            make_policy(
+                "op-1",
+                [{"overriders": {"jsonpatch": [IMAGE_PATCH]}}],
+            ),
+        )
+        self.kube.create(
+            self.fed_res,
+            make_fed(
+                labels={
+                    OVERRIDE_POLICY_NAME_LABEL: "op-1",
+                    CLUSTER_OVERRIDE_POLICY_NAME_LABEL: "cop-1",
+                }
+            ),
+        )
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        overrides = C.get_overrides(fed, C.OVERRIDE_CONTROLLER)
+        # ClusterOverridePolicy applies first, namespaced second.
+        assert [p["op"] for p in overrides["c1"]] == ["add", "replace"]
+
+    def test_dangling_policy_reference_waits(self):
+        self.kube.create(
+            self.fed_res, make_fed(labels={OVERRIDE_POLICY_NAME_LABEL: "ghost"})
+        )
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        # Pipeline not advanced while the reference dangles.
+        assert pending.get_pending(fed) == [[C.OVERRIDE_CONTROLLER]]
+
+        # Policy appears -> fed object re-enqueued -> resolved.
+        self.kube.create(
+            OVERRIDE_POLICIES,
+            make_policy("ghost", [{"overriders": {"jsonpatch": [IMAGE_PATCH]}}]),
+        )
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        assert C.get_overrides(fed, C.OVERRIDE_CONTROLLER)["c1"]
+        assert pending.get_pending(fed) == []
+
+    def test_policy_update_reconciles_objects(self):
+        self.kube.create(
+            OVERRIDE_POLICIES,
+            make_policy("op-1", [{"overriders": {"jsonpatch": [IMAGE_PATCH]}}]),
+        )
+        self.kube.create(
+            self.fed_res, make_fed(labels={OVERRIDE_POLICY_NAME_LABEL: "op-1"})
+        )
+        self.ctl.run_until_idle()
+
+        policy = self.kube.get(OVERRIDE_POLICIES, "default/op-1")
+        policy["spec"]["overrideRules"] = [
+            {
+                "overriders": {
+                    "jsonpatch": [
+                        {"operator": "replace", "path": "/spec/replicas", "value": 0}
+                    ]
+                }
+            }
+        ]
+        self.kube.update(OVERRIDE_POLICIES, policy)
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.fed_res, "default/web")
+        overrides = C.get_overrides(fed, C.OVERRIDE_CONTROLLER)
+        assert overrides["c1"][0]["path"] == "/spec/replicas"

@@ -1,0 +1,344 @@
+"""Status controller + StatusAggregator (reference:
+pkg/controllers/status, pkg/controllers/statusaggregator)."""
+
+import json
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.statusctl import (
+    StatusAggregator,
+    StatusController,
+    aggregate_workload_status,
+)
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+
+def deployment_ftc():
+    return next(f for f in default_ftcs() if f.name == "deployments.apps")
+
+
+def make_cluster(name):
+    return {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedCluster",
+        "metadata": {"name": name},
+        "spec": {},
+        "status": {
+            "conditions": [
+                {"type": "Joined", "status": "True"},
+                {"type": "Ready", "status": "True"},
+            ]
+        },
+    }
+
+
+def make_fed(name="web", clusters=("c1", "c2"), synced=True):
+    fed = {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedDeployment",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": {"app": name},
+            "annotations": {pending.PENDING_CONTROLLERS: json.dumps([])},
+        },
+        "spec": {
+            "template": {"apiVersion": "apps/v1", "kind": "Deployment"},
+            "placements": [
+                {
+                    "controller": C.SCHEDULER,
+                    "placement": [{"cluster": c} for c in clusters],
+                }
+            ],
+        },
+    }
+    if synced:
+        fed["status"] = {
+            "clusters": [{"cluster": c, "status": "OK"} for c in clusters]
+        }
+    return fed
+
+
+def member_deployment(name="web", replicas=3, ready=3):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": {C.MANAGED_LABEL: "true"},
+        },
+        "spec": {"replicas": replicas},
+        "status": {
+            "replicas": replicas,
+            "readyReplicas": ready,
+            "availableReplicas": ready,
+            "updatedReplicas": replicas,
+        },
+    }
+
+
+def fleet_with(names=("c1", "c2")):
+    fleet = ClusterFleet()
+    for n in names:
+        fleet.add_member(n)
+        fleet.host.create(C.FEDERATED_CLUSTERS, make_cluster(n))
+    return fleet
+
+
+class TestStatusController:
+    def test_collects_fields_per_cluster(self):
+        fleet = fleet_with()
+        ftc = deployment_ftc()
+        ctl = StatusController(fleet, ftc)
+        fleet.member("c1").create(ftc.source.resource, member_deployment(replicas=2))
+        fleet.member("c2").create(ftc.source.resource, member_deployment(replicas=5))
+        fleet.host.create(ftc.federated.resource, make_fed())
+        ctl.run_until_idle()
+
+        status_cr = fleet.host.get(ftc.status.resource, "default/web")
+        assert status_cr["kind"] == "FederatedDeploymentStatus"
+        by_cluster = {
+            e["clusterName"]: e for e in status_cr["clusterStatus"]
+        }
+        assert by_cluster["c1"]["collectedFields"]["status"]["replicas"] == 2
+        assert by_cluster["c2"]["collectedFields"]["status"]["replicas"] == 5
+        assert status_cr["metadata"]["labels"] == {"app": "web"}
+
+    def test_member_status_change_updates_cr(self):
+        fleet = fleet_with(("c1",))
+        ftc = deployment_ftc()
+        ctl = StatusController(fleet, ftc)
+        fleet.member("c1").create(ftc.source.resource, member_deployment(replicas=1))
+        fleet.host.create(ftc.federated.resource, make_fed(clusters=("c1",)))
+        ctl.run_until_idle()
+
+        obj = fleet.member("c1").get(ftc.source.resource, "default/web")
+        obj["status"]["replicas"] = 7
+        fleet.member("c1").update_status(ftc.source.resource, obj)
+        ctl.run_until_idle()
+        status_cr = fleet.host.get(ftc.status.resource, "default/web")
+        assert (
+            status_cr["clusterStatus"][0]["collectedFields"]["status"]["replicas"]
+            == 7
+        )
+
+    def test_fed_deletion_removes_status_cr(self):
+        fleet = fleet_with(("c1",))
+        ftc = deployment_ftc()
+        ctl = StatusController(fleet, ftc)
+        fleet.host.create(ftc.federated.resource, make_fed(clusters=("c1",)))
+        ctl.run_until_idle()
+        assert fleet.host.try_get(ftc.status.resource, "default/web")
+        fleet.host.delete(ftc.federated.resource, "default/web")
+        ctl.run_until_idle()
+        assert fleet.host.try_get(ftc.status.resource, "default/web") is None
+
+    def test_unavailable_cluster_reported(self):
+        fleet = fleet_with(("c1",))
+        ftc = deployment_ftc()
+        ctl = StatusController(fleet, ftc)
+        fed = make_fed(clusters=("c1", "ghost"))
+        fleet.host.create(ftc.federated.resource, fed)
+        fleet.member("c1").create(ftc.source.resource, member_deployment())
+        ctl.run_until_idle()
+        status_cr = fleet.host.get(ftc.status.resource, "default/web")
+        by_cluster = {e["clusterName"]: e for e in status_cr["clusterStatus"]}
+        assert by_cluster["ghost"]["error"] == "cluster unavailable"
+
+
+class TestWorkloadAggregation:
+    def test_sums_counters(self):
+        source = {"metadata": {"generation": 4}}
+        objs = {
+            "c1": member_deployment(replicas=2, ready=2),
+            "c2": member_deployment(replicas=3, ready=1),
+        }
+        status = aggregate_workload_status(source, objs, True)
+        assert status["replicas"] == 5
+        assert status["readyReplicas"] == 3
+        assert status["observedGeneration"] == 4
+
+    def test_stale_clusters_hold_observed_generation(self):
+        source = {"metadata": {"generation": 4}, "status": {"observedGeneration": 2}}
+        status = aggregate_workload_status(source, {}, False)
+        assert status["observedGeneration"] == 2
+
+
+class TestStatusAggregator:
+    def test_deployment_status_summed_onto_source(self):
+        fleet = fleet_with()
+        ftc = deployment_ftc()
+        agg = StatusAggregator(fleet, ftc)
+        fleet.host.create(
+            ftc.source.resource,
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 5},
+            },
+        )
+        fleet.member("c1").create(ftc.source.resource, member_deployment(replicas=2))
+        fleet.member("c2").create(ftc.source.resource, member_deployment(replicas=3))
+        fleet.host.create(ftc.federated.resource, make_fed())
+        agg.run_until_idle()
+
+        src = fleet.host.get(ftc.source.resource, "default/web")
+        assert src["status"]["replicas"] == 5
+        assert src["status"]["readyReplicas"] == 6
+        assert src["status"]["observedGeneration"] == src["metadata"]["generation"]
+
+    def test_unsynced_cluster_blocks_observed_generation(self):
+        fleet = fleet_with()
+        ftc = deployment_ftc()
+        agg = StatusAggregator(fleet, ftc)
+        fleet.host.create(
+            ftc.source.resource,
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 5},
+            },
+        )
+        fleet.member("c1").create(ftc.source.resource, member_deployment(replicas=2))
+        # c2 has no object yet.
+        fleet.host.create(ftc.federated.resource, make_fed())
+        agg.run_until_idle()
+        src = fleet.host.get(ftc.source.resource, "default/web")
+        assert src["status"]["replicas"] == 2
+        assert "observedGeneration" not in src["status"]
+
+    def test_pluginless_kind_gets_feedback_annotation(self):
+        fleet = fleet_with(("c1",))
+        ftc = next(f for f in default_ftcs() if f.name == "configmaps")
+        agg = StatusAggregator(fleet, ftc)
+        fleet.host.create(
+            ftc.source.resource,
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": "cm", "namespace": "default"},
+                "data": {"k": "v"},
+            },
+        )
+        fleet.member("c1").create(
+            ftc.source.resource,
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": "cm", "namespace": "default"},
+                "data": {"k": "v"},
+                "status": {"phase": "Active"},
+            },
+        )
+        fed = make_fed(name="cm", clusters=("c1",))
+        fed["kind"] = "FederatedConfigMap"
+        fleet.host.create(ftc.federated.resource, fed)
+        agg.run_until_idle()
+        src = fleet.host.get(ftc.source.resource, "default/cm")
+        feedback = json.loads(
+            src["metadata"]["annotations"][C.SOURCE_FEEDBACK_STATUS]
+        )
+        assert feedback["clusters"][0]["name"] == "c1"
+
+
+class TestJobAggregation:
+    def test_sums_and_completes(self):
+        from kubeadmiral_tpu.federation.statusctl import aggregate_job_status
+
+        objs = {
+            "c1": {
+                "status": {
+                    "succeeded": 1,
+                    "startTime": "2026-01-01T00:00:00Z",
+                    "completionTime": "2026-01-01T01:00:00Z",
+                }
+            },
+            "c2": {
+                "status": {
+                    "succeeded": 2,
+                    "startTime": "2026-01-01T00:30:00Z",
+                    "completionTime": "2026-01-01T02:00:00Z",
+                }
+            },
+        }
+        status = aggregate_job_status({}, objs, True)
+        assert status["succeeded"] == 3
+        assert status["startTime"] == "2026-01-01T00:00:00Z"
+        assert status["completionTime"] == "2026-01-01T02:00:00Z"
+        assert status["conditions"][0]["type"] == "Complete"
+
+    def test_mixed_outcome_is_failed(self):
+        from kubeadmiral_tpu.federation.statusctl import aggregate_job_status
+
+        objs = {
+            "c1": {"status": {"completionTime": "2026-01-01T01:00:00Z"}},
+            "c2": {
+                "status": {
+                    "failed": 1,
+                    "conditions": [{"type": "Failed", "status": "True"}],
+                }
+            },
+        }
+        status = aggregate_job_status({}, objs, True)
+        cond = status["conditions"][0]
+        assert cond["type"] == "Failed"
+        assert cond["reason"] == "Mixed"
+
+    def test_incomplete_jobs_have_no_condition(self):
+        from kubeadmiral_tpu.federation.statusctl import aggregate_job_status
+
+        objs = {
+            "c1": {"status": {"active": 1}},
+            "c2": {"status": {"completionTime": "2026-01-01T01:00:00Z"}},
+        }
+        status = aggregate_job_status({}, objs, True)
+        assert "conditions" not in status
+        assert status["active"] == 1
+
+
+class TestPodAggregation:
+    def test_phase_precedence(self):
+        from kubeadmiral_tpu.federation.statusctl import aggregate_pod_status
+
+        objs = {
+            "c1": {"status": {"phase": "Running"}},
+            "c2": {"status": {"phase": "Failed"}},
+        }
+        status = aggregate_pod_status({}, objs, True)
+        assert status["phase"] == "Failed"
+
+    def test_container_statuses_tagged_by_cluster(self):
+        from kubeadmiral_tpu.federation.statusctl import aggregate_pod_status
+
+        objs = {
+            "c1": {
+                "status": {
+                    "phase": "Running",
+                    "containerStatuses": [{"name": "app", "ready": True}],
+                }
+            },
+        }
+        status = aggregate_pod_status({}, objs, True)
+        assert status["containerStatuses"][0]["name"] == "app (c1)"
+
+
+class TestSingleClusterAggregation:
+    def test_statefulset_adopts_lone_status(self):
+        from kubeadmiral_tpu.federation.statusctl import (
+            AGGREGATION_PLUGINS,
+            aggregate_single_cluster,
+        )
+
+        assert AGGREGATION_PLUGINS["apps/v1/StatefulSet"] is aggregate_single_cluster
+        objs = {"c1": {"status": {"readyReplicas": 3, "currentRevision": "r1"}}}
+        assert aggregate_single_cluster({}, objs, True) == {
+            "readyReplicas": 3,
+            "currentRevision": "r1",
+        }
+        # Ambiguous with two clusters.
+        objs["c2"] = {"status": {}}
+        assert aggregate_single_cluster({}, objs, True) is None
