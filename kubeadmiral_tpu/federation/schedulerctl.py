@@ -295,8 +295,17 @@ class SchedulerController:
                 except KeyError:
                     results[key] = Result.ok()  # not yet initialized by federate
                     continue
+                if P.matched_policy_key(fed_obj) is None:
+                    # No policy bound: deschedule (empty own placement)
+                    # but still advance the pipeline so downstream
+                    # controllers — override, follower, sync — process
+                    # the object (scheduler.go:454-466 + persist).
+                    results[key] = self._deschedule(fed_obj)
+                    continue
                 policy = self._policy_for(fed_obj)
                 if policy is None:
+                    # Bound policy not created yet: wait for its event
+                    # (scheduler.go:356-367).
                     results[key] = Result.ok()
                     continue
                 trigger = self._trigger_hash(fed_obj, policy, clusters)
@@ -321,6 +330,26 @@ class SchedulerController:
         return results
 
     # -- persistence -----------------------------------------------------
+    def _deschedule(self, fed_obj: dict) -> Result:
+        """No policy bound: clear own placement/overrides and hand off
+        downstream (scheduler.go schedule() with nil policy)."""
+        modified = C.set_placement(fed_obj, self.name, set())
+        if C.get_overrides(fed_obj, self.name):
+            C.set_overrides(fed_obj, self.name, {})
+            modified = True
+        pend = pending.update_pending(
+            fed_obj, self.name, modified, self.ftc.controller_groups
+        )
+        if not (modified or pend):
+            return Result.ok()
+        try:
+            self.host.update(self._resource, fed_obj)
+        except Conflict:
+            return Result.retry()
+        except NotFound:
+            pass
+        return Result.ok()
+
     def _persist(
         self,
         key: str,

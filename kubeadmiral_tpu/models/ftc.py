@@ -129,10 +129,18 @@ WORKLOAD_PATH = PathDefinition(
 )
 
 
+WORKLOAD_PIPELINE = (
+    ("kubeadmiral.io/global-scheduler",),
+    ("kubeadmiral.io/overridepolicy-controller",),
+    ("kubeadmiral.io/follower-controller",),
+)
+
+
 def default_ftcs() -> list[FederatedTypeConfig]:
     """The sample set the reference ships (config/sample/host/01-ftc.yaml),
     trimmed to the types the tests/bench exercise; more are added by
-    simply registering additional FTC objects."""
+    simply registering additional FTC objects.  Workload leader types run
+    the follower controller after scheduling (01-ftc.yaml:94-97)."""
     return [
         make_ftc(
             "deployments.apps",
@@ -140,6 +148,7 @@ def default_ftcs() -> list[FederatedTypeConfig]:
             "v1",
             "Deployment",
             "deployments",
+            controllers=WORKLOAD_PIPELINE,
             path=WORKLOAD_PATH,
             status_collection=True,
             status_aggregation=True,
@@ -152,11 +161,13 @@ def default_ftcs() -> list[FederatedTypeConfig]:
             "v1",
             "StatefulSet",
             "statefulsets",
+            controllers=WORKLOAD_PIPELINE,
             path=WORKLOAD_PATH,
             status_collection=True,
         ),
         make_ftc(
             "daemonsets.apps", "apps", "v1", "DaemonSet", "daemonsets",
+            controllers=WORKLOAD_PIPELINE,
             status_collection=True,
         ),
         make_ftc("configmaps", "", "v1", "ConfigMap", "configmaps"),
@@ -166,10 +177,12 @@ def default_ftcs() -> list[FederatedTypeConfig]:
         make_ftc("namespaces", "", "v1", "Namespace", "namespaces", namespaced=False),
         make_ftc(
             "jobs.batch", "batch", "v1", "Job", "jobs",
+            controllers=WORKLOAD_PIPELINE,
             path=PathDefinition(replicas_spec="spec.parallelism"),
             status_collection=True,
         ),
-        make_ftc("cronjobs.batch", "batch", "v1", "CronJob", "cronjobs"),
+        make_ftc("cronjobs.batch", "batch", "v1", "CronJob", "cronjobs",
+            controllers=WORKLOAD_PIPELINE),
         make_ftc(
             "ingresses.networking.k8s.io",
             "networking.k8s.io",

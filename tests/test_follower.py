@@ -1,0 +1,175 @@
+"""Follower controller: leader↔follower inference, spec.follows, and
+placement union (reference: pkg/controllers/follower)."""
+
+import json
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.follower import (
+    ENABLE_FOLLOWER_SCHEDULING,
+    FOLLOWERS_ANNOTATION,
+    FollowerController,
+    followers_from_pod_spec,
+)
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+
+def ftc_by_name(name):
+    return next(f for f in default_ftcs() if f.name == name)
+
+
+POD_SPEC = {
+    "serviceAccountName": "runner",
+    "imagePullSecrets": [{"name": "pull-secret"}],
+    "containers": [
+        {
+            "name": "app",
+            "envFrom": [{"configMapRef": {"name": "app-config"}}],
+            "env": [
+                {
+                    "name": "TOKEN",
+                    "valueFrom": {"secretKeyRef": {"name": "app-token", "key": "t"}},
+                }
+            ],
+        }
+    ],
+    "volumes": [
+        {"name": "v1", "configMap": {"name": "vol-config"}},
+        {"name": "v2", "secret": {"secretName": "vol-secret"}},
+        {"name": "v3", "persistentVolumeClaim": {"claimName": "data"}},
+    ],
+}
+
+
+class TestInference:
+    def test_pod_spec_followers(self):
+        refs = followers_from_pod_spec(POD_SPEC, "ns1")
+        assert ("/ServiceAccount", "ns1", "runner") in refs
+        assert ("/Secret", "ns1", "pull-secret") in refs
+        assert ("/Secret", "ns1", "app-token") in refs
+        assert ("/Secret", "ns1", "vol-secret") in refs
+        assert ("/ConfigMap", "ns1", "app-config") in refs
+        assert ("/ConfigMap", "ns1", "vol-config") in refs
+        assert ("/PersistentVolumeClaim", "ns1", "data") in refs
+
+
+def make_fed_deployment(name="web", pod_spec=None, followers_ann=None, placed=("c1",)):
+    ann = {
+        pending.PENDING_CONTROLLERS: json.dumps([]),
+        ENABLE_FOLLOWER_SCHEDULING: "true",
+    }
+    if followers_ann is not None:
+        ann[FOLLOWERS_ANNOTATION] = json.dumps(followers_ann)
+    return {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedDeployment",
+        "metadata": {"name": name, "namespace": "default", "annotations": ann},
+        "spec": {
+            "template": {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "spec": {"template": {"spec": pod_spec or POD_SPEC}},
+            },
+            "placements": [
+                {
+                    "controller": C.SCHEDULER,
+                    "placement": [{"cluster": c} for c in placed],
+                }
+            ],
+        },
+    }
+
+
+def make_fed_configmap(name, namespace="default"):
+    return {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedConfigMap",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "annotations": {pending.PENDING_CONTROLLERS: json.dumps([])},
+        },
+        "spec": {"template": {"apiVersion": "v1", "kind": "ConfigMap"}},
+    }
+
+
+class TestFollowerController:
+    def setup_method(self):
+        self.kube = FakeKube()
+        self.ftcs = default_ftcs()
+        self.ctl = FollowerController(self.kube, self.ftcs)
+        self.dep_res = ftc_by_name("deployments.apps").federated.resource
+        self.cm_res = ftc_by_name("configmaps").federated.resource
+
+    def test_follower_gets_leader_placement(self):
+        self.kube.create(self.cm_res, make_fed_configmap("vol-config"))
+        self.kube.create(self.dep_res, make_fed_deployment(placed=("c1", "c2")))
+        self.ctl.run_until_idle()
+
+        cm = self.kube.get(self.cm_res, "default/vol-config")
+        follows = cm["spec"]["follows"]
+        assert follows == [{"group": "apps", "kind": "Deployment", "name": "web"}]
+        assert C.get_placement(cm, C.FOLLOWER_CONTROLLER) == {"c1", "c2"}
+
+    def test_leader_deletion_releases_follower(self):
+        self.kube.create(self.cm_res, make_fed_configmap("vol-config"))
+        self.kube.create(self.dep_res, make_fed_deployment())
+        self.ctl.run_until_idle()
+        self.kube.delete(self.dep_res, "default/web")
+        self.ctl.run_until_idle()
+        cm = self.kube.get(self.cm_res, "default/vol-config")
+        assert cm["spec"]["follows"] == []
+        assert C.get_placement(cm, C.FOLLOWER_CONTROLLER) == set()
+
+    def test_followers_annotation(self):
+        self.kube.create(self.cm_res, make_fed_configmap("extra"))
+        self.kube.create(
+            self.dep_res,
+            make_fed_deployment(
+                pod_spec={"containers": []},
+                followers_ann=[{"group": "", "kind": "ConfigMap", "name": "extra"}],
+            ),
+        )
+        self.ctl.run_until_idle()
+        cm = self.kube.get(self.cm_res, "default/extra")
+        assert C.get_placement(cm, C.FOLLOWER_CONTROLLER) == {"c1"}
+
+    def test_disabled_follower_scheduling_infers_nothing(self):
+        self.kube.create(self.cm_res, make_fed_configmap("vol-config"))
+        fed = make_fed_deployment()
+        fed["metadata"]["annotations"][ENABLE_FOLLOWER_SCHEDULING] = "false"
+        self.kube.create(self.dep_res, fed)
+        self.ctl.run_until_idle()
+        cm = self.kube.get(self.cm_res, "default/vol-config")
+        assert not C.get_placement(cm, C.FOLLOWER_CONTROLLER)
+
+    def test_two_leaders_union_placement(self):
+        self.kube.create(self.cm_res, make_fed_configmap("vol-config"))
+        self.kube.create(self.dep_res, make_fed_deployment("web1", placed=("c1",)))
+        self.kube.create(self.dep_res, make_fed_deployment("web2", placed=("c2",)))
+        self.ctl.run_until_idle()
+        cm = self.kube.get(self.cm_res, "default/vol-config")
+        assert C.get_placement(cm, C.FOLLOWER_CONTROLLER) == {"c1", "c2"}
+        assert len(cm["spec"]["follows"]) == 2
+
+    def test_leader_rescale_updates_follower(self):
+        self.kube.create(self.cm_res, make_fed_configmap("vol-config"))
+        self.kube.create(self.dep_res, make_fed_deployment(placed=("c1",)))
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.dep_res, "default/web")
+        C.set_placement(fed, C.SCHEDULER, {"c2", "c3"})
+        self.kube.update(self.dep_res, fed)
+        self.ctl.run_until_idle()
+        cm = self.kube.get(self.cm_res, "default/vol-config")
+        assert C.get_placement(cm, C.FOLLOWER_CONTROLLER) == {"c2", "c3"}
+
+    def test_leader_pipeline_consumed(self):
+        fed = make_fed_deployment()
+        fed["metadata"]["annotations"][pending.PENDING_CONTROLLERS] = json.dumps(
+            [[C.FOLLOWER_CONTROLLER]]
+        )
+        self.kube.create(self.dep_res, fed)
+        self.ctl.run_until_idle()
+        fed = self.kube.get(self.dep_res, "default/web")
+        assert pending.get_pending(fed) == []
