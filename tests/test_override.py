@@ -161,7 +161,10 @@ class TestOverrideController:
         fed = self.kube.get(self.fed_res, "default/web")
         overrides = C.get_overrides(fed, C.OVERRIDE_CONTROLLER)
         assert set(overrides) == {"c1"}
-        assert pending.get_pending(fed) == []
+        # The object changed, so the downstream follower group is re-armed
+        # (reference pipeline: scheduler -> override -> follower,
+        # config/sample/host/01-ftc.yaml:94-97).
+        assert pending.get_pending(fed) == [[C.FOLLOWER_CONTROLLER]]
 
     def test_no_policy_label_clears_and_advances(self):
         self.kube.create(self.fed_res, make_fed())
@@ -230,7 +233,7 @@ class TestOverrideController:
         self.ctl.run_until_idle()
         fed = self.kube.get(self.fed_res, "default/web")
         assert C.get_overrides(fed, C.OVERRIDE_CONTROLLER)["c1"]
-        assert pending.get_pending(fed) == []
+        assert pending.get_pending(fed) == [[C.FOLLOWER_CONTROLLER]]
 
     def test_policy_update_reconciles_objects(self):
         self.kube.create(
@@ -241,6 +244,12 @@ class TestOverrideController:
             self.fed_res, make_fed(labels={OVERRIDE_POLICY_NAME_LABEL: "op-1"})
         )
         self.ctl.run_until_idle()
+
+        # Drain the downstream follower group (as the follower controller
+        # would) so the override controller may act on the policy update.
+        fed = self.kube.get(self.fed_res, "default/web")
+        pending.update_pending(fed, C.FOLLOWER_CONTROLLER, False, [])
+        self.kube.update(self.fed_res, fed)
 
         policy = self.kube.get(OVERRIDE_POLICIES, "default/op-1")
         policy["spec"]["overrideRules"] = [
