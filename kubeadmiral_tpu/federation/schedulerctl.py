@@ -20,6 +20,7 @@ from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models import policy as P
+from kubeadmiral_tpu.models import profile as PR
 from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.models.types import parse_resources
@@ -107,26 +108,50 @@ class SchedulerController:
         host.watch(P.PROPAGATION_POLICIES, self._on_policy_event, replay=False)
         host.watch(P.CLUSTER_PROPAGATION_POLICIES, self._on_policy_event, replay=False)
         host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+        host.watch(PR.SCHEDULING_PROFILES, self._on_profile_event, replay=False)
 
     # -- event handlers (fan-in to the dirty queue) ----------------------
     def _on_object_event(self, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
 
-    def _on_policy_event(self, event: str, obj: dict) -> None:
-        # Re-enqueue every federated object bound to this policy
-        # (schedulingtriggers.go enqueueFederatedObjectsForPolicy).  Scan
-        # without deep-copying: at 100k objects a full copying LIST per
-        # policy event would stall the store.
-        pname = obj["metadata"]["name"]
-        pns = obj["metadata"].get("namespace", "")
+    def _enqueue_objects_for_policies(self, policies: set[tuple[str, str]]) -> None:
+        """Re-enqueue every federated object bound to one of the given
+        (namespace, name) policy keys.  Scan without deep-copying: at
+        100k objects a full copying LIST per event would stall the store."""
+        if not policies:
+            return
         matched: list[str] = []
 
         def check(fed: dict) -> None:
-            if P.matched_policy_key(fed) == (pns, pname):
+            if P.matched_policy_key(fed) in policies:
                 matched.append(obj_key(fed))
 
         self.host.scan(self._resource, check)
         self.worker.enqueue_all(matched)
+
+    def _on_policy_event(self, event: str, obj: dict) -> None:
+        # (schedulingtriggers.go enqueueFederatedObjectsForPolicy).
+        pname = obj["metadata"]["name"]
+        pns = obj["metadata"].get("namespace", "")
+        self._enqueue_objects_for_policies({(pns, pname)})
+
+    def _on_profile_event(self, event: str, obj: dict) -> None:
+        # A profile change reschedules every object bound to a policy
+        # naming that profile (scheduler.go enqueueFederatedObjectsForProfile
+        # analogue).  The profile's generation is part of the trigger hash,
+        # so hash-gated objects re-enter the engine.
+        pname = obj["metadata"]["name"]
+        policies: set[tuple[str, str]] = set()
+
+        def collect(pol: dict) -> None:
+            if pol.get("spec", {}).get("schedulingProfile", "") == pname:
+                policies.add(
+                    (pol["metadata"].get("namespace", ""), pol["metadata"]["name"])
+                )
+
+        self.host.scan(P.PROPAGATION_POLICIES, collect)
+        self.host.scan(P.CLUSTER_PROPAGATION_POLICIES, collect)
+        self._enqueue_objects_for_policies(policies)
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         # Cluster changes can change every placement
@@ -152,7 +177,21 @@ class SchedulerController:
         obj = self.host.try_get(resource, f"{ns}/{name}" if ns else name)
         return P.parse_policy(obj) if obj else None
 
-    def _trigger_hash(self, fed_obj: dict, policy: P.PolicySpec, clusters) -> str:
+    def _profile_for(self, policy: P.PolicySpec) -> Optional[PR.ProfileSpec]:
+        """Cluster-scoped SchedulingProfile named by the policy
+        (scheduler.go:371-376; missing profile schedules with defaults)."""
+        if not policy.scheduling_profile:
+            return None
+        obj = self.host.try_get(PR.SCHEDULING_PROFILES, policy.scheduling_profile)
+        return PR.parse_profile(obj) if obj else None
+
+    def _trigger_hash(
+        self,
+        fed_obj: dict,
+        policy: P.PolicySpec,
+        clusters,
+        profile: Optional[PR.ProfileSpec] = None,
+    ) -> str:
         ann = fed_obj["metadata"].get("annotations", {})
         scheduling_annotations = {
             k: v
@@ -166,6 +205,11 @@ class SchedulerController:
             "replicas": replicas,
             "request": extract_pod_resource_request(C.template(fed_obj)),
             "policy": [policy.namespace, policy.name, policy.generation],
+            # Unlike the reference (schedulingtriggers.go hashes only the
+            # policy), the profile generation is hashed too so profile
+            # edits reschedule bound objects instead of being swallowed by
+            # the dedupe gate.
+            "profile": [profile.name, profile.generation] if profile else None,
             "autoMigration": ann.get(C.AUTO_MIGRATION_INFO)
             if policy.auto_migration_enabled
             else None,
@@ -179,7 +223,10 @@ class SchedulerController:
         return str(stable_json_hash(trigger))
 
     def _scheduling_unit(
-        self, fed_obj: dict, policy: P.PolicySpec
+        self,
+        fed_obj: dict,
+        policy: P.PolicySpec,
+        profile: Optional[PR.ProfileSpec] = None,
     ) -> T.SchedulingUnit:
         template = C.template(fed_obj)
         meta = fed_obj["metadata"]
@@ -249,6 +296,12 @@ class SchedulerController:
         if A_MAX_CLUSTERS in ann:
             max_clusters = int(ann[A_MAX_CLUSTERS])
 
+        # Profile-resolved plugin enablement (profile.go createFramework).
+        # Disabling MaxCluster at the select point removes the top-K cap.
+        enabled_filters, enabled_scores, enabled_selects = PR.resolve_plugins(profile)
+        if T.MAX_CLUSTER not in enabled_selects:
+            max_clusters = None
+
         return T.SchedulingUnit(
             gvk=self.ftc.source.gvk,
             namespace=meta.get("namespace", ""),
@@ -270,11 +323,21 @@ class SchedulerController:
             min_replicas=min_replicas,
             max_replicas=max_replicas,
             weights=weights,
+            enabled_filters=enabled_filters,
+            enabled_scores=enabled_scores,
         )
 
     def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
         results: dict[str, Result] = {}
         clusters = self._clusters()
+        # One profile lookup per distinct name per batch, not per object.
+        profile_memo: dict[str, Optional[PR.ProfileSpec]] = {}
+
+        def profile_for(policy: P.PolicySpec) -> Optional[PR.ProfileSpec]:
+            name = policy.scheduling_profile
+            if name not in profile_memo:
+                profile_memo[name] = self._profile_for(policy)
+            return profile_memo[name]
 
         to_schedule: list[tuple[str, dict, P.PolicySpec, str]] = []
         units = []
@@ -308,11 +371,12 @@ class SchedulerController:
                     # (scheduler.go:356-367).
                     results[key] = Result.ok()
                     continue
-                trigger = self._trigger_hash(fed_obj, policy, clusters)
+                profile = profile_for(policy)
+                trigger = self._trigger_hash(fed_obj, policy, clusters, profile)
                 if fed_obj["metadata"].get("annotations", {}).get(C.SCHEDULING_TRIGGER_HASH) == trigger:
                     results[key] = Result.ok()
                     continue
-                units.append(self._scheduling_unit(fed_obj, policy))
+                units.append(self._scheduling_unit(fed_obj, policy, profile))
             except Exception:
                 self.metrics.counter(f"scheduler-{self.ftc.name}.unit_errors")
                 results[key] = Result.retry()

@@ -1,0 +1,198 @@
+"""SchedulingProfile: plugin-set resolution + scheduler wiring
+(reference: pkg/controllers/scheduler/profile.go,
+pkg/apis/core/v1alpha1/types_schedulingprofile.go; behavioral model
+test/e2e/schedulingprofile)."""
+
+import dataclasses
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    NODES,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.models import profile as PR
+from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+from test_e2e_slice import make_deployment, make_node, settle
+
+
+class TestPluginSetResolution:
+    def test_defaults_when_no_profile(self):
+        filters, scores, selects = PR.resolve_plugins(None)
+        assert filters == T.DEFAULT_FILTERS
+        assert scores == T.DEFAULT_SCORES
+        assert selects == (T.MAX_CLUSTER,)
+
+    def test_disabled_removes_default(self):
+        out = PR.reconcile_ext_point(
+            T.DEFAULT_FILTERS,
+            PR.PluginSet(disabled=(T.TAINT_TOLERATION,)),
+        )
+        assert T.TAINT_TOLERATION not in out
+        assert T.APIRESOURCES in out
+
+    def test_star_disables_all_defaults(self):
+        out = PR.reconcile_ext_point(
+            T.DEFAULT_FILTERS,
+            PR.PluginSet(disabled=("*",), enabled=(T.PLACEMENT_FILTER,)),
+        )
+        assert out == (T.PLACEMENT_FILTER,)
+
+    def test_enabled_appends_after_defaults(self):
+        out = PR.reconcile_ext_point(
+            T.DEFAULT_SCORES, PR.PluginSet(enabled=(T.CLUSTER_RESOURCES_MOST,))
+        )
+        assert out == T.DEFAULT_SCORES + (T.CLUSTER_RESOURCES_MOST,)
+
+    def test_parse_profile(self):
+        spec = PR.parse_profile(
+            {
+                "metadata": {"name": "p", "generation": 3},
+                "spec": {
+                    "plugins": {
+                        "filter": {"disabled": [{"name": "*"}]},
+                        "score": {
+                            "enabled": [
+                                {"name": T.CLUSTER_RESOURCES_MOST},
+                            ],
+                            "disabled": [
+                                {"name": T.CLUSTER_RESOURCES_LEAST},
+                            ],
+                        },
+                    }
+                },
+            }
+        )
+        assert spec.generation == 3
+        filters, scores, _ = PR.resolve_plugins(spec)
+        assert filters == ()
+        assert T.CLUSTER_RESOURCES_MOST in scores
+        assert T.CLUSTER_RESOURCES_LEAST not in scores
+
+
+class TestSchedulerProfileWiring:
+    def setup_method(self):
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        self.ftc = dataclasses.replace(
+            ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
+        )
+        self.fleet = ClusterFleet()
+        gvk = "apps/v1/Deployment"
+        self.clusterctl = FederatedClusterController(
+            self.fleet, api_resource_probe=[gvk]
+        )
+        self.federate = FederateController(self.fleet.host, self.ftc)
+        self.scheduler = SchedulerController(self.fleet.host, self.ftc)
+
+        for name in ("c1", "c2", "c3"):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", "64", "128Gi"))
+            cluster = {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "FederatedCluster",
+                "metadata": {"name": name},
+                "spec": {},
+            }
+            if name == "c1":
+                cluster["spec"]["taints"] = [
+                    {"key": "dedicated", "value": "infra", "effect": "NoSchedule"}
+                ]
+            self.fleet.host.create(FEDERATED_CLUSTERS, cluster)
+
+    def controllers(self):
+        return (self.clusterctl, self.federate, self.scheduler)
+
+    def create_policy(self, **spec):
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": spec,
+            },
+        )
+
+    def placement(self):
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        return C.get_placement(fed, C.SCHEDULER)
+
+    def test_default_profile_respects_taints(self):
+        self.create_policy(schedulingMode="Duplicate")
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.controllers())
+        assert self.placement() == {"c2", "c3"}
+
+    def test_profile_disabling_taint_filter_admits_tainted_cluster(self):
+        self.fleet.host.create(
+            PR.SCHEDULING_PROFILES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulingProfile",
+                "metadata": {"name": "no-taints"},
+                "spec": {
+                    "plugins": {
+                        "filter": {"disabled": [{"name": T.TAINT_TOLERATION}]},
+                        "score": {"disabled": [{"name": T.TAINT_TOLERATION}]},
+                    }
+                },
+            },
+        )
+        self.create_policy(schedulingMode="Duplicate", schedulingProfile="no-taints")
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.controllers())
+        assert self.placement() == {"c1", "c2", "c3"}
+
+    def test_profile_update_triggers_reschedule(self):
+        # Starts with defaults (profile object absent): tainted c1 excluded.
+        self.create_policy(schedulingMode="Duplicate", schedulingProfile="later")
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.controllers())
+        assert self.placement() == {"c2", "c3"}
+
+        # Profile appears and disables the taint filter: the profile event
+        # plus the hashed profile generation force a reschedule.
+        self.fleet.host.create(
+            PR.SCHEDULING_PROFILES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulingProfile",
+                "metadata": {"name": "later"},
+                "spec": {
+                    "plugins": {
+                        "filter": {"disabled": [{"name": T.TAINT_TOLERATION}]},
+                        "score": {"disabled": [{"name": T.TAINT_TOLERATION}]},
+                    }
+                },
+            },
+        )
+        settle(*self.controllers())
+        assert self.placement() == {"c1", "c2", "c3"}
+
+    def test_profile_disabling_maxcluster_lifts_topk_cap(self):
+        self.fleet.host.create(
+            PR.SCHEDULING_PROFILES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulingProfile",
+                "metadata": {"name": "no-topk"},
+                "spec": {
+                    "plugins": {"select": {"disabled": [{"name": T.MAX_CLUSTER}]}}
+                },
+            },
+        )
+        self.create_policy(
+            schedulingMode="Duplicate",
+            maxClusters=1,
+            schedulingProfile="no-topk",
+            tolerations=[{"key": "dedicated", "operator": "Exists"}],
+        )
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.controllers())
+        assert self.placement() == {"c1", "c2", "c3"}
