@@ -39,6 +39,12 @@ UNSCHEDULABLE_THRESHOLD = PREFIX + "auto-migration-unschedulable-threshold"
 SOURCE_GENERATION = PREFIX + "source-generation"
 CONFLICT_RESOLUTION = PREFIX + "conflict-resolution"  # adopt | abort
 ORPHAN_MODE = PREFIX + "orphan"  # all | adopted
+# Internal variants set by controllers (not copied from the source object;
+# they win over the user-facing annotation — reference:
+# util/conflictresolutionannotation.go, util/orphaningannotation.go).
+CONFLICT_RESOLUTION_INTERNAL = CONFLICT_RESOLUTION + ".internal"
+ORPHAN_MODE_INTERNAL = ORPHAN_MODE + ".internal"
+NO_AUTO_PROPAGATION = PREFIX + "no-auto-propagation"
 RETAIN_REPLICAS = PREFIX + "retain-replicas"
 TEMPLATE_HASH = PREFIX + "template-hash"
 OVERRIDE_HASH = PREFIX + "override-hash"

@@ -43,7 +43,7 @@ FEDERATED_ANNOTATIONS = frozenset(
         C.PREFIX + "scheduling-mode",
         C.PREFIX + "sticky-cluster",
         C.CONFLICT_RESOLUTION,
-        C.PREFIX + "no-auto-propagation",
+        C.NO_AUTO_PROPAGATION,
         C.ORPHAN_MODE,
         C.PREFIX + "tolerations",
         C.PREFIX + "placements",
@@ -65,8 +65,8 @@ IGNORED_ANNOTATIONS = frozenset(
         C.SOURCE_FEEDBACK_SCHEDULING,
         C.SOURCE_FEEDBACK_SYNCING,
         C.SOURCE_FEEDBACK_STATUS,
-        C.CONFLICT_RESOLUTION + ".internal",
-        C.ORPHAN_MODE + ".internal",
+        C.CONFLICT_RESOLUTION_INTERNAL,
+        C.ORPHAN_MODE_INTERNAL,
         C.PREFIX + "enable-follower-scheduling",
     }
 )

@@ -177,15 +177,19 @@ class FederatedResource:
 
 
 def should_adopt_preexisting(fed_obj: dict) -> bool:
-    """conflict-resolution annotation == adopt (util.ShouldAdoptPreexistingResources)."""
+    """conflict-resolution annotation == adopt, internal variant winning
+    (util.ShouldAdoptPreexistingResources)."""
     ann = fed_obj.get("metadata", {}).get("annotations", {})
-    return ann.get(C.CONFLICT_RESOLUTION, "") == "adopt"
+    value = ann.get(C.CONFLICT_RESOLUTION_INTERNAL, ann.get(C.CONFLICT_RESOLUTION, ""))
+    return value == "adopt"
 
 
 def orphaning_behavior(fed_obj: dict) -> str:
-    """'' | 'all' | 'adopted' (util orphaning annotation)."""
+    """'' | 'all' | 'adopted', internal variant winning
+    (util.GetOrphaningBehavior)."""
     ann = fed_obj.get("metadata", {}).get("annotations", {})
-    return ann.get(C.ORPHAN_MODE, "")
+    value = ann.get(C.ORPHAN_MODE_INTERNAL, ann.get(C.ORPHAN_MODE, ""))
+    return value if value in ("all", "adopted") else ""
 
 
 def object_version(cluster_obj: dict) -> str:

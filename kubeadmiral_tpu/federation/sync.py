@@ -16,6 +16,7 @@ from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation import dispatch as D
 from kubeadmiral_tpu.federation.resource import (
     FederatedResource,
+    orphaning_behavior,
     should_adopt_preexisting,
 )
 from kubeadmiral_tpu.federation.version import VersionManager
@@ -316,8 +317,7 @@ class SyncController:
     ) -> None:
         """(controller.go:821-845 deleteFromCluster)."""
         if respect_orphaning:
-            ann = fed.obj.get("metadata", {}).get("annotations", {})
-            behavior = ann.get(C.ORPHAN_MODE, "")
+            behavior = orphaning_behavior(fed.obj)
             adopted = cluster_obj.get("metadata", {}).get("annotations", {}).get(
                 D.ADOPTED_ANNOTATION
             )
@@ -372,8 +372,7 @@ class SyncController:
         if C.SYNC_FINALIZER not in fins:
             return Result.ok()
 
-        ann = fed.obj.get("metadata", {}).get("annotations", {})
-        if ann.get(C.ORPHAN_MODE) == ORPHAN_ALL:
+        if orphaning_behavior(fed.obj) == ORPHAN_ALL:
             # Orphan everywhere: strip managed labels, drop finalizer.
             if not self._remove_managed_labels_everywhere(fed):
                 return Result.retry()

@@ -174,7 +174,15 @@ def default_ftcs() -> list[FederatedTypeConfig]:
         make_ftc("secrets", "", "v1", "Secret", "secrets"),
         make_ftc("services", "", "v1", "Service", "services"),
         make_ftc("serviceaccounts", "", "v1", "ServiceAccount", "serviceaccounts"),
-        make_ftc("namespaces", "", "v1", "Namespace", "namespaces", namespaced=False),
+        # Namespaces are placed by nsautoprop, not the scheduler
+        # (01-ftc.yaml:23-25; running both would fight over placements).
+        make_ftc(
+            "namespaces", "", "v1", "Namespace", "namespaces", namespaced=False,
+            controllers=(
+                ("kubeadmiral.io/nsautoprop-controller",),
+                ("kubeadmiral.io/overridepolicy-controller",),
+            ),
+        ),
         make_ftc(
             "jobs.batch", "batch", "v1", "Job", "jobs",
             controllers=WORKLOAD_PIPELINE,

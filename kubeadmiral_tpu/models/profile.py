@@ -44,10 +44,11 @@ class ProfileSpec:
     select: PluginSet = field(default_factory=PluginSet)
 
 
-def _parse_plugin_set(raw: dict) -> PluginSet:
+def _parse_plugin_set(raw: dict | None) -> PluginSet:
+    raw = raw or {}
     return PluginSet(
-        enabled=tuple(p.get("name", "") for p in raw.get("enabled", ())),
-        disabled=tuple(p.get("name", "") for p in raw.get("disabled", ())),
+        enabled=tuple(p.get("name", "") for p in raw.get("enabled") or ()),
+        disabled=tuple(p.get("name", "") for p in raw.get("disabled") or ()),
     )
 
 

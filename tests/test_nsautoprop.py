@@ -1,0 +1,163 @@
+"""Namespace auto-propagation (reference: pkg/controllers/nsautoprop)."""
+
+import dataclasses
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    NODES,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.nsautoprop import NamespaceAutoPropagationController
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+from test_e2e_slice import make_node, settle
+
+NSAUTOPROP = "kubeadmiral.io/nsautoprop-controller"
+
+
+def namespace_ftc(pipeline=((NSAUTOPROP,),)):
+    ftc = next(f for f in default_ftcs() if f.name == "namespaces")
+    return dataclasses.replace(ftc, controllers=pipeline)
+
+
+def make_fed_namespace(name, annotations=None):
+    obj = {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedNamespace",
+        "metadata": {"name": name, "annotations": dict(annotations or {})},
+        "spec": {"template": {"apiVersion": "v1", "kind": "Namespace",
+                              "metadata": {"name": name}, "spec": {}}},
+    }
+    pending.set_pending(obj, ((NSAUTOPROP,),))
+    return obj
+
+
+class TestNSAutoProp:
+    def setup_method(self):
+        self.ftc = namespace_ftc()
+        self.fleet = ClusterFleet()
+        self.ctl = NamespaceAutoPropagationController(self.fleet.host, self.ftc)
+        for name in ("c1", "c2"):
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                },
+            )
+
+    def fed(self, name):
+        return self.fleet.host.get(self.ftc.federated.resource, name)
+
+    def test_places_to_all_clusters_with_adoption_annotations(self):
+        self.fleet.host.create(
+            self.ftc.federated.resource, make_fed_namespace("team-a")
+        )
+        settle(self.ctl)
+        fed = self.fed("team-a")
+        assert C.get_placement(fed, NSAUTOPROP) == {"c1", "c2"}
+        ann = fed["metadata"]["annotations"]
+        assert ann[C.CONFLICT_RESOLUTION_INTERNAL] == "adopt"
+        assert ann[C.ORPHAN_MODE_INTERNAL] == "adopted"
+        assert pending.get_pending(fed) in ([], [[]])
+
+    def test_new_cluster_extends_placement(self):
+        self.fleet.host.create(
+            self.ftc.federated.resource, make_fed_namespace("team-a")
+        )
+        settle(self.ctl)
+        self.fleet.host.create(
+            FEDERATED_CLUSTERS,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "FederatedCluster",
+                "metadata": {"name": "c3"},
+                "spec": {},
+            },
+        )
+        settle(self.ctl)
+        assert C.get_placement(self.fed("team-a"), NSAUTOPROP) == {"c1", "c2", "c3"}
+
+    def test_skips_system_and_excluded_namespaces(self):
+        ctl = NamespaceAutoPropagationController(
+            self.fleet.host, self.ftc, exclude_regexp="^private-"
+        )
+        for name in ("kube-system", "kube-admiral-system", "private-x"):
+            self.fleet.host.create(
+                self.ftc.federated.resource, make_fed_namespace(name)
+            )
+        self.fleet.host.create(
+            self.ftc.federated.resource,
+            make_fed_namespace("opted-out", {C.NO_AUTO_PROPAGATION: "true"}),
+        )
+        settle(ctl)
+        for name in ("kube-system", "kube-admiral-system", "private-x", "opted-out"):
+            fed = self.fed(name)
+            assert C.get_placement(fed, NSAUTOPROP) in (None, set()), name
+            # Pipeline still advances so downstream controllers run.
+            assert pending.get_pending(fed) in ([], [[]]), name
+
+
+class TestNSAutoPropEndToEnd:
+    """Namespace source -> federate -> nsautoprop -> sync, with member-side
+    adoption and orphan-on-delete (controller.go:66-71 behavioral goals)."""
+
+    def setup_method(self):
+        self.ftc = namespace_ftc()
+        self.fleet = ClusterFleet()
+        self.clusterctl = FederatedClusterController(
+            self.fleet, api_resource_probe=["v1/Namespace"]
+        )
+        self.federate = FederateController(self.fleet.host, self.ftc)
+        self.nsautoprop = NamespaceAutoPropagationController(self.fleet.host, self.ftc)
+        self.sync = SyncController(self.fleet, self.ftc)
+        for name in ("c1", "c2"):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", "8", "16Gi"))
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                },
+            )
+
+    def everything(self):
+        return (self.clusterctl, self.federate, self.nsautoprop, self.sync)
+
+    def test_namespace_propagates_and_adopts_preexisting(self):
+        # c1 already has the namespace: it must be adopted, not conflicted.
+        self.fleet.member("c1").create(
+            self.ftc.source.resource,
+            {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "team-a"}, "spec": {}},
+        )
+        self.fleet.host.create(
+            self.ftc.source.resource,
+            {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": "team-a"}, "spec": {}},
+        )
+        settle(*self.everything(), rounds=40)
+
+        for name in ("c1", "c2"):
+            obj = self.fleet.member(name).get(self.ftc.source.resource, "team-a")
+            assert obj["metadata"]["labels"][C.MANAGED_LABEL] == "true", name
+
+        # Deleting the federated namespace orphans the adopted member copy
+        # (c1) but removes the non-adopted one (c2).
+        self.fleet.host.delete(self.ftc.source.resource, "team-a")
+        settle(*self.everything(), rounds=40)
+        assert self.fleet.member("c1").try_get(self.ftc.source.resource, "team-a")
+        assert (
+            self.fleet.member("c2").try_get(self.ftc.source.resource, "team-a")
+            is None
+        )
