@@ -144,13 +144,22 @@ class FakeKube:
             return copy.deepcopy(obj)
 
     def update_status(self, resource: str, obj: dict) -> dict:
-        """Status-subresource style update: only .status is applied."""
+        """Status-subresource style update: only .status is applied.
+        Optimistic concurrency applies as on the main resource — without
+        it, two controllers read-modify-writing different parts of the
+        same status would silently lose each other's updates."""
         with self._lock:
             key = obj_key(obj)
             store = self._store(resource)
             if key not in store:
                 raise NotFound(f"{resource} {key} in {self.name}")
-            cur = copy.deepcopy(store[key])
+            old = store[key]
+            sent_rv = obj.get("metadata", {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != old["metadata"]["resourceVersion"]:
+                raise Conflict(
+                    f"{resource} {key}: {sent_rv} != {old['metadata']['resourceVersion']}"
+                )
+            cur = copy.deepcopy(old)
             cur["status"] = copy.deepcopy(obj.get("status"))
             cur["metadata"]["resourceVersion"] = self._bump()
             store[key] = cur

@@ -1,0 +1,164 @@
+"""PolicyRC reference counting (reference: pkg/controllers/policyrc)."""
+
+from kubeadmiral_tpu.federation.policyrc import Counter, PolicyRCController
+from kubeadmiral_tpu.models import policy as P
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+
+def deployment_ftc():
+    return next(f for f in default_ftcs() if f.name == "deployments.apps")
+
+
+def make_fed(name, ns="default", labels=None):
+    return {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedDeployment",
+        "metadata": {"name": name, "namespace": ns, "labels": dict(labels or {})},
+        "spec": {"template": {}},
+    }
+
+
+def make_policy(resource, name, ns=None):
+    meta = {"name": name}
+    if ns:
+        meta["namespace"] = ns
+    return {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "Policy",
+        "metadata": meta,
+        "spec": {},
+    }
+
+
+class TestCounter:
+    def test_diffs_previous_against_new(self):
+        dirty = []
+        c = Counter(dirty.extend)
+        c.update("obj1", (("ns", "a"),))
+        c.update("obj2", (("ns", "a"),))
+        assert c.count(("ns", "a")) == 2
+        c.update("obj1", (("ns", "b"),))
+        assert c.count(("ns", "a")) == 1
+        assert c.count(("ns", "b")) == 1
+        c.update("obj2", ())
+        assert c.count(("ns", "a")) == 0
+        assert ("ns", "a") in dirty and ("ns", "b") in dirty
+
+
+class TestPolicyRCController:
+    def setup_method(self):
+        self.host = FakeKube()
+        self.ftc = deployment_ftc()
+        self.ctl = PolicyRCController(self.host, self.ftc)
+        self.resource = self.ftc.federated.resource
+
+    def settle(self):
+        for _ in range(30):
+            if not self.ctl.step_all():
+                return
+
+    def test_propagation_policy_refcount(self):
+        self.host.create(
+            P.PROPAGATION_POLICIES, make_policy(P.PROPAGATION_POLICIES, "pp", "default")
+        )
+        for i in range(3):
+            self.host.create(
+                self.resource,
+                make_fed(f"w{i}", labels={P.PROPAGATION_POLICY_LABEL: "pp"}),
+            )
+        self.settle()
+        pol = self.host.get(P.PROPAGATION_POLICIES, "default/pp")
+        assert pol["status"]["refCount"] == 3
+        assert pol["status"]["typedRefCount"] == [
+            {"group": "apps", "resource": "deployments", "count": 3}
+        ]
+
+    def test_refcount_drops_on_unbind_and_delete(self):
+        self.host.create(
+            P.PROPAGATION_POLICIES, make_policy(P.PROPAGATION_POLICIES, "pp", "default")
+        )
+        self.host.create(
+            self.resource, make_fed("w0", labels={P.PROPAGATION_POLICY_LABEL: "pp"})
+        )
+        self.host.create(
+            self.resource, make_fed("w1", labels={P.PROPAGATION_POLICY_LABEL: "pp"})
+        )
+        self.settle()
+
+        obj = self.host.get(self.resource, "default/w0")
+        del obj["metadata"]["labels"][P.PROPAGATION_POLICY_LABEL]
+        self.host.update(self.resource, obj)
+        self.settle()
+        assert self.host.get(P.PROPAGATION_POLICIES, "default/pp")["status"]["refCount"] == 1
+
+        self.host.delete(self.resource, "default/w1")
+        self.settle()
+        assert self.host.get(P.PROPAGATION_POLICIES, "default/pp")["status"]["refCount"] == 0
+
+    def test_policy_created_after_referrers_gets_counts(self):
+        for i in range(2):
+            self.host.create(
+                self.resource,
+                make_fed(f"w{i}", labels={P.CLUSTER_PROPAGATION_POLICY_LABEL: "cpp"}),
+            )
+        self.settle()
+        # Policy appears afterwards: the create event triggers persist.
+        self.host.create(
+            P.CLUSTER_PROPAGATION_POLICIES,
+            make_policy(P.CLUSTER_PROPAGATION_POLICIES, "cpp"),
+        )
+        self.settle()
+        pol = self.host.get(P.CLUSTER_PROPAGATION_POLICIES, "cpp")
+        assert pol["status"]["refCount"] == 2
+
+    def test_override_policy_refcounts_both_kinds(self):
+        from kubeadmiral_tpu.federation.overridectl import (
+            CLUSTER_OVERRIDE_POLICY_NAME_LABEL,
+            OVERRIDE_POLICY_NAME_LABEL,
+        )
+
+        self.host.create(
+            P.OVERRIDE_POLICIES, make_policy(P.OVERRIDE_POLICIES, "op", "default")
+        )
+        self.host.create(
+            P.CLUSTER_OVERRIDE_POLICIES,
+            make_policy(P.CLUSTER_OVERRIDE_POLICIES, "cop"),
+        )
+        self.host.create(
+            self.resource,
+            make_fed(
+                "w0",
+                labels={
+                    OVERRIDE_POLICY_NAME_LABEL: "op",
+                    CLUSTER_OVERRIDE_POLICY_NAME_LABEL: "cop",
+                },
+            ),
+        )
+        self.settle()
+        assert self.host.get(P.OVERRIDE_POLICIES, "default/op")["status"]["refCount"] == 1
+        assert (
+            self.host.get(P.CLUSTER_OVERRIDE_POLICIES, "cop")["status"]["refCount"] == 1
+        )
+
+    def test_typed_refcount_aggregates_across_ftcs(self):
+        sts_ftc = next(f for f in default_ftcs() if f.name == "statefulsets.apps")
+        ctl2 = PolicyRCController(self.host, sts_ftc)
+        self.host.create(
+            P.PROPAGATION_POLICIES, make_policy(P.PROPAGATION_POLICIES, "pp", "default")
+        )
+        self.host.create(
+            self.resource, make_fed("w0", labels={P.PROPAGATION_POLICY_LABEL: "pp"})
+        )
+        fed_sts = make_fed("s0", labels={P.PROPAGATION_POLICY_LABEL: "pp"})
+        fed_sts["kind"] = "FederatedStatefulSet"
+        self.host.create(sts_ftc.federated.resource, fed_sts)
+        for _ in range(30):
+            progressed = self.ctl.step_all()
+            progressed |= ctl2.step_all()
+            if not progressed:
+                break
+        pol = self.host.get(P.PROPAGATION_POLICIES, "default/pp")
+        assert pol["status"]["refCount"] == 2
+        by_type = {t["resource"]: t["count"] for t in pol["status"]["typedRefCount"]}
+        assert by_type == {"deployments": 1, "statefulsets": 1}
