@@ -374,7 +374,12 @@ class SchedulerController:
                 profile = profile_for(policy)
                 trigger = self._trigger_hash(fed_obj, policy, clusters, profile)
                 if fed_obj["metadata"].get("annotations", {}).get(C.SCHEDULING_TRIGGER_HASH) == trigger:
-                    results[key] = Result.ok()
+                    # Skip scheduling, but still advance the pipeline:
+                    # template-only changes re-arm pending-controllers
+                    # without changing the trigger hash, and downstream
+                    # controllers (override, sync) must still run
+                    # (scheduler.go:423-434).
+                    results[key] = self._advance_pipeline(fed_obj, modified=False)
                     continue
                 units.append(self._scheduling_unit(fed_obj, policy, profile))
             except Exception:
@@ -394,6 +399,22 @@ class SchedulerController:
         return results
 
     # -- persistence -----------------------------------------------------
+    def _advance_pipeline(self, fed_obj: dict, modified: bool) -> Result:
+        """Remove self from pending-controllers (re-arming downstream when
+        ``modified``) and persist, sharing the Conflict/NotFound policy of
+        every scheduler write."""
+        if not pending.update_pending(
+            fed_obj, self.name, modified, self.ftc.controller_groups
+        ):
+            return Result.ok()
+        try:
+            self.host.update(self._resource, fed_obj)
+        except Conflict:
+            return Result.retry()
+        except NotFound:
+            pass
+        return Result.ok()
+
     def _deschedule(self, fed_obj: dict) -> Result:
         """No policy bound: clear own placement/overrides and hand off
         downstream (scheduler.go schedule() with nil policy)."""

@@ -19,6 +19,12 @@ from kubeadmiral_tpu.federation.resource import (
     orphaning_behavior,
     should_adopt_preexisting,
 )
+from kubeadmiral_tpu.federation.history import (
+    LAST_REVISION_ANNOTATION,
+    RevisionManager,
+    RevisionSyncError,
+)
+from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
 from kubeadmiral_tpu.federation.version import VersionManager
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.runtime import pending
@@ -92,6 +98,7 @@ class SyncController:
         self._fed_resource = ftc.federated.resource
         self._target_resource = ftc.source.resource
         self.versions = VersionManager(self.host, ftc.source.kind, ftc.namespaced)
+        self.revisions = RevisionManager(self.host) if ftc.revision_history else None
         self.pool = ThreadPoolExecutor(max_workers=max_dispatch_workers)
         self.worker = Worker(
             f"sync-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
@@ -138,7 +145,37 @@ class SyncController:
         if self._ensure_finalizer(fed_obj) is None:
             return Result.retry()  # conflict adding finalizer
 
-        return self._sync_to_clusters(fed)
+        collision_count = None
+        if self.revisions is not None:
+            # Record the template revision + annotate the fed object
+            # (controller.go:399-418 syncRevisions/ensureAnnotations).
+            try:
+                collision_count, last_rev, current_rev = (
+                    self.revisions.sync_revisions(fed_obj)
+                )
+            except RevisionSyncError:
+                return Result.retry()
+            ann = fed_obj["metadata"].setdefault("annotations", {})
+            dirty = False
+            for key_, value in (
+                (LAST_REVISION_ANNOTATION, last_rev),
+                (CURRENT_REVISION_ANNOTATION, current_rev),
+            ):
+                if value and ann.get(key_) != value:
+                    ann[key_] = value
+                    dirty = True
+            if dirty:
+                try:
+                    updated = self.host.update(self._fed_resource, fed_obj)
+                except Conflict:
+                    return Result.retry()
+                except NotFound:
+                    return Result.ok()
+                fed_obj["metadata"]["resourceVersion"] = updated["metadata"][
+                    "resourceVersion"
+                ]
+
+        return self._sync_to_clusters(fed, collision_count)
 
     # -- cluster cascading-delete finalizer (controller.go:1050-1196) ----
     def _reconcile_cluster(self, name: str) -> Result:
@@ -208,7 +245,9 @@ class SyncController:
         return fed_obj
 
     # -- the propagation round (controller.go:425-596) -------------------
-    def _sync_to_clusters(self, fed: FederatedResource) -> Result:
+    def _sync_to_clusters(
+        self, fed: FederatedResource, collision_count: Optional[int] = None
+    ) -> Result:
         clusters = self.host.list(FEDERATED_CLUSTERS)
         joined = [c for c in clusters if is_cluster_joined(c)]
         selected = fed.compute_placement([c["metadata"]["name"] for c in joined])
@@ -295,7 +334,9 @@ class SyncController:
 
         status_map = dispatcher.status_map
         reason = AGGREGATE_SUCCESS if ok else CHECK_CLUSTERS
-        status_result = self._set_federated_status(fed, reason, status_map)
+        status_result = self._set_federated_status(
+            fed, reason, status_map, collision_count
+        )
         if not status_result.success:
             return status_result
         if not ok:
@@ -328,9 +369,14 @@ class SyncController:
 
     # -- status ----------------------------------------------------------
     def _set_federated_status(
-        self, fed: FederatedResource, reason: str, status_map: dict[str, str]
+        self,
+        fed: FederatedResource,
+        reason: str,
+        status_map: dict[str, str],
+        collision_count: Optional[int] = None,
     ) -> Result:
-        """Write status.clusters + the Propagated condition via the status
+        """Write status.clusters + the Propagated condition (and the
+        revision collisionCount, when history is on) via the status
         subresource, with conflict-retry (controller.go:637-721)."""
         desired_clusters = [
             {"cluster": c, "status": s} for c, s in sorted(status_map.items())
@@ -350,6 +396,12 @@ class SyncController:
                 or prop.get("reason") != reason
                 or prop.get("status") != new_status
             )
+            if (
+                collision_count is not None
+                and status.get("collisionCount") != collision_count
+            ):
+                status["collisionCount"] = collision_count
+                changed = True
             if not changed:
                 return Result.ok()
             status["clusters"] = desired_clusters

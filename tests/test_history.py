@@ -1,0 +1,169 @@
+"""Revision history: ControllerRevisions + sync wiring
+(reference: pkg/controllers/sync/history.go)."""
+
+from kubeadmiral_tpu.federation.history import (
+    CONTROLLER_REVISIONS,
+    LAST_REVISION_ANNOTATION,
+    RevisionManager,
+    _revision_name,
+)
+from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+from test_e2e_slice import TestEndToEndSlice, make_deployment, settle
+
+
+def make_fed(image="nginx:1", history_limit=None, uid="u1"):
+    obj = {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedDeployment",
+        "metadata": {
+            "name": "web",
+            "namespace": "default",
+            "uid": uid,
+            "labels": {"app": "web"},
+        },
+        "spec": {
+            "template": {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "spec": {
+                    "template": {
+                        "metadata": {"labels": {"app": "web"}},
+                        "spec": {"containers": [{"name": "c", "image": image}]},
+                    }
+                },
+            }
+        },
+        "status": {},
+    }
+    if history_limit is not None:
+        obj["spec"]["revisionHistoryLimit"] = history_limit
+    return obj
+
+
+class TestRevisionManager:
+    def setup_method(self):
+        self.host = FakeKube()
+        self.mgr = RevisionManager(self.host)
+
+    def revisions(self):
+        return sorted(
+            self.host.list(CONTROLLER_REVISIONS), key=lambda r: r["revision"]
+        )
+
+    def test_first_sync_creates_revision_one(self):
+        collision, last, current = self.mgr.sync_revisions(make_fed())
+        assert collision == 0
+        assert last == ""
+        revs = self.revisions()
+        assert len(revs) == 1
+        assert revs[0]["revision"] == 1
+        assert revs[0]["metadata"]["name"] == current
+        assert revs[0]["metadata"]["labels"]["uid"] == "u1"
+        assert revs[0]["data"][0]["path"] == "/spec/template/spec/template"
+
+    def test_same_template_is_deduplicated(self):
+        self.mgr.sync_revisions(make_fed())
+        _, _, current = self.mgr.sync_revisions(make_fed())
+        assert len(self.revisions()) == 1
+        assert self.revisions()[0]["metadata"]["name"] == current
+
+    def test_template_change_bumps_revision_and_reports_last(self):
+        _, _, first = self.mgr.sync_revisions(make_fed("nginx:1"))
+        _, last, second = self.mgr.sync_revisions(make_fed("nginx:2"))
+        revs = self.revisions()
+        assert [r["revision"] for r in revs] == [1, 2]
+        assert second != first
+        assert last.startswith(first + "|")
+
+    def test_rollback_renumbers_old_revision(self):
+        _, _, first = self.mgr.sync_revisions(make_fed("nginx:1"))
+        self.mgr.sync_revisions(make_fed("nginx:2"))
+        # Roll back to the original template: its revision becomes newest.
+        _, last, current = self.mgr.sync_revisions(make_fed("nginx:1"))
+        assert current == first
+        by_name = {r["metadata"]["name"]: r["revision"] for r in self.revisions()}
+        assert by_name[first] == 3
+        assert len(by_name) == 2
+
+    def test_history_truncated_to_limit(self):
+        for i in range(5):
+            self.mgr.sync_revisions(make_fed(f"nginx:{i}", history_limit=2))
+        revs = self.revisions()
+        # 2 old + the current one survive.
+        assert len(revs) == 3
+        assert [r["revision"] for r in revs] == [3, 4, 5]
+
+    def test_history_limit_zero_keeps_no_old_revisions(self):
+        self.mgr.sync_revisions(make_fed("nginx:1", history_limit=0))
+        _, last, _ = self.mgr.sync_revisions(make_fed("nginx:2", history_limit=0))
+        assert last == ""
+        assert [r["revision"] for r in self.revisions()] == [2]
+
+    def test_owner_label_named_uid_does_not_break_ownership(self):
+        fed = make_fed("nginx:1")
+        fed["metadata"]["labels"]["uid"] = "liar"
+        self.mgr.sync_revisions(fed)
+        self.mgr.sync_revisions(fed)
+        revs = self.revisions()
+        assert len(revs) == 1
+        assert revs[0]["metadata"]["labels"]["uid"] == "u1"
+
+    def test_name_collision_bumps_collision_count(self):
+        fed = make_fed("nginx:1")
+        colliding_name = _revision_name("web",
+            [{"op": "replace", "path": "/spec/template/spec/template",
+              "value": fed["spec"]["template"]["spec"]["template"]}], 0)
+        # A pre-existing revision with the colliding name but different
+        # data forces the collision-count retry.
+        self.host.create(
+            CONTROLLER_REVISIONS,
+            {
+                "apiVersion": "apps/v1",
+                "kind": "ControllerRevision",
+                "metadata": {
+                    "name": colliding_name,
+                    "namespace": "default",
+                    "labels": {"uid": "someone-else"},
+                },
+                "data": [{"op": "replace", "path": "/x", "value": 1}],
+                "revision": 9,
+            },
+        )
+        collision, _, current = self.mgr.sync_revisions(fed)
+        assert collision == 1
+        assert current != colliding_name
+
+
+class TestSyncRevisionWiring(TestEndToEndSlice):
+    """The deployments FTC has revisionHistory enabled: propagation must
+    record revisions and annotate objects (controller.go:399-418)."""
+
+    def test_revisions_recorded_through_sync(self):
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.everything())
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        ann = fed["metadata"]["annotations"]
+        current = ann[CURRENT_REVISION_ANNOTATION]
+        revs = self.fleet.host.list(CONTROLLER_REVISIONS)
+        assert [r["metadata"]["name"] for r in revs] == [current]
+        assert fed["status"].get("collisionCount") == 0
+
+        # Member objects carry the current-revision annotation for the
+        # rollout planner to pair against.
+        for name in ("c1", "c2", "c3"):
+            obj = self.fleet.member(name).get(self.ftc.source.resource, "default/web")
+            assert obj["metadata"]["annotations"][CURRENT_REVISION_ANNOTATION] == current
+
+        # A template update creates a second revision and records the last.
+        src = self.fleet.host.get(self.ftc.source.resource, "default/web")
+        src["spec"]["template"]["spec"]["containers"][0]["image"] = "nginx:2"
+        self.fleet.host.update(self.ftc.source.resource, src)
+        settle(*self.everything())
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        assert fed["metadata"]["annotations"][LAST_REVISION_ANNOTATION].startswith(
+            current + "|"
+        )
+        assert fed["metadata"]["annotations"][CURRENT_REVISION_ANNOTATION] != current
+        assert len(self.fleet.host.list(CONTROLLER_REVISIONS)) == 2
