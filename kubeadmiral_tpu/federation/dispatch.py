@@ -19,6 +19,14 @@ from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation import retain
+from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
+from kubeadmiral_tpu.federation.rollout import (
+    LAST_RS_NAME,
+    LATEST_RS_NAME,
+    MAX_SURGE_PATH,
+    MAX_UNAVAILABLE_PATH,
+)
+from kubeadmiral_tpu.utils.unstructured import delete_path, get_path, set_path
 from kubeadmiral_tpu.federation.resource import (
     FederatedResource,
     has_managed_label,
@@ -54,6 +62,61 @@ MANAGED_LABEL_FALSE = "ManagedLabelFalse"
 FINALIZER_CHECK_FAILED = "FinalizerCheckFailed"
 
 ADOPTED_ANNOTATION = C.PREFIX + "adopted"
+
+
+def _set_last_replicaset_name(obj: dict, cluster_obj: dict) -> None:
+    """When a new template revision is being dispatched, remember which
+    ReplicaSet was newest BEFORE it, so stale latest-replicaset
+    annotations are recognizable (retain.go setLastReplicasetName)."""
+    if cluster_obj is None:
+        return
+    ann = obj.get("metadata", {}).get("annotations", {})
+    revision = ann.get(CURRENT_REVISION_ANNOTATION)
+    if revision is None:
+        return
+    cluster_ann = cluster_obj.get("metadata", {}).get("annotations", {})
+    last_dispatched = cluster_ann.get(CURRENT_REVISION_ANNOTATION)
+    if last_dispatched is not None and revision != last_dispatched:
+        rs_name = cluster_ann.get(LATEST_RS_NAME)
+        if rs_name is not None:
+            obj.setdefault("metadata", {}).setdefault("annotations", {})[
+                LAST_RS_NAME
+            ] = rs_name
+
+
+def _retain_template(
+    obj: dict, cluster_obj: dict, replicas_path: str, keep_rollout_settings: bool
+) -> None:
+    """Keep the member's current pod template (and optionally its rollout
+    knobs) in the desired object: "not your turn yet"
+    (retain.go retainTemplate)."""
+    tpl = get_path(cluster_obj, "spec.template")
+    if tpl is not None:
+        set_path(obj, "spec.template", tpl)
+    else:
+        delete_path(obj, "spec.template")
+    ann = obj.setdefault("metadata", {}).setdefault("annotations", {})
+    cluster_revision = cluster_obj.get("metadata", {}).get("annotations", {}).get(
+        CURRENT_REVISION_ANNOTATION
+    )
+    if cluster_revision is not None:
+        ann[CURRENT_REVISION_ANNOTATION] = cluster_revision
+    else:
+        ann.pop(CURRENT_REVISION_ANNOTATION, None)
+    if keep_rollout_settings:
+        if replicas_path:
+            replicas = get_path(cluster_obj, replicas_path)
+            if replicas is not None:
+                set_path(obj, replicas_path, replicas)
+            else:
+                delete_path(obj, replicas_path)
+        for path in (MAX_SURGE_PATH, MAX_UNAVAILABLE_PATH):
+            dotted = path[1:].replace("/", ".")
+            value = get_path(cluster_obj, dotted)
+            if value is not None:
+                set_path(obj, dotted, value)
+            else:
+                delete_path(obj, dotted)
 
 
 class ManagedDispatcher:
@@ -221,6 +284,8 @@ class ManagedDispatcher:
         try:
             retain.retain_cluster_fields(self.fed.target_kind, obj, cluster_obj)
             retain.retain_replicas(obj, cluster_obj, self.fed.obj, self.replicas_path)
+            if self.fed.target_kind == "Deployment":
+                _set_last_replicaset_name(obj, cluster_obj)
         except Exception as e:
             return self.record_error(cluster, FIELD_RETENTION_FAILED, str(e))
 
@@ -240,6 +305,59 @@ class ManagedDispatcher:
             return self.record_error(cluster, UPDATE_FAILED, str(e))
         self._resources_updated = True
         self._record_version(cluster, object_version(updated))
+
+    def patch_and_keep_template(
+        self,
+        cluster: str,
+        cluster_obj: dict,
+        keep_rollout_settings: bool,
+        recorded_version: str = "",
+    ) -> None:
+        """Dispatch everything EXCEPT the pod template: an unplanned
+        cluster waits its rollout turn with its current template (and,
+        with ``keep_rollout_settings``, its current replicas/fenceposts)
+        (managed.go:483-560 PatchAndKeepTemplate)."""
+        self.record_status(cluster, UPDATE_TIMED_OUT)
+
+        def run() -> None:
+            if is_explicitly_unmanaged(cluster_obj):
+                return self.record_error(
+                    cluster,
+                    MANAGED_LABEL_FALSE,
+                    f"object has label {C.MANAGED_LABEL}=false",
+                )
+            try:
+                obj = self._desired(cluster)
+            except Exception as e:
+                return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
+            try:
+                retain.retain_cluster_fields(self.fed.target_kind, obj, cluster_obj)
+                retain.retain_replicas(
+                    obj, cluster_obj, self.fed.obj, self.replicas_path
+                )
+                # No _set_last_replicaset_name here: _retain_template just
+                # forced the revision annotations equal, so the real
+                # update() path is where the last-RS marker gets written.
+                _retain_template(
+                    obj, cluster_obj, self.replicas_path, keep_rollout_settings
+                )
+            except Exception as e:
+                return self.record_error(cluster, FIELD_RETENTION_FAILED, str(e))
+
+            if recorded_version and not object_needs_update(
+                obj, cluster_obj, recorded_version, self.replicas_path
+            ):
+                self._record_version(cluster, recorded_version)
+                return
+            client = self.client_for_cluster(cluster)
+            try:
+                updated = client.update(self.resource, obj)
+            except Exception as e:
+                return self.record_error(cluster, UPDATE_FAILED, str(e))
+            self._resources_updated = True
+            self._record_version(cluster, object_version(updated))
+
+        self._submit(run)
 
     def delete(self, cluster: str) -> None:
         """Delete from a member cluster (unmanaged.go Delete): the object

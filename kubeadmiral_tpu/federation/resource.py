@@ -166,6 +166,26 @@ class FederatedResource:
         ] = C.MANAGED_TRUE
         return obj
 
+    def replicas_override_for_cluster(self, cluster: str) -> int:
+        """The replicas this cluster is scheduled for: the last
+        /spec/replicas override patch, else the template's replicas
+        (resource.go:392-416 ReplicasOverrideForCluster)."""
+        replicas_path = "/" + self.ftc.path.replicas_spec.replace(".", "/") if (
+            self.ftc.path.replicas_spec
+        ) else "/spec/replicas"
+        value = None
+        for patch in self._ordered_overrides().get(cluster, ()):
+            if patch.get("path") == replicas_path and patch.get("value") is not None:
+                value = patch["value"]
+        if value is not None:
+            return int(value)
+        template = self.obj.get("spec", {}).get("template", {})
+        return int(get_path(template, self.ftc.path.replicas_spec, 0) or 0)
+
+    def total_replicas(self, clusters) -> int:
+        """(resource.go:417-427 TotalReplicas)"""
+        return sum(self.replicas_override_for_cluster(c) for c in clusters)
+
     # -- version hashes --------------------------------------------------
     def template_version(self) -> str:
         """Hash of the template (resource.go TemplateVersion via
@@ -219,6 +239,16 @@ def object_needs_update(
     ):
         if get_path(desired, p) != get_path(cluster_obj, p):
             return True
+    # Generation-sourced versions don't change on metadata-only edits, so
+    # label/annotation drift (e.g. a new current-revision annotation during
+    # a rollout) needs an explicit equivalence check
+    # (propagatedversion.go:115-119 + meta.go ObjectMetaObjEquivalent).
+    if recorded_version.startswith("gen:"):
+        for field_ in ("labels", "annotations"):
+            a = desired.get("metadata", {}).get(field_) or {}
+            b = cluster_obj.get("metadata", {}).get(field_) or {}
+            if a != b and (a or b):
+                return True
     return False
 
 

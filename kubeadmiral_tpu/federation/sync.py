@@ -14,6 +14,7 @@ from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation import dispatch as D
+from kubeadmiral_tpu.federation import rollout as R
 from kubeadmiral_tpu.federation.resource import (
     FederatedResource,
     orphaning_behavior,
@@ -106,6 +107,14 @@ class SyncController:
         # Per-FTC cascading-delete finalizer held on FederatedCluster
         # objects (controller.go:216 cascadingDeleteFinalizer).
         self.cluster_finalizer = C.PREFIX + "cascading-delete-" + ftc.name
+        # Member-object events re-enqueue the owning federated object
+        # (the FederatedInformer path, SURVEY §3.3) — rollout planning in
+        # particular must observe member progress between dispatches.
+        # Attached before the cluster watch: its replay fires
+        # _on_cluster_event, which re-attaches members, synchronously.
+        self._reattach_members = fleet.watch_members(
+            self._target_resource, self._on_member_event
+        )
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=True)
 
@@ -113,9 +122,13 @@ class SyncController:
     def _on_fed_event(self, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
 
+    def _on_member_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         # Cluster lifecycle re-enqueues everything (controller.go:244-260)
         # and reconciles the per-cluster cascading-delete finalizer.
+        self._reattach_members()
         self.worker.enqueue(_CLUSTER_KEY_PREFIX + obj["metadata"]["name"])
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
@@ -255,6 +268,18 @@ class SyncController:
         recorded = self.versions.get(
             fed.namespace, fed.name, fed.template_version(), fed.override_version()
         )
+        # Rollout planning is Deployment-only, incompatible with
+        # member-owned replicas (managed.go:204-213), and depends on the
+        # current-revision annotation that only revision history stamps —
+        # without it every plan would fail and nothing would ever be
+        # created.
+        rollout_enabled = (
+            self.ftc.rollout_plan
+            and self.revisions is not None
+            and self.ftc.source.kind == "Deployment"
+            and not fed.obj.get("spec", {}).get("retainReplicas")
+        )
+        plans_holder: dict[str, R.RolloutPlan] = {}
         dispatcher = D.ManagedDispatcher(
             self._member_client,
             fed,
@@ -262,7 +287,19 @@ class SyncController:
             replicas_path=self.ftc.path.replicas_spec,
             skip_adopting=not should_adopt_preexisting(fed.obj),
             pool=self.pool,
+            rollout_overrides=(
+                (
+                    lambda c: plans_holder[c].to_overrides()
+                    if c in plans_holder
+                    else []
+                )
+                if rollout_enabled
+                else None
+            ),
         )
+        # (cluster, cluster_obj, should_be_deleted, cascading) actions
+        # deferred until after rollout planning.
+        rollout_ops: list[tuple[str, Optional[dict], bool, bool]] = []
 
         for cluster in joined:
             cname = cluster["metadata"]["name"]
@@ -304,6 +341,11 @@ class SyncController:
                     # Preserve member objects of a non-cascading
                     # terminating cluster (controller.go:498-506).
                     continue
+                if rollout_enabled:
+                    # Deletions drain through the rollout plan so removing
+                    # a cluster counts against maxUnavailable.
+                    rollout_ops.append((cname, cluster_obj, True, cascading))
+                    continue
                 # Orphaning is only respected during cascading deletion,
                 # not when migrating between clusters (controller.go:508).
                 self._delete_one(dispatcher, cname, fed, cluster_obj, cascading)
@@ -314,10 +356,41 @@ class SyncController:
                     cname, D.CLUSTER_TERMINATING, "cluster terminating"
                 )
                 continue
-            if cluster_obj is None:
+            if rollout_enabled:
+                rollout_ops.append((cname, cluster_obj, False, False))
+            elif cluster_obj is None:
                 dispatcher.create(cname)
             else:
                 dispatcher.update(cname, cluster_obj, recorded.get(cname, ""))
+
+        if rollout_enabled:
+            plans = self._plan_rollout(fed, rollout_ops, selected)
+            if plans:
+                plans_holder.update(plans)
+            # The dispatch decisions of managed.go:214-250: unplanned
+            # clusters keep their template (and rollout knobs); planned
+            # ones create/update/shrink/delete as the plan dictates.
+            for cname, cluster_obj, to_delete, cascading in rollout_ops:
+                plan = plans.get(cname) if plans else None
+                version = recorded.get(cname, "")
+                if plan is None:
+                    if cluster_obj is not None:
+                        dispatcher.patch_and_keep_template(
+                            cname, cluster_obj, True, version
+                        )
+                    continue
+                if to_delete and (plan.replicas is None or plan.replicas == 0):
+                    self._delete_one(dispatcher, cname, fed, cluster_obj, cascading)
+                    continue
+                if cluster_obj is None:
+                    dispatcher.create(cname)
+                    continue
+                if plan.only_patch_replicas and plan.replicas is not None:
+                    dispatcher.patch_and_keep_template(
+                        cname, cluster_obj, False, version
+                    )
+                    continue
+                dispatcher.update(cname, cluster_obj, version)
 
         ok = dispatcher.wait()
 
@@ -347,6 +420,39 @@ class SyncController:
             # (controller.go recheckAfterDispatchDelay).
             return Result.after(10.0)
         return Result.ok()
+
+    def _plan_rollout(
+        self,
+        fed: FederatedResource,
+        ops: list,
+        selected: set[str],
+    ) -> Optional[dict[str, R.RolloutPlan]]:
+        """Build the cross-cluster rollout plan for this tick
+        (managed.go:272-323 planRolloutProcess).  None = planning failed;
+        existing members then keep their template this round."""
+        try:
+            replicas = fed.total_replicas(selected)
+            planner = R.RolloutPlanner(fed.key, fed.obj, replicas)
+            for cname, cluster_obj, to_delete, _ in ops:
+                desired = 0 if to_delete else fed.replicas_override_for_cluster(cname)
+                planner.register(
+                    R.target_from_cluster_object(
+                        cname,
+                        cluster_obj,
+                        desired,
+                        planner.revision,
+                        self.ftc.path.replicas_spec,
+                        self.ftc.path.available_replicas_status,
+                    )
+                )
+            plans = planner.plan()
+        except (R.RolloutPlanError, TypeError, ValueError):
+            # Malformed member-written state degrades to a no-plan tick
+            # (existing members keep their template) rather than wedging
+            # the whole reconcile.
+            self.metrics.counter(f"sync-{self.ftc.name}.plan_rollout_failed")
+            return None
+        return plans or None
 
     def _delete_one(
         self,

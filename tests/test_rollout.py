@@ -1,0 +1,343 @@
+"""Cross-cluster rollout planning (reference:
+pkg/controllers/util/rolloutplan.go + rolloutplan_test.go's behavioral
+model, applied through the sync dispatcher)."""
+
+import dataclasses
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation import rollout as R
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    NODES,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+from kubeadmiral_tpu.testing.membersim import MemberDeploymentSimulator
+
+from test_e2e_slice import make_node, settle
+
+
+class TestResolveFenceposts:
+    def test_ints(self):
+        assert R.resolve_fenceposts(2, 1, 10) == (2, 1)
+
+    def test_percent_rounding(self):
+        # surge rounds up, unavailable rounds down (k8s intstr semantics).
+        assert R.resolve_fenceposts("25%", "25%", 10) == (3, 2)
+
+    def test_both_zero_degenerates_to_one_unavailable(self):
+        assert R.resolve_fenceposts(0, 0, 10) == (0, 1)
+
+    def test_none_defaults_to_zero_then_degenerates(self):
+        assert R.resolve_fenceposts(None, None, 10) == (0, 1)
+
+
+def fed_obj(max_surge=0, max_unavailable=2, revision="rev-2"):
+    return {
+        "metadata": {
+            "name": "web",
+            "namespace": "default",
+            "annotations": {CURRENT_REVISION_ANNOTATION: revision},
+        },
+        "spec": {
+            "template": {
+                "spec": {
+                    "strategy": {
+                        "rollingUpdate": {
+                            "maxSurge": max_surge,
+                            "maxUnavailable": max_unavailable,
+                        }
+                    }
+                }
+            }
+        },
+    }
+
+
+def target(cluster, replicas, desired, updated=False, available=None,
+           current_new=None, current_new_available=None,
+           max_surge=0, max_unavailable=1):
+    """A stable member: its newest ReplicaSet is its own template's RS at
+    full scale (current_new == replicas) whether or not that template
+    matches the fed revision; ``updated`` only controls whether those
+    count toward the fed rollout."""
+    available = replicas if available is None else available
+    current_new = replicas if current_new is None else current_new
+    if current_new_available is None:
+        current_new_available = current_new if available >= current_new else available
+    return R.Target(
+        cluster=cluster,
+        desired_replicas=desired,
+        status=R.TargetStatus(
+            replicas=replicas,
+            actual_replicas=replicas,
+            available_replicas=available,
+            updated_replicas=current_new if updated else 0,
+            updated_available_replicas=current_new_available if updated else 0,
+            current_new_replicas=current_new,
+            current_new_available_replicas=current_new_available,
+            updated=updated,
+            max_surge=max_surge,
+            max_unavailable=max_unavailable,
+        ),
+    )
+
+
+class TestRolloutPlanner:
+    def make_planner(self, targets, max_surge=0, max_unavailable=2, replicas=9):
+        planner = R.RolloutPlanner("default/web", fed_obj(max_surge, max_unavailable), replicas)
+        for t in targets:
+            planner.register(t)
+        return planner
+
+    def test_pure_scaling_gives_empty_plans(self):
+        planner = self.make_planner(
+            [
+                target("c1", 3, 5, updated=True),
+                target("c2", 3, 3, updated=True),
+            ],
+            replicas=8,
+        )
+        plans = planner.plan()
+        assert set(plans) == {"c1", "c2"}
+        for plan in plans.values():
+            assert plan.replicas is None
+            assert plan.max_surge is None
+            assert plan.max_unavailable is None
+
+    def test_update_budget_serializes_clusters(self):
+        # All three need the new template; federation allows 2 unavailable.
+        planner = self.make_planner(
+            [
+                target("c1", 3, 3),
+                target("c2", 3, 3),
+                target("c3", 3, 3),
+            ]
+        )
+        plans = planner.plan()
+        # Only the first (name-ordered) cluster gets the budget.
+        assert set(plans) == {"c1"}
+        assert plans["c1"].max_unavailable == 2
+        assert plans["c1"].max_surge == 0
+
+    def test_completed_cluster_frees_budget_for_next(self):
+        planner = self.make_planner(
+            [
+                target("c1", 3, 3, updated=True),
+                target("c2", 3, 3),
+                target("c3", 3, 3),
+            ]
+        )
+        plans = planner.plan()
+        assert "c2" in plans
+        assert plans["c2"].max_unavailable == 2
+        # Completed c1 gets the nil-fencepost final plan.
+        assert "c1" in plans
+        assert plans["c1"].max_surge is None and plans["c1"].max_unavailable is None
+        assert "c3" not in plans
+
+    def test_unavailable_replicas_occupy_budget(self):
+        # c1 has 2 unavailable replicas mid-update: no remaining budget.
+        planner = self.make_planner(
+            [
+                target("c1", 3, 3, available=1, current_new=2,
+                       current_new_available=0, max_unavailable=2),
+                target("c2", 3, 3),
+                target("c3", 3, 3),
+            ]
+        )
+        plans = planner.plan()
+        assert "c2" not in plans and "c3" not in plans
+
+    def test_scale_in_prefers_unavailable_and_funds_upgrade(self):
+        # c1 shrinks 6->3: its shrink happens within the unavailability
+        # budget; onlyPatchReplicas protects its template.
+        planner = self.make_planner(
+            [
+                target("c1", 6, 3),
+                target("c2", 3, 6, updated=True),
+            ],
+            replicas=9,
+            max_unavailable=2,
+        )
+        plans = planner.plan()
+        assert "c1" in plans
+        assert plans["c1"].replicas == 4  # shrank by the budget of 2
+        assert plans["c1"].only_patch_replicas
+
+    def test_deleted_cluster_drains_through_plan(self):
+        planner = self.make_planner(
+            [
+                target("c1", 3, 0, updated=True),
+                target("c2", 3, 3, updated=True),
+                target("c3", 3, 3, updated=True),
+            ],
+            replicas=6,
+            max_unavailable=3,
+        )
+        plans = planner.plan()
+        # A pure scaling event yields nil-replica plans; the dispatcher's
+        # deletion branch treats nil replicas on a to-delete cluster as
+        # "drain now" (managed.go:236-239).
+        assert plans["c1"].replicas in (None, 0)
+
+    def test_validate_rejects_overdraining_plans(self):
+        planner = self.make_planner([target("c1", 3, 3)], replicas=3)
+        bad = {"c1": R.RolloutPlan(replicas=0)}
+        assert not planner._validate(bad)
+
+
+def make_rollout_deployment(replicas=9):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": "web",
+            "namespace": "default",
+            "labels": {"kubeadmiral.io/propagation-policy-name": "pp"},
+        },
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": "web"}},
+            "strategy": {
+                "type": "RollingUpdate",
+                "rollingUpdate": {"maxSurge": 0, "maxUnavailable": 2},
+            },
+            "template": {
+                "metadata": {"labels": {"app": "web"}},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "image": "nginx:1",
+                            "resources": {"requests": {"cpu": "100m"}},
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+class TestRolloutEndToEnd:
+    """Image update across 3 members: at no point may federation-wide
+    unavailability exceed the fed maxUnavailable, and no surge is allowed
+    with maxSurge 0."""
+
+    def setup_method(self):
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        self.ftc = dataclasses.replace(
+            ftc,
+            controllers=(("kubeadmiral.io/global-scheduler",),),
+            rollout_plan=True,
+        )
+        self.fleet = ClusterFleet()
+        gvk = "apps/v1/Deployment"
+        self.clusterctl = FederatedClusterController(
+            self.fleet, api_resource_probe=[gvk]
+        )
+        self.federate = FederateController(self.fleet.host, self.ftc)
+        self.scheduler = SchedulerController(self.fleet.host, self.ftc)
+        self.sync = SyncController(self.fleet, self.ftc)
+        self.sim = MemberDeploymentSimulator(self.fleet)
+
+        for name in ("c1", "c2", "c3"):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", "64", "128Gi"))
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                },
+            )
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {
+                    "schedulingMode": "Divide",
+                    "placements": [
+                        {"cluster": c, "preferences": {"weight": 1}}
+                        for c in ("c1", "c2", "c3")
+                    ],
+                },
+            },
+        )
+
+    def controllers(self):
+        return (self.clusterctl, self.federate, self.scheduler, self.sync)
+
+    def run_to_convergence(self, max_rounds=60, invariant=None):
+        for _ in range(max_rounds):
+            progressed = False
+            for c in self.controllers():
+                progressed |= c.worker.step()
+            progressed |= self.sim.step()
+            if invariant is not None:
+                invariant()
+            if not progressed:
+                return
+
+    def member_images(self):
+        out = {}
+        for name in ("c1", "c2", "c3"):
+            obj = self.fleet.member(name).try_get(
+                self.ftc.source.resource, "default/web"
+            )
+            out[name] = (
+                obj["spec"]["template"]["spec"]["containers"][0]["image"]
+                if obj
+                else None
+            )
+        return out
+
+    def test_rollout_respects_federation_invariants(self):
+        self.fleet.host.create(
+            self.ftc.source.resource, make_rollout_deployment(replicas=9)
+        )
+        self.run_to_convergence()
+        assert self.member_images() == {c: "nginx:1" for c in ("c1", "c2", "c3")}
+        assert self.sim.total_unavailable(9) == 0
+
+        src = self.fleet.host.get(self.ftc.source.resource, "default/web")
+        src["spec"]["template"]["spec"]["containers"][0]["image"] = "nginx:2"
+        self.fleet.host.update(self.ftc.source.resource, src)
+
+        violations = []
+
+        def invariant():
+            unavailable = self.sim.total_unavailable(9)
+            surge = self.sim.total_surge(9)
+            if unavailable > 2 or surge > 0:
+                violations.append((unavailable, surge))
+
+        self.run_to_convergence(invariant=invariant)
+        assert self.member_images() == {c: "nginx:2" for c in ("c1", "c2", "c3")}
+        assert not violations, f"invariant violated: {violations}"
+        assert self.sim.total_unavailable(9) == 0
+
+    def test_scale_only_change_skips_rollout_gating(self):
+        self.fleet.host.create(
+            self.ftc.source.resource, make_rollout_deployment(replicas=9)
+        )
+        self.run_to_convergence()
+        src = self.fleet.host.get(self.ftc.source.resource, "default/web")
+        src["spec"]["replicas"] = 12
+        self.fleet.host.update(self.ftc.source.resource, src)
+        self.run_to_convergence()
+        total = 0
+        for name in ("c1", "c2", "c3"):
+            obj = self.fleet.member(name).get(self.ftc.source.resource, "default/web")
+            total += obj["spec"]["replicas"]
+        assert total == 12
