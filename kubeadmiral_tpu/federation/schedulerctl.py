@@ -14,9 +14,13 @@ featurized into one batch and pushed through the XLA engine
 
 from __future__ import annotations
 
+import dataclasses
 import json as _json
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
+
+import numpy as np
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models import policy as P
@@ -28,6 +32,7 @@ from kubeadmiral_tpu.runtime import pending
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
 from kubeadmiral_tpu.scheduler.engine import ScheduleResult, SchedulerEngine
+from kubeadmiral_tpu.scheduler import webhook as W
 from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_key
 from kubeadmiral_tpu.utils.hashing import stable_json_hash
 from kubeadmiral_tpu.utils.unstructured import get_path
@@ -96,6 +101,7 @@ class SchedulerController:
         ftc: FederatedTypeConfig,
         engine: Optional[SchedulerEngine] = None,
         metrics: Optional[Metrics] = None,
+        webhook_client: Optional[W.HTTPClient] = None,
     ):
         self.host = host
         self.ftc = ftc
@@ -103,12 +109,18 @@ class SchedulerController:
         self.metrics = metrics or Metrics()
         self.worker = BatchWorker(f"scheduler-{ftc.name}", self.reconcile_batch, metrics=self.metrics)
         self._resource = ftc.federated.resource
+        self._webhook_client = webhook_client
+        self._webhook_pool: Optional[ThreadPoolExecutor] = None
+        # name -> WebhookPlugin, maintained from config watch events
+        # (scheduler.go s.webhookPlugins sync.Map).
+        self.webhook_plugins: dict[str, W.WebhookPlugin] = {}
 
         host.watch(self._resource, self._on_object_event, replay=True)
         host.watch(P.PROPAGATION_POLICIES, self._on_policy_event, replay=False)
         host.watch(P.CLUSTER_PROPAGATION_POLICIES, self._on_policy_event, replay=False)
         host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
         host.watch(PR.SCHEDULING_PROFILES, self._on_profile_event, replay=False)
+        host.watch(W.SCHEDULER_WEBHOOK_CONFIGS, self._on_webhook_config_event, replay=True)
 
     # -- event handlers (fan-in to the dirty queue) ----------------------
     def _on_object_event(self, event: str, obj: dict) -> None:
@@ -156,6 +168,35 @@ class SchedulerController:
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         # Cluster changes can change every placement
         # (schedulingtriggers.go enqueueFederatedObjectsForCluster).
+        self.worker.enqueue_all(self.host.keys(self._resource))
+
+    def _on_webhook_config_event(self, event: str, obj: dict) -> None:
+        """Register/refresh/remove the webhook plugin and reschedule
+        everything (scheduler.go cacheWebhookPlugin + event fan-out).
+        A malformed config must not escape the watch handler — it would
+        break delivery to every later-registered watcher."""
+        name = obj["metadata"]["name"]
+        if event == "DELETED":
+            self.webhook_plugins.pop(name, None)
+        else:
+            try:
+                config = W.parse_webhook_config(obj)
+            except Exception:
+                self.metrics.counter(
+                    f"scheduler-{self.ftc.name}.webhook_config_errors"
+                )
+                return
+            if not any(
+                v in W.SUPPORTED_PAYLOAD_VERSIONS for v in config.payload_versions
+            ):
+                self.metrics.counter(
+                    f"scheduler-{self.ftc.name}.webhook_unsupported_payload"
+                )
+                self.webhook_plugins.pop(name, None)
+            else:
+                self.webhook_plugins[name] = W.WebhookPlugin(
+                    config, client=self._webhook_client
+                )
         self.worker.enqueue_all(self.host.keys(self._resource))
 
     # -- reconcile -------------------------------------------------------
@@ -206,10 +247,15 @@ class SchedulerController:
             "request": extract_pod_resource_request(C.template(fed_obj)),
             "policy": [policy.namespace, policy.name, policy.generation],
             # Unlike the reference (schedulingtriggers.go hashes only the
-            # policy), the profile generation is hashed too so profile
-            # edits reschedule bound objects instead of being swallowed by
-            # the dedupe gate.
+            # policy), the profile and webhook-config generations are
+            # hashed too so their edits reschedule bound objects instead
+            # of being swallowed by the dedupe gate.
             "profile": [profile.name, profile.generation] if profile else None,
+            # dict(...) snapshots against concurrent watch-thread mutation.
+            "webhooks": sorted(
+                (name, p.config.generation)
+                for name, p in dict(self.webhook_plugins).items()
+            ),
             "autoMigration": ann.get(C.AUTO_MIGRATION_INFO)
             if policy.auto_migration_enabled
             else None,
@@ -325,6 +371,7 @@ class SchedulerController:
             weights=weights,
             enabled_filters=enabled_filters,
             enabled_scores=enabled_scores,
+            enabled_selects=enabled_selects,
         )
 
     def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
@@ -391,12 +438,154 @@ class SchedulerController:
         if not to_schedule:
             return results
         with self.metrics.timer(f"scheduler-{self.ftc.name}.engine_latency"):
-            outcomes = self.engine.schedule(units, clusters)
+            webhook_eval = self._webhook_eval()
+            outcomes = self.engine.schedule(
+                units, clusters, webhook_eval=webhook_eval
+            )
+            outcomes = self._apply_webhook_selects(
+                units, clusters, outcomes, webhook_eval
+            )
         self.metrics.counter(f"scheduler-{self.ftc.name}.scheduled", len(units))
 
         for (key, fed_obj, policy, trigger), outcome in zip(to_schedule, outcomes):
             results[key] = self._persist(key, fed_obj, policy, trigger, outcome)
         return results
+
+    # -- webhook (out-of-process) plugins --------------------------------
+    def _webhook_eval(self):
+        """Host-side evaluator handed to the engine: AND of the unit's
+        enabled webhook filters, sum of its webhook scores, per cluster.
+        Any failing webhook call marks the cluster infeasible for this
+        tick (the batch-mode analogue of the reference failing the whole
+        per-object schedule and backing off).  Calls fan out over a
+        thread pool per cluster row, and results are memoized by object
+        key so the select-narrowing rerun reuses them."""
+        plugins = dict(self.webhook_plugins)  # watch-thread-safe snapshot
+        if not plugins:
+            return None
+        if self._webhook_pool is None:
+            self._webhook_pool = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="webhook-eval"
+            )
+        pool = self._webhook_pool
+        cache: dict[str, Optional[tuple]] = {}
+
+        def eval_cluster(su, cluster, filters, scorers):
+            score = np.int64(0)
+            try:
+                for plugin in filters:
+                    if not plugin.filter(su, cluster):
+                        return False, score
+                for plugin in scorers:
+                    score += plugin.score(su, cluster)
+            except Exception:
+                self.metrics.counter(f"scheduler-{self.ftc.name}.webhook_errors")
+                return False, np.int64(0)
+            return True, score
+
+        def evaluate(su: T.SchedulingUnit, clusters):
+            if su.key in cache:
+                return cache[su.key]
+            # Sticky short-circuit: plugins never run for a stickily
+            # placed object (generic_scheduler.go:103-107).
+            if su.sticky_cluster and su.current_clusters:
+                cache[su.key] = None
+                return None
+            filters = [
+                p
+                for name in (su.enabled_filters or ())
+                if (p := plugins.get(name)) is not None and p.has_filter
+            ]
+            scorers = [
+                p
+                for name in (su.enabled_scores or ())
+                if (p := plugins.get(name)) is not None and p.has_score
+            ]
+            if not filters and not scorers:
+                cache[su.key] = None
+                return None
+            rows = list(
+                pool.map(
+                    lambda cluster: eval_cluster(su, cluster, filters, scorers),
+                    clusters,
+                )
+            )
+            ok = np.array([r[0] for r in rows], bool)
+            scores = np.array([r[1] for r in rows], np.int64)
+            cache[su.key] = (ok, scores)
+            return ok, scores
+
+        return evaluate
+
+    def _apply_webhook_selects(
+        self, units, clusters, outcomes: list[ScheduleResult], webhook_eval=None
+    ) -> list[ScheduleResult]:
+        """Webhook select plugins narrow the tick's selected set; affected
+        Divide-mode units are re-planned over the narrowed set in one
+        follow-up batch (the sequential RunSelectClustersPlugin chain,
+        framework.go:183-209, with the planner re-run batched).  The
+        first pass's memoizing evaluator is reused so the rerun repeats
+        no webhook filter/score calls."""
+        plugins = dict(self.webhook_plugins)  # watch-thread-safe snapshot
+        if not plugins:
+            return outcomes
+        by_name = {c.name: c for c in clusters}
+        rerun_units, rerun_slots = [], []
+        for i, (su, outcome) in enumerate(zip(units, outcomes)):
+            if su.sticky_cluster and su.current_clusters:
+                # Plugins never run for a stickily placed object
+                # (generic_scheduler.go:103-107).
+                continue
+            selects = [
+                p
+                for name in (su.enabled_selects or ())
+                if (p := plugins.get(name)) is not None and p.has_select
+            ]
+            if not selects or not outcome.clusters:
+                continue
+            narrowed = set(outcome.clusters)
+            try:
+                for plugin in selects:
+                    cluster_scores = [
+                        (by_name[c], outcome.scores.get(c, 0))
+                        for c in sorted(narrowed)
+                        if c in by_name
+                    ]
+                    narrowed &= set(plugin.select(su, cluster_scores))
+            except Exception:
+                self.metrics.counter(f"scheduler-{self.ftc.name}.webhook_errors")
+                continue  # keep the un-narrowed outcome this tick
+            if narrowed == set(outcome.clusters):
+                continue
+            if not narrowed:
+                # An empty cluster_names means "no explicit placement" to
+                # the featurizer, so short-circuit instead of re-running.
+                outcomes = list(outcomes)
+                outcomes[i] = ScheduleResult(clusters={})
+                continue
+            rerun_units.append(
+                dataclasses.replace(
+                    su,
+                    cluster_names=frozenset(narrowed),
+                    enabled_filters=tuple(
+                        dict.fromkeys(
+                            (su.enabled_filters or ()) + (T.PLACEMENT_FILTER,)
+                        )
+                    ),
+                    enabled_selects=None,
+                    max_clusters=None,
+                )
+            )
+            rerun_slots.append(i)
+        if not rerun_units:
+            return outcomes
+        rerun_outcomes = self.engine.schedule(
+            rerun_units, clusters, webhook_eval=webhook_eval
+        )
+        outcomes = list(outcomes)
+        for slot, new_outcome in zip(rerun_slots, rerun_outcomes):
+            outcomes[slot] = new_outcome
+        return outcomes
 
     # -- persistence -----------------------------------------------------
     def _advance_pipeline(self, fed_obj: dict, modified: bool) -> Result:

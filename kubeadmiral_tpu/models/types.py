@@ -142,9 +142,11 @@ class SchedulingUnit:
     max_replicas: dict[str, int] = field(default_factory=dict)
     weights: dict[str, int] = field(default_factory=dict)
 
-    # Enabled plugin names per extension point (None = defaults).
+    # Enabled plugin names per extension point (None = defaults).  Names
+    # that aren't in-tree refer to registered webhook plugins.
     enabled_filters: Optional[tuple[str, ...]] = None
     enabled_scores: Optional[tuple[str, ...]] = None
+    enabled_selects: Optional[tuple[str, ...]] = None
 
     @property
     def key(self) -> str:

@@ -52,6 +52,9 @@ class TickInputs(NamedTuple):
     score_enabled: jax.Array   # bool[B,5] (ops.scores.S_* order)
     taint_counts: jax.Array    # i64[B,C]
     affinity_scores: jax.Array # i64[B,C]
+    # --- out-of-process (webhook) plugins, evaluated host-side ---
+    webhook_ok: jax.Array      # bool[B,C]; AND-ed into the filter result
+    webhook_scores: jax.Array  # i64[B,C]; added to the score totals
     # --- select stage ---
     max_clusters: jax.Array    # i32[B]; INT32_INF = unlimited, <0 = none
     # --- replicas stage ---
@@ -100,7 +103,7 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
         inp.placement_ok,
         inp.selector_ok,
     )
-    feasible = feasible & inp.cluster_valid[None, :]
+    feasible = feasible & inp.cluster_valid[None, :] & inp.webhook_ok
 
     # --- Score + Normalize ---
     totals = S.total_scores(
@@ -112,6 +115,10 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
         inp.taint_counts,
         inp.affinity_scores,
     )
+    # Webhook scores arrive pre-computed (one HTTP call per object x
+    # cluster happens host-side); like in-tree plugin sums they only
+    # matter on feasible clusters.
+    totals = totals + jnp.where(feasible, inp.webhook_scores, 0)
 
     # --- Select ---
     selected = select_topk(totals, feasible, inp.max_clusters)

@@ -44,6 +44,8 @@ _FIELD_SPECS: dict[str, tuple[Optional[str], ...]] = {
     "score_enabled": (OBJECTS, None),
     "taint_counts": (OBJECTS, CLUSTERS),
     "affinity_scores": (OBJECTS, CLUSTERS),
+    "webhook_ok": (OBJECTS, CLUSTERS),
+    "webhook_scores": (OBJECTS, CLUSTERS),
     "max_clusters": (OBJECTS,),
     "mode_divide": (OBJECTS,),
     "sticky": (OBJECTS,),

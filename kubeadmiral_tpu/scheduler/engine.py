@@ -13,7 +13,7 @@ device dispatches.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import numpy as np
@@ -29,9 +29,12 @@ DUPLICATE = None
 @dataclass
 class ScheduleResult:
     """Placement decision for one object: cluster -> replicas (None in
-    Duplicate mode), mirroring core.ScheduleResult.SuggestedClusters."""
+    Duplicate mode), mirroring core.ScheduleResult.SuggestedClusters.
+    ``scores`` carries the post-normalize totals of the selected clusters
+    (consumed by webhook select plugins)."""
 
     clusters: dict[str, Optional[int]]
+    scores: dict[str, int] = field(default_factory=dict)
 
     @property
     def cluster_set(self) -> set[str]:
@@ -65,6 +68,8 @@ def _pad_batch(inputs: TickInputs, b_pad: int) -> TickInputs:
         "score_enabled": False,
         "taint_counts": 0,
         "affinity_scores": 0,
+        "webhook_ok": True,
+        "webhook_scores": 0,
         "max_clusters": 0,
         "mode_divide": False,
         "sticky": False,
@@ -99,6 +104,8 @@ _CLUSTER_AXIS_FILL = {
     "placement_ok": False,
     "taint_counts": 0,
     "affinity_scores": 0,
+    "webhook_ok": True,
+    "webhook_scores": 0,
     "current_mask": False,
     "current_replicas": NIL_REPLICAS,
     "weights": 0,
@@ -210,6 +217,7 @@ class SchedulerEngine:
         units: Sequence[T.SchedulingUnit],
         clusters: Sequence[T.ClusterState],
         view: Optional[ClusterView] = None,
+        webhook_eval=None,
     ) -> list[ScheduleResult]:
         units = list(units)
         if not units:
@@ -219,7 +227,7 @@ class SchedulerEngine:
         results: list[ScheduleResult] = []
         for start in range(0, len(units), self.chunk_size):
             chunk = units[start : start + self.chunk_size]
-            fb = featurize(chunk, clusters, view=view)
+            fb = featurize(chunk, clusters, view=view, webhook_eval=webhook_eval)
             padded = _pad_batch(fb.inputs, self._bucket(len(chunk)))
             n_clusters = padded.cluster_valid.shape[0]
             padded = _pad_clusters(
@@ -229,15 +237,26 @@ class SchedulerEngine:
             selected = np.asarray(out.selected)[: len(chunk)]
             replicas = np.asarray(out.replicas)[: len(chunk)]
             counted = np.asarray(out.counted)[: len(chunk)]
+            totals = np.asarray(out.scores)[: len(chunk)]
             names = fb.view.names
             # Vectorized decode: one nonzero over the whole chunk.
             rows, cols = np.nonzero(selected)
             reps_sel = replicas[rows, cols]
             counted_sel = counted[rows, cols]
+            score_sel = totals[rows, cols]
             placed_lists: list[dict[str, Optional[int]]] = [dict() for _ in chunk]
-            for r, c, reps, has_count in zip(
-                rows.tolist(), cols.tolist(), reps_sel.tolist(), counted_sel.tolist()
+            score_lists: list[dict[str, int]] = [dict() for _ in chunk]
+            for r, c, reps, has_count, score in zip(
+                rows.tolist(),
+                cols.tolist(),
+                reps_sel.tolist(),
+                counted_sel.tolist(),
+                score_sel.tolist(),
             ):
                 placed_lists[r][names[c]] = reps if has_count else DUPLICATE
-            results.extend(ScheduleResult(clusters=p) for p in placed_lists)
+                score_lists[r][names[c]] = score
+            results.extend(
+                ScheduleResult(clusters=p, scores=s)
+                for p, s in zip(placed_lists, score_lists)
+            )
         return results

@@ -1,0 +1,121 @@
+"""ExtensionService: host a scheduler webhook plugin over HTTP.
+
+The serving side of the webhook protocol (reference precedent:
+examples/scheduler/webhook/main.go — a standalone HTTP server
+implementing filter/score/select against the JSON payload schema of
+pkg/apis/schedulerwebhook/v1alpha1).  Plug in Python callables:
+
+    service = ExtensionService(
+        filter_fn=lambda req: {"selected": ...},
+        score_fn=lambda req: {"score": ...},
+        select_fn=lambda req: {"selectedClusterNames": [...]},
+    )
+    port = service.start()
+
+Each callable receives the decoded request dict ({schedulingUnit,
+cluster} for filter/score, {schedulingUnit, clusterScores} for select)
+and returns the response dict; raising maps to the protocol's ``error``
+field.  This is also how a TPU-backed scoring sidecar is exposed to a
+non-TPU control plane: run the engine inside ``score_fn``/``select_fn``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+Handler = Callable[[dict], dict]
+
+
+class ExtensionService:
+    FILTER_PATH = "/filter"
+    SCORE_PATH = "/score"
+    SELECT_PATH = "/select"
+
+    def __init__(
+        self,
+        filter_fn: Optional[Handler] = None,
+        score_fn: Optional[Handler] = None,
+        select_fn: Optional[Handler] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.handlers: dict[str, Handler] = {}
+        if filter_fn:
+            self.handlers[self.FILTER_PATH] = filter_fn
+        if score_fn:
+            self.handlers[self.SCORE_PATH] = score_fn
+        if select_fn:
+            self.handlers[self.SELECT_PATH] = select_fn
+        self._host = host
+        self._port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url_prefix(self) -> str:
+        assert self._server is not None, "service not started"
+        return f"http://{self._host}:{self._server.server_address[1]}"
+
+    def start(self) -> int:
+        handlers = self.handlers
+
+        class RequestHandler(BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802 (http.server API)
+                handler = handlers.get(self.path)
+                if handler is None:
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    request = json.loads(self.rfile.read(length) or b"{}")
+                    response = handler(request)
+                except Exception as e:  # -> protocol error field
+                    response = {"error": str(e)}
+                body = json.dumps(response).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer((self._host, self._port), RequestHandler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="extension-service", daemon=True
+        )
+        self._thread.start()
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def webhook_configuration(self, name: str) -> dict:
+        """The SchedulerPluginWebhookConfiguration object registering this
+        service (for tests and local setups)."""
+        spec: dict = {
+            "urlPrefix": self.url_prefix,
+            "payloadVersions": ["v1alpha1"],
+        }
+        if self.FILTER_PATH in self.handlers:
+            spec["filterPath"] = self.FILTER_PATH
+        if self.SCORE_PATH in self.handlers:
+            spec["scorePath"] = self.SCORE_PATH
+        if self.SELECT_PATH in self.handlers:
+            spec["selectPath"] = self.SELECT_PATH
+        return {
+            "apiVersion": "core.kubeadmiral.io/v1alpha1",
+            "kind": "SchedulerPluginWebhookConfiguration",
+            "metadata": {"name": name},
+            "spec": spec,
+        }

@@ -202,13 +202,27 @@ def featurize(
     units: Sequence[T.SchedulingUnit],
     clusters: Sequence[T.ClusterState],
     view: Optional[ClusterView] = None,
+    webhook_eval=None,
 ) -> FeaturizedBatch:
-    """Pack a batch of scheduling units against the member clusters."""
+    """Pack a batch of scheduling units against the member clusters.
+
+    ``webhook_eval(unit, clusters) -> (ok_row, score_row) | None`` is the
+    host-side hook for out-of-process scheduler plugins (reference:
+    scheduler/extensions/webhook): their per-(object, cluster) HTTP
+    results enter the fused tick as an extra mask and score plane."""
     units = list(units)
     if view is None:
         view = _build_cluster_view(clusters, units)
     b, c = len(units), len(view.clusters)
     r = view.alloc.shape[1]
+
+    webhook_ok = np.ones((b, c), bool)
+    webhook_scores = np.zeros((b, c), np.int64)
+    if webhook_eval is not None:
+        for i, su in enumerate(units):
+            result = webhook_eval(su, view.clusters)
+            if result is not None:
+                webhook_ok[i], webhook_scores[i] = result
 
     # --- plugin enablement ---
     filter_enabled = np.zeros((b, OF.NUM_FILTER_PLUGINS), bool)
@@ -385,6 +399,8 @@ def featurize(
         score_enabled=score_enabled,
         taint_counts=taint_counts,
         affinity_scores=affinity_scores,
+        webhook_ok=webhook_ok,
+        webhook_scores=webhook_scores,
         max_clusters=np.array(
             [INT32_INF if su.max_clusters is None else su.max_clusters for su in units],
             np.int32,

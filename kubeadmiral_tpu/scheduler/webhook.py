@@ -1,0 +1,276 @@
+"""Out-of-process scheduler plugins over HTTP (webhook plugins).
+
+A SchedulerPluginWebhookConfiguration registers an external service that
+participates in scheduling at the filter/score/select extension points
+(reference: pkg/apis/core/v1alpha1/types_schedulerpluginwebhookconfiguration.go,
+payload schema pkg/apis/schedulerwebhook/v1alpha1/types.go:29-102, HTTP
+adapter pkg/controllers/scheduler/extensions/webhook/v1alpha1/plugin.go).
+
+Request/response wire format (one POST per call, JSON both ways):
+
+* filter: {schedulingUnit, cluster} -> {selected, error}
+* score:  {schedulingUnit, cluster} -> {score, error}
+* select: {schedulingUnit, clusterScores: [{cluster, score}]}
+          -> {selectedClusterNames, error}
+
+In the batch engine, filter/score results are evaluated host-side (they
+are network calls) and enter the fused XLA tick as an extra mask / score
+plane; select plugins narrow the tick's output afterwards.
+
+``HTTPClient`` is injectable so tests run against a fake transport, as
+the reference's plugin tests do (plugin.go:42-44).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional, Protocol
+
+from kubeadmiral_tpu.models import types as T
+
+SCHEDULER_WEBHOOK_CONFIGS = (
+    "core.kubeadmiral.io/v1alpha1/schedulerpluginwebhookconfigurations"
+)
+
+PAYLOAD_VERSION = "v1alpha1"
+SUPPORTED_PAYLOAD_VERSIONS = frozenset({PAYLOAD_VERSION})
+
+DEFAULT_TIMEOUT_SECONDS = 5.0
+
+
+class WebhookError(Exception):
+    pass
+
+
+class HTTPClient(Protocol):
+    def post(self, url: str, body: bytes, timeout: float) -> bytes: ...
+
+
+class UrllibClient:
+    """Default transport: stdlib urllib with the reference's headers."""
+
+    def post(self, url: str, body: bytes, timeout: float) -> bytes:
+        req = urllib.request.Request(
+            url,
+            data=body,
+            method="POST",
+            headers={
+                "Content-Type": "application/json",
+                "Accept": "application/json",
+                "User-Agent": "kubeadmiral-tpu-scheduler",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            if resp.status != 200:
+                raise WebhookError(f"unexpected status code: {resp.status}")
+            return resp.read()
+
+
+# -- payload conversion (adapter.go ConvertSchedulingUnit) ---------------
+
+def scheduling_unit_payload(su: T.SchedulingUnit) -> dict:
+    parts = su.gvk.split("/")  # "group/version/Kind" ("" group collapsed)
+    if len(parts) == 3:
+        group, version, kind = parts
+    else:
+        group, (version, kind) = "", parts
+    payload: dict = {
+        "apiVersion": f"{group}/{version}" if group else version,
+        "kind": kind,
+        "resource": kind.lower() + "s",
+        "name": su.name,
+        "schedulingMode": su.scheduling_mode,
+        "currentClusters": sorted(su.current_clusters),
+    }
+    if su.namespace:
+        payload["namespace"] = su.namespace
+    if su.labels:
+        payload["labels"] = dict(su.labels)
+    if su.annotations:
+        payload["annotations"] = dict(su.annotations)
+    if su.desired_replicas is not None:
+        payload["desiredReplicas"] = int(su.desired_replicas)
+    if su.resource_request:
+        payload["resourceRequest"] = {
+            name: str(q) for name, q in sorted(su.resource_request.items())
+        }
+    distribution = {
+        c: int(r) for c, r in su.current_clusters.items() if r is not None
+    }
+    if distribution:
+        payload["currentReplicaDistribution"] = distribution
+    if su.cluster_selector:
+        payload["clusterSelector"] = dict(su.cluster_selector)
+    if su.tolerations:
+        payload["tolerations"] = [
+            {
+                k: v
+                for k, v in (
+                    ("key", t.key),
+                    ("operator", t.operator),
+                    ("value", t.value),
+                    ("effect", t.effect),
+                )
+                if v
+            }
+            for t in su.tolerations
+        ]
+    if su.max_clusters is not None:
+        payload["maxClusters"] = int(su.max_clusters)
+    return payload
+
+
+def cluster_payload(cluster: T.ClusterState) -> dict:
+    """ClusterState -> FederatedCluster-shaped JSON."""
+    return {
+        "metadata": {"name": cluster.name, "labels": dict(cluster.labels)},
+        "spec": {
+            "taints": [
+                {"key": t.key, "value": t.value, "effect": t.effect}
+                for t in cluster.taints
+            ]
+        },
+        "status": {
+            "resources": {
+                "allocatable": {
+                    name: str(q) for name, q in sorted(cluster.allocatable.items())
+                },
+                "available": {
+                    name: str(q) for name, q in sorted(cluster.available.items())
+                },
+            },
+            "apiResourceTypes": sorted(cluster.api_resources),
+        },
+    }
+
+
+@dataclass(frozen=True)
+class WebhookConfig:
+    """Parsed SchedulerPluginWebhookConfiguration."""
+
+    name: str
+    url_prefix: str
+    filter_path: str = ""
+    score_path: str = ""
+    select_path: str = ""
+    payload_versions: tuple[str, ...] = (PAYLOAD_VERSION,)
+    timeout: float = DEFAULT_TIMEOUT_SECONDS
+    generation: int = 1
+
+
+_DURATION_UNITS = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
+
+
+def parse_duration(raw) -> Optional[float]:
+    """metav1.Duration-style string ("5s", "500ms", "1m30s") or bare
+    number -> seconds; None when absent or unparseable."""
+    if raw is None:
+        return None
+    if isinstance(raw, (int, float)):
+        return float(raw)
+    total, number = 0.0, ""
+    i, s = 0, str(raw).strip()
+    try:
+        while i < len(s):
+            ch = s[i]
+            if ch.isdigit() or ch in ".+-":
+                number += ch
+                i += 1
+                continue
+            unit = ch
+            if s[i : i + 2] in _DURATION_UNITS:
+                unit = s[i : i + 2]
+            if unit not in _DURATION_UNITS:
+                return None
+            total += float(number) * _DURATION_UNITS[unit]
+            number = ""
+            i += len(unit)
+        if number:  # bare trailing number
+            total += float(number)
+        return total
+    except ValueError:
+        return None
+
+
+def parse_webhook_config(obj: dict) -> WebhookConfig:
+    spec = obj.get("spec", {})
+    timeout = parse_duration(spec.get("httpTimeout"))
+    return WebhookConfig(
+        name=obj["metadata"]["name"],
+        url_prefix=spec.get("urlPrefix", ""),
+        filter_path=spec.get("filterPath", ""),
+        score_path=spec.get("scorePath", ""),
+        select_path=spec.get("selectPath", ""),
+        payload_versions=tuple(spec.get("payloadVersions", (PAYLOAD_VERSION,))),
+        timeout=timeout if timeout else DEFAULT_TIMEOUT_SECONDS,
+        generation=obj["metadata"].get("generation", 1),
+    )
+
+
+class WebhookPlugin:
+    """One registered webhook, callable at its configured extension
+    points (plugin.go:46-251)."""
+
+    def __init__(self, config: WebhookConfig, client: Optional[HTTPClient] = None):
+        self.config = config
+        self.name = config.name
+        self.client = client or UrllibClient()
+
+    @property
+    def has_filter(self) -> bool:
+        return bool(self.config.filter_path)
+
+    @property
+    def has_score(self) -> bool:
+        return bool(self.config.score_path)
+
+    @property
+    def has_select(self) -> bool:
+        return bool(self.config.select_path)
+
+    def _call(self, path: str, body: dict) -> dict:
+        url = self.config.url_prefix.rstrip("/") + "/" + path.lstrip("/")
+        raw = self.client.post(
+            url, json.dumps(body).encode(), timeout=self.config.timeout
+        )
+        response = json.loads(raw)
+        if response.get("error"):
+            raise WebhookError(response["error"])
+        return response
+
+    def filter(self, su: T.SchedulingUnit, cluster: T.ClusterState) -> bool:
+        response = self._call(
+            self.config.filter_path,
+            {
+                "schedulingUnit": scheduling_unit_payload(su),
+                "cluster": cluster_payload(cluster),
+            },
+        )
+        return bool(response.get("selected"))
+
+    def score(self, su: T.SchedulingUnit, cluster: T.ClusterState) -> int:
+        response = self._call(
+            self.config.score_path,
+            {
+                "schedulingUnit": scheduling_unit_payload(su),
+                "cluster": cluster_payload(cluster),
+            },
+        )
+        return int(response.get("score", 0))
+
+    def select(
+        self, su: T.SchedulingUnit, cluster_scores: list[tuple[T.ClusterState, int]]
+    ) -> list[str]:
+        response = self._call(
+            self.config.select_path,
+            {
+                "schedulingUnit": scheduling_unit_payload(su),
+                "clusterScores": [
+                    {"cluster": cluster_payload(c), "score": int(s)}
+                    for c, s in cluster_scores
+                ],
+            },
+        )
+        return list(response.get("selectedClusterNames", ()))

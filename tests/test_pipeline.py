@@ -116,6 +116,8 @@ def to_tick_inputs(problems, c):
         score_enabled=np.array([p.score_enabled for p in problems]),
         taint_counts=grid(lambda p: p.taint_counts, np.int64),
         affinity_scores=grid(lambda p: p.affinity_scores, np.int64),
+        webhook_ok=np.ones((len(problems), c), bool),
+        webhook_scores=np.zeros((len(problems), c), np.int64),
         max_clusters=np.array(
             [INF if p.max_clusters is None else p.max_clusters for p in problems],
             np.int32,

@@ -1,0 +1,256 @@
+"""Webhook scheduler plugins (reference:
+pkg/controllers/scheduler/extensions/webhook/v1alpha1/plugin_test.go's
+fake-HTTP pattern + examples/scheduler/webhook)."""
+
+import dataclasses
+import json
+
+import pytest
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    NODES,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.models import profile as PR
+from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.scheduler import webhook as W
+from kubeadmiral_tpu.scheduler.extension_service import ExtensionService
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+from test_e2e_slice import make_deployment, make_node, settle
+
+
+class FakeClient:
+    """Records requests; replies from a canned url-suffix -> dict map."""
+
+    def __init__(self, responses):
+        self.responses = responses
+        self.requests = []
+
+    def post(self, url, body, timeout):
+        self.requests.append((url, json.loads(body)))
+        for suffix, response in self.responses.items():
+            if url.endswith(suffix):
+                return json.dumps(response).encode()
+        raise AssertionError(f"unexpected url {url}")
+
+
+def make_unit(**kw):
+    defaults = dict(
+        gvk="apps/v1/Deployment",
+        namespace="default",
+        name="web",
+        scheduling_mode=T.MODE_DUPLICATE,
+    )
+    defaults.update(kw)
+    return T.SchedulingUnit(**defaults)
+
+
+def make_cluster(name, labels=None):
+    return T.ClusterState(
+        name=name,
+        labels=dict(labels or {}),
+        allocatable={"cpu": 64000, "memory": 1 << 36},
+        available={"cpu": 32000, "memory": 1 << 35},
+        api_resources=frozenset({"apps/v1/Deployment"}),
+    )
+
+
+class TestParseDuration:
+    def test_formats(self):
+        assert W.parse_duration("5s") == 5.0
+        assert W.parse_duration("500ms") == 0.5
+        assert W.parse_duration("1m30s") == 90.0
+        assert W.parse_duration(2) == 2.0
+        assert W.parse_duration(None) is None
+        assert W.parse_duration("bogus") is None
+
+
+class TestWebhookPlugin:
+    def make_plugin(self, responses):
+        config = W.WebhookConfig(
+            name="wh",
+            url_prefix="http://webhook.example",
+            filter_path="/filter",
+            score_path="/score",
+            select_path="/select",
+        )
+        client = FakeClient(responses)
+        return W.WebhookPlugin(config, client=client), client
+
+    def test_filter_payload_and_response(self):
+        plugin, client = self.make_plugin({"/filter": {"selected": True}})
+        su = make_unit(desired_replicas=5, scheduling_mode=T.MODE_DIVIDE)
+        assert plugin.filter(su, make_cluster("c1", {"region": "eu"}))
+        url, body = client.requests[0]
+        assert url == "http://webhook.example/filter"
+        assert body["schedulingUnit"]["name"] == "web"
+        assert body["schedulingUnit"]["schedulingMode"] == "Divide"
+        assert body["schedulingUnit"]["desiredReplicas"] == 5
+        assert body["cluster"]["metadata"]["name"] == "c1"
+        assert body["cluster"]["metadata"]["labels"] == {"region": "eu"}
+
+    def test_score_and_select(self):
+        plugin, client = self.make_plugin(
+            {
+                "/score": {"score": 42},
+                "/select": {"selectedClusterNames": ["c2"]},
+            }
+        )
+        su = make_unit()
+        assert plugin.score(su, make_cluster("c1")) == 42
+        selected = plugin.select(su, [(make_cluster("c1"), 10), (make_cluster("c2"), 20)])
+        assert selected == ["c2"]
+        _, select_body = client.requests[1]
+        assert [cs["score"] for cs in select_body["clusterScores"]] == [10, 20]
+
+    def test_error_field_raises(self):
+        plugin, _ = self.make_plugin({"/filter": {"selected": False, "error": "boom"}})
+        with pytest.raises(W.WebhookError):
+            plugin.filter(make_unit(), make_cluster("c1"))
+
+
+class TestWebhookScheduling:
+    """Webhook plugins wired through profile -> controller -> engine."""
+
+    def setup_method(self):
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        self.ftc = dataclasses.replace(
+            ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
+        )
+        self.fleet = ClusterFleet()
+        self.clusterctl = FederatedClusterController(
+            self.fleet, api_resource_probe=["apps/v1/Deployment"]
+        )
+        self.federate = FederateController(self.fleet.host, self.ftc)
+        for name, region in (("c1", "us"), ("c2", "eu"), ("c3", "eu")):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", "64", "128Gi"))
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name, "labels": {"region": region}},
+                    "spec": {},
+                },
+            )
+
+    def create_profile_and_policy(self, webhook_name, points=("filter",)):
+        plugins = {}
+        for point in points:
+            plugins[point] = {
+                "enabled": [{"type": "Webhook", "name": webhook_name}]
+            }
+        self.fleet.host.create(
+            PR.SCHEDULING_PROFILES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulingProfile",
+                "metadata": {"name": "with-webhook"},
+                "spec": {"plugins": plugins},
+            },
+        )
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {
+                    "schedulingMode": "Duplicate",
+                    "schedulingProfile": "with-webhook",
+                },
+            },
+        )
+
+    def placement(self):
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        return C.get_placement(fed, C.SCHEDULER)
+
+    def test_fake_client_filter_narrows_placement(self):
+        responses = {"/filter": None}  # replaced per request below
+
+        class RegionFilter(FakeClient):
+            def post(self, url, body, timeout):
+                self.requests.append((url, json.loads(body)))
+                req = json.loads(body)
+                selected = (
+                    req["cluster"]["metadata"]["labels"].get("region") == "eu"
+                )
+                return json.dumps({"selected": selected}).encode()
+
+        client = RegionFilter(responses)
+        scheduler = SchedulerController(
+            self.fleet.host, self.ftc, webhook_client=client
+        )
+        self.fleet.host.create(
+            W.SCHEDULER_WEBHOOK_CONFIGS,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulerPluginWebhookConfiguration",
+                "metadata": {"name": "eu-only"},
+                "spec": {
+                    "urlPrefix": "http://webhook.example",
+                    "filterPath": "/filter",
+                    "payloadVersions": ["v1alpha1"],
+                },
+            },
+        )
+        self.create_profile_and_policy("eu-only")
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(self.clusterctl, self.federate, scheduler)
+        assert self.placement() == {"c2", "c3"}
+        assert client.requests  # the webhook was actually consulted
+
+    def test_live_extension_service_end_to_end(self):
+        """Real HTTP round trip: ExtensionService serving filter+select."""
+        service = ExtensionService(
+            filter_fn=lambda req: {
+                "selected": req["cluster"]["metadata"]["labels"].get("region")
+                == "eu"
+            },
+            select_fn=lambda req: {
+                "selectedClusterNames": sorted(
+                    cs["cluster"]["metadata"]["name"]
+                    for cs in req["clusterScores"]
+                )[:1]
+            },
+        )
+        service.start()
+        try:
+            scheduler = SchedulerController(self.fleet.host, self.ftc)
+            self.fleet.host.create(
+                W.SCHEDULER_WEBHOOK_CONFIGS,
+                service.webhook_configuration("eu-picker"),
+            )
+            self.create_profile_and_policy("eu-picker", points=("filter", "select"))
+            self.fleet.host.create(self.ftc.source.resource, make_deployment())
+            settle(self.clusterctl, self.federate, scheduler)
+            # filter keeps {c2,c3}; select narrows to the first by name.
+            assert self.placement() == {"c2"}
+        finally:
+            service.stop()
+
+    def test_unsupported_payload_version_is_not_registered(self):
+        scheduler = SchedulerController(self.fleet.host, self.ftc)
+        self.fleet.host.create(
+            W.SCHEDULER_WEBHOOK_CONFIGS,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulerPluginWebhookConfiguration",
+                "metadata": {"name": "future"},
+                "spec": {
+                    "urlPrefix": "http://webhook.example",
+                    "filterPath": "/filter",
+                    "payloadVersions": ["v99"],
+                },
+            },
+        )
+        assert "future" not in scheduler.webhook_plugins
