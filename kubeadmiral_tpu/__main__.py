@@ -1,0 +1,116 @@
+"""CLI entry: ``python -m kubeadmiral_tpu``.
+
+Mirrors the reference controller-manager's flag surface (reference:
+cmd/controller-manager/main.go:32-46,
+cmd/controller-manager/app/options/options.go:34-130) over the in-memory
+control plane: build a fleet, install the default FederatedTypeConfigs,
+start the controller manager behind leader election, and serve
+/livez + /readyz.  This is the ``make dev-up`` analogue — a
+self-contained control plane for local exploration; a real-apiserver
+transport drops in behind the same ClusterFleet interface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeadmiral-tpu-controller-manager",
+        description="TPU-native KubeAdmiral controller manager",
+    )
+    parser.add_argument(
+        "--port", type=int, default=11257,
+        help="health probe port (0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--controllers", default="*",
+        help="comma list of always-on controllers; '-name' disables, '*' = defaults",
+    )
+    parser.add_argument(
+        "--worker-count", type=int, default=1,
+        help="reconcile worker threads per controller",
+    )
+    parser.add_argument("--leader-elect", action="store_true", default=True)
+    parser.add_argument("--no-leader-elect", dest="leader_elect", action="store_false")
+    parser.add_argument(
+        "--cluster-join-timeout", type=float, default=600.0,
+        help="seconds before an unjoinable cluster is marked timed out",
+    )
+    parser.add_argument(
+        "--nsautoprop-exclude-regexp", default="",
+        help="namespaces matching this regexp are not auto-propagated",
+    )
+    parser.add_argument(
+        "--create-crds-for-ftcs", action="store_true",
+        help="install the default FederatedTypeConfig set at startup",
+    )
+    parser.add_argument(
+        "--members", type=int, default=3,
+        help="number of in-memory member clusters to create (demo mode)",
+    )
+    parser.add_argument("--run-seconds", type=float, default=0.0,
+        help="exit after this many seconds (0 = run forever)")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from kubeadmiral_tpu.models.ftc import FEDERATED_TYPE_CONFIGS, default_ftcs, ftc_to_object
+    from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
+    from kubeadmiral_tpu.runtime.leaderelection import LeaderElector
+    from kubeadmiral_tpu.runtime.manager import ControllerManager
+    from kubeadmiral_tpu.testing.fakekube import AlreadyExists, ClusterFleet
+
+    fleet = ClusterFleet()
+    for i in range(args.members):
+        fleet.add_member(f"member-{i + 1}")
+
+    health = HealthCheckRegistry()
+    server = HealthServer(health, port=args.port)
+    port = server.start()
+    print(f"health endpoints on :{port} (/livez, /readyz)")
+
+    elector = LeaderElector(fleet.host, identity=f"manager-{os.getpid()}")
+    if args.leader_elect:
+        while not elector.try_acquire_or_renew():
+            time.sleep(1.0)
+        print(f"leader election won as {elector.identity}")
+
+    manager = ControllerManager(
+        fleet,
+        enabled=[c for c in args.controllers.split(",") if c],
+        health=health,
+        cluster_controller_kwargs={"join_timeout": args.cluster_join_timeout},
+    )
+    if args.create_crds_for_ftcs:
+        for ftc in default_ftcs():
+            try:
+                fleet.host.create(FEDERATED_TYPE_CONFIGS, ftc_to_object(ftc))
+            except AlreadyExists:
+                pass
+        print(f"installed {len(default_ftcs())} FederatedTypeConfigs")
+
+    manager.run(args.worker_count)
+    print("controller manager running; Ctrl-C to stop")
+    deadline = time.monotonic() + args.run_seconds if args.run_seconds else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            if args.leader_elect and not elector.try_acquire_or_renew():
+                print("lost leader election; exiting")  # fatal, as in the reference
+                return 1
+            time.sleep(min(elector.lease_seconds / 3, 5.0))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        manager.stop()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

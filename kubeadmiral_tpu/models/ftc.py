@@ -55,6 +55,13 @@ class PathDefinition:
     label_selector: str = ""
 
 
+# Default controller pipeline when an FTC doesn't specify one.
+DEFAULT_PIPELINE: tuple[tuple[str, ...], ...] = (
+    ("kubeadmiral.io/global-scheduler",),
+    ("kubeadmiral.io/overridepolicy-controller",),
+)
+
+
 @dataclass(frozen=True)
 class FederatedTypeConfig:
     name: str
@@ -63,10 +70,7 @@ class FederatedTypeConfig:
     status: Optional[TypeRef] = None
     path: PathDefinition = PathDefinition()
     # Ordered controller pipeline groups (spec.controllers).
-    controllers: tuple[tuple[str, ...], ...] = (
-        ("kubeadmiral.io/global-scheduler",),
-        ("kubeadmiral.io/overridepolicy-controller",),
-    )
+    controllers: tuple[tuple[str, ...], ...] = DEFAULT_PIPELINE
     status_collection: bool = False
     # Dotted paths collected from member objects into the status CR
     # (types_federatedtypeconfig.go StatusCollection.Fields).
@@ -80,6 +84,129 @@ class FederatedTypeConfig:
     @property
     def controller_groups(self) -> list[list[str]]:
         return [list(g) for g in self.controllers]
+
+
+FEDERATED_TYPE_CONFIGS = "core.kubeadmiral.io/v1alpha1/federatedtypeconfigs"
+
+
+def _parse_type_ref(raw: dict) -> TypeRef:
+    return TypeRef(
+        group=raw.get("group", ""),
+        version=raw.get("version", ""),
+        kind=raw.get("kind", ""),
+        plural=raw.get("pluralName", raw.get("plural", "")),
+    )
+
+
+def _type_ref_to_raw(ref: TypeRef) -> dict:
+    raw = {"version": ref.version, "kind": ref.kind, "pluralName": ref.plural}
+    if ref.group:
+        raw["group"] = ref.group
+    return raw
+
+
+def parse_ftc(obj: dict) -> FederatedTypeConfig:
+    """Unstructured FederatedTypeConfig -> typed registry entry
+    (types_federatedtypeconfig.go:63-182).  This is what makes the type
+    registry CRD-driven: the manager watches these objects and starts the
+    per-type controllers from them."""
+    spec = obj.get("spec", {})
+    source_raw = spec.get("sourceType") or {}
+    source = _parse_type_ref(source_raw)
+    federated = (
+        _parse_type_ref(spec["federatedType"])
+        if spec.get("federatedType")
+        else federated_ref(source)
+    )
+    status = _parse_type_ref(spec["statusType"]) if spec.get("statusType") else None
+    path_raw = spec.get("pathDefinition") or {}
+
+    # Absent controllers -> default pipeline; an explicit [] stays empty
+    # ("no pipeline controllers" is expressible, e.g. sync-only types).
+    if "controllers" in spec and spec["controllers"] is not None:
+        controllers = tuple(
+            tuple(group) for group in spec["controllers"] if group
+        )
+    else:
+        controllers = DEFAULT_PIPELINE
+
+    def feature(raw) -> tuple[bool, dict]:
+        """Normalize a toggle that may be bool, "Enabled", null or an
+        object with an ``enabled`` field."""
+        if isinstance(raw, dict):
+            return bool(raw.get("enabled", False)), raw
+        return raw in ("Enabled", True), {}
+
+    status_collection, sc_raw = feature(spec.get("statusCollection"))
+    auto_migration, _ = feature(spec.get("autoMigration"))
+
+    return FederatedTypeConfig(
+        name=obj["metadata"]["name"],
+        source=source,
+        federated=federated,
+        status=status,
+        path=PathDefinition(
+            replicas_spec=path_raw.get("replicasSpec", ""),
+            replicas_status=path_raw.get("replicasStatus", ""),
+            available_replicas_status=path_raw.get("availableReplicasStatus", ""),
+            ready_replicas_status=path_raw.get("readyReplicasStatus", ""),
+            label_selector=path_raw.get("labelSelector", ""),
+        ),
+        controllers=controllers,
+        status_collection=status_collection,
+        status_collection_fields=tuple(sc_raw.get("fields") or ("status",)),
+        status_aggregation=spec.get("statusAggregation", "") in ("Enabled", True),
+        revision_history=spec.get("revisionHistory", "") in ("Enabled", True),
+        rollout_plan=spec.get("rolloutPlan", "") in ("Enabled", True),
+        auto_migration=auto_migration,
+        namespaced=source_raw.get("scope", "Namespaced") != "Cluster",
+    )
+
+
+def ftc_to_object(ftc: FederatedTypeConfig) -> dict:
+    """Typed registry entry -> unstructured FederatedTypeConfig object."""
+    spec: dict = {
+        "sourceType": {
+            **_type_ref_to_raw(ftc.source),
+            "scope": "Namespaced" if ftc.namespaced else "Cluster",
+        },
+        "federatedType": _type_ref_to_raw(ftc.federated),
+        "controllers": [list(g) for g in ftc.controllers],
+    }
+    if ftc.status is not None:
+        spec["statusType"] = _type_ref_to_raw(ftc.status)
+    path = {
+        k: v
+        for k, v in (
+            ("replicasSpec", ftc.path.replicas_spec),
+            ("replicasStatus", ftc.path.replicas_status),
+            ("availableReplicasStatus", ftc.path.available_replicas_status),
+            ("readyReplicasStatus", ftc.path.ready_replicas_status),
+            ("labelSelector", ftc.path.label_selector),
+        )
+        if v
+    }
+    if path:
+        spec["pathDefinition"] = path
+    if ftc.status_collection:
+        spec["statusCollection"] = {
+            "enabled": True,
+            "fields": list(ftc.status_collection_fields),
+        }
+    if ftc.status_aggregation:
+        spec["statusAggregation"] = "Enabled"
+    if ftc.revision_history:
+        spec["revisionHistory"] = "Enabled"
+    if ftc.rollout_plan:
+        spec["rolloutPlan"] = "Enabled"
+    if ftc.auto_migration:
+        spec["autoMigration"] = {"enabled": True}
+    return {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedTypeConfig",
+        "metadata": {"name": ftc.name},
+        "spec": spec,
+    }
 
 
 def federated_ref(source: TypeRef) -> TypeRef:

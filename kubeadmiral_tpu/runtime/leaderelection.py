@@ -1,0 +1,94 @@
+"""Leader election over a lease object in the host store.
+
+The reference elects one controller-manager replica through a
+resourcelock lease in the federation system namespace; losing the lease
+is fatal to the process (reference:
+pkg/controllermanager/leaderelection/leaderelection.go).  Here the lock
+is a plain object in the host store updated under optimistic
+concurrency: acquire when absent or expired, renew while held, and
+report loss when another identity overwrites an expired lease.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from kubeadmiral_tpu.testing.fakekube import AlreadyExists, Conflict, FakeKube, NotFound
+
+LEASES = "coordination.k8s.io/v1/leases"
+
+DEFAULT_LEASE_SECONDS = 15.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        host: FakeKube,
+        identity: str,
+        name: str = "kubeadmiral-controller-manager",
+        namespace: str = "kube-admiral-system",
+        lease_seconds: float = DEFAULT_LEASE_SECONDS,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.host = host
+        self.identity = identity
+        self.name = name
+        self.namespace = namespace
+        self.lease_seconds = lease_seconds
+        self.on_stopped_leading = on_stopped_leading
+        self.clock = clock
+        self.is_leader = False
+
+    @property
+    def _key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def _lease_expired(self, lease: dict) -> bool:
+        renewed = float(lease.get("spec", {}).get("renewTime", 0.0))
+        duration = float(
+            lease.get("spec", {}).get("leaseDurationSeconds", self.lease_seconds)
+        )
+        return self.clock() - renewed > duration
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; call periodically (≲ lease_seconds/3).
+        Returns True while this identity holds the lease."""
+        now = self.clock()
+        desired_spec = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": self.lease_seconds,
+            "renewTime": now,
+        }
+        lease = self.host.try_get(LEASES, self._key)
+        try:
+            if lease is None:
+                self.host.create(
+                    LEASES,
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {"name": self.name, "namespace": self.namespace},
+                        "spec": desired_spec,
+                    },
+                )
+                self._became(True)
+                return True
+            holder = lease.get("spec", {}).get("holderIdentity")
+            if holder != self.identity and not self._lease_expired(lease):
+                self._became(False)
+                return False
+            lease["spec"] = desired_spec
+            self.host.update(LEASES, lease)
+            self._became(True)
+            return True
+        except (Conflict, AlreadyExists, NotFound):
+            # Someone else won the race this round.
+            self._became(False)
+            return False
+
+    def _became(self, leading: bool) -> None:
+        if self.is_leader and not leading and self.on_stopped_leading is not None:
+            self.on_stopped_leading()
+        self.is_leader = leading

@@ -1,0 +1,184 @@
+"""Controller-manager runtime: registry, FTC lifecycle, health, leader
+election (reference: cmd/controller-manager/app +
+pkg/controllermanager)."""
+
+import json
+import urllib.request
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import FEDERATED_CLUSTERS, NODES
+from kubeadmiral_tpu.models.ftc import (
+    FEDERATED_TYPE_CONFIGS,
+    default_ftcs,
+    ftc_to_object,
+    parse_ftc,
+)
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
+from kubeadmiral_tpu.runtime.leaderelection import LeaderElector
+from kubeadmiral_tpu.runtime.manager import ControllerManager
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+from test_e2e_slice import make_deployment, make_node
+
+
+class TestFTCRoundTrip:
+    def test_parse_inverts_serialize(self):
+        for ftc in default_ftcs():
+            assert parse_ftc(ftc_to_object(ftc)) == ftc
+
+    def test_explicit_empty_controllers_preserved(self):
+        obj = deployment_ftc_object()
+        obj["spec"]["controllers"] = []
+        assert parse_ftc(obj).controllers == ()
+
+    def test_explicit_nulls_tolerated(self):
+        obj = deployment_ftc_object()
+        obj["spec"]["controllers"] = None
+        obj["spec"]["statusCollection"] = {"enabled": True, "fields": None}
+        obj["spec"]["autoMigration"] = None
+        ftc = parse_ftc(obj)
+        assert ftc.controllers  # default pipeline
+        assert ftc.status_collection
+        assert ftc.status_collection_fields == ("status",)
+        assert not ftc.auto_migration
+
+
+class TestHealthCheck:
+    def test_registry_and_server(self):
+        registry = HealthCheckRegistry()
+        registry.add_readiness("a", lambda: True)
+        registry.add_readiness("b", lambda: False)
+        assert registry.readyz() == {"a": True, "b": False}
+
+        server = HealthServer(registry)
+        port = server.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/livez") as resp:
+                assert resp.status == 200
+            try:
+                urllib.request.urlopen(f"http://127.0.0.1:{port}/readyz")
+                raise AssertionError("expected 500")
+            except urllib.error.HTTPError as e:
+                assert e.code == 500
+                body = json.loads(e.read())
+                assert body["checks"]["b"] is False
+            registry.remove("b")
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/readyz") as resp:
+                assert resp.status == 200
+        finally:
+            server.stop()
+
+    def test_raising_check_reads_unhealthy(self):
+        registry = HealthCheckRegistry()
+        registry.add_liveness("bad", lambda: 1 / 0)
+        assert registry.livez() == {"bad": False}
+
+
+class TestLeaderElection:
+    def test_single_holder(self):
+        fleet = ClusterFleet()
+        now = [0.0]
+        a = LeaderElector(fleet.host, "a", clock=lambda: now[0])
+        b = LeaderElector(fleet.host, "b", clock=lambda: now[0])
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        # a renews within the lease: b still locked out.
+        now[0] += 10.0
+        assert a.try_acquire_or_renew()
+        now[0] += 10.0
+        assert not b.try_acquire_or_renew()
+
+    def test_expired_lease_taken_over_with_callback(self):
+        fleet = ClusterFleet()
+        now = [0.0]
+        lost = []
+        a = LeaderElector(
+            fleet.host, "a", clock=lambda: now[0],
+            on_stopped_leading=lambda: lost.append(True),
+        )
+        b = LeaderElector(fleet.host, "b", clock=lambda: now[0])
+        assert a.try_acquire_or_renew()
+        now[0] += 60.0  # a's lease expires
+        assert b.try_acquire_or_renew()
+        assert not a.try_acquire_or_renew()
+        assert lost == [True]
+
+
+def deployment_ftc_object():
+    ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+    return ftc_to_object(ftc)
+
+
+class TestControllerManager:
+    def setup_method(self):
+        self.fleet = ClusterFleet()
+        self.manager = ControllerManager(
+            self.fleet,
+            cluster_controller_kwargs={
+                "api_resource_probe": ["apps/v1/Deployment"]
+            },
+        )
+        for name in ("c1", "c2", "c3"):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", "64", "128Gi"))
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                },
+            )
+
+    def test_ftc_starts_controllers_and_propagates(self):
+        self.fleet.host.create(FEDERATED_TYPE_CONFIGS, deployment_ftc_object())
+        assert "deployments.apps" in self.manager._ftcs
+        ready = self.manager.health.readyz()
+        assert ready.get("deployments.apps/scheduler") is True
+        assert ready.get("deployments.apps/sync") is True
+
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {"schedulingMode": "Divide"},
+            },
+        )
+        self.fleet.host.create("apps/v1/deployments", make_deployment(replicas=9))
+        self.manager.settle()
+
+        total = 0
+        for name in ("c1", "c2", "c3"):
+            obj = self.fleet.member(name).get("apps/v1/deployments", "default/web")
+            assert obj["metadata"]["labels"][C.MANAGED_LABEL] == "true"
+            total += obj["spec"]["replicas"]
+        assert total == 9
+
+    def test_ftc_delete_stops_controllers(self):
+        self.fleet.host.create(FEDERATED_TYPE_CONFIGS, deployment_ftc_object())
+        runtime = self.manager._ftcs["deployments.apps"]
+        self.fleet.host.delete(FEDERATED_TYPE_CONFIGS, "deployments.apps")
+        assert "deployments.apps" not in self.manager._ftcs
+        assert self.manager.health.readyz().get("deployments.apps/sync") is None
+        for controller in runtime.controllers.values():
+            for worker in self.manager._workers_of(controller):
+                assert worker._stop.is_set()
+
+    def test_ftc_spec_change_restarts_controllers(self):
+        self.fleet.host.create(FEDERATED_TYPE_CONFIGS, deployment_ftc_object())
+        old = self.manager._ftcs["deployments.apps"]
+        obj = self.fleet.host.get(FEDERATED_TYPE_CONFIGS, "deployments.apps")
+        obj["spec"]["statusAggregation"] = None
+        self.fleet.host.update(FEDERATED_TYPE_CONFIGS, obj)
+        new = self.manager._ftcs["deployments.apps"]
+        assert new is not old
+        assert "statusaggregator" not in new.controllers
+
+    def test_controllers_flag_semantics(self):
+        assert ControllerManager._resolve_enabled(None) == {"cluster", "follower"}
+        assert ControllerManager._resolve_enabled(["*", "-follower"]) == {"cluster"}
+        assert ControllerManager._resolve_enabled(["cluster"]) == {"cluster"}
