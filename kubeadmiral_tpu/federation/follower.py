@@ -12,6 +12,7 @@ pkg/controllers/follower/controller.go:40-552, util.go:46-150).
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Iterable, Optional
 
@@ -183,20 +184,23 @@ class FollowerController:
         self.worker = Worker(
             "follower-controller", self.reconcile, metrics=self.metrics, clock=clock
         )
+        # Partials of a bound method, not lambdas: owner-based unwatch
+        # (dynamic FTC lifecycle) identifies handlers by their owner.
         for gk, ftc in self.leader_ftcs.items():
             host.watch(
                 ftc.federated.resource,
-                lambda e, o, gk=gk: self.worker.enqueue(f"leader|{gk}|{obj_key(o)}"),
+                functools.partial(self._on_object_event, "leader", gk),
                 replay=True,
             )
         for gk, ftc in self.follower_ftcs.items():
             host.watch(
                 ftc.federated.resource,
-                lambda e, o, gk=gk: self.worker.enqueue(
-                    f"follower|{gk}|{obj_key(o)}"
-                ),
+                functools.partial(self._on_object_event, "follower", gk),
                 replay=True,
             )
+
+    def _on_object_event(self, role: str, gk: str, event: str, obj: dict) -> None:
+        self.worker.enqueue(f"{role}|{gk}|{obj_key(obj)}")
 
     def run_until_idle(self) -> None:
         while self.worker.step():

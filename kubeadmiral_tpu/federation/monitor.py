@@ -1,0 +1,128 @@
+"""Monitor controller: federation-health gauges per FTC.
+
+Off by default, as in the reference (reference:
+pkg/controllers/monitor/monitor_controller.go:85-258,
+monitor_subcontroller.go, report.go): per federated type it meters
+
+* ``monitor.<ftc>.total`` / ``.propagated`` / ``.unpropagated`` — how
+  many federated objects exist and how many have a True Propagation
+  condition with every placed cluster reporting OK,
+* ``monitor.<ftc>.sync_latency`` — per object generation, the time from
+  first observation to successful propagation (the BaseMeter
+  sync-latency equivalent),
+* ``monitor.<ftc>.out_of_sync_seconds`` — the current age of the oldest
+  unpropagated generation,
+* ``monitor.clusters.ready`` / ``.total`` — member-cluster health.
+
+Gauges land in the shared :class:`Metrics` store on a periodic tick
+(report.go DoReport's interval loop).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.worker import Result, Worker
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+_TICK = "tick"
+
+DEFAULT_INTERVAL_SECONDS = 30.0
+
+
+def _is_propagated(fed_obj: dict) -> bool:
+    status = fed_obj.get("status", {})
+    conditions = {
+        c.get("type"): c.get("status") for c in status.get("conditions", [])
+    }
+    if conditions.get("Propagation") != "True":
+        return False
+    clusters = status.get("clusters", [])
+    placed = C.all_placement_clusters(fed_obj)
+    reported = {c.get("cluster") for c in clusters if c.get("status") == "OK"}
+    return placed <= reported
+
+
+class MonitorController:
+    name = "monitor"
+
+    def __init__(
+        self,
+        host: FakeKube,
+        ftc: FederatedTypeConfig,
+        metrics: Optional[Metrics] = None,
+        interval: float = DEFAULT_INTERVAL_SECONDS,
+        clock=time.monotonic,
+    ):
+        self.host = host
+        self.ftc = ftc
+        self.metrics = metrics or Metrics()
+        self.interval = interval
+        self.clock = clock
+        self._resource = ftc.federated.resource
+        # (key, generation) -> first-seen timestamp, dropped once synced.
+        self._pending_since: dict[tuple[str, int], float] = {}
+        # The same clock drives latency math AND the requeue timer, so a
+        # fake clock steps the whole controller deterministically.
+        self.worker = Worker(
+            f"monitor-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        )
+        self.worker.enqueue(_TICK)
+
+    def reconcile(self, key: str) -> Result:
+        if key != _TICK:
+            return Result.ok()
+        self._report()
+        return Result.after(self.interval)
+
+    def _report(self) -> None:
+        prefix = f"monitor.{self.ftc.name}"
+        now = self.clock()
+        total = propagated = 0
+        live: set[tuple[str, int]] = set()
+
+        def visit(fed_obj: dict) -> None:
+            nonlocal total, propagated
+            total += 1
+            meta = fed_obj.get("metadata", {})
+            obj_key = f"{meta.get('namespace', '')}/{meta.get('name', '')}".lstrip("/")
+            generation = meta.get("generation", 1)
+            pending_key = (obj_key, generation)
+            if _is_propagated(fed_obj):
+                propagated += 1
+                started = self._pending_since.pop(pending_key, None)
+                if started is not None:
+                    self.metrics.duration(f"{prefix}.sync_latency", now - started)
+            else:
+                live.add(pending_key)
+                self._pending_since.setdefault(pending_key, now)
+
+        self.host.scan(self._resource, visit)
+        # Drop meters for deleted objects / superseded generations.
+        for stale in [k for k in self._pending_since if k not in live]:
+            del self._pending_since[stale]
+
+        self.metrics.store(f"{prefix}.total", total)
+        self.metrics.store(f"{prefix}.propagated", propagated)
+        self.metrics.store(f"{prefix}.unpropagated", total - propagated)
+        oldest = min(self._pending_since.values(), default=None)
+        self.metrics.store(
+            f"{prefix}.out_of_sync_seconds",
+            (now - oldest) if oldest is not None else 0.0,
+        )
+
+        ready = total_clusters = 0
+        for cluster in self.host.list(C.FEDERATED_CLUSTERS):
+            total_clusters += 1
+            conditions = {
+                c.get("type"): c.get("status")
+                for c in cluster.get("status", {}).get("conditions", [])
+            }
+            if conditions.get("Ready") == "True":
+                ready += 1
+        self.metrics.store("monitor.clusters.total", total_clusters)
+        self.metrics.store("monitor.clusters.ready", ready)

@@ -28,6 +28,7 @@ from kubeadmiral_tpu.federation.history import (
 from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
 from kubeadmiral_tpu.federation.version import VersionManager
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime.eventsink import DefederatingRecorderMux
 from kubeadmiral_tpu.runtime import pending
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
@@ -100,6 +101,9 @@ class SyncController:
         self._target_resource = ftc.source.resource
         self.versions = VersionManager(self.host, ftc.source.kind, ftc.namespaced)
         self.revisions = RevisionManager(self.host) if ftc.revision_history else None
+        # Events recorded on the federated object are re-targeted to the
+        # source object too (util/eventsink DefederatingRecorderMux).
+        self.recorder = DefederatingRecorderMux(self.host, f"sync-{ftc.name}")
         self.pool = ThreadPoolExecutor(max_workers=max_dispatch_workers)
         self.worker = Worker(
             f"sync-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
@@ -407,6 +411,17 @@ class SyncController:
 
         status_map = dispatcher.status_map
         reason = AGGREGATE_SUCCESS if ok else CHECK_CLUSTERS
+        if not ok:
+            failed = sorted(
+                c for c, s in status_map.items()
+                if s not in (D.OK, D.WAITING, D.WAITING_FOR_REMOVAL)
+            )
+            self.recorder.event(
+                fed.obj,
+                "Warning",
+                "PropagationFailed",
+                f"failed clusters: {', '.join(failed)}",
+            )
         status_result = self._set_federated_status(
             fed, reason, status_map, collision_count
         )

@@ -29,6 +29,7 @@ from kubeadmiral_tpu.federation.automigration import AutoMigrationController
 from kubeadmiral_tpu.federation.clusterctl import FederatedClusterController
 from kubeadmiral_tpu.federation.federate import FederateController
 from kubeadmiral_tpu.federation.follower import FollowerController
+from kubeadmiral_tpu.federation.monitor import MonitorController
 from kubeadmiral_tpu.federation.nsautoprop import NamespaceAutoPropagationController
 from kubeadmiral_tpu.federation.overridectl import OverrideController
 from kubeadmiral_tpu.federation.policyrc import PolicyRCController
@@ -94,6 +95,9 @@ class ControllerManager:
         self._enabled = self._resolve_enabled(enabled)
         self._lock = threading.RLock()
         self._ftcs: dict[str, _FTCRuntime] = {}
+        # Set by run(): controllers started after that point get their
+        # worker threads immediately.
+        self._threaded_workers: Optional[int] = None
 
         self.always_on: dict[str, object] = {}
         if CLUSTER_CONTROLLER in self._enabled:
@@ -179,13 +183,37 @@ class ControllerManager:
             controllers[AUTOMIGRATION] = AutoMigrationController(
                 self.fleet, ftc, metrics=self.metrics
             )
+        if MONITOR_CONTROLLER in self._enabled:
+            # Off by default, like the reference's monitor controller.
+            controllers[MONITOR_CONTROLLER] = MonitorController(
+                self.host, ftc, metrics=self.metrics
+            )
         with self._lock:
             self._ftcs[ftc.name] = runtime
         for cname, controller in controllers.items():
             self.health.add_readiness(
                 f"{ftc.name}/{cname}", self._controller_ready(controller)
             )
+            self._maybe_thread(controller)
         self._rebuild_follower()
+
+    def _teardown(self, controller) -> None:
+        """Fully release a dynamically stopped controller: worker
+        threads, watch registrations, dispatch pools."""
+        for worker in self._workers_of(controller):
+            worker.stop()
+        self.fleet.unwatch_owner(controller)
+        pool = getattr(controller, "pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _maybe_thread(self, controller) -> None:
+        """After run(), newly started controllers thread immediately."""
+        if self._threaded_workers is None:
+            return
+        for worker in self._workers_of(controller):
+            if not worker._threads:
+                worker.run(self._threaded_workers)
 
     def _stop_ftc(self, name: str) -> None:
         with self._lock:
@@ -194,8 +222,7 @@ class ControllerManager:
             return
         for cname, controller in runtime.controllers.items():
             self.health.remove(f"{name}/{cname}")
-            for worker in self._workers_of(controller):
-                worker.stop()
+            self._teardown(controller)
         self._rebuild_follower()
 
     def _rebuild_follower(self) -> None:
@@ -205,11 +232,11 @@ class ControllerManager:
         if FOLLOWER_CONTROLLER not in self._enabled:
             return
         if self._follower is not None:
-            for worker in self._workers_of(self._follower):
-                worker.stop()
+            self._teardown(self._follower)
         with self._lock:
             ftcs = [rt.ftc for rt in self._ftcs.values()]
         self._follower = FollowerController(self.host, ftcs, metrics=self.metrics)
+        self._maybe_thread(self._follower)
 
     @staticmethod
     def _controller_ready(controller) -> Callable[[], bool]:
@@ -255,10 +282,12 @@ class ControllerManager:
 
     def run(self, workers_per_controller: int = 1) -> None:
         """Threaded operation: every controller worker gets its own
-        thread(s) (the reference's N goroutines per ReconcileWorker)."""
+        thread(s) (the reference's N goroutines per ReconcileWorker).
+        Controllers started later — new/changed FTCs — are threaded as
+        they appear."""
+        self._threaded_workers = workers_per_controller
         for controller in self._all_controllers():
-            for worker in self._workers_of(controller):
-                worker.run(workers_per_controller)
+            self._maybe_thread(controller)
 
     def stop(self) -> None:
         for controller in self._all_controllers():

@@ -228,6 +228,25 @@ class FakeKube:
             if handler in handlers:
                 handlers.remove(handler)
 
+    @staticmethod
+    def _handler_owner(handler: Handler) -> Optional[object]:
+        """The instance a handler is bound to (directly, or through a
+        functools.partial of a bound method)."""
+        owner = getattr(handler, "__self__", None)
+        if owner is not None:
+            return owner
+        return getattr(getattr(handler, "func", None), "__self__", None)
+
+    def unwatch_owner(self, owner: object) -> None:
+        """Remove every handler owned by ``owner`` — how a dynamically
+        stopped controller detaches all its watches without having
+        tracked each registration."""
+        with self._lock:
+            for handlers in self._watchers.values():
+                handlers[:] = [
+                    h for h in handlers if self._handler_owner(h) is not owner
+                ]
+
 
 class ClusterFleet:
     """Host + member apiservers — the FederatedClientFactory analogue
@@ -246,6 +265,12 @@ class ClusterFleet:
         if name not in self.members:
             raise NotFound(f"cluster {name}")
         return self.members[name]
+
+    def unwatch_owner(self, owner: object) -> None:
+        """Detach a controller's handlers from the host and every member."""
+        self.host.unwatch_owner(owner)
+        for member in self.members.values():
+            member.unwatch_owner(owner)
 
     def watch_members(self, resource: str, handler: Handler) -> Callable[[], None]:
         """Watch ``resource`` in every current member and return a

@@ -178,6 +178,25 @@ class TestControllerManager:
         assert new is not old
         assert "statusaggregator" not in new.controllers
 
+    def test_ftc_delete_detaches_watch_handlers(self):
+        baseline = sum(
+            len(hs) for hs in self.fleet.host._watchers.values()
+        )
+        self.fleet.host.create(FEDERATED_TYPE_CONFIGS, deployment_ftc_object())
+        attached = sum(len(hs) for hs in self.fleet.host._watchers.values())
+        assert attached > baseline
+        self.fleet.host.delete(FEDERATED_TYPE_CONFIGS, "deployments.apps")
+        remaining = sum(len(hs) for hs in self.fleet.host._watchers.values())
+        # Only the rebuilt follower controller's handlers remain beyond
+        # the baseline.
+        follower_handlers = sum(
+            1
+            for hs in self.fleet.host._watchers.values()
+            for h in hs
+            if self.fleet.host._handler_owner(h) is self.manager._follower
+        )
+        assert remaining == baseline + follower_handlers
+
     def test_controllers_flag_semantics(self):
         assert ControllerManager._resolve_enabled(None) == {"cluster", "follower"}
         assert ControllerManager._resolve_enabled(["*", "-follower"]) == {"cluster"}

@@ -1,0 +1,166 @@
+"""Monitor controller + eventsink (reference: pkg/controllers/monitor,
+pkg/controllers/util/eventsink)."""
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.monitor import MonitorController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime.eventsink import (
+    EVENTS,
+    FEDERATED_OBJECT_ANNOTATION,
+    DefederatingRecorderMux,
+    EventRecorder,
+)
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+
+def deployment_ftc():
+    return next(f for f in default_ftcs() if f.name == "deployments.apps")
+
+
+def make_fed(name, propagated, clusters=("c1",), generation=1):
+    conditions = [{"type": "Propagation", "status": "True" if propagated else "False"}]
+    return {
+        "apiVersion": "types.kubeadmiral.io/v1alpha1",
+        "kind": "FederatedDeployment",
+        "metadata": {"name": name, "namespace": "default", "generation": generation},
+        "spec": {
+            "template": {},
+            "placements": [
+                {
+                    "controller": C.SCHEDULER,
+                    "placement": [{"cluster": c} for c in clusters],
+                }
+            ],
+        },
+        "status": {
+            "conditions": conditions,
+            "clusters": [
+                {"cluster": c, "status": "OK" if propagated else "Waiting"}
+                for c in clusters
+            ],
+        },
+    }
+
+
+class TestMonitor:
+    def setup_method(self):
+        self.host = FakeKube()
+        self.metrics = Metrics()
+        self.now = [100.0]
+        self.ctl = MonitorController(
+            self.host,
+            deployment_ftc(),
+            metrics=self.metrics,
+            interval=30.0,
+            clock=lambda: self.now[0],
+        )
+        self.resource = deployment_ftc().federated.resource
+
+    def tick(self):
+        self.ctl._report()
+
+    def test_periodic_tick_via_worker_and_fake_clock(self):
+        self.host.create(self.resource, make_fed("a", True))
+        assert self.ctl.worker.step()  # first tick reports immediately
+        assert self.metrics.stores["monitor.deployments.apps.total"] == 1
+        assert not self.ctl.worker.step()  # requeued 30s out
+        self.now[0] += 31.0
+        assert self.ctl.worker.step()  # fake clock reaches the interval
+
+    def test_propagation_gauges(self):
+        self.host.create(self.resource, make_fed("a", True))
+        self.host.create(self.resource, make_fed("b", False))
+        self.tick()
+        assert self.metrics.stores["monitor.deployments.apps.total"] == 2
+        assert self.metrics.stores["monitor.deployments.apps.propagated"] == 1
+        assert self.metrics.stores["monitor.deployments.apps.unpropagated"] == 1
+
+    def test_sync_latency_measured_per_generation(self):
+        self.host.create(self.resource, make_fed("a", False))
+        self.tick()
+        self.now[0] += 42.0
+        obj = self.host.get(self.resource, "default/a")
+        obj["status"] = make_fed("a", True)["status"]
+        self.host.update_status(self.resource, obj)
+        self.tick()
+        latencies = self.metrics.durations["monitor.deployments.apps.sync_latency"]
+        assert latencies == [42.0]
+        assert self.metrics.stores["monitor.deployments.apps.out_of_sync_seconds"] == 0
+
+    def test_out_of_sync_age_tracks_oldest(self):
+        self.host.create(self.resource, make_fed("a", False))
+        self.tick()
+        self.now[0] += 60.0
+        self.tick()
+        assert (
+            self.metrics.stores["monitor.deployments.apps.out_of_sync_seconds"]
+            == 60.0
+        )
+
+    def test_cluster_ready_gauges(self):
+        for name, ready in (("c1", True), ("c2", False)):
+            self.host.create(
+                C.FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                    "status": {
+                        "conditions": [
+                            {"type": "Ready", "status": "True" if ready else "False"}
+                        ]
+                    },
+                },
+            )
+        self.tick()
+        assert self.metrics.stores["monitor.clusters.total"] == 2
+        assert self.metrics.stores["monitor.clusters.ready"] == 1
+
+
+class TestEventSink:
+    def setup_method(self):
+        self.host = FakeKube()
+
+    def test_event_created_and_deduplicated(self):
+        recorder = EventRecorder(self.host, "sync-controller", clock=lambda: 1.0)
+        dep = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+        }
+        recorder.event(dep, "Normal", "Updating", "updating cluster c1")
+        recorder.event(dep, "Normal", "Updating", "updating cluster c1")
+        events = self.host.list(EVENTS)
+        assert len(events) == 1
+        assert events[0]["count"] == 2
+        assert events[0]["involvedObject"]["kind"] == "Deployment"
+
+    def test_defederating_mux_targets_source_too(self):
+        mux = DefederatingRecorderMux(self.host, "scheduler", clock=lambda: 1.0)
+        fed = {
+            "apiVersion": "types.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedDeployment",
+            "metadata": {
+                "name": "web",
+                "namespace": "default",
+                "annotations": {FEDERATED_OBJECT_ANNOTATION: "1"},
+            },
+            "spec": {"template": {"apiVersion": "apps/v1", "kind": "Deployment"}},
+        }
+        mux.event(fed, "Normal", "Scheduled", "placed on c1,c2")
+        kinds = {
+            e["involvedObject"]["kind"] for e in self.host.list(EVENTS)
+        }
+        assert kinds == {"FederatedDeployment", "Deployment"}
+
+    def test_non_federated_object_gets_single_event(self):
+        mux = DefederatingRecorderMux(self.host, "scheduler", clock=lambda: 1.0)
+        dep = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+        }
+        mux.event(dep, "Warning", "Failed", "boom")
+        assert len(self.host.list(EVENTS)) == 1
