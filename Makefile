@@ -7,7 +7,12 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench graft-check graft-dryrun
+.PHONY: test test-fast bench graft-check graft-dryrun native
+
+native: kubeadmiral_tpu/native/libkadmhash.so
+
+kubeadmiral_tpu/native/libkadmhash.so: kubeadmiral_tpu/native/fnvhash.cpp
+	g++ -O3 -shared -fPIC -o $@ $<
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q

@@ -29,6 +29,7 @@ MANAGED_LABEL = PREFIX + "managed"
 MANAGED_TRUE = "true"
 
 # Annotations.
+FEDERATED_OBJECT = PREFIX + "federated-object"  # marks federate-created objects
 SCHEDULING_TRIGGER_HASH = PREFIX + "scheduling-trigger-hash"
 PROPAGATION_POLICY_NAME = PREFIX + "propagation-policy-name"
 CLUSTER_PROPAGATION_POLICY_NAME = PREFIX + "cluster-propagation-policy-name"

@@ -29,7 +29,7 @@ NO_FEDERATED_RESOURCE = C.PREFIX + "no-federated-resource"
 
 # Bookkeeping annotations on the federated object
 # (reference: pkg/controllers/common/constants.go).
-FEDERATED_OBJECT = C.PREFIX + "federated-object"
+FEDERATED_OBJECT = C.FEDERATED_OBJECT
 OBSERVED_ANNOTATION_KEYS = C.PREFIX + "observed-annotation-keys"
 OBSERVED_LABEL_KEYS = C.PREFIX + "observed-label-keys"
 TEMPLATE_GENERATOR_MERGE_PATCH = C.PREFIX + "template-generator-merge-patch"

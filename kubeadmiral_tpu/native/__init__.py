@@ -1,0 +1,91 @@
+"""Native (C++) hot-path library loader.
+
+``load()`` returns the ctypes handle to libkadmhash.so, building it with
+g++ on first use when only the source is present (the toolchain path; CI
+and the Makefile prebuild it with ``make native``).  Returns None when
+neither a prebuilt library nor a working compiler is available — callers
+fall back to the pure-Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_DIR, "fnvhash.cpp")
+LIBRARY = os.path.join(_DIR, "libkadmhash.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.kadm_fnv32.restype = ctypes.c_uint32
+    lib.kadm_fnv32.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.kadm_fnv32a.restype = ctypes.c_uint32
+    lib.kadm_fnv32a.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    lib.kadm_fnv32_batch.restype = None
+    lib.kadm_fnv32_batch.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_size_t,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.kadm_fnv32_extend_batch.restype = None
+    lib.kadm_fnv32_extend_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_size_t,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    return lib
+
+
+def build(force: bool = False) -> bool:
+    """Compile the shared library; True on success.  The output lands in
+    a temp file first and is renamed into place, so concurrent builders
+    (parallel test workers, several controller processes) never dlopen a
+    half-written library."""
+    if os.path.exists(LIBRARY) and not force:
+        return True
+    tmp = f"{LIBRARY}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, SOURCE],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, LIBRARY)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(LIBRARY) and not build():
+            _load_failed = True
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(LIBRARY))
+        except OSError:
+            _load_failed = True
+            return None
+    return _lib

@@ -23,7 +23,7 @@ from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound
 EVENTS = "v1/events"
 
 # Set by the federate controller on every federated object it creates.
-FEDERATED_OBJECT_ANNOTATION = C.PREFIX + "federated-object"
+FEDERATED_OBJECT_ANNOTATION = C.FEDERATED_OBJECT
 
 
 def _defederate_reference(obj: dict) -> Optional[dict]:

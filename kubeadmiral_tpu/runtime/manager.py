@@ -290,6 +290,10 @@ class ControllerManager:
             self._maybe_thread(controller)
 
     def stop(self) -> None:
+        # Clear the threading mode first so controllers started by a
+        # late watch event stay inert instead of spawning threads on a
+        # stopped manager.
+        self._threaded_workers = None
         for controller in self._all_controllers():
             for worker in self._workers_of(controller):
                 worker.stop()
