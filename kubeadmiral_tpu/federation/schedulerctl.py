@@ -438,12 +438,20 @@ class SchedulerController:
         if not to_schedule:
             return results
         with self.metrics.timer(f"scheduler-{self.ftc.name}.engine_latency"):
-            webhook_eval = self._webhook_eval()
+            # ONE watch-thread-safe snapshot for the whole tick: the
+            # score-decode decision and the select pass must agree on
+            # the plugin set, or a select plugin registered mid-tick
+            # would narrow on fabricated zero scores.
+            plugins = dict(self.webhook_plugins)
+            webhook_eval = self._webhook_eval(plugins)
+            # Score decoding only matters when a select webhook might
+            # consume it (the decode is the engine's main host cost).
+            want_scores = any(p.has_select for p in plugins.values())
             outcomes = self.engine.schedule(
-                units, clusters, webhook_eval=webhook_eval
+                units, clusters, webhook_eval=webhook_eval, want_scores=want_scores
             )
             outcomes = self._apply_webhook_selects(
-                units, clusters, outcomes, webhook_eval
+                units, clusters, outcomes, plugins, webhook_eval
             )
         self.metrics.counter(f"scheduler-{self.ftc.name}.scheduled", len(units))
 
@@ -452,15 +460,15 @@ class SchedulerController:
         return results
 
     # -- webhook (out-of-process) plugins --------------------------------
-    def _webhook_eval(self):
+    def _webhook_eval(self, plugins: dict[str, W.WebhookPlugin]):
         """Host-side evaluator handed to the engine: AND of the unit's
         enabled webhook filters, sum of its webhook scores, per cluster.
         Any failing webhook call marks the cluster infeasible for this
         tick (the batch-mode analogue of the reference failing the whole
         per-object schedule and backing off).  Calls fan out over a
         thread pool per cluster row, and results are memoized by object
-        key so the select-narrowing rerun reuses them."""
-        plugins = dict(self.webhook_plugins)  # watch-thread-safe snapshot
+        key so the select-narrowing rerun reuses them.  ``plugins`` is
+        the tick's plugin snapshot."""
         if not plugins:
             return None
         if self._webhook_pool is None:
@@ -518,15 +526,20 @@ class SchedulerController:
         return evaluate
 
     def _apply_webhook_selects(
-        self, units, clusters, outcomes: list[ScheduleResult], webhook_eval=None
+        self,
+        units,
+        clusters,
+        outcomes: list[ScheduleResult],
+        plugins: dict[str, W.WebhookPlugin],
+        webhook_eval=None,
     ) -> list[ScheduleResult]:
         """Webhook select plugins narrow the tick's selected set; affected
         Divide-mode units are re-planned over the narrowed set in one
         follow-up batch (the sequential RunSelectClustersPlugin chain,
         framework.go:183-209, with the planner re-run batched).  The
         first pass's memoizing evaluator is reused so the rerun repeats
-        no webhook filter/score calls."""
-        plugins = dict(self.webhook_plugins)  # watch-thread-safe snapshot
+        no webhook filter/score calls; ``plugins`` is the same snapshot
+        the scores were decoded for."""
         if not plugins:
             return outcomes
         by_name = {c.name: c for c in clusters}

@@ -53,7 +53,11 @@ def build(force: bool = False) -> bool:
     a temp file first and is renamed into place, so concurrent builders
     (parallel test workers, several controller processes) never dlopen a
     half-written library."""
-    if os.path.exists(LIBRARY) and not force:
+    if (
+        os.path.exists(LIBRARY)
+        and not force
+        and os.path.getmtime(LIBRARY) >= os.path.getmtime(SOURCE)
+    ):
         return True
     tmp = f"{LIBRARY}.{os.getpid()}.tmp"
     try:
@@ -80,7 +84,7 @@ def load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(LIBRARY) and not build():
+        if not build():  # no-op when the library is newer than the source
             _load_failed = True
             return None
         try:

@@ -219,10 +219,18 @@ def featurize(
     webhook_ok = np.ones((b, c), bool)
     webhook_scores = np.zeros((b, c), np.int64)
     if webhook_eval is not None:
+        int32_info = np.iinfo(np.int32)
         for i, su in enumerate(units):
             result = webhook_eval(su, view.clusters)
             if result is not None:
-                webhook_ok[i], webhook_scores[i] = result
+                webhook_ok[i], scores_row = result
+                # Free-form HTTP responses are clamped to int32: the
+                # tick's score outputs travel as int32 to keep the
+                # device->host transfer small, and an unclamped 2**31
+                # webhook score would wrap.
+                webhook_scores[i] = np.clip(
+                    scores_row, int32_info.min // 2, int32_info.max // 2
+                )
 
     # --- plugin enablement ---
     filter_enabled = np.zeros((b, OF.NUM_FILTER_PLUGINS), bool)
