@@ -115,6 +115,33 @@ def classify_labels(src: dict) -> tuple[dict, dict]:
     return federated, template
 
 
+# Annotations this control plane writes back onto SOURCE objects.
+_FEEDBACK_ANNOTATIONS = frozenset(
+    {
+        C.SOURCE_FEEDBACK_SCHEDULING,
+        C.SOURCE_FEEDBACK_SYNCING,
+        C.SOURCE_FEEDBACK_STATUS,
+    }
+)
+
+
+def source_for_bookkeeping(source: dict) -> dict:
+    """Source with the feedback annotations stripped: observed-keys and
+    the template-generator merge patch must not react to keys this
+    control plane writes back onto the source, or every feedback write
+    would restart the whole pipeline.  Other ignored annotations (e.g.
+    retain-replicas) stay — they are user-written inputs the federated
+    spec derives from."""
+    src = copy.deepcopy(source)
+    ann = src.get("metadata", {}).get("annotations")
+    if ann:
+        for key in _FEEDBACK_ANNOTATIONS:
+            ann.pop(key, None)
+        if not ann:
+            src["metadata"].pop("annotations", None)
+    return src
+
+
 def observed_keys(source_map: dict, federated_map: dict) -> str:
     """``fedKeys|otherKeys`` bookkeeping so later syncs know which source
     keys were observed (federate/util.go generateObservedKeys)."""
@@ -165,6 +192,7 @@ def _ensure_deployment_fields(source: dict, fed_obj: dict) -> bool:
 
 
 def new_federated_object(ftc: FederatedTypeConfig, source: dict) -> dict:
+    source = source_for_bookkeeping(source)
     src_meta = source.get("metadata", {})
     fed_labels, tmpl_labels = classify_labels(src_meta.get("labels", {}))
     fed_anno, tmpl_anno = classify_annotations(src_meta.get("annotations", {}))
@@ -206,6 +234,7 @@ def update_federated_object(
     True when it changed (federate/util.go
     updateFederatedObjectForSourceObject)."""
     changed = False
+    source = source_for_bookkeeping(source)
     src_meta = source.get("metadata", {})
     fed_meta = fed_obj.setdefault("metadata", {})
 
@@ -365,17 +394,33 @@ class FederateController:
         return Result.ok()
 
     def _sync_feedback(self, source: dict, fed_obj: dict) -> Result:
-        """Copy scheduling/syncing feedback annotations from the federated
-        object back onto the source (federate/controller.go
-        updateFeedbackAnnotations; sourcefeedback/*.go)."""
+        """Write scheduling feedback (computed from the federated object's
+        placements) and copy syncing feedback onto the source object
+        (federate/controller.go:485-494;
+        sourcefeedback/scheduling.go PopulateSchedulingAnnotation)."""
         fed_anno = fed_obj["metadata"].get("annotations", {}) or {}
         changed = False
         src_anno = source["metadata"].setdefault("annotations", {})
-        for key in (C.SOURCE_FEEDBACK_SCHEDULING, C.SOURCE_FEEDBACK_SYNCING):
-            value = fed_anno.get(key)
-            if value is not None and src_anno.get(key) != value:
-                src_anno[key] = value
-                changed = True
+
+        scheduling: dict = {
+            # Generation of the source as observed in the template (the
+            # template prunes it, as the reference's does, so this stays
+            # null unless another controller kept it).
+            "generation": get_path(fed_obj, "spec.template.metadata.generation"),
+            "fedGeneration": fed_obj["metadata"].get("generation", 1),
+        }
+        placement = sorted(C.all_placement_clusters(fed_obj))
+        if placement:
+            scheduling["placement"] = placement
+        scheduling_value = C.compact_json(scheduling)
+        if src_anno.get(C.SOURCE_FEEDBACK_SCHEDULING) != scheduling_value:
+            src_anno[C.SOURCE_FEEDBACK_SCHEDULING] = scheduling_value
+            changed = True
+
+        syncing = fed_anno.get(C.SOURCE_FEEDBACK_SYNCING)
+        if syncing is not None and src_anno.get(C.SOURCE_FEEDBACK_SYNCING) != syncing:
+            src_anno[C.SOURCE_FEEDBACK_SYNCING] = syncing
+            changed = True
         if not changed:
             return Result.ok()
         try:

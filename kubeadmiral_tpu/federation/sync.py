@@ -427,6 +427,9 @@ class SyncController:
         )
         if not status_result.success:
             return status_result
+        # The syncing feedback annotation is a separate (non-status)
+        # write: UpdateStatus ignores annotations (controller.go:686-718).
+        self._set_syncing_annotation(fed, status_map)
         if not ok:
             return Result.retry()
         if D.WAITING_FOR_REMOVAL in status_map.values():
@@ -537,6 +540,50 @@ class SyncController:
             except Conflict:
                 continue
         return Result.retry()
+
+    def _set_syncing_annotation(
+        self, fed: FederatedResource, status_map: dict[str, str]
+    ) -> None:
+        """Record per-cluster sync progress on the federated object for
+        the federate controller to mirror onto the source
+        (sourcefeedback/syncing.go PopulateSyncingAnnotation); best-effort
+        with conflict-refresh."""
+
+        def desired(generation: int) -> str:
+            return C.compact_json(
+                {
+                    "generation": None,
+                    "fedGeneration": generation,
+                    "clusters": [
+                        {"name": c, "status": s}
+                        for c, s in sorted(status_map.items())
+                    ],
+                }
+            )
+
+        # Cheap steady-state exit using the in-hand object: no refetch
+        # (a full deep copy per tick) when the annotation is current.
+        in_hand = fed.obj.get("metadata", {})
+        if in_hand.get("annotations", {}).get(
+            C.SOURCE_FEEDBACK_SYNCING
+        ) == desired(in_hand.get("generation", 1)):
+            return
+        for _ in range(5):
+            obj = self.host.try_get(self._fed_resource, fed.key)
+            if obj is None:
+                return
+            syncing = desired(obj["metadata"].get("generation", 1))
+            ann = obj["metadata"].setdefault("annotations", {})
+            if ann.get(C.SOURCE_FEEDBACK_SYNCING) == syncing:
+                return
+            ann[C.SOURCE_FEEDBACK_SYNCING] = syncing
+            try:
+                self.host.update(self._fed_resource, obj)
+                return
+            except NotFound:
+                return
+            except Conflict:
+                continue
 
     # -- deletion (controller.go:723-819) --------------------------------
     def _ensure_deletion(self, fed: FederatedResource) -> Result:

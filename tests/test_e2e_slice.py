@@ -139,6 +139,22 @@ class TestEndToEndSlice:
         status = {c["cluster"]: c["status"] for c in fed["status"]["clusters"]}
         assert status == {"c1": "OK", "c2": "OK", "c3": "OK"}
 
+    def test_source_feedback_annotations(self):
+        """Scheduling + syncing feedback lands on the source object
+        (sourcefeedback/scheduling.go, syncing.go; federate
+        controller.go:485-494)."""
+        import json
+
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle(*self.everything())
+        src = self.fleet.host.get(self.ftc.source.resource, "default/web")
+        ann = src["metadata"]["annotations"]
+        scheduling = json.loads(ann[C.SOURCE_FEEDBACK_SCHEDULING])
+        assert scheduling["placement"] == ["c1", "c2", "c3"]
+        syncing = json.loads(ann[C.SOURCE_FEEDBACK_SYNCING])
+        assert [c["name"] for c in syncing["clusters"]] == ["c1", "c2", "c3"]
+        assert all(c["status"] == "OK" for c in syncing["clusters"])
+
     def test_source_update_rolls_through(self):
         self.fleet.host.create(self.ftc.source.resource, make_deployment())
         settle(*self.everything())

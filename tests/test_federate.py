@@ -86,9 +86,11 @@ class TestNewFederatedObject:
         assert C.SOURCE_FEEDBACK_SYNCING not in fa
         tmpl_anno = fed["spec"]["template"]["metadata"]["annotations"]
         assert tmpl_anno == {"team": "infra"}
-        # observed-keys bookkeeping: fed keys | other keys
+        # observed-keys bookkeeping: fed keys | other keys.  Ignored
+        # (feedback) keys are excluded entirely — they are written by
+        # this control plane and must not churn the bookkeeping.
         assert fa[OBSERVED_ANNOTATION_KEYS] == (
-            C.PREFIX + "scheduling-mode" + "|" + C.SOURCE_FEEDBACK_SYNCING + ",team"
+            C.PREFIX + "scheduling-mode" + "|team"
         )
 
     def test_label_classification(self):
