@@ -196,7 +196,7 @@ class AutoMigrationController:
                 member = self.fleet.member(cname)
             except NotFound:
                 continue
-            workload = member.try_get(self._target_resource, key)
+            workload = member.try_get_view(self._target_resource, key)  # read-only
             if workload is None:
                 continue
 

@@ -380,8 +380,9 @@ class FederatedClusterController:
         return Result.after(self.resync_seconds)
 
     def _update_resources(self, cluster: dict, member: FakeKube) -> bool:
-        nodes = member.list(NODES)
-        pods = member.list(PODS)
+        # View reads: aggregation only sums parsed quantities.
+        nodes = member.list_view(NODES)
+        pods = member.list_view(PODS)
         allocatable, available, schedulable = aggregate_resources(nodes, pods)
         status = cluster.setdefault("status", {})
         desired = {

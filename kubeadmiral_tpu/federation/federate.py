@@ -131,15 +131,18 @@ def source_for_bookkeeping(source: dict) -> dict:
     control plane writes back onto the source, or every feedback write
     would restart the whole pipeline.  Other ignored annotations (e.g.
     retain-replicas) stay — they are user-written inputs the federated
-    spec derives from."""
-    src = copy.deepcopy(source)
-    ann = src.get("metadata", {}).get("annotations")
-    if ann:
-        for key in _FEEDBACK_ANNOTATIONS:
-            ann.pop(key, None)
-        if not ann:
-            src["metadata"].pop("annotations", None)
-    return src
+    spec derives from.  Only the metadata/annotations layers are rebuilt
+    (no deep copy of large pod templates on this hot path)."""
+    ann = source.get("metadata", {}).get("annotations")
+    if not ann or not (_FEEDBACK_ANNOTATIONS & ann.keys()):
+        return source
+    pruned = {k: v for k, v in ann.items() if k not in _FEEDBACK_ANNOTATIONS}
+    meta = {**source["metadata"]}
+    if pruned:
+        meta["annotations"] = pruned
+    else:
+        meta.pop("annotations", None)
+    return {**source, "metadata": meta}
 
 
 def observed_keys(source_map: dict, federated_map: dict) -> str:

@@ -24,6 +24,7 @@ rollback targets.  Mechanics mirrored from the reference:
 from __future__ import annotations
 
 import copy
+import threading
 from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
@@ -81,19 +82,41 @@ def _revision_labels(fed_obj: dict) -> dict[str, str]:
 
 
 class RevisionManager:
-    """Host-side ControllerRevision bookkeeping for one FTC."""
+    """Host-side ControllerRevision bookkeeping for one FTC.
+
+    Revisions are indexed by owner uid from a watch (the informer-indexer
+    pattern): without it every sync reconcile would scan the whole
+    ControllerRevision store — O(objects^2) work per settled batch."""
 
     def __init__(self, host: FakeKube):
         self.host = host
+        self._lock = threading.Lock()
+        self._by_uid: dict[str, set[str]] = {}
+        host.watch(CONTROLLER_REVISIONS, self._on_revision_event, replay=True)
+
+    def _on_revision_event(self, event: str, obj: dict) -> None:
+        uid = obj.get("metadata", {}).get("labels", {}).get(UID_LABEL)
+        if uid is None:
+            return
+        ns = obj["metadata"].get("namespace", "")
+        name = obj["metadata"]["name"]
+        key = f"{ns}/{name}" if ns else name
+        with self._lock:
+            if event == "DELETED":
+                self._by_uid.get(uid, set()).discard(key)
+            else:
+                self._by_uid.setdefault(uid, set()).add(key)
 
     def _list_owned(self, fed_obj: dict) -> list[dict]:
         uid = str(fed_obj["metadata"].get("uid", ""))
-        ns = fed_obj["metadata"].get("namespace", "")
-        return self.host.list(
-            CONTROLLER_REVISIONS,
-            namespace=ns or None,
-            label_selector={UID_LABEL: uid},
-        )
+        with self._lock:
+            keys = sorted(self._by_uid.get(uid, ()))
+        out = []
+        for key in keys:
+            obj = self.host.try_get(CONTROLLER_REVISIONS, key)
+            if obj is not None:
+                out.append(obj)
+        return out
 
     def sync_revisions(self, fed_obj: dict) -> tuple[int, str, str]:
         """Record the current template; returns (collisionCount,

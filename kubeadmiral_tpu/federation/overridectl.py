@@ -184,9 +184,10 @@ class OverrideController:
 
     def _placed_clusters(self, fed_obj: dict) -> list[dict]:
         placed = C.all_placement_clusters(fed_obj)
+        # list_view: read-only matching, no mutation/retention.
         return [
             c
-            for c in self.host.list(C.FEDERATED_CLUSTERS)
+            for c in self.host.list_view(C.FEDERATED_CLUSTERS)
             if c["metadata"]["name"] in placed
         ]
 

@@ -202,7 +202,8 @@ class SchedulerController:
     # -- reconcile -------------------------------------------------------
     def _clusters(self) -> list[T.ClusterState]:
         out = []
-        for obj in self.host.list(FEDERATED_CLUSTERS):
+        # list_view: cluster_state_from_object copies what it keeps.
+        for obj in self.host.list_view(FEDERATED_CLUSTERS):
             state = cluster_state_from_object(obj)
             if state is not None:
                 out.append(state)
@@ -226,11 +227,27 @@ class SchedulerController:
         obj = self.host.try_get(PR.SCHEDULING_PROFILES, policy.scheduling_profile)
         return PR.parse_profile(obj) if obj else None
 
+    @staticmethod
+    def _clusters_hash(clusters) -> str:
+        """One hash of the scheduling-relevant cluster state, shared by
+        every object in a batch: hashing the full cluster list per object
+        would be O(objects x clusters) JSON work per tick."""
+        return str(
+            stable_json_hash(
+                [
+                    [c.name, sorted(c.labels.items()),
+                     [[t.key, t.value, t.effect] for t in c.taints],
+                     sorted(c.api_resources)]
+                    for c in clusters
+                ]
+            )
+        )
+
     def _trigger_hash(
         self,
         fed_obj: dict,
         policy: P.PolicySpec,
-        clusters,
+        clusters_hash: str,
         profile: Optional[PR.ProfileSpec] = None,
     ) -> str:
         ann = fed_obj["metadata"].get("annotations", {})
@@ -259,12 +276,7 @@ class SchedulerController:
             "autoMigration": ann.get(C.AUTO_MIGRATION_INFO)
             if policy.auto_migration_enabled
             else None,
-            "clusters": [
-                [c.name, sorted(c.labels.items()),
-                 [[t.key, t.value, t.effect] for t in c.taints],
-                 sorted(c.api_resources)]
-                for c in clusters
-            ],
+            "clusters": clusters_hash,
         }
         return str(stable_json_hash(trigger))
 
@@ -377,6 +389,7 @@ class SchedulerController:
     def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
         results: dict[str, Result] = {}
         clusters = self._clusters()
+        clusters_hash = self._clusters_hash(clusters)
         # One profile lookup per distinct name per batch, not per object.
         profile_memo: dict[str, Optional[PR.ProfileSpec]] = {}
 
@@ -419,7 +432,7 @@ class SchedulerController:
                     results[key] = Result.ok()
                     continue
                 profile = profile_for(policy)
-                trigger = self._trigger_hash(fed_obj, policy, clusters, profile)
+                trigger = self._trigger_hash(fed_obj, policy, clusters_hash, profile)
                 if fed_obj["metadata"].get("annotations", {}).get(C.SCHEDULING_TRIGGER_HASH) == trigger:
                     # Skip scheduling, but still advance the pipeline:
                     # template-only changes re-arm pending-controllers

@@ -17,6 +17,7 @@ to the user:
 
 from __future__ import annotations
 
+import copy
 from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
@@ -138,7 +139,10 @@ class StatusController:
                 entry["error"] = "cluster unavailable"
                 out.append(entry)
                 continue
-            obj = member.try_get(self._target_resource, key)
+            # View read: only the collected fields are retained, deep-
+            # copied below (copying whole member objects per cluster per
+            # round dominated status collection at scale).
+            obj = member.try_get_view(self._target_resource, key)
             if obj is None:
                 continue  # not propagated yet: skip silently
             collected: dict = {}
@@ -146,7 +150,7 @@ class StatusController:
                 value = get_path(obj, field)
                 if value is None:
                     continue
-                set_path(collected, field, value)
+                set_path(collected, field, copy.deepcopy(value))
             entry["collectedFields"] = collected
             out.append(entry)
         return out
@@ -394,7 +398,9 @@ class StatusAggregator:
             except NotFound:
                 up_to_date = False
                 continue
-            obj = member.try_get(self._target_resource, key)
+            # View read: aggregation plugins only read fields; any status
+            # they return is deep-copied by the store on write.
+            obj = member.try_get_view(self._target_resource, key)
             if obj is None:
                 up_to_date = False
                 continue

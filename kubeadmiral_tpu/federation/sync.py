@@ -122,6 +122,14 @@ class SyncController:
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=True)
 
+    def watch_owners(self) -> list[object]:
+        """Everything holding watch registrations on this controller's
+        behalf (consumed by the manager's dynamic teardown)."""
+        owners: list[object] = [self]
+        if self.revisions is not None:
+            owners.append(self.revisions)
+        return owners
+
     # -- event fan-in ----------------------------------------------------
     def _on_fed_event(self, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
@@ -265,7 +273,8 @@ class SyncController:
     def _sync_to_clusters(
         self, fed: FederatedResource, collision_count: Optional[int] = None
     ) -> Result:
-        clusters = self.host.list(FEDERATED_CLUSTERS)
+        # list_view: read-only fan-out, no mutation/retention of the dicts.
+        clusters = self.host.list_view(FEDERATED_CLUSTERS)
         joined = [c for c in clusters if is_cluster_joined(c)]
         selected = fed.compute_placement([c["metadata"]["name"] for c in joined])
 
@@ -606,7 +615,11 @@ class SyncController:
         return self._remove_finalizer(fed)
 
     def _joined_members(self) -> list[dict]:
-        return [c for c in self.host.list(FEDERATED_CLUSTERS) if is_cluster_joined(c)]
+        return [
+            c
+            for c in self.host.list_view(FEDERATED_CLUSTERS)
+            if is_cluster_joined(c)
+        ]
 
     def _delete_from_clusters(self, fed: FederatedResource) -> Optional[list[str]]:
         """Returns clusters still holding the object, or None on failure

@@ -18,6 +18,11 @@ Handler = Callable[[str, dict], None]
 
 
 class Informer:
+    """Cache entries are SHARED dicts (the same snapshot the store hands
+    every watcher): handlers and ``get()``/``list()`` consumers must not
+    mutate them — copy anything you modify or retain, exactly as with
+    real informer caches."""
+
     def __init__(self, kube: FakeKube, resource: str):
         self.kube = kube
         self.resource = resource

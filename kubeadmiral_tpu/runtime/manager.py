@@ -199,10 +199,15 @@ class ControllerManager:
 
     def _teardown(self, controller) -> None:
         """Fully release a dynamically stopped controller: worker
-        threads, watch registrations, dispatch pools."""
+        threads, watch registrations, dispatch pools.  Controllers with
+        watch-holding sub-objects expose them via ``watch_owners()``
+        (the generic contract; hardcoding attribute names here would
+        silently leak the next sub-indexer's watches)."""
         for worker in self._workers_of(controller):
             worker.stop()
-        self.fleet.unwatch_owner(controller)
+        owners = getattr(controller, "watch_owners", None)
+        for owner in owners() if owners is not None else (controller,):
+            self.fleet.unwatch_owner(owner)
         pool = getattr(controller, "pool", None)
         if pool is not None:
             pool.shutdown(wait=False)

@@ -73,10 +73,17 @@ class FakeKube:
         return self._objects.setdefault(resource, {})
 
     def _notify(self, resource: str, event: str, obj: dict) -> None:
-        for handler in list(self._watchers.get(resource, ())) + list(
+        handlers = list(self._watchers.get(resource, ())) + list(
             self._watchers.get("*", ())
-        ):
-            handler(event, copy.deepcopy(obj))
+        )
+        if not handlers:
+            return
+        # ONE snapshot shared by every handler: with a dozen controllers
+        # watching, per-handler deep copies dominate the control plane's
+        # host time at scale.  Handlers must not mutate delivered objects.
+        snapshot = copy.deepcopy(obj)
+        for handler in handlers:
+            handler(event, snapshot)
 
     # -- CRUD ------------------------------------------------------------
     def create(self, resource: str, obj: dict) -> dict:
@@ -110,6 +117,14 @@ class FakeKube:
             return self.get(resource, key)
         except NotFound:
             return None
+
+    def try_get_view(self, resource: str, key: str) -> Optional[dict]:
+        """Read WITHOUT deep-copying — for hot read-only paths.  Callers
+        must not mutate the dict and must copy anything they retain
+        (every store write deep-copies on entry, so short-lived aliasing
+        is safe)."""
+        with self._lock:
+            return self._store(resource).get(key)
 
     def update(self, resource: str, obj: dict) -> dict:
         """Full-object update with optimistic concurrency; removing the
@@ -174,8 +189,12 @@ class FakeKube:
             obj = store[key]
             if obj["metadata"].get("finalizers"):
                 if not obj["metadata"].get("deletionTimestamp"):
+                    # Replace, don't mutate in place: view readers
+                    # (try_get_view/list_view) may hold the old dict.
+                    obj = copy.deepcopy(obj)
                     obj["metadata"]["deletionTimestamp"] = "now"
                     obj["metadata"]["resourceVersion"] = self._bump()
+                    store[key] = obj
                     self._notify(resource, MODIFIED, obj)
                 return
             del store[key]
@@ -188,8 +207,24 @@ class FakeKube:
         label_selector: Optional[dict[str, str]] = None,
     ) -> list[dict]:
         with self._lock:
+            return [
+                copy.deepcopy(obj)
+                for obj in self.list_view(resource, namespace, label_selector)
+            ]
+
+    def list_view(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[dict]:
+        """Like :meth:`list` but WITHOUT deep-copying — the cheap path
+        for hot read-only fan-outs (cluster sets, policy matching).
+        Callers must not mutate or retain the returned dicts, the same
+        contract as :meth:`scan`."""
+        with self._lock:
             out = []
-            for key, obj in self._store(resource).items():
+            for obj in self._store(resource).values():
                 if namespace is not None:
                     if obj["metadata"].get("namespace", "") != namespace:
                         continue
@@ -197,7 +232,7 @@ class FakeKube:
                     labels = obj["metadata"].get("labels", {})
                     if any(labels.get(k) != v for k, v in label_selector.items()):
                         continue
-                out.append(copy.deepcopy(obj))
+                out.append(obj)
             return out
 
     def keys(self, resource: str) -> list[str]:
