@@ -36,5 +36,22 @@ import jax as _jax
 # is deliberate.
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: the fused scheduling tick's compile
+# time grows steeply with the cluster axis (26s at C=512, ~2min at
+# C=1024 on the tunneled backend) while the compiled program is
+# millisecond-fast; the on-disk cache makes that a one-time cost per
+# shape per machine.  JAX reads JAX_COMPILATION_CACHE_DIR natively and
+# an explicit app/env setting wins — only the unset default is filled.
+try:
+    if _jax.config.jax_compilation_cache_dir is None:
+        import os as _os
+
+        _jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.path.expanduser("~/.cache/kubeadmiral_tpu/xla-cache"),
+        )
+except Exception:  # older jax without the option
+    pass
+
 __version__ = "0.1.0"
 
