@@ -2,18 +2,39 @@
 
 Tests run on a virtual 8-device CPU platform so that multi-chip sharding
 (jax.sharding.Mesh over objects x clusters) is exercised without TPU
-hardware, mirroring how the driver dry-runs the multichip path.  The env
-vars must be set before jax is first imported anywhere.
+hardware, mirroring how the driver dry-runs the multichip path.
+
+The environment pre-imports jax at interpreter startup, so setting
+JAX_PLATFORMS via os.environ here is too late — jax's config binds it at
+import time.  Backends, however, initialize lazily (at the first
+jax.devices()/dispatch), so `jax.config.update` plus an XLA_FLAGS env
+update still take effect as long as they run before any test touches a
+device.  Force, don't defer: the ambient environment pins JAX_PLATFORMS
+to the real TPU backend, and concurrent test runs would serialize (and
+block) on the single tunneled chip.
 """
 
 import os
+import re
 
-# Force, don't setdefault: the ambient environment pins JAX_PLATFORMS to
-# the real TPU backend, and concurrent test runs would serialize (and
-# block) on the single chip.  Tests always run on the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+match = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+if match and int(match.group(1)) >= 8:
+    pass  # respect a larger ambient mesh
+elif match:
+    os.environ["XLA_FLAGS"] = flags.replace(
+        match.group(0), "--xla_force_host_platform_device_count=8"
+    )
+else:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, (
+    "virtual CPU mesh unavailable: jax backends were initialized before "
+    f"conftest ran (devices={jax.devices()})"
+)
