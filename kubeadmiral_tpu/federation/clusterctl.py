@@ -302,7 +302,17 @@ class FederatedClusterController:
                 )
             except AlreadyExists:
                 pass
-        token = f"token-{name}-{uid}"
+        # Real members (kwok-lite HTTP apiservers) mint a token secret
+        # for the new service account — prefer it, as the reference does
+        # (clusterjoin.go:449-529 waits for the SA token secret).  Bare
+        # FakeKube members have no token controller; fall back to a
+        # deterministic synthetic token.
+        sa_token_secret = member.try_get(
+            SECRETS, f"{FED_SYSTEM_NAMESPACE}/{sa_name}-token"
+        )
+        token = (sa_token_secret or {}).get("data", {}).get(
+            "token"
+        ) or f"token-{name}-{uid}"
         secret_name = cluster.get("spec", {}).get("secretRef", {}).get(
             "name"
         ) or f"{name}-secret"

@@ -51,6 +51,16 @@ def split_key(key: str) -> tuple[str, str]:
     return "", key
 
 
+def handler_owner(handler: Handler) -> Optional[object]:
+    """The instance a handler is bound to (directly, or through a
+    functools.partial of a bound method) — shared by every transport's
+    unwatch_owner."""
+    owner = getattr(handler, "__self__", None)
+    if owner is not None:
+        return owner
+    return getattr(getattr(handler, "func", None), "__self__", None)
+
+
 class FakeKube:
     """One apiserver (host or member cluster)."""
 
@@ -62,6 +72,7 @@ class FakeKube:
         self._lock = threading.RLock()
         self._objects: dict[str, dict[str, dict]] = {}  # resource -> key -> obj
         self._watchers: dict[str, list[Handler]] = {}
+        self._all_watchers: list[Callable[[str, str, dict, int], None]] = []
         self._rv = 0
 
     # -- helpers ---------------------------------------------------------
@@ -76,7 +87,7 @@ class FakeKube:
         handlers = list(self._watchers.get(resource, ())) + list(
             self._watchers.get("*", ())
         )
-        if not handlers:
+        if not handlers and not self._all_watchers:
             return
         # ONE snapshot shared by every handler: with a dozen controllers
         # watching, per-handler deep copies dominate the control plane's
@@ -84,6 +95,8 @@ class FakeKube:
         snapshot = copy.deepcopy(obj)
         for handler in handlers:
             handler(event, snapshot)
+        for observer in self._all_watchers:
+            observer(resource, event, snapshot, self._rv)
 
     # -- CRUD ------------------------------------------------------------
     def create(self, resource: str, obj: dict) -> dict:
@@ -198,6 +211,11 @@ class FakeKube:
                     self._notify(resource, MODIFIED, obj)
                 return
             del store[key]
+            # Like etcd, deletion advances the revision: the DELETED
+            # event must carry a resourceVersion newer than any previous
+            # event or watch-resume cursors would skip it.
+            obj = copy.deepcopy(obj)
+            obj["metadata"]["resourceVersion"] = self._bump()
             self._notify(resource, DELETED, obj)
 
     def list(
@@ -235,6 +253,17 @@ class FakeKube:
                 out.append(obj)
             return out
 
+    def list_with_rv(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> tuple[list[dict], int]:
+        """Atomic (items, resourceVersion) snapshot — what a LIST
+        response needs so a subsequent watch can resume without a gap."""
+        with self._lock:
+            return self.list(resource, namespace, label_selector), self._rv
+
     def keys(self, resource: str) -> list[str]:
         with self._lock:
             return list(self._store(resource))
@@ -257,20 +286,26 @@ class FakeKube:
                 for obj in self._store(resource).values():
                     handler(ADDED, copy.deepcopy(obj))
 
+    def watch_all(
+        self, observer: Callable[[str, str, dict, int], None]
+    ) -> None:
+        """Register a cross-resource observer, called under the store
+        lock as ``observer(resource, event, obj, seq)`` where ``seq`` is
+        the store's monotonic resourceVersion counter at notify time.
+        This is the apiserver's event-log feed; observers must be fast
+        and must not mutate ``obj``."""
+        with self._lock:
+            self._all_watchers.append(observer)
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
     def unwatch(self, resource: str, handler: Handler) -> None:
         with self._lock:
             handlers = self._watchers.get(resource, [])
             if handler in handlers:
                 handlers.remove(handler)
-
-    @staticmethod
-    def _handler_owner(handler: Handler) -> Optional[object]:
-        """The instance a handler is bound to (directly, or through a
-        functools.partial of a bound method)."""
-        owner = getattr(handler, "__self__", None)
-        if owner is not None:
-            return owner
-        return getattr(getattr(handler, "func", None), "__self__", None)
 
     def unwatch_owner(self, owner: object) -> None:
         """Remove every handler owned by ``owner`` — how a dynamically
@@ -279,7 +314,7 @@ class FakeKube:
         with self._lock:
             for handlers in self._watchers.values():
                 handlers[:] = [
-                    h for h in handlers if self._handler_owner(h) is not owner
+                    h for h in handlers if handler_owner(h) is not owner
                 ]
 
 

@@ -1,0 +1,81 @@
+"""kwok-lite: a multi-cluster farm of real HTTP apiservers.
+
+Plays the role kwokctl plays in the reference's e2e suite (reference:
+test/e2e/framework/clusterprovider/kwokprovider.go:70-260): provisions a
+host apiserver plus N member apiservers — real sockets, auth, watches —
+without real kubelets.  Member servers mint service-account tokens (the
+piece of the cluster-join handshake a bare store can't provide), and
+each member gets a bootstrap join secret on the host carrying its admin
+token, mirroring how a kubeadmiral operator seeds cluster credentials
+before the join handshake upgrades them to a service-account token.
+"""
+
+from __future__ import annotations
+
+import secrets as pysecrets
+
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+from kubeadmiral_tpu.transport.client import (
+    FED_SYSTEM_NAMESPACE,
+    SECRETS,
+    HttpFleet,
+    HttpKube,
+)
+
+
+class KwokLiteFarm:
+    """Host + member apiservers on localhost ports.
+
+    ``fleet`` exposes the ClusterFleet interface (host client + join-
+    secret-derived member clients) so controllers run over it unmodified.
+    """
+
+    def __init__(self, host_token: str | None = None):
+        self.host_store = FakeKube("host")
+        self.host_server = KubeApiServer(self.host_store, admin_token=host_token)
+        self.host = HttpKube(self.host_server.url, token=host_token, name="host")
+        self.fleet = HttpFleet(self.host)
+        self.member_servers: dict[str, KubeApiServer] = {}
+        self._extra_clients: list[HttpKube] = []
+
+    def endpoint(self, name: str) -> str:
+        return self.member_servers[name].url
+
+    def cluster_spec(self, name: str) -> dict:
+        """The FederatedCluster spec fields pointing at this member."""
+        return {
+            "apiEndpoint": self.endpoint(name),
+            "secretRef": {"name": f"{name}-secret"},
+        }
+
+    def add_member(self, name: str) -> HttpKube:
+        """Provision a member apiserver + bootstrap join secret; returns
+        an admin client for test setup writes."""
+        admin_token = f"admin-{name}-{pysecrets.token_hex(8)}"
+        store = FakeKube(name)
+        server = KubeApiServer(store, admin_token=admin_token, mint_sa_tokens=True)
+        self.member_servers[name] = server
+        self.host.create(
+            SECRETS,
+            {
+                "apiVersion": "v1",
+                "kind": "Secret",
+                "metadata": {
+                    "name": f"{name}-secret",
+                    "namespace": FED_SYSTEM_NAMESPACE,
+                },
+                "data": {"token": admin_token},
+            },
+        )
+        client = HttpKube(server.url, token=admin_token, name=name)
+        self._extra_clients.append(client)
+        return client
+
+    def close(self) -> None:
+        for client in self._extra_clients:
+            client.close()
+        self.fleet.close()
+        for server in self.member_servers.values():
+            server.close()
+        self.host_server.close()

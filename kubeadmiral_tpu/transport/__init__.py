@@ -1,0 +1,16 @@
+"""Real HTTP transport for the control plane.
+
+The controllers speak to apiservers through a small duck-typed seam
+(create/get/update/update_status/delete/list/watch + view reads) defined
+by :mod:`kubeadmiral_tpu.testing.fakekube`.  This package provides the
+real-network implementation of that seam:
+
+* :mod:`kubeadmiral_tpu.transport.apiserver` — an HTTP apiserver serving
+  a store over Kubernetes-style REST paths with chunked watch streams,
+  optimistic concurrency, status subresources and bearer-token auth.
+* :mod:`kubeadmiral_tpu.transport.client` — the HTTP client implementing
+  the same interface as FakeKube, per-member clients built from
+  FederatedCluster join secrets (the FederatedClientFactory analogue;
+  reference: pkg/controllers/util/federatedclient/client.go:48-386),
+  and an HttpFleet the controller manager can run over unmodified.
+"""

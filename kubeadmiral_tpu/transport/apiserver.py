@@ -1,0 +1,409 @@
+"""HTTP apiserver: Kubernetes-style REST + watch over a FakeKube store.
+
+This is the server half of the real transport (SURVEY.md §2.2 "generated
+clients / apiserver transport"): it serves a :class:`FakeKube` store —
+which already implements the semantics the control plane depends on
+(optimistic concurrency, finalizer-gated deletion, generation bumps,
+status subresource) — over real sockets with the protocol shape of an
+apiserver:
+
+* ``GET/POST/PUT/DELETE`` on ``/api/...`` / ``/apis/...`` paths
+  (:mod:`kubeadmiral_tpu.transport.paths`), JSON bodies, k8s-style
+  ``Status`` error objects with ``reason`` Conflict/NotFound/AlreadyExists.
+* ``GET ...?watch=true&resourceVersion=N`` — chunked-transfer watch
+  stream of ``{"type": ..., "object": ...}`` lines resuming after N,
+  backed by a bounded per-resource event log; a too-old N gets 410 Gone
+  and the client must relist (exactly client-go's contract).
+* ``PUT .../{name}/status`` — status subresource.
+* ``GET /healthz`` — respects ``store.healthy`` so tests can fail probes.
+* Optional bearer-token auth: an admin token plus any service-account
+  token minted by the server (see ``mint_sa_tokens``), which is how the
+  cluster-join handshake's credentials become real
+  (reference: pkg/controllers/federatedcluster/clusterjoin.go:241-580).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import secrets as pysecrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlsplit
+
+from kubeadmiral_tpu.testing.fakekube import (
+    ADDED,
+    AlreadyExists,
+    Conflict,
+    FakeKube,
+    NotFound,
+)
+from kubeadmiral_tpu.transport.paths import parse_path
+
+SERVICE_ACCOUNTS = "v1/serviceaccounts"
+SECRETS = "v1/secrets"
+
+# Watch streams send a heartbeat line when idle so dead peers are
+# detected; clients ignore it (k8s uses BOOKMARK events similarly).
+HEARTBEAT = b'{"type":"HEARTBEAT"}\n'
+
+
+class _ResourceLog:
+    """One resource's event history: parallel (seqs, lines) lists with
+    front-eviction by compaction, so resume is a bisect + slice instead
+    of an O(cap) scan per watcher wakeup."""
+
+    __slots__ = ("seqs", "lines", "evicted")
+
+    def __init__(self):
+        self.seqs: list[int] = []
+        self.lines: list[bytes] = []
+        self.evicted = False
+
+
+class _EventLog:
+    """Per-resource bounded event logs with resourceVersion resume."""
+
+    def __init__(self, cap: int = 100_000):
+        self.cap = cap
+        self.cond = threading.Condition()
+        self.logs: dict[str, _ResourceLog] = {}
+
+    def append(self, resource: str, event: str, obj: dict, seq: int) -> None:
+        line = json.dumps({"type": event, "object": obj}).encode() + b"\n"
+        with self.cond:
+            log = self.logs.setdefault(resource, _ResourceLog())
+            log.seqs.append(seq)
+            log.lines.append(line)
+            if len(log.seqs) > 2 * self.cap:  # amortized O(1) eviction
+                drop = len(log.seqs) - self.cap
+                del log.seqs[:drop]
+                del log.lines[:drop]
+                log.evicted = True
+            self.cond.notify_all()
+
+    def since(self, resource: str, rv: int) -> tuple[Optional[list[bytes]], int]:
+        """(lines after rv, latest seq); lines is None when rv is too old
+        (already evicted from the log) and the watcher must relist."""
+        with self.cond:
+            log = self.logs.get(resource)
+            if log is None or not log.seqs:
+                return [], rv
+            latest = log.seqs[-1]
+            if log.evicted and rv < log.seqs[0] - 1:
+                return None, latest  # history truncated: 410 Gone
+            idx = bisect.bisect_right(log.seqs, rv)
+            return log.lines[idx:], latest
+
+
+class KubeApiServer:
+    """One apiserver process-equivalent serving ``store`` on localhost."""
+
+    def __init__(
+        self,
+        store: FakeKube,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        admin_token: Optional[str] = None,
+        mint_sa_tokens: bool = False,
+        event_log_cap: int = 100_000,
+    ):
+        self.store = store
+        self.admin_token = admin_token
+        self._tokens: set[str] = set()
+        self._log = _EventLog(event_log_cap)
+        self._closed = threading.Event()
+        self._mint_sa_tokens = mint_sa_tokens
+
+        # Seed accepted tokens from pre-existing secrets, then track via
+        # the event feed (under the store lock, so no races with auth).
+        if admin_token is not None:
+            for secret in store.list_view(SECRETS):
+                token = (secret.get("data") or {}).get("token")
+                if token:
+                    self._tokens.add(token)
+        store.watch_all(self._on_store_event)
+
+        server = ThreadingHTTPServer((host, port), _Handler)
+        server.daemon_threads = True
+        server.api = self  # type: ignore[attr-defined]
+        self._server = server
+        self.host = host
+        self.port = server.server_address[1]
+        self.url = f"http://{self.host}:{self.port}"
+        self._thread = threading.Thread(
+            target=server.serve_forever, name=f"apiserver-{store.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- store event feed (runs under the store lock) --------------------
+    def _on_store_event(self, resource: str, event: str, obj: dict, seq: int) -> None:
+        self._log.append(resource, event, obj, seq)
+        if resource != SECRETS:
+            if self._mint_sa_tokens and resource == SERVICE_ACCOUNTS and event == ADDED:
+                self._mint_token(obj)
+            return
+        token = (obj.get("data") or {}).get("token")
+        if token:
+            if event == "DELETED":
+                self._tokens.discard(token)
+            else:
+                self._tokens.add(token)
+
+    def _mint_token(self, sa: dict) -> None:
+        """Create a token Secret for a new ServiceAccount — the member-
+        side token controller the join handshake waits on (the reference
+        reads the SA's token secret, clusterjoin.go:449-529)."""
+        meta = sa["metadata"]
+        try:
+            self.store.create(
+                SECRETS,
+                {
+                    "apiVersion": "v1",
+                    "kind": "Secret",
+                    "type": "kubernetes.io/service-account-token",
+                    "metadata": {
+                        "name": f"{meta['name']}-token",
+                        "namespace": meta.get("namespace", ""),
+                        "annotations": {
+                            "kubernetes.io/service-account.name": meta["name"]
+                        },
+                    },
+                    "data": {"token": pysecrets.token_hex(16)},
+                },
+            )
+        except AlreadyExists:
+            pass
+
+    # -- auth ------------------------------------------------------------
+    def authorized(self, header: Optional[str]) -> bool:
+        if self.admin_token is None:
+            return True
+        if not header or not header.startswith("Bearer "):
+            return False
+        token = header[len("Bearer "):]
+        return token == self.admin_token or token in self._tokens
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._log.cond:
+            self._log.cond.notify_all()  # release idle watch streams
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def api(self) -> KubeApiServer:
+        return self.server.api  # type: ignore[attr-defined]
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+    # -- plumbing --------------------------------------------------------
+    def _send_json(self, code: int, payload: dict, extra: Optional[dict] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_status(self, code: int, reason: str, message: str) -> None:
+        self._send_json(
+            code,
+            {
+                "kind": "Status",
+                "apiVersion": "v1",
+                "status": "Failure",
+                "reason": reason,
+                "message": message,
+                "code": code,
+            },
+        )
+
+    def _read_body(self) -> Optional[dict]:
+        length = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(length) if length else b""
+        if not data:
+            return {}
+        try:
+            return json.loads(data)
+        except ValueError:
+            return None
+
+    def _route(self):
+        split = urlsplit(self.path)
+        if split.path == "/healthz":
+            return None
+        parsed = parse_path(split.path)
+        query = {k: v[-1] for k, v in parse_qs(split.query).items()}
+        return parsed, query
+
+    def _object_key(self, parsed) -> str:
+        return f"{parsed.namespace}/{parsed.name}" if parsed.namespace else parsed.name
+
+    # -- verbs -----------------------------------------------------------
+    def do_GET(self):
+        split = urlsplit(self.path)
+        if split.path == "/healthz":
+            if self.api.store.healthy:
+                self._send_json(200, {"status": "ok"})
+            else:
+                self._send_status(500, "InternalError", "unhealthy")
+            return
+        if not self._check_auth():
+            return
+        try:
+            parsed, query = self._route()
+        except ValueError as e:
+            self._send_status(404, "NotFound", str(e))
+            return
+        try:
+            if parsed.name is None:
+                if query.get("watch") in ("true", "1"):
+                    self._serve_watch(parsed.resource, int(query.get("resourceVersion", 0)))
+                else:
+                    self._serve_list(parsed, query)
+            else:
+                obj = self.api.store.get(parsed.resource, self._object_key(parsed))
+                self._send_json(200, obj)
+        except NotFound as e:
+            self._send_status(404, "NotFound", str(e))
+
+    def do_POST(self):
+        # Drain the body before any error response: leftover body bytes
+        # would be parsed as the next request line on this keep-alive
+        # connection, corrupting the client's pooled connection.
+        obj = self._read_body()
+        if not self._check_auth():
+            return
+        if obj is None:
+            self._send_status(400, "BadRequest", "invalid JSON body")
+            return
+        try:
+            parsed, _ = self._route()
+        except ValueError as e:
+            self._send_status(404, "NotFound", str(e))
+            return
+        if parsed.namespace:
+            obj.setdefault("metadata", {}).setdefault("namespace", parsed.namespace)
+        try:
+            created = self.api.store.create(parsed.resource, obj)
+            self._send_json(201, created)
+        except AlreadyExists as e:
+            self._send_status(409, "AlreadyExists", str(e))
+
+    def do_PUT(self):
+        obj = self._read_body()  # drain before any error response
+        if not self._check_auth():
+            return
+        if obj is None:
+            self._send_status(400, "BadRequest", "invalid JSON body")
+            return
+        try:
+            parsed, _ = self._route()
+        except ValueError as e:
+            self._send_status(404, "NotFound", str(e))
+            return
+        store = self.api.store
+        try:
+            if parsed.subresource == "status":
+                updated = store.update_status(parsed.resource, obj)
+            elif parsed.subresource is None:
+                updated = store.update(parsed.resource, obj)
+            else:
+                self._send_status(404, "NotFound", f"subresource {parsed.subresource}")
+                return
+            self._send_json(200, updated)
+        except Conflict as e:
+            self._send_status(409, "Conflict", str(e))
+        except NotFound as e:
+            self._send_status(404, "NotFound", str(e))
+
+    def do_DELETE(self):
+        if not self._check_auth():
+            return
+        try:
+            parsed, _ = self._route()
+        except ValueError as e:
+            self._send_status(404, "NotFound", str(e))
+            return
+        try:
+            self.api.store.delete(parsed.resource, self._object_key(parsed))
+            self._send_json(200, {"kind": "Status", "status": "Success"})
+        except NotFound as e:
+            self._send_status(404, "NotFound", str(e))
+
+    def _check_auth(self) -> bool:
+        if self.api.authorized(self.headers.get("Authorization")):
+            return True
+        self._send_status(401, "Unauthorized", "invalid bearer token")
+        return False
+
+    # -- list + watch ----------------------------------------------------
+    def _serve_list(self, parsed, query) -> None:
+        selector = None
+        if "labelSelector" in query:
+            selector = dict(
+                part.split("=", 1)
+                for part in query["labelSelector"].split(",")
+                if "=" in part
+            )
+        items, rv = self.api.store.list_with_rv(
+            parsed.resource, parsed.namespace or None, selector
+        )
+        self._send_json(
+            200,
+            {"kind": "List", "items": items, "metadata": {"resourceVersion": str(rv)}},
+            extra={"X-Resource-Version": str(rv)},
+        )
+
+    def _serve_watch(self, resource: str, since_rv: int) -> None:
+        log = self.api._log
+        lines, cursor = log.since(resource, since_rv)
+        if lines is None:
+            self._send_status(410, "Expired", f"resourceVersion {since_rv} is too old")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while not self.api._closed.is_set():
+                heartbeat = False
+                for line in lines:
+                    self._write_chunk(line)
+                # cursor from since() is the latest logged seq at query
+                # time, i.e. the resume point after the lines just sent.
+                with log.cond:
+                    while True:
+                        if self.api._closed.is_set():
+                            return
+                        lines, cursor = log.since(resource, cursor)
+                        if lines is None:
+                            return  # truncated under us: client relists
+                        if lines:
+                            break
+                        if not log.cond.wait(timeout=15.0):
+                            heartbeat = True
+                            break
+                if heartbeat:
+                    self._write_chunk(HEARTBEAT)
+        except (BrokenPipeError, ConnectionResetError):
+            return
+        finally:
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+    def _write_chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()

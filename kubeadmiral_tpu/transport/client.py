@@ -1,0 +1,436 @@
+"""HTTP client implementing the FakeKube seam over real sockets.
+
+:class:`HttpKube` is interface-compatible with
+:class:`kubeadmiral_tpu.testing.fakekube.FakeKube` — the same CRUD +
+watch + view-read surface every controller is written against — so the
+whole control plane runs over a real apiserver unmodified.
+
+Watches are LIST+WATCH: one streaming connection per watched resource
+(shared by all handlers via a mux), resuming from the list's
+resourceVersion, relisting on 410 Gone, reconnecting with backoff on
+connection loss.  This is the client-go reflector loop
+(reference: pkg/controllers/util/federatedinformer.go:151-250).
+
+:class:`FederatedClientFactory` builds per-member clients from
+FederatedCluster ``spec.apiEndpoint`` + the join secret's token
+(reference: pkg/controllers/util/federatedclient/client.go:48-386), and
+:class:`HttpFleet` exposes the ClusterFleet interface over it.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Callable, Optional
+from urllib.parse import urlsplit
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.testing.fakekube import (
+    ADDED,
+    DELETED,
+    AlreadyExists,
+    Conflict,
+    Handler,
+    NotFound,
+    handler_owner,
+    obj_key as _obj_key,
+)
+from kubeadmiral_tpu.transport.paths import key_to_path, resource_to_path
+
+# Mirrors clusterctl.FED_SYSTEM_NAMESPACE (kept literal to avoid a
+# transport -> federation.clusterctl import cycle).
+FED_SYSTEM_NAMESPACE = "kube-admiral-system"
+SECRETS = "v1/secrets"
+
+
+class TransportError(Exception):
+    """Connection-level or unexpected-HTTP-status failure."""
+
+
+class Gone(Exception):
+    """410: watch resourceVersion expired — relist."""
+
+
+class HttpKube:
+    """One apiserver client; duck-types FakeKube."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        name: str = "",
+        timeout: float = 10.0,
+    ):
+        split = urlsplit(base_url)
+        self.name = name or split.netloc
+        self._netloc = split.netloc
+        self._token = token
+        self._timeout = timeout
+        self._local = threading.local()
+        self._mux: dict[str, _ResourceWatch] = {}
+        self._mux_lock = threading.Lock()
+        self._closed = threading.Event()
+
+    # -- HTTP plumbing ---------------------------------------------------
+    def _headers(self) -> dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        return headers
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self._netloc, timeout=self._timeout)
+            self._local.conn = conn
+        return conn
+
+    def _request(
+        self, method: str, path: str, body: Optional[dict] = None
+    ) -> tuple[int, dict, dict]:
+        payload = json.dumps(body).encode() if body is not None else None
+        last_err: Optional[Exception] = None
+        for attempt in range(2):
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=payload, headers=self._headers())
+                resp = conn.getresponse()
+                data = resp.read()
+                headers = dict(resp.getheaders())
+                return resp.status, (json.loads(data) if data else {}), headers
+            except (OSError, http.client.HTTPException) as e:
+                # Stale kept-alive connection: drop and retry once.
+                last_err = e
+                conn.close()
+                self._local.conn = None
+        raise TransportError(f"{method} {self._netloc}{path}: {last_err}")
+
+    def _raise_for(self, status: int, payload: dict, context: str):
+        reason = payload.get("reason", "")
+        message = payload.get("message", context)
+        if status == 404:
+            raise NotFound(message)
+        if status == 409 and reason == "AlreadyExists":
+            raise AlreadyExists(message)
+        if status == 409:
+            raise Conflict(message)
+        if status == 410:
+            raise Gone(message)
+        raise TransportError(f"{context}: HTTP {status} {reason} {message}")
+
+    # -- health ----------------------------------------------------------
+    @property
+    def healthy(self) -> bool:
+        try:
+            status, _, _ = self._request("GET", "/healthz")
+            return status == 200
+        except TransportError:
+            return False
+
+    # -- CRUD (the FakeKube seam) ----------------------------------------
+    def create(self, resource: str, obj: dict) -> dict:
+        meta = obj.get("metadata", {})
+        path = resource_to_path(resource, meta.get("namespace") or None)
+        status, payload, _ = self._request("POST", path, obj)
+        if status != 201:
+            self._raise_for(status, payload, f"create {resource}")
+        return payload
+
+    def get(self, resource: str, key: str) -> dict:
+        status, payload, _ = self._request("GET", key_to_path(resource, key))
+        if status != 200:
+            self._raise_for(status, payload, f"get {resource} {key}")
+        return payload
+
+    def try_get(self, resource: str, key: str) -> Optional[dict]:
+        try:
+            return self.get(resource, key)
+        except NotFound:
+            return None
+
+    # View reads have no cache to alias into over HTTP; they are the
+    # same round-trip as their copying counterparts.
+    try_get_view = try_get
+
+    def update(self, resource: str, obj: dict) -> dict:
+        key = _obj_key(obj)
+        status, payload, _ = self._request("PUT", key_to_path(resource, key), obj)
+        if status != 200:
+            self._raise_for(status, payload, f"update {resource} {key}")
+        return payload
+
+    def update_status(self, resource: str, obj: dict) -> dict:
+        key = _obj_key(obj)
+        path = key_to_path(resource, key, subresource="status")
+        status, payload, _ = self._request("PUT", path, obj)
+        if status != 200:
+            self._raise_for(status, payload, f"update_status {resource} {key}")
+        return payload
+
+    def delete(self, resource: str, key: str) -> None:
+        status, payload, _ = self._request("DELETE", key_to_path(resource, key))
+        if status != 200:
+            self._raise_for(status, payload, f"delete {resource} {key}")
+
+    def list(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[dict]:
+        items, _ = self._list_rv(resource, namespace, label_selector)
+        return items
+
+    list_view = list
+
+    def _list_rv(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> tuple[list[dict], int]:
+        path = resource_to_path(resource, namespace or None)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+            path += f"?labelSelector={sel}"
+        status, payload, headers = self._request("GET", path)
+        if status != 200:
+            self._raise_for(status, payload, f"list {resource}")
+        rv = int(headers.get("X-Resource-Version", 0))
+        return payload.get("items", []), rv
+
+    def keys(self, resource: str) -> list[str]:
+        return [_obj_key(obj) for obj in self.list(resource)]
+
+    def scan(self, resource: str, fn: Callable[[dict], None]) -> None:
+        for obj in self.list(resource):
+            fn(obj)
+
+    # -- watch (reflector mux) -------------------------------------------
+    def watch(self, resource: str, handler: Handler, replay: bool = True) -> None:
+        with self._mux_lock:
+            mux = self._mux.get(resource)
+            if mux is None:
+                mux = _ResourceWatch(self, resource)
+                self._mux[resource] = mux
+        mux.add(handler, replay)
+
+    def unwatch(self, resource: str, handler: Handler) -> None:
+        mux = self._mux.get(resource)
+        if mux is not None:
+            mux.remove(handler)
+
+    def unwatch_owner(self, owner: object) -> None:
+        for mux in list(self._mux.values()):
+            mux.remove_owner(owner)
+
+    def close(self) -> None:
+        self._closed.set()
+        for mux in list(self._mux.values()):
+            mux.stop()
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+
+
+class _ResourceWatch:
+    """One streaming watch per resource, fanned out to handlers."""
+
+    def __init__(self, kube: HttpKube, resource: str):
+        self.kube = kube
+        self.resource = resource
+        self._lock = threading.Lock()
+        self._handlers: list[Handler] = []
+        self._known: dict[str, dict] = {}  # stream-thread only
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def add(self, handler: Handler, replay: bool) -> None:
+        # Register BEFORE the replay list: an object created between the
+        # list response and registration would otherwise be dispatched
+        # only to the pre-existing handlers and this one would never see
+        # it.  The cost is possible duplicates (stream event + replay
+        # ADDED), which level-triggered controllers dedupe by key.
+        with self._lock:
+            self._handlers.append(handler)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run,
+                    name=f"watch-{self.kube.name}-{self.resource}",
+                    daemon=True,
+                )
+                self._thread.start()
+        if replay:
+            for obj in self.kube.list(self.resource):
+                handler(ADDED, obj)
+
+    def remove(self, handler: Handler) -> None:
+        with self._lock:
+            if handler in self._handlers:
+                self._handlers.remove(handler)
+
+    def remove_owner(self, owner: object) -> None:
+        with self._lock:
+            self._handlers[:] = [
+                h for h in self._handlers if handler_owner(h) is not owner
+            ]
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _dispatch(self, event: str, obj: dict) -> None:
+        # Track known keys so a relist can synthesize DELETED events for
+        # objects that vanished during a watch gap (client-go's reflector
+        # emits DeletedFinalStateUnknown the same way).
+        key = _obj_key(obj)
+        if event == DELETED:
+            self._known.pop(key, None)
+        else:
+            meta = obj.get("metadata", {})
+            self._known[key] = {
+                "name": meta.get("name"),
+                "namespace": meta.get("namespace", ""),
+            }
+        with self._lock:
+            handlers = list(self._handlers)
+        for handler in handlers:
+            handler(event, obj)
+
+    # -- the reflector loop ---------------------------------------------
+    def _run(self) -> None:
+        rv = 0
+        need_list = True
+        while not self._stop.is_set() and not self.kube._closed.is_set():
+            try:
+                if need_list:
+                    items, rv = self.kube._list_rv(self.resource)
+                    listed = {_obj_key(obj) for obj in items}
+                    for key, meta in list(self._known.items()):
+                        if key not in listed:
+                            self._dispatch(
+                                DELETED,
+                                {"metadata": dict(meta)},
+                            )
+                    for obj in items:
+                        self._dispatch(ADDED, obj)
+                    need_list = False
+                rv = self._stream(rv)
+            except Gone:
+                need_list = True
+            except (TransportError, OSError, http.client.HTTPException, ValueError):
+                time.sleep(0.2)
+
+    def _stream(self, rv: int) -> int:
+        """One watch connection; returns the last seen resourceVersion."""
+        conn = http.client.HTTPConnection(
+            self.kube._netloc, timeout=30.0
+        )
+        try:
+            path = resource_to_path(self.resource) + f"?watch=true&resourceVersion={rv}"
+            conn.request("GET", path, headers=self.kube._headers())
+            resp = conn.getresponse()
+            if resp.status == 410:
+                resp.read()
+                raise Gone(f"watch {self.resource} from {rv}")
+            if resp.status != 200:
+                resp.read()
+                raise TransportError(f"watch {self.resource}: HTTP {resp.status}")
+            while not self._stop.is_set() and not self.kube._closed.is_set():
+                line = resp.readline()
+                if not line:
+                    return rv  # stream closed; reconnect from rv
+                event = json.loads(line)
+                if event.get("type") == "HEARTBEAT":
+                    continue
+                obj = event["object"]
+                obj_rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
+                rv = max(rv, obj_rv)
+                self._dispatch(event["type"], obj)
+            return rv
+        finally:
+            conn.close()
+
+
+class FederatedClientFactory:
+    """Per-member clients from FederatedCluster join secrets."""
+
+    def __init__(self, host, timeout: float = 10.0):
+        self.host = host
+        self.timeout = timeout
+        self._cache: dict[tuple[str, str], HttpKube] = {}
+        self._lock = threading.Lock()
+
+    def client_for(self, cluster: dict) -> HttpKube:
+        name = cluster["metadata"]["name"]
+        spec = cluster.get("spec", {})
+        endpoint = spec.get("apiEndpoint")
+        if not endpoint:
+            raise NotFound(f"cluster {name} has no apiEndpoint")
+        secret_name = (spec.get("secretRef") or {}).get("name") or f"{name}-secret"
+        secret = self.host.try_get(SECRETS, f"{FED_SYSTEM_NAMESPACE}/{secret_name}")
+        if secret is None:
+            raise NotFound(f"cluster {name}: join secret {secret_name} missing")
+        token = (secret.get("data") or {}).get("token")
+        cache_key = (endpoint, token or "")
+        with self._lock:
+            client = self._cache.get(cache_key)
+            if client is None:
+                client = HttpKube(
+                    endpoint, token=token, name=name, timeout=self.timeout
+                )
+                self._cache[cache_key] = client
+            return client
+
+    def close(self) -> None:
+        with self._lock:
+            for client in self._cache.values():
+                client.close()
+            self._cache.clear()
+
+
+class HttpFleet:
+    """ClusterFleet interface over HTTP: host client + join-secret-built
+    member clients, member watches driven by FederatedCluster state."""
+
+    def __init__(self, host: HttpKube, factory: Optional[FederatedClientFactory] = None):
+        self.host = host
+        self.factory = factory or FederatedClientFactory(host)
+        self.members: dict[str, HttpKube] = {}
+
+    def member(self, name: str) -> HttpKube:
+        cluster = self.host.try_get(C.FEDERATED_CLUSTERS, name)
+        if cluster is None:
+            raise NotFound(f"cluster {name}")
+        client = self.factory.client_for(cluster)
+        self.members[name] = client
+        return client
+
+    def unwatch_owner(self, owner: object) -> None:
+        self.host.unwatch_owner(owner)
+        for client in self.members.values():
+            client.unwatch_owner(owner)
+
+    def watch_members(self, resource: str, handler: Handler) -> Callable[[], None]:
+        attached: set[str] = set()
+
+        def attach() -> None:
+            for cluster in self.host.list(C.FEDERATED_CLUSTERS):
+                name = cluster["metadata"]["name"]
+                if name in attached:
+                    continue
+                try:
+                    client = self.factory.client_for(cluster)
+                except NotFound:
+                    continue  # not joined yet; reattached on next event
+                attached.add(name)
+                self.members[name] = client
+                client.watch(resource, handler, replay=False)
+
+        attach()
+        return attach
+
+    def close(self) -> None:
+        self.factory.close()
+        self.host.close()

@@ -1,0 +1,103 @@
+"""CRD manifests: generation, on-disk sync, FTC-implied CRDs, install."""
+
+import glob
+import os
+
+import yaml
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.models import crds
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import (
+    CLUSTER_PROPAGATION_POLICIES,
+    OVERRIDE_POLICIES,
+    PROPAGATION_POLICIES,
+)
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+
+def crd_resource_key(manifest: dict) -> str:
+    spec = manifest["spec"]
+    version = spec["versions"][0]["name"]
+    return f"{spec['group']}/{version}/{spec['names']['plural']}"
+
+
+class TestCoreCrds:
+    def test_covers_the_api_surface(self):
+        keys = {crd_resource_key(m) for m in crds.core_crds()}
+        for expected in (
+            C.FEDERATED_CLUSTERS,
+            PROPAGATION_POLICIES,
+            CLUSTER_PROPAGATION_POLICIES,
+            OVERRIDE_POLICIES,
+            "core.kubeadmiral.io/v1alpha1/federatedtypeconfigs",
+            "core.kubeadmiral.io/v1alpha1/schedulingprofiles",
+            "core.kubeadmiral.io/v1alpha1/schedulerpluginwebhookconfigurations",
+            "core.kubeadmiral.io/v1alpha1/propagatedversions",
+            "core.kubeadmiral.io/v1alpha1/clusterpropagatedversions",
+        ):
+            assert expected in keys, expected
+
+    def test_manifests_on_disk_match_generator(self):
+        on_disk = {}
+        for path in glob.glob(os.path.join(crds.MANIFEST_DIR, "*.yaml")):
+            with open(path) as f:
+                manifest = yaml.safe_load(f)
+            on_disk[manifest["metadata"]["name"]] = manifest
+        generated = {m["metadata"]["name"]: m for m in crds.core_crds()}
+        assert on_disk == generated, (
+            "config/crds/ out of sync: run python -m kubeadmiral_tpu.models.crds"
+        )
+
+    def test_schema_shape(self):
+        for manifest in crds.core_crds():
+            v = manifest["spec"]["versions"][0]
+            schema = v["schema"]["openAPIV3Schema"]
+            assert schema["type"] == "object"
+            assert "spec" in schema["properties"]
+            assert manifest["metadata"]["name"].startswith(
+                manifest["spec"]["names"]["plural"] + "."
+            )
+
+    def test_policy_spec_fields(self):
+        pp = next(
+            m for m in crds.core_crds()
+            if m["spec"]["names"]["kind"] == "PropagationPolicy"
+        )
+        props = (
+            pp["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+            ["properties"]["spec"]["properties"]
+        )
+        for field in (
+            "schedulingMode", "stickyCluster", "clusterSelector",
+            "clusterAffinity", "tolerations", "maxClusters", "placement",
+            "schedulingProfile", "disableFollowerScheduling",
+            "autoMigration", "replicaRescheduling",
+        ):
+            assert field in props, field
+
+
+class TestFtcCrds:
+    def test_crd_for_every_default_ftc(self):
+        for ftc in default_ftcs():
+            manifest = crds.crd_for_ftc(ftc)
+            assert crd_resource_key(manifest) == ftc.federated.resource
+            scope = manifest["spec"]["scope"]
+            assert scope == ("Namespaced" if ftc.namespaced else "Cluster")
+            schema = (
+                manifest["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+            )
+            spec_props = schema["properties"]["spec"]["properties"]
+            assert {"template", "placements", "overrides", "follows"} <= set(
+                spec_props
+            )
+
+    def test_install_is_idempotent(self):
+        store = FakeKube("host")
+        ftcs = default_ftcs()
+        n = crds.install(store, ftcs)
+        assert n == len(crds.core_crds()) + len(ftcs)
+        assert crds.install(store, ftcs) == 0
+        names = store.keys(crds.CRD_RESOURCE)
+        assert "propagationpolicies.core.kubeadmiral.io" in names
+        assert any(n.startswith("federateddeployments.") for n in names)

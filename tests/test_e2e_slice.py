@@ -79,13 +79,27 @@ def settle(*controllers, rounds=20):
 
 
 class TestEndToEndSlice:
+    # Hooks overridden by the real-transport variant (test_e2e_http.py):
+    # the same tests run over in-process FakeKube and over HTTP apiservers.
+    def make_fleet(self):
+        return ClusterFleet()
+
+    def add_member(self, name):
+        return self.fleet.add_member(name)
+
+    def cluster_spec(self, name) -> dict:
+        return {}
+
+    def settle(self, *controllers, rounds=20):
+        settle(*controllers, rounds=rounds)
+
     def setup_method(self):
         # Scheduler-only pipeline: the override controller doesn't run in
         # this slice, so it must not gate sync.
         self.ftc = deployment_ftc(
             pipeline=(("kubeadmiral.io/global-scheduler",),)
         )
-        self.fleet = ClusterFleet()
+        self.fleet = self.make_fleet()
         gvk = "apps/v1/Deployment"
         self.clusterctl = FederatedClusterController(
             self.fleet, api_resource_probe=[gvk]
@@ -95,7 +109,7 @@ class TestEndToEndSlice:
         self.sync = SyncController(self.fleet, self.ftc)
 
         for name, cpu in (("c1", "64"), ("c2", "32"), ("c3", "32")):
-            member = self.fleet.add_member(name)
+            member = self.add_member(name)
             member.create(NODES, make_node("n1", cpu, "128Gi"))
             self.fleet.host.create(
                 FEDERATED_CLUSTERS,
@@ -103,7 +117,7 @@ class TestEndToEndSlice:
                     "apiVersion": "core.kubeadmiral.io/v1alpha1",
                     "kind": "FederatedCluster",
                     "metadata": {"name": name},
-                    "spec": {},
+                    "spec": self.cluster_spec(name),
                 },
             )
         self.fleet.host.create(
@@ -121,7 +135,7 @@ class TestEndToEndSlice:
 
     def test_deployment_propagates_with_divided_replicas(self):
         self.fleet.host.create(self.ftc.source.resource, make_deployment())
-        settle(*self.everything())
+        self.settle(*self.everything())
 
         fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
         placed = C.get_placement(fed, C.SCHEDULER)
@@ -146,7 +160,7 @@ class TestEndToEndSlice:
         import json
 
         self.fleet.host.create(self.ftc.source.resource, make_deployment())
-        settle(*self.everything())
+        self.settle(*self.everything())
         src = self.fleet.host.get(self.ftc.source.resource, "default/web")
         ann = src["metadata"]["annotations"]
         scheduling = json.loads(ann[C.SOURCE_FEEDBACK_SCHEDULING])
@@ -157,12 +171,12 @@ class TestEndToEndSlice:
 
     def test_source_update_rolls_through(self):
         self.fleet.host.create(self.ftc.source.resource, make_deployment())
-        settle(*self.everything())
+        self.settle(*self.everything())
         src = self.fleet.host.get(self.ftc.source.resource, "default/web")
         src["spec"]["replicas"] = 15
         src["spec"]["template"]["spec"]["containers"][0]["image"] = "nginx:2"
         self.fleet.host.update(self.ftc.source.resource, src)
-        settle(*self.everything())
+        self.settle(*self.everything())
 
         total = 0
         for name in ("c1", "c2", "c3"):
@@ -177,9 +191,9 @@ class TestEndToEndSlice:
 
     def test_source_delete_cascades_everywhere(self):
         self.fleet.host.create(self.ftc.source.resource, make_deployment())
-        settle(*self.everything())
+        self.settle(*self.everything())
         self.fleet.host.delete(self.ftc.source.resource, "default/web")
-        settle(*self.everything(), rounds=40)
+        self.settle(*self.everything(), rounds=40)
 
         assert self.fleet.host.try_get(self.ftc.source.resource, "default/web") is None
         assert (

@@ -17,6 +17,7 @@ from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
 from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
 from kubeadmiral_tpu.runtime.leaderelection import LeaderElector
 from kubeadmiral_tpu.runtime.manager import ControllerManager
+from kubeadmiral_tpu.testing import fakekube
 from kubeadmiral_tpu.testing.fakekube import ClusterFleet
 
 from test_e2e_slice import make_deployment, make_node
@@ -193,7 +194,7 @@ class TestControllerManager:
             1
             for hs in self.fleet.host._watchers.values()
             for h in hs
-            if self.fleet.host._handler_owner(h) is self.manager._follower
+            if fakekube.handler_owner(h) is self.manager._follower
         )
         assert remaining == baseline + follower_handlers
 
