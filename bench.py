@@ -1,32 +1,48 @@
-"""Benchmark: batched TPU scheduling tick vs the sequential in-process scheduler.
+"""Benchmark: batched TPU scheduling tick vs the native sequential scheduler.
 
-Workload: BASELINE.md config #3 shape — a mixed Deployment/StatefulSet
-batch with taint/affinity masks, static+dynamic weights and capacity
-feedback, scheduled against taint/label-heterogeneous member clusters.
+Configs (BASELINE.md; select with BENCH_CONFIG, override shapes with
+BENCH_OBJECTS / BENCH_CLUSTERS):
 
-Baseline: the sequential per-object reference implementation
-(kubeadmiral_tpu.ops.pipeline_oracle.schedule_one) — a faithful
-re-statement of the reference's in-process scheduler control flow
-(pkg/controllers/scheduler, one object at a time through
-Filter -> Score -> Select -> planner).  It is timed on a sample and
-extrapolated; vs_baseline = batched throughput / sequential throughput.
+  3 (default) 10k mixed Deployment/StatefulSet x 500 clusters —
+     taint/affinity masks, static+dynamic weights, capacity feedback.
+  4  50k x 2k — dynamic-weight rebalancing with status-aggregation
+     feedback: every object carries current placements and avoids
+     disruption, capacity caps arrive from auto-migration.
+  5  100k x 5k — multi-resource (cpu/mem/gpu) bin-pack scoring plus a
+     follower-scheduling dependency DAG (10% followers take the union
+     of their leaders' placements after the tick).
+
+Baseline: the native C++ sequential scheduler
+(kubeadmiral_tpu/native/seqsched.cpp), a compiled re-statement of the
+reference's in-process per-object control flow (reference:
+pkg/controllers/scheduler/core/generic_scheduler.go via
+framework/runtime plugin loops + util/planner/planner.go),
+differentially tested against the Python oracle.  The Go toolchain is
+absent in this image, so g++ -O3 stands in for Go: same algorithm, same
+performance class.  It consumes the already-featurized arrays, so the
+baseline is NOT charged for featurization — only the batched path pays
+host encoding in its tick time.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+  {"metric", "value", "unit", "vs_baseline", "detail": {...}}
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-N_OBJECTS = int(__import__("os").environ.get("BENCH_OBJECTS", 10_000))
-N_CLUSTERS = int(__import__("os").environ.get("BENCH_CLUSTERS", 500))
-ORACLE_SAMPLE = 400
-TICKS = 3
+CONFIG = os.environ.get("BENCH_CONFIG", "3")
+SHAPES = {"3": (10_000, 500), "4": (50_000, 2_000), "5": (100_000, 5_000)}
+N_OBJECTS, N_CLUSTERS = SHAPES.get(CONFIG, SHAPES["3"])
+N_OBJECTS = int(os.environ.get("BENCH_OBJECTS", N_OBJECTS))
+N_CLUSTERS = int(os.environ.get("BENCH_CLUSTERS", N_CLUSTERS))
+TICKS = int(os.environ.get("BENCH_TICKS", 3))
+CHUNK = int(os.environ.get("BENCH_CHUNK", 4096))
 
 
 def build_world(rng):
@@ -34,23 +50,35 @@ def build_world(rng):
         AutoMigrationSpec,
         ClusterAffinity,
         ClusterState,
+        CLUSTER_RESOURCES_MOST,
         MODE_DIVIDE,
         PreferredSchedulingTerm,
         SelectorRequirement,
         SelectorTerm,
         SchedulingUnit,
         Taint,
+        TAINT_TOLERATION,
         Toleration,
         parse_resources,
     )
 
     gvks = ("apps/v1/Deployment", "apps/v1/StatefulSet")
     regions = ("us", "eu", "ap")
+    gpu = CONFIG == "5"
     clusters = []
     for j in range(N_CLUSTERS):
         cpu = int(rng.integers(32, 512))
         mem_gi = int(rng.integers(128, 2048))
         free_frac = float(rng.uniform(0.1, 0.9))
+        alloc = {"cpu": str(cpu), "memory": f"{mem_gi}Gi"}
+        avail = {
+            "cpu": f"{int(cpu * free_frac * 1000)}m",
+            "memory": f"{int(mem_gi * free_frac)}Gi",
+        }
+        if gpu and j % 3 == 0:
+            n_gpu = int(rng.integers(4, 64))
+            alloc["nvidia.com/gpu"] = str(n_gpu)
+            avail["nvidia.com/gpu"] = str(int(n_gpu * free_frac))
         clusters.append(
             ClusterState(
                 name=f"member-{j:05d}",
@@ -62,18 +90,12 @@ def build_world(rng):
                 taints=(Taint("dedicated", "batch", "NoSchedule"),)
                 if j % 11 == 0
                 else (),
-                allocatable=parse_resources(
-                    {"cpu": str(cpu), "memory": f"{mem_gi}Gi"}
-                ),
-                available=parse_resources(
-                    {
-                        "cpu": f"{int(cpu * free_frac * 1000)}m",
-                        "memory": f"{int(mem_gi * free_frac)}Gi",
-                    }
-                ),
+                allocatable=parse_resources(alloc),
+                available=parse_resources(avail),
                 api_resources=frozenset(gvks),
             )
         )
+    names = [c.name for c in clusters]
 
     affinities = [None] + [
         ClusterAffinity(
@@ -98,9 +120,32 @@ def build_world(rng):
         for k in range(3)
     ] + [None]
 
+    # Config 4: steady-state rebalance — objects carry current
+    # placements (as if read back from status aggregation) and avoid
+    # disruption; auto-migration capacity estimates cap some clusters.
+    steady = CONFIG == "4"
+    # Config 5: bin-pack profile (MostAllocated replaces the default
+    # spreading scores) and gpu requests on a third of the fleet.
+    binpack_scores = (TAINT_TOLERATION, CLUSTER_RESOURCES_MOST)
+
     units = []
+    followers = []
     for i in range(N_OBJECTS):
+        if CONFIG == "5" and i % 10 == 9:
+            followers.append(i)  # placement = union of leaders, post-tick
         divide = i % 4 != 0
+        request = {
+            "cpu": f"{int(rng.integers(0, 8)) * 250}m",
+            "memory": f"{int(rng.integers(0, 16)) * 256}Mi",
+        }
+        if gpu and i % 3 == 0:
+            request["nvidia.com/gpu"] = str(int(rng.integers(1, 4)))
+        current = {}
+        if steady:
+            spread = int(rng.integers(1, 6))
+            picks = rng.integers(0, N_CLUSTERS, spread)
+            reps = int(rng.integers(1, 40))
+            current = {names[int(p)]: reps for p in picks}
         units.append(
             SchedulingUnit(
                 gvk=gvks[i % 2],
@@ -108,21 +153,18 @@ def build_world(rng):
                 name=f"workload-{i:06d}",
                 scheduling_mode=MODE_DIVIDE if divide else "Duplicate",
                 desired_replicas=int(rng.integers(1, 100)) if divide else None,
-                resource_request=parse_resources(
-                    {
-                        "cpu": f"{int(rng.integers(0, 8)) * 250}m",
-                        "memory": f"{int(rng.integers(0, 16)) * 256}Mi",
-                    }
-                ),
+                resource_request=parse_resources(request),
+                current_clusters=current,
                 tolerations=(Toleration(key="dedicated", operator="Exists"),)
                 if i % 3 == 0
                 else (),
                 affinity=affinities[i % len(affinities)],
                 max_clusters=int(rng.integers(1, 20)) if i % 5 == 0 else None,
-                avoid_disruption=bool(i % 2),
+                avoid_disruption=steady or bool(i % 2),
+                enabled_scores=binpack_scores if CONFIG == "5" else None,
                 auto_migration=AutoMigrationSpec(
                     estimated_capacity={
-                        f"member-{int(rng.integers(0, N_CLUSTERS)):05d}": int(
+                        names[int(rng.integers(0, N_CLUSTERS))]: int(
                             rng.integers(0, 50)
                         )
                     }
@@ -131,52 +173,121 @@ def build_world(rng):
                 else None,
             )
         )
-    return units, clusters
+    return units, clusters, followers
 
 
-def time_batched(units, clusters):
+def follower_union(results, followers):
+    """Follower scheduling: placement = union of the leaders' clusters
+    (reference: pkg/controllers/follower/controller.go:95-521 writes
+    spec.follows so follower placement covers its leaders).  Bench
+    models each follower following its 3 preceding leaders."""
+    for i in followers:
+        union: dict = {}
+        for leader in range(max(0, i - 3), i):
+            union.update(results[leader].clusters)
+        results[i].clusters = {c: None for c in union}
+    return results
+
+
+def time_batched(units, clusters, followers):
     from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
 
-    engine = SchedulerEngine(chunk_size=4096)
-    engine.schedule(units, clusters)  # warm the compile caches at full shape
+    engine = SchedulerEngine(chunk_size=CHUNK)
+    # Warm tick: compiles the XLA programs and fills the feature cache;
+    # its featurize time is the COLD encode cost.  The timed ticks below
+    # are the steady-state path (incremental featurization).
+    engine.schedule(units, clusters)
+    cold_featurize_ms = round(engine.timings["featurize"] * 1e3, 1)
+    detail = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
     t0 = time.perf_counter()
     for _ in range(TICKS):
         results = engine.schedule(units, clusters)
+        if followers:
+            t_f = time.perf_counter()
+            results = follower_union(results, followers)
+            detail["follower"] = detail.get("follower", 0.0) + (
+                time.perf_counter() - t_f
+            )
+        for stage, secs in engine.timings.items():
+            detail[stage] = detail.get(stage, 0.0) + secs
     dt = (time.perf_counter() - t0) / TICKS
     placed = sum(1 for r in results if r.clusters)
-    return dt, placed
+    detail = {k: round(v / TICKS * 1e3, 1) for k, v in detail.items()}
+    detail["featurize_cold"] = cold_featurize_ms
+    detail["cache"] = dict(engine.cache_stats)
+    return dt, placed, detail
 
 
-def time_sequential_via_oracle(units, clusters):
+def time_native_baseline(units, clusters):
+    """The compiled sequential scheduler over the full batch, fed
+    pre-featurized, pre-marshalled arrays (neither featurization nor
+    numpy dtype conversion is charged to it)."""
+    from kubeadmiral_tpu.native import load as native_load
+    from kubeadmiral_tpu.native.seqsched import prepare, run
+    from kubeadmiral_tpu.scheduler.featurize import featurize
+
+    if native_load() is None:
+        return None, 0
+    chunks = []
+    for start in range(0, len(units), CHUNK):
+        fb = featurize(units[start : start + CHUNK], clusters)
+        chunks.append(prepare(fb.inputs))
+    t0 = time.perf_counter()
+    placed = 0
+    for prepared in chunks:
+        out = run(prepared)
+        placed += int((out[0].sum(axis=1) > 0).sum())
+    return time.perf_counter() - t0, placed
+
+
+def time_python_oracle(units, clusters, sample=200):
     from kubeadmiral_tpu.bench_support import sequential_schedule
 
-    sample = units[:ORACLE_SAMPLE]
     t0 = time.perf_counter()
-    sequential_schedule(sample, clusters)
-    dt = time.perf_counter() - t0
-    return dt / len(sample)
+    sequential_schedule(units[:sample], clusters)
+    return (time.perf_counter() - t0) / sample
 
 
 def main():
     rng = np.random.default_rng(20260729)
-    units, clusters = build_world(rng)
+    units, clusters, followers = build_world(rng)
 
-    tick_seconds, placed = time_batched(units, clusters)
-    per_obj_seq = time_sequential_via_oracle(units, clusters)
+    tick_seconds, placed, detail = time_batched(units, clusters, followers)
+    native_seconds, native_placed = time_native_baseline(units, clusters)
 
     batched_rate = N_OBJECTS / tick_seconds
-    seq_rate = 1.0 / per_obj_seq
+    if native_seconds is not None:
+        native_rate = N_OBJECTS / native_seconds
+        vs = batched_rate / native_rate
+        detail["native_baseline_ms"] = round(native_seconds * 1e3, 1)
+    else:  # no toolchain: fall back to the (slower) python oracle
+        per_obj = time_python_oracle(units, clusters)
+        native_rate = 1.0 / per_obj
+        vs = batched_rate / native_rate
+        detail["native_baseline_ms"] = None
+
     result = {
         "metric": f"objects_scheduled_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
         "value": round(batched_rate, 1),
         "unit": "objects/s",
-        "vs_baseline": round(batched_rate / seq_rate, 2),
+        "vs_baseline": round(vs, 2),
+        "detail": {
+            "config": CONFIG,
+            "tick_ms": round(tick_seconds * 1e3, 1),
+            "stage_ms": detail,
+            "baseline": "native-seqsched(g++ -O3)"
+            if native_seconds is not None
+            else "python-oracle",
+            "baseline_objects_per_sec": round(native_rate, 1),
+            "placed": placed,
+        },
     }
     print(json.dumps(result))
     print(
-        f"# tick={tick_seconds * 1e3:.1f}ms for {N_OBJECTS} objects x "
-        f"{N_CLUSTERS} clusters ({placed} placed); sequential reference "
-        f"{seq_rate:.1f} obj/s (sampled {ORACLE_SAMPLE})",
+        f"# config {CONFIG}: tick={tick_seconds * 1e3:.0f}ms for "
+        f"{N_OBJECTS}x{N_CLUSTERS} ({placed} placed) -> {batched_rate:.0f} obj/s; "
+        f"stages(ms)={detail}; native sequential "
+        f"{native_rate:.0f} obj/s ({native_placed} placed)",
         file=sys.stderr,
     )
 

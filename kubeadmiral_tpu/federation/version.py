@@ -151,7 +151,8 @@ class VersionManager:
         cr = dict(existing)
         cr["status"] = status
         try:
-            self._cache[key] = self.host.update(self.resource, cr)
+            # Status subresource: plain updates ignore .status.
+            self._cache[key] = self.host.update_status(self.resource, cr)
         except (Conflict, NotFound):
             # Version recording is an optimization (manager.go callers
             # tolerate failure); drop the cache so the next get reloads.

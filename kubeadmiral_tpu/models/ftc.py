@@ -264,10 +264,11 @@ WORKLOAD_PIPELINE = (
 
 
 def default_ftcs() -> list[FederatedTypeConfig]:
-    """The sample set the reference ships (config/sample/host/01-ftc.yaml),
-    trimmed to the types the tests/bench exercise; more are added by
-    simply registering additional FTC objects.  Workload leader types run
-    the follower controller after scheduling (01-ftc.yaml:94-97)."""
+    """The full default set the reference ships — all 21 types of
+    config/sample/host/01-ftc.yaml (namespaces, workloads, config/rbac/
+    quota/storage types, CRDs); more are added by simply registering
+    additional FTC objects.  Workload leader types run the follower
+    controller after scheduling (01-ftc.yaml:94-97)."""
     return [
         make_ftc(
             "deployments.apps",
@@ -331,5 +332,38 @@ def default_ftcs() -> list[FederatedTypeConfig]:
             "v1",
             "PersistentVolumeClaim",
             "persistentvolumeclaims",
+        ),
+        make_ftc(
+            "persistentvolumes", "", "v1", "PersistentVolume",
+            "persistentvolumes", namespaced=False,
+        ),
+        make_ftc(
+            "storageclasses.storage.k8s.io", "storage.k8s.io", "v1",
+            "StorageClass", "storageclasses", namespaced=False,
+        ),
+        make_ftc(
+            "roles.rbac.authorization.k8s.io",
+            "rbac.authorization.k8s.io", "v1", "Role", "roles",
+        ),
+        make_ftc(
+            "rolebindings.rbac.authorization.k8s.io",
+            "rbac.authorization.k8s.io", "v1", "RoleBinding", "rolebindings",
+        ),
+        make_ftc(
+            "clusterroles.rbac.authorization.k8s.io",
+            "rbac.authorization.k8s.io", "v1", "ClusterRole", "clusterroles",
+            namespaced=False,
+        ),
+        make_ftc(
+            "clusterrolebindings.rbac.authorization.k8s.io",
+            "rbac.authorization.k8s.io", "v1", "ClusterRoleBinding",
+            "clusterrolebindings", namespaced=False,
+        ),
+        make_ftc("limitranges", "", "v1", "LimitRange", "limitranges"),
+        make_ftc("resourcequotas", "", "v1", "ResourceQuota", "resourcequotas"),
+        make_ftc(
+            "customresourcedefinitions.apiextensions.k8s.io",
+            "apiextensions.k8s.io", "v1", "CustomResourceDefinition",
+            "customresourcedefinitions", namespaced=False,
         ),
     ]

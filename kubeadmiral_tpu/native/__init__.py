@@ -16,7 +16,11 @@ import threading
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-SOURCE = os.path.join(_DIR, "fnvhash.cpp")
+SOURCES = [
+    os.path.join(_DIR, "fnvhash.cpp"),
+    os.path.join(_DIR, "seqsched.cpp"),
+]
+SOURCE = SOURCES[0]  # kept for callers that reference the hash source
 LIBRARY = os.path.join(_DIR, "libkadmhash.so")
 
 _lock = threading.Lock()
@@ -45,6 +49,20 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_char_p,
         ctypes.c_size_t,
     ]
+    u8 = ctypes.POINTER(ctypes.c_uint8)
+    i32 = ctypes.POINTER(ctypes.c_int32)
+    i64 = ctypes.POINTER(ctypes.c_int64)
+    lib.kadm_seq_schedule_batch.restype = None
+    lib.kadm_seq_schedule_batch.argtypes = (
+        [ctypes.c_int32] * 3
+        + [u8, u8, u8, u8, u8, u8, u8]      # filter flags + masks
+        + [i64, i64, i64]                   # request, alloc, used
+        + [u8, i64, i64]                    # score flags, taints, affinity
+        + [i32, u8, u8, u8, i64, i32]       # maxc, mode, sticky, cur, total
+        + [u8, i32, i32, i32, i32]          # weights_given..capacity
+        + [u8, u8, i32, i64, i64]           # keep, avoid, tiebreak, cpu
+        + [u8, i64, u8]                     # outputs
+    )
     return lib
 
 
@@ -56,13 +74,16 @@ def build(force: bool = False) -> bool:
     if (
         os.path.exists(LIBRARY)
         and not force
-        and os.path.getmtime(LIBRARY) >= os.path.getmtime(SOURCE)
+        and all(
+            os.path.getmtime(LIBRARY) >= os.path.getmtime(src)
+            for src in SOURCES
+        )
     ):
         return True
     tmp = f"{LIBRARY}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, SOURCE],
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, *SOURCES],
             check=True,
             capture_output=True,
             timeout=120,
@@ -89,7 +110,10 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         try:
             _lib = _configure(ctypes.CDLL(LIBRARY))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt library lacking newly
+            # added symbols; degrade to the pure-Python fallbacks.
             _load_failed = True
+            _lib = None
             return None
     return _lib

@@ -1,0 +1,472 @@
+// Sequential in-process scheduler: the compiled baseline for bench.py.
+//
+// A faithful native re-statement of the reference's per-object scheduling
+// control flow (reference: pkg/controllers/scheduler/core/
+// generic_scheduler.go:92-150 via framework/runtime/framework.go plugin
+// loops, and pkg/controllers/util/planner/planner.go:83-366), matching
+// kubeadmiral_tpu.ops.pipeline_oracle.schedule_one bit for bit — it is
+// differentially tested against that oracle.  The Go toolchain is not
+// available in this environment, so this C++ build (g++ -O3) stands in
+// for the in-process Go scheduler when computing vs_baseline: same
+// algorithm, same performance class of language.
+//
+// Operates on the featurized arrays a tick carries (TickInputs layout);
+// per-cluster sort order uses the precomputed fnv32 tie-break values so
+// no string hashing happens in the hot loop (the Go planner hashes
+// cluster+key per comparison; precomputing favors the baseline).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kInf = INT32_MAX;
+constexpr int64_t kNil = -1;
+constexpr int64_t kMaxScore = 100;
+
+struct Pref {
+  int64_t weight = 0;
+  int64_t min_replicas = 0;
+  int64_t max_replicas = -1;  // -1 = unbounded
+  int32_t tiebreak = 0;
+};
+
+// planner.go:62-66 order: weight desc, fnv32 tie-break asc.
+void sort_order(std::vector<int>& order, const std::vector<Pref>& prefs) {
+  std::sort(order.begin(), order.end(), [&](int a, int b) {
+    if (prefs[a].weight != prefs[b].weight)
+      return prefs[a].weight > prefs[b].weight;
+    return prefs[a].tiebreak < prefs[b].tiebreak;
+  });
+}
+
+// planner.go getDesiredPlan: min pass + weighted ceil rounds.
+// capacity: -1 = no estimate.  Returns (plan, overflow) in `out`/`over`.
+void distribute(const std::vector<int>& order, const std::vector<Pref>& prefs,
+                const std::vector<int64_t>& capacity, int64_t total,
+                bool keep_unschedulable, std::vector<int64_t>& out,
+                std::vector<int64_t>& over) {
+  int64_t remaining = total;
+  for (int idx : order) {
+    int64_t take = std::min(prefs[idx].min_replicas, remaining);
+    int64_t cap = capacity.empty() ? -1 : capacity[idx];
+    if (cap >= 0 && cap < take) {
+      over[idx] = take - cap;
+      take = cap;
+    }
+    remaining -= take;
+    out[idx] = take;
+  }
+
+  std::vector<int> active = order;
+  bool moved = true;
+  while (moved && remaining > 0) {
+    moved = false;
+    int64_t weight_sum = 0;
+    for (int idx : active) weight_sum += prefs[idx].weight;
+    if (weight_sum <= 0) break;
+    int64_t snapshot = remaining;
+    std::vector<int> survivors;
+    for (int idx : active) {
+      int64_t start = out[idx];
+      int64_t extra =
+          (snapshot * prefs[idx].weight + weight_sum - 1) / weight_sum;
+      extra = std::min(extra, remaining);
+      int64_t total_n = start + extra;
+
+      bool full = false;
+      if (prefs[idx].max_replicas >= 0 && total_n > prefs[idx].max_replicas) {
+        total_n = prefs[idx].max_replicas;
+        full = true;
+      }
+      int64_t cap = capacity.empty() ? -1 : capacity[idx];
+      if (cap >= 0 && total_n > cap) {
+        over[idx] += total_n - cap;
+        total_n = cap;
+        full = true;
+      }
+      if (!full) survivors.push_back(idx);
+      remaining -= total_n - start;
+      out[idx] = total_n;
+      if (total_n > start) moved = true;
+    }
+    active = std::move(survivors);
+  }
+
+  if (!keep_unschedulable) {
+    for (size_t i = 0; i < over.size(); ++i) {
+      over[i] = std::min(over[i], remaining);
+      if (over[i] < 0) over[i] = 0;
+    }
+  }
+}
+
+int64_t round_half(double x) {
+  return (int64_t)std::copysign(std::floor(std::fabs(x) + 0.5), x);
+}
+
+struct Object {
+  // Views into the batch arrays for one object (row i).
+  const uint8_t *filter_enabled, *score_enabled;
+  const uint8_t *api_ok, *taint_ok_new, *taint_ok_cur, *selector_ok,
+      *placement_ok, *current_mask;
+  uint8_t placement_has, mode_divide, sticky, weights_given,
+      keep_unschedulable, avoid_disruption;
+  const int64_t *request, *taint_counts, *affinity_scores, *current_replicas;
+  const int32_t *weights, *min_replicas, *max_replicas, *capacity, *tiebreak;
+  int32_t max_clusters, total;
+};
+
+struct World {
+  int c, r;
+  const int64_t *alloc, *used, *cpu_alloc, *cpu_avail;
+};
+
+bool fits(const Object& o, const World& w, int j) {
+  bool any = false;
+  for (int k = 0; k < w.r; ++k) any |= o.request[k] > 0;
+  if (!any) return true;
+  for (int k = 0; k < w.r; ++k) {
+    if (k >= 2 && o.request[k] <= 0) continue;
+    if (w.alloc[j * w.r + k] < o.request[k] + w.used[j * w.r + k]) return false;
+  }
+  return true;
+}
+
+int64_t balanced_score(const Object& o, const World& w, int j) {
+  auto frac = [](int64_t req, int64_t cap) {
+    return cap == 0 ? 1.0 : (double)req / (double)cap;
+  };
+  double f_cpu = frac(w.used[j * w.r + 0] + o.request[0], w.alloc[j * w.r + 0]);
+  double f_mem = frac(w.used[j * w.r + 1] + o.request[1], w.alloc[j * w.r + 1]);
+  if (f_cpu >= 1 || f_mem >= 1) return 0;
+  return (int64_t)((1 - std::fabs(f_cpu - f_mem)) * kMaxScore);
+}
+
+int64_t ratio_score(const Object& o, const World& w, int j, bool least) {
+  int64_t total = 0;
+  for (int k = 0; k < 2; ++k) {
+    int64_t cap = w.alloc[j * w.r + k];
+    int64_t req = w.used[j * w.r + k] + o.request[k];
+    int64_t s;
+    if (cap == 0 || req > cap)
+      s = 0;
+    else if (least)
+      s = (cap - req) * kMaxScore / cap;
+    else
+      s = req * kMaxScore / cap;
+    total += s;
+  }
+  return total / 2;
+}
+
+// framework normalize: scale to [0,100] by max, optionally reversed.
+void normalize_add(std::vector<int64_t>& totals,
+                   const std::vector<int>& feasible,
+                   const std::vector<int64_t>& raw, bool reverse) {
+  int64_t max_count = 0;
+  for (int j : feasible) max_count = std::max(max_count, raw[j]);
+  if (max_count == 0) {
+    if (reverse)
+      for (int j : feasible) totals[j] += kMaxScore;
+    else
+      for (int j : feasible) totals[j] += raw[j];
+    return;
+  }
+  for (int j : feasible) {
+    int64_t s = kMaxScore * raw[j] / max_count;
+    totals[j] += reverse ? kMaxScore - s : s;
+  }
+}
+
+// rsp.go CalcWeightLimit + AvailableToPercentage over the selection.
+void dynamic_weights(const World& w, const std::vector<int>& selected,
+                     std::vector<int64_t>& weights_out) {
+  int n = (int)selected.size();
+  int64_t alloc_sum = 0;
+  for (int j : selected) alloc_sum += w.cpu_alloc[j];
+  std::vector<double> limit(w.c, 0);
+  if (alloc_sum == 0) {
+    for (int j : selected) limit[j] = (double)round_half(1000.0 / n);
+  } else {
+    for (int j : selected)
+      limit[j] =
+          (double)round_half((double)w.cpu_alloc[j] / alloc_sum * 1000 * 1.4);
+  }
+  int64_t avail_sum = 0;
+  for (int j : selected)
+    if (w.cpu_avail[j] > 0) avail_sum += w.cpu_avail[j];
+  std::vector<int64_t> tmp(w.c, 0);
+  if (avail_sum == 0) {
+    for (int j : selected) tmp[j] = round_half(1000.0 / n);
+  } else {
+    for (int j : selected) {
+      int64_t avail = std::max(w.cpu_avail[j], (int64_t)0);
+      tmp[j] = std::min(round_half((double)avail / avail_sum * 1000),
+                        (int64_t)limit[j]);
+    }
+  }
+  int64_t tmp_sum = 0;
+  for (int j : selected) tmp_sum += tmp[j];
+  if (tmp_sum <= 0) {
+    for (int j : selected) weights_out[j] = 0;
+    return;
+  }
+  int64_t max_w = 0, other = 0;
+  int max_j = -1;
+  for (int j : selected) {  // deterministic first-max, selection order
+    int64_t wgt = round_half((double)tmp[j] / tmp_sum * 1000);
+    if (wgt > max_w) {
+      max_w = wgt;
+      max_j = j;
+    }
+    weights_out[j] = wgt;
+    other += wgt;
+  }
+  if (max_j >= 0) weights_out[max_j] += 1000 - other;
+}
+
+// planner.go scaleUp: grow clusters under their desired share.
+void scale_up(const std::vector<Pref>& rsp_prefs,
+              const std::vector<int>& selected,
+              const std::vector<int64_t>& current,
+              const std::vector<int64_t>& desired, int64_t count, int c,
+              std::vector<int64_t>& result) {
+  std::vector<Pref> prefs(c);
+  std::vector<int> order;
+  for (int j : selected) {
+    int64_t have = current[j], want = desired[j];
+    if (want > have) {
+      Pref p;
+      p.weight = want - have;
+      p.tiebreak = rsp_prefs[j].tiebreak;
+      if (rsp_prefs[j].max_replicas >= 0)
+        p.max_replicas = rsp_prefs[j].max_replicas - have;
+      prefs[j] = p;
+      order.push_back(j);
+    }
+  }
+  sort_order(order, prefs);
+  std::vector<int64_t> grow(c, 0), over(c, 0);
+  distribute(order, prefs, {}, count, false, grow, over);
+  result = current;
+  for (int j : order) result[j] += grow[j];
+}
+
+// planner.go scaleDown: shrink clusters over their desired share.
+void scale_down(const std::vector<Pref>& rsp_prefs,
+                const std::vector<int>& selected,
+                const std::vector<int64_t>& current,
+                const std::vector<int64_t>& desired, int64_t count, int c,
+                std::vector<int64_t>& result) {
+  std::vector<Pref> prefs(c);
+  std::vector<int> order;
+  for (int j : selected) {
+    int64_t have = current[j], want = desired[j];
+    if (want < have) {
+      Pref p;
+      p.weight = have - want;
+      p.max_replicas = have;
+      p.tiebreak = rsp_prefs[j].tiebreak;
+      prefs[j] = p;
+      order.push_back(j);
+    }
+  }
+  sort_order(order, prefs);
+  std::vector<int64_t> shrink(c, 0), over(c, 0);
+  distribute(order, prefs, {}, count, false, shrink, over);
+  result = current;
+  for (int j : order) result[j] -= shrink[j];
+}
+
+void schedule_one(const Object& o, const World& w, uint8_t* out_selected,
+                  int64_t* out_replicas, uint8_t* out_counted) {
+  const int c = w.c;
+  std::memset(out_selected, 0, c);
+  std::memset(out_counted, 0, c);
+  for (int j = 0; j < c; ++j) out_replicas[j] = 0;
+
+  // Sticky short-circuit (generic_scheduler.go:103-107).
+  bool has_current = false;
+  for (int j = 0; j < c; ++j) has_current |= o.current_mask[j] != 0;
+  if (o.sticky && has_current) {
+    for (int j = 0; j < c; ++j) {
+      if (!o.current_mask[j]) continue;
+      out_selected[j] = 1;
+      out_replicas[j] = o.current_replicas[j];
+      out_counted[j] = o.current_replicas[j] != kNil;
+    }
+    return;
+  }
+
+  // Filter.
+  std::vector<int> feasible;
+  feasible.reserve(c);
+  for (int j = 0; j < c; ++j) {
+    bool ok = true;
+    if (o.filter_enabled[0]) ok &= o.api_ok[j] != 0;
+    if (o.filter_enabled[1])
+      ok &= (o.current_mask[j] ? o.taint_ok_cur[j] : o.taint_ok_new[j]) != 0;
+    if (ok && o.filter_enabled[2]) ok &= fits(o, w, j);
+    if (o.filter_enabled[3] && o.placement_has) ok &= o.placement_ok[j] != 0;
+    if (o.filter_enabled[4]) ok &= o.selector_ok[j] != 0;
+    if (ok) feasible.push_back(j);
+  }
+  if (feasible.empty()) return;
+
+  // Score + normalize + sum.
+  std::vector<int64_t> totals(c, 0), raw(c, 0);
+  if (o.score_enabled[0]) {
+    for (int j : feasible) raw[j] = o.taint_counts[j];
+    normalize_add(totals, feasible, raw, true);
+  }
+  if (o.score_enabled[1])
+    for (int j : feasible) totals[j] += balanced_score(o, w, j);
+  if (o.score_enabled[2])
+    for (int j : feasible) totals[j] += ratio_score(o, w, j, true);
+  if (o.score_enabled[3]) {
+    for (int j : feasible) raw[j] = o.affinity_scores[j];
+    normalize_add(totals, feasible, raw, false);
+  }
+  if (o.score_enabled[4])
+    for (int j : feasible) totals[j] += ratio_score(o, w, j, false);
+
+  // Select: top-K by (score desc, index asc).
+  if (o.max_clusters < 0 && o.max_clusters != kInf) return;
+  std::vector<int> ranked = feasible;
+  std::stable_sort(ranked.begin(), ranked.end(), [&](int a, int b) {
+    if (totals[a] != totals[b]) return totals[a] > totals[b];
+    return a < b;
+  });
+  size_t k = ranked.size();
+  if (o.max_clusters != kInf) k = std::min(k, (size_t)o.max_clusters);
+  std::vector<int> selected(ranked.begin(), ranked.begin() + k);
+
+  if (!o.mode_divide) {
+    for (int j : selected) {
+      out_selected[j] = 1;
+      out_replicas[j] = kNil;
+    }
+    return;
+  }
+
+  // Replicas: the planner (planner.go:83-177).
+  std::vector<int64_t> weights(c, 0);
+  if (o.weights_given) {
+    for (int j : selected) weights[j] = o.weights[j];
+  } else {
+    dynamic_weights(w, selected, weights);
+  }
+  std::vector<Pref> prefs(c);
+  for (int j : selected) {
+    prefs[j].weight = weights[j];
+    prefs[j].min_replicas = o.min_replicas[j];
+    prefs[j].max_replicas = o.max_replicas[j] == kInf ? -1 : o.max_replicas[j];
+    prefs[j].tiebreak = o.tiebreak[j];
+  }
+  std::vector<int64_t> capacity(c, -1);
+  for (int j = 0; j < c; ++j)
+    if (o.capacity[j] != kInf) capacity[j] = o.capacity[j];
+
+  std::vector<int> order = selected;
+  sort_order(order, prefs);
+
+  bool keep = o.keep_unschedulable || !o.avoid_disruption;
+  std::vector<int64_t> desired(c, 0), overflow(c, 0);
+  distribute(order, prefs, capacity, o.total, keep, desired, overflow);
+
+  std::vector<int64_t> plan_out;
+  if (!o.avoid_disruption) {
+    plan_out = desired;
+  } else {
+    std::vector<int64_t> current(c, 0);
+    int64_t cur_total = 0, want_total = 0;
+    for (int j : order) {
+      int64_t reps =
+          o.current_mask[j]
+              ? (o.current_replicas[j] == kNil ? o.total : o.current_replicas[j])
+              : 0;
+      if (capacity[j] >= 0) reps = std::min(reps, capacity[j]);
+      current[j] = reps;
+      cur_total += reps;
+      want_total += desired[j];
+    }
+    if (cur_total == want_total) {
+      plan_out = current;
+    } else if (cur_total > want_total) {
+      scale_down(prefs, order, current, desired, cur_total - want_total, c,
+                 plan_out);
+    } else {
+      scale_up(prefs, order, current, desired, want_total - cur_total, c,
+               plan_out);
+    }
+  }
+
+  // Merge plan + overflow, drop zero entries (rsp.go:158-177).
+  for (int j : selected) {
+    int64_t reps = plan_out[j] + overflow[j];
+    if (reps != 0) {
+      out_selected[j] = 1;
+      out_replicas[j] = reps;
+      out_counted[j] = 1;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void kadm_seq_schedule_batch(
+    int32_t b, int32_t c, int32_t r, const uint8_t* filter_enabled,
+    const uint8_t* api_ok, const uint8_t* taint_ok_new,
+    const uint8_t* taint_ok_cur, const uint8_t* selector_ok,
+    const uint8_t* placement_has, const uint8_t* placement_ok,
+    const int64_t* request, const int64_t* alloc, const int64_t* used,
+    const uint8_t* score_enabled, const int64_t* taint_counts,
+    const int64_t* affinity_scores, const int32_t* max_clusters,
+    const uint8_t* mode_divide, const uint8_t* sticky,
+    const uint8_t* current_mask, const int64_t* current_replicas,
+    const int32_t* total, const uint8_t* weights_given, const int32_t* weights,
+    const int32_t* min_replicas, const int32_t* max_replicas,
+    const int32_t* capacity, const uint8_t* keep_unschedulable,
+    const uint8_t* avoid_disruption, const int32_t* tiebreak,
+    const int64_t* cpu_alloc, const int64_t* cpu_avail, uint8_t* out_selected,
+    int64_t* out_replicas, uint8_t* out_counted) {
+  World w{c, r, alloc, used, cpu_alloc, cpu_avail};
+  for (int32_t i = 0; i < b; ++i) {
+    Object o;
+    o.filter_enabled = filter_enabled + i * 5;
+    o.score_enabled = score_enabled + i * 5;
+    o.api_ok = api_ok + (size_t)i * c;
+    o.taint_ok_new = taint_ok_new + (size_t)i * c;
+    o.taint_ok_cur = taint_ok_cur + (size_t)i * c;
+    o.selector_ok = selector_ok + (size_t)i * c;
+    o.placement_ok = placement_ok + (size_t)i * c;
+    o.current_mask = current_mask + (size_t)i * c;
+    o.placement_has = placement_has[i];
+    o.mode_divide = mode_divide[i];
+    o.sticky = sticky[i];
+    o.weights_given = weights_given[i];
+    o.keep_unschedulable = keep_unschedulable[i];
+    o.avoid_disruption = avoid_disruption[i];
+    o.request = request + (size_t)i * r;
+    o.taint_counts = taint_counts + (size_t)i * c;
+    o.affinity_scores = affinity_scores + (size_t)i * c;
+    o.current_replicas = current_replicas + (size_t)i * c;
+    o.weights = weights + (size_t)i * c;
+    o.min_replicas = min_replicas + (size_t)i * c;
+    o.max_replicas = max_replicas + (size_t)i * c;
+    o.capacity = capacity + (size_t)i * c;
+    o.tiebreak = tiebreak + (size_t)i * c;
+    o.max_clusters = max_clusters[i];
+    o.total = total[i];
+    schedule_one(o, w, out_selected + (size_t)i * c,
+                 out_replicas + (size_t)i * c, out_counted + (size_t)i * c);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,109 @@
+"""ctypes wrapper for the native sequential scheduler baseline.
+
+``seq_schedule_batch`` runs the C++ per-object scheduling loop
+(native/seqsched.cpp — the compiled stand-in for the reference's
+in-process Go scheduler) over a featurized batch, returning
+(selected, replicas, counted) arrays shaped like TickOutputs.  Returns
+None when no native toolchain/library is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from kubeadmiral_tpu import native
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def prepare(inp) -> tuple:
+    """Dtype/layout conversion of a TickInputs-like namedtuple into the
+    C ABI's arrays — separated from :func:`run` so benchmarks can keep
+    marshalling out of the timed region (the Go scheduler operates on
+    its own in-memory structs; charging the baseline for numpy
+    conversions would inflate vs_baseline)."""
+
+    def u8(x):
+        return np.ascontiguousarray(np.asarray(x).astype(np.uint8))
+
+    def i32(x):
+        return np.ascontiguousarray(np.asarray(x), dtype=np.int32)
+
+    def i64(x):
+        return np.ascontiguousarray(np.asarray(x), dtype=np.int64)
+
+    api_ok = u8(inp.api_ok)
+    b, c = api_ok.shape
+    request = i64(inp.request)
+    r = request.shape[1]
+
+    args = [
+        u8(inp.filter_enabled),
+        api_ok,
+        u8(inp.taint_ok_new),
+        u8(inp.taint_ok_cur),
+        u8(inp.selector_ok),
+        u8(inp.placement_has),
+        u8(inp.placement_ok),
+        request,
+        i64(inp.alloc),
+        i64(inp.used),
+        u8(inp.score_enabled),
+        i64(inp.taint_counts),
+        i64(inp.affinity_scores),
+        i32(inp.max_clusters),
+        u8(inp.mode_divide),
+        u8(inp.sticky),
+        u8(inp.current_mask),
+        i64(inp.current_replicas),
+        i32(inp.total),
+        u8(inp.weights_given),
+        i32(inp.weights),
+        i32(inp.min_replicas),
+        i32(inp.max_replicas),
+        i32(inp.capacity),
+        u8(inp.keep_unschedulable),
+        u8(inp.avoid_disruption),
+        i32(inp.tiebreak),
+        i64(inp.cpu_alloc),
+        i64(inp.cpu_avail),
+    ]
+    return b, c, r, args
+
+
+def run(prepared) -> Optional[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Run the C++ scheduling loop on :func:`prepare`'s output."""
+    lib = native.load()
+    if lib is None:
+        return None
+    b, c, r, args = prepared
+    out_selected = np.zeros((b, c), np.uint8)
+    out_replicas = np.zeros((b, c), np.int64)
+    out_counted = np.zeros((b, c), np.uint8)
+
+    ctype_for = {np.uint8: ctypes.c_uint8, np.int32: ctypes.c_int32,
+                 np.int64: ctypes.c_int64}
+    lib.kadm_seq_schedule_batch(
+        b,
+        c,
+        r,
+        *[_ptr(a, ctype_for[a.dtype.type]) for a in args],
+        _ptr(out_selected, ctypes.c_uint8),
+        _ptr(out_replicas, ctypes.c_int64),
+        _ptr(out_counted, ctypes.c_uint8),
+    )
+    return out_selected, out_replicas, out_counted
+
+
+def seq_schedule_batch(
+    inp,
+) -> Optional[tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """inp: a TickInputs-like namedtuple of (numpy-convertible) arrays."""
+    if native.load() is None:
+        return None
+    return run(prepare(inp))

@@ -161,6 +161,41 @@ class ClusterView:
         return out
 
 
+def featurize_signature(su: T.SchedulingUnit) -> tuple:
+    """Equality-comparable digest of every unit field the featurizer
+    reads — the tensor-level analogue of the reference's scheduling
+    trigger hash (reference: scheduler/schedulingtriggers.go:106-148).
+    Two units with equal signatures featurize to identical rows against
+    the same cluster topology, which is what lets the engine patch only
+    changed rows into a cached chunk across ticks."""
+    am = su.auto_migration
+    # Mutable dicts are snapshotted (sorted items) so a caller mutating a
+    # unit in place can't silently alias the cached signature.
+    return (
+        su.key,
+        su.gvk,
+        su.scheduling_mode,
+        su.desired_replicas,
+        su.sticky_cluster,
+        su.avoid_disruption,
+        su.max_clusters,
+        tuple(sorted(su.resource_request.items())),
+        su.tolerations,
+        tuple(sorted(su.cluster_selector.items())),
+        su.cluster_names,
+        su.affinity,
+        tuple(sorted(su.current_clusters.items(), key=lambda kv: kv[0])),
+        tuple(sorted(su.min_replicas.items())),
+        tuple(sorted(su.max_replicas.items())),
+        tuple(sorted(su.weights.items())),
+        (am.keep_unschedulable_replicas, tuple(sorted(am.estimated_capacity.items())))
+        if am is not None
+        else None,
+        su.enabled_filters,
+        su.enabled_scores,
+    )
+
+
 def _build_cluster_view(clusters, units) -> ClusterView:
     scalars: list[str] = []
     seen = set()

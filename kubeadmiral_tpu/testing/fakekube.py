@@ -155,6 +155,15 @@ class FakeKube:
             meta = obj.setdefault("metadata", {})
             meta["uid"] = old["metadata"].get("uid")
             meta["resourceVersion"] = self._bump()
+            # Status is a subresource: like a real apiserver, a main-
+            # resource update ignores the request's .status and keeps the
+            # stored one (only update_status writes it).  This is what
+            # lets sync push template updates without clobbering
+            # member-owned status.
+            if "status" in old:
+                obj["status"] = copy.deepcopy(old["status"])
+            else:
+                obj.pop("status", None)
             if "spec" in old or "spec" in obj:
                 old_gen = old["metadata"].get("generation", 1)
                 spec_changed = obj.get("spec") != old.get("spec")

@@ -64,7 +64,15 @@ class MemberDeploymentSimulator:
                     continue
                 if self._step_one(member_name, dep):
                     try:
-                        member.update(self.resource, dep)
+                        # Like the real deployment controller: replica-set
+                        # bookkeeping annotations go through a main update
+                        # (which ignores .status), observed counts through
+                        # the status subresource.
+                        updated = member.update(self.resource, dep)
+                        dep["metadata"]["resourceVersion"] = updated[
+                            "metadata"
+                        ]["resourceVersion"]
+                        member.update_status(self.resource, dep)
                     except Conflict:
                         pass  # raced with sync; next step retries
                     progressed = True

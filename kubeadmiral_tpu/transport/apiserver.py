@@ -116,11 +116,15 @@ class KubeApiServer:
         self._closed = threading.Event()
         self._mint_sa_tokens = mint_sa_tokens
 
-        # Seed accepted tokens from pre-existing secrets, then track via
-        # the event feed (under the store lock, so no races with auth).
+        # Seed accepted tokens from pre-existing service-account token
+        # secrets, then track via the event feed (under the store lock,
+        # so no races with auth).  ONLY type kubernetes.io/service-
+        # account-token secrets count: an ordinary workload Secret that
+        # happens to carry a data.token key (e.g. federated user data)
+        # must never become an apiserver credential.
         if admin_token is not None:
             for secret in store.list_view(SECRETS):
-                token = (secret.get("data") or {}).get("token")
+                token = self._sa_token(secret)
                 if token:
                     self._tokens.add(token)
         store.watch_all(self._on_store_event)
@@ -138,6 +142,12 @@ class KubeApiServer:
         )
         self._thread.start()
 
+    @staticmethod
+    def _sa_token(secret: dict) -> Optional[str]:
+        if secret.get("type") != "kubernetes.io/service-account-token":
+            return None
+        return (secret.get("data") or {}).get("token")
+
     # -- store event feed (runs under the store lock) --------------------
     def _on_store_event(self, resource: str, event: str, obj: dict, seq: int) -> None:
         self._log.append(resource, event, obj, seq)
@@ -145,7 +155,7 @@ class KubeApiServer:
             if self._mint_sa_tokens and resource == SERVICE_ACCOUNTS and event == ADDED:
                 self._mint_token(obj)
             return
-        token = (obj.get("data") or {}).get("token")
+        token = self._sa_token(obj)
         if token:
             if event == "DELETED":
                 self._tokens.discard(token)

@@ -91,8 +91,28 @@ class HttpKube:
         self, method: str, path: str, body: Optional[dict] = None
     ) -> tuple[int, dict, dict]:
         payload = json.dumps(body).encode() if body is not None else None
+        if method != "GET":
+            # Mutations ride a fresh connection: a stale kept-alive socket
+            # fails ambiguously (the server may already have applied the
+            # request), and blindly re-sending a POST/PUT/DELETE would
+            # surface spurious AlreadyExists/Conflict/NotFound to callers
+            # that treat those as genuine races.  A localhost handshake
+            # costs microseconds; ambiguity costs correctness.
+            conn = http.client.HTTPConnection(self._netloc, timeout=self._timeout)
+            try:
+                conn.request(method, path, body=payload, headers=self._headers())
+                resp = conn.getresponse()
+                data = resp.read()
+                headers = dict(resp.getheaders())
+                return resp.status, (json.loads(data) if data else {}), headers
+            except (OSError, http.client.HTTPException) as e:
+                raise TransportError(f"{method} {self._netloc}{path}: {e}")
+            finally:
+                conn.close()
+        # Idempotent GETs reuse the pooled connection, retrying once on a
+        # stale keep-alive.
         last_err: Optional[Exception] = None
-        for attempt in range(2):
+        for _ in range(2):
             conn = self._conn()
             try:
                 conn.request(method, path, body=payload, headers=self._headers())
@@ -101,7 +121,6 @@ class HttpKube:
                 headers = dict(resp.getheaders())
                 return resp.status, (json.loads(data) if data else {}), headers
             except (OSError, http.client.HTTPException) as e:
-                # Stale kept-alive connection: drop and retry once.
                 last_err = e
                 conn.close()
                 self._local.conn = None

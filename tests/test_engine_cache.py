@@ -1,0 +1,131 @@
+"""Incremental featurization: cached chunks must never change results.
+
+Every path (full hit, row patch, topology invalidation, resource drift)
+is differentially checked against a cache-less engine on the same world.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from kubeadmiral_tpu.models.types import (
+    ClusterState,
+    MODE_DIVIDE,
+    SchedulingUnit,
+    Taint,
+    Toleration,
+    parse_resources,
+)
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+
+def make_world(b=64, c=12):
+    clusters = [
+        ClusterState(
+            name=f"m-{j:03d}",
+            labels={"region": "eu" if j % 2 else "us"},
+            taints=(Taint("dedicated", "x", "NoSchedule"),) if j % 5 == 0 else (),
+            allocatable=parse_resources({"cpu": str(8 + j), "memory": f"{32 + j}Gi"}),
+            available=parse_resources({"cpu": str(4 + j // 2), "memory": f"{16 + j}Gi"}),
+            api_resources=frozenset({"apps/v1/Deployment"}),
+        )
+        for j in range(c)
+    ]
+    units = [
+        SchedulingUnit(
+            gvk="apps/v1/Deployment",
+            namespace=f"ns-{i % 5}",
+            name=f"w-{i:04d}",
+            scheduling_mode=MODE_DIVIDE if i % 3 else "Duplicate",
+            desired_replicas=(i % 20) + 1,
+            resource_request=parse_resources({"cpu": f"{(i % 4) * 100}m"}),
+            tolerations=(Toleration(key="dedicated", operator="Exists"),)
+            if i % 2
+            else (),
+            avoid_disruption=bool(i % 2),
+        )
+        for i in range(b)
+    ]
+    return units, clusters
+
+
+def results_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.clusters == y.clusters
+
+
+class TestEngineCache:
+    def test_unchanged_retick_hits_and_matches(self):
+        units, clusters = make_world()
+        engine = SchedulerEngine(chunk_size=32)
+        first = engine.schedule(units, clusters)
+        second = engine.schedule(units, clusters)
+        assert engine.cache_stats["hit"] >= 2  # both chunks
+        results_equal(first, second)
+
+    def test_small_churn_patches_and_matches_fresh(self):
+        units, clusters = make_world()
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)
+
+        churned = list(units)
+        for k in (3, 40):
+            churned[k] = dataclasses.replace(
+                units[k],
+                desired_replicas=(units[k].desired_replicas or 1) + 7,
+                resource_request=parse_resources({"cpu": "900m"}),
+            )
+        got = engine.schedule(churned, clusters)
+        assert engine.cache_stats["patch"] >= 2
+        want = SchedulerEngine(chunk_size=32).schedule(churned, clusters)
+        results_equal(got, want)
+
+    def test_resource_drift_keeps_cache_and_matches_fresh(self):
+        units, clusters = make_world()
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)
+        drifted = [
+            dataclasses.replace(
+                cl, available=parse_resources({"cpu": "2", "memory": "8Gi"})
+            )
+            for cl in clusters
+        ]
+        got = engine.schedule(units, drifted)
+        assert engine.cache_stats["hit"] >= 2
+        assert engine.cache_stats["miss"] == 2  # only the cold tick
+        want = SchedulerEngine(chunk_size=32).schedule(units, drifted)
+        results_equal(got, want)
+
+    def test_topology_change_invalidates(self):
+        units, clusters = make_world()
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)
+        relabeled = [
+            dataclasses.replace(cl, labels={**cl.labels, "tier": "gold"})
+            for cl in clusters
+        ]
+        got = engine.schedule(units, relabeled)
+        assert engine.cache_stats["miss"] >= 4  # cold tick + invalidated
+        want = SchedulerEngine(chunk_size=32).schedule(units, relabeled)
+        results_equal(got, want)
+
+    def test_mass_churn_falls_back_to_full_featurize(self):
+        units, clusters = make_world()
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)
+        churned = [
+            dataclasses.replace(u, desired_replicas=50) for u in units
+        ]
+        got = engine.schedule(churned, clusters)
+        assert engine.cache_stats["patch"] == 0
+        want = SchedulerEngine(chunk_size=32).schedule(churned, clusters)
+        results_equal(got, want)
+
+    def test_cache_budget_zero_disables(self):
+        units, clusters = make_world()
+        engine = SchedulerEngine(chunk_size=32, cache_bytes=0)
+        first = engine.schedule(units, clusters)
+        second = engine.schedule(units, clusters)
+        assert engine.cache_stats["hit"] == 0
+        results_equal(first, second)

@@ -1,0 +1,177 @@
+"""The full 21-type default FTC set, with RBAC/quota propagation e2e.
+
+Mirrors the reference's default registrations
+(config/sample/host/01-ftc.yaml) and its resourcepropagation e2e style:
+create a source object + policy, run the controllers, observe member
+objects — for a namespaced RBAC type (Role), a quota type
+(ResourceQuota, member-owned status retained across template updates),
+and a cluster-scoped type (ClusterRole via ClusterPropagationPolicy).
+"""
+
+import dataclasses
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import (
+    CLUSTER_PROPAGATION_POLICIES,
+    PROPAGATION_POLICIES,
+)
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+REFERENCE_21 = {
+    "namespaces", "configmaps", "deployments.apps", "serviceaccounts",
+    "secrets", "services", "storageclasses.storage.k8s.io",
+    "persistentvolumes", "persistentvolumeclaims",
+    "roles.rbac.authorization.k8s.io",
+    "rolebindings.rbac.authorization.k8s.io",
+    "clusterroles.rbac.authorization.k8s.io",
+    "clusterrolebindings.rbac.authorization.k8s.io",
+    "statefulsets.apps", "daemonsets.apps", "jobs.batch", "cronjobs.batch",
+    "ingresses.networking.k8s.io", "limitranges", "resourcequotas",
+    "customresourcedefinitions.apiextensions.k8s.io",
+}
+
+
+def test_default_set_matches_reference_21():
+    names = {f.name for f in default_ftcs()}
+    assert names == REFERENCE_21
+    by_name = {f.name: f for f in default_ftcs()}
+    for cluster_scoped in (
+        "persistentvolumes", "storageclasses.storage.k8s.io",
+        "clusterroles.rbac.authorization.k8s.io",
+        "clusterrolebindings.rbac.authorization.k8s.io",
+        "customresourcedefinitions.apiextensions.k8s.io", "namespaces",
+    ):
+        assert not by_name[cluster_scoped].namespaced, cluster_scoped
+
+
+def ftc_by_name(name, scheduler_only=True):
+    ftc = next(f for f in default_ftcs() if f.name == name)
+    if scheduler_only:
+        ftc = dataclasses.replace(
+            ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
+        )
+    return ftc
+
+
+def settle(*controllers, rounds=30):
+    for _ in range(rounds):
+        if not any([c.worker.step() for c in controllers]):
+            return
+
+
+class _Harness:
+    def __init__(self, ftc):
+        self.ftc = ftc
+        self.fleet = ClusterFleet()
+        gvk = ftc.source.gvk
+        self.controllers = (
+            FederatedClusterController(self.fleet, api_resource_probe=[gvk]),
+            FederateController(self.fleet.host, ftc),
+            SchedulerController(self.fleet.host, ftc),
+            SyncController(self.fleet, ftc),
+        )
+        for name in ("c1", "c2"):
+            self.fleet.add_member(name)
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                 "kind": "FederatedCluster",
+                 "metadata": {"name": name}, "spec": {}},
+            )
+
+    def run(self):
+        settle(*self.controllers)
+
+
+def test_role_propagates_to_members():
+    h = _Harness(ftc_by_name("roles.rbac.authorization.k8s.io"))
+    h.fleet.host.create(
+        PROPAGATION_POLICIES,
+        {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+         "kind": "PropagationPolicy",
+         "metadata": {"name": "pp", "namespace": "team-a"},
+         "spec": {"schedulingMode": "Duplicate"}},
+    )
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": {"name": "reader", "namespace": "team-a",
+                     "labels": {C.PROPAGATION_POLICY_NAME: "pp"}},
+        "rules": [{"apiGroups": [""], "resources": ["pods"],
+                   "verbs": ["get", "list"]}],
+    }
+    h.fleet.host.create(h.ftc.source.resource, role)
+    h.run()
+    for member in ("c1", "c2"):
+        got = h.fleet.member(member).get(h.ftc.source.resource, "team-a/reader")
+        assert got["rules"] == role["rules"]
+        assert got["metadata"]["labels"][C.MANAGED_LABEL] == "true"
+
+
+def test_resourcequota_propagates_and_member_status_retained():
+    h = _Harness(ftc_by_name("resourcequotas"))
+    h.fleet.host.create(
+        PROPAGATION_POLICIES,
+        {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+         "kind": "PropagationPolicy",
+         "metadata": {"name": "pp", "namespace": "team-a"},
+         "spec": {"schedulingMode": "Duplicate"}},
+    )
+    quota = {
+        "apiVersion": "v1",
+        "kind": "ResourceQuota",
+        "metadata": {"name": "caps", "namespace": "team-a",
+                     "labels": {C.PROPAGATION_POLICY_NAME: "pp"}},
+        "spec": {"hard": {"cpu": "10", "memory": "20Gi"}},
+    }
+    h.fleet.host.create(h.ftc.source.resource, quota)
+    h.run()
+    member = h.fleet.member("c1")
+    got = member.get(h.ftc.source.resource, "team-a/caps")
+    assert got["spec"]["hard"] == {"cpu": "10", "memory": "20Gi"}
+
+    # Member-side controller fills status (member-owned); a template
+    # update from the host must not clobber it.
+    got["status"] = {"used": {"cpu": "3"}}
+    member.update_status(h.ftc.source.resource, got)
+
+    src = h.fleet.host.get(h.ftc.source.resource, "team-a/caps")
+    src["spec"]["hard"]["cpu"] = "16"
+    h.fleet.host.update(h.ftc.source.resource, src)
+    h.run()
+
+    got = member.get(h.ftc.source.resource, "team-a/caps")
+    assert got["spec"]["hard"]["cpu"] == "16"
+    assert got["status"] == {"used": {"cpu": "3"}}
+
+
+def test_clusterrole_propagates_via_cluster_policy():
+    h = _Harness(ftc_by_name("clusterroles.rbac.authorization.k8s.io"))
+    h.fleet.host.create(
+        CLUSTER_PROPAGATION_POLICIES,
+        {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+         "kind": "ClusterPropagationPolicy",
+         "metadata": {"name": "cpp"},
+         "spec": {"schedulingMode": "Duplicate"}},
+    )
+    cr = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "admin-lite",
+                     "labels": {C.CLUSTER_PROPAGATION_POLICY_NAME: "cpp"}},
+        "rules": [{"apiGroups": ["*"], "resources": ["*"], "verbs": ["get"]}],
+    }
+    h.fleet.host.create(h.ftc.source.resource, cr)
+    h.run()
+    for member in ("c1", "c2"):
+        got = h.fleet.member(member).get(h.ftc.source.resource, "admin-lite")
+        assert got["rules"] == cr["rules"]
+        assert got["metadata"]["labels"][C.MANAGED_LABEL] == "true"

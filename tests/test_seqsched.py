@@ -1,0 +1,51 @@
+"""Differential test: native C++ sequential scheduler vs the Python oracle.
+
+The C++ baseline (native/seqsched.cpp) must agree bit-for-bit with
+pipeline_oracle.schedule_one on the full feature space — it is the
+number bench.py divides by, so any semantic drift would silently distort
+vs_baseline.
+"""
+
+import numpy as np
+import pytest
+
+from kubeadmiral_tpu.native.seqsched import seq_schedule_batch
+from kubeadmiral_tpu.ops.pipeline_oracle import schedule_one
+
+from test_pipeline import R, random_problem, to_tick_inputs
+
+
+@pytest.mark.parametrize("c", [3, 8, 19])
+def test_native_matches_oracle(c):
+    rng = np.random.default_rng(7_000 + c)
+    names = [f"member-{j}" for j in range(c)]
+    shared_alloc = [[int(x) for x in rng.integers(5, 50, R)] for _ in range(c)]
+    shared_used = [[int(x) for x in rng.integers(0, 40, R)] for _ in range(c)]
+    shared_cpu_a = [int(x) for x in rng.integers(0, 30, c)]
+    shared_cpu_v = [int(x) for x in rng.integers(-3, 25, c)]
+    problems = []
+    for i in range(120):
+        p = random_problem(rng, c, f"ns-{i}/workload-{i}", names)
+        p.alloc, p.used = shared_alloc, shared_used
+        p.cpu_alloc, p.cpu_avail = shared_cpu_a, shared_cpu_v
+        problems.append(p)
+
+    out = seq_schedule_batch(to_tick_inputs(problems, c))
+    assert out is not None, "native library unavailable"
+    selected, replicas, counted = out
+
+    for i, p in enumerate(problems):
+        want = schedule_one(p)
+        got_idx = set(np.nonzero(selected[i])[0].tolist())
+        assert got_idx == set(want.keys()), (
+            f"case {i}: native selected {sorted(got_idx)} != "
+            f"oracle {sorted(want)}\n{p}"
+        )
+        for j in got_idx:
+            w = want[j]
+            g = int(replicas[i, j])
+            if w is None:
+                assert g == -1, f"case {i} cluster {j}: {g} != nil\n{p}"
+                assert not counted[i, j]
+            else:
+                assert g == w, f"case {i} cluster {j}: {g} != {w}\n{p}\n{want}"
