@@ -1,0 +1,232 @@
+"""End-to-end control-plane benchmark with a per-stage budget.
+
+Runs the FULL pipeline — source create -> federate -> batch-schedule ->
+override -> sync (member writes) -> status collection + aggregation —
+over an in-process fleet, and attributes wall time to each controller so
+throughput regressions are assignable to a stage (VERDICT r1 #10).
+
+Shapes via BENCH_E2E_OBJECTS / BENCH_E2E_CLUSTERS (default 1000x50, the
+reference e2e suite's scale knob; config #2 of BASELINE.md).
+
+Prints one JSON line:
+  {"metric": "e2e_objects_per_sec_BxC", "value": ..., "unit": ...,
+   "stages_s": {controller: seconds}, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+N_OBJECTS = int(os.environ.get("BENCH_E2E_OBJECTS", 1000))
+N_CLUSTERS = int(os.environ.get("BENCH_E2E_CLUSTERS", 50))
+
+
+class StageTimer:
+    """Wraps each controller's worker.step() with cumulative timing."""
+
+    def __init__(self, named_controllers):
+        self.stages = {name: 0.0 for name, _ in named_controllers}
+        self.controllers = named_controllers
+
+    def settle(self, max_rounds=10_000):
+        for _ in range(max_rounds):
+            progressed = False
+            for name, ctl in self.controllers:
+                t0 = time.perf_counter()
+                stepped = True
+                # Drain this controller fully before moving on: batch
+                # controllers amortize best over a full queue.
+                while stepped:
+                    stepped = ctl.worker.step()
+                    progressed |= stepped
+                self.stages[name] += time.perf_counter() - t0
+            if not progressed:
+                return
+
+
+def main():
+    import dataclasses
+
+    from kubeadmiral_tpu.federation.clusterctl import (
+        FEDERATED_CLUSTERS,
+        FederatedClusterController,
+        NODES,
+    )
+    from kubeadmiral_tpu.federation.federate import FederateController
+    from kubeadmiral_tpu.federation.overridectl import OverrideController
+    from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+    from kubeadmiral_tpu.federation.statusctl import StatusController
+    from kubeadmiral_tpu.federation.sync import SyncController
+    from kubeadmiral_tpu.models.ftc import default_ftcs
+    from kubeadmiral_tpu.federation.overridectl import OVERRIDE_POLICIES
+    from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+    from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+    ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+    ftc = dataclasses.replace(
+        ftc,
+        controllers=(
+            ("kubeadmiral.io/global-scheduler",),
+            ("kubeadmiral.io/overridepolicy-controller",),
+        ),
+    )
+    fleet = ClusterFleet()
+    gvk = "apps/v1/Deployment"
+
+    named = [
+        ("cluster", FederatedClusterController(fleet, api_resource_probe=[gvk])),
+        ("federate", FederateController(fleet.host, ftc)),
+        ("schedule", SchedulerController(fleet.host, ftc)),
+        ("override", OverrideController(fleet.host, ftc)),
+        ("sync", SyncController(fleet, ftc)),
+        ("status", StatusController(fleet, ftc)),
+    ]
+    timer = StageTimer(named)
+
+    for j in range(N_CLUSTERS):
+        member = fleet.add_member(f"m-{j:04d}")
+        member.create(
+            NODES,
+            {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {"name": "n1"},
+                "spec": {},
+                "status": {
+                    "allocatable": {"cpu": str(32 + j % 64), "memory": "256Gi"},
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            },
+        )
+        fleet.host.create(
+            FEDERATED_CLUSTERS,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "FederatedCluster",
+                "metadata": {"name": f"m-{j:04d}", "labels": {"tier": str(j % 3)}},
+                "spec": {},
+            },
+        )
+    fleet.host.create(
+        PROPAGATION_POLICIES,
+        {
+            "apiVersion": "core.kubeadmiral.io/v1alpha1",
+            "kind": "PropagationPolicy",
+            "metadata": {"name": "pp", "namespace": "default"},
+            "spec": {"schedulingMode": "Divide"},
+        },
+    )
+    fleet.host.create(
+        OVERRIDE_POLICIES,
+        {
+            "apiVersion": "core.kubeadmiral.io/v1alpha1",
+            "kind": "OverridePolicy",
+            "metadata": {"name": "op", "namespace": "default"},
+            "spec": {
+                "overrideRules": [
+                    {
+                        "targetClusters": {"clusterSelector": {"tier": "1"}},
+                        "overriders": {
+                            "jsonpatch": [
+                                {
+                                    "operator": "add",
+                                    "path": "/metadata/annotations/tier",
+                                    "value": "one",
+                                }
+                            ]
+                        },
+                    }
+                ]
+            },
+        },
+    )
+    timer.settle()  # join clusters before the clock starts
+
+    def make_deployment(i):
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": f"web-{i:05d}",
+                "namespace": "default",
+                "labels": {
+                    "kubeadmiral.io/propagation-policy-name": "pp",
+                    "kubeadmiral.io/override-policy-name": "op",
+                },
+            },
+            "spec": {
+                "replicas": (i % 20) + 1,
+                "selector": {"matchLabels": {"app": f"web-{i:05d}"}},
+                "template": {
+                    "metadata": {"labels": {"app": f"web-{i:05d}"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "nginx",
+                                "resources": {"requests": {"cpu": "50m"}},
+                            }
+                        ]
+                    },
+                },
+            },
+        }
+
+    t_create = time.perf_counter()
+    for i in range(N_OBJECTS):
+        fleet.host.create(ftc.source.resource, make_deployment(i))
+    create_s = time.perf_counter() - t_create
+
+    stages_before = dict(timer.stages)
+    t0 = time.perf_counter()
+    timer.settle()
+    total_s = time.perf_counter() - t0
+
+    # Verify full propagation: every placed (object, cluster) pair has a
+    # member object and an OK propagation status.  (Divide mode drops
+    # zero-replica clusters, so the expected count comes from the actual
+    # placements, not N x C.)
+    member_objects = sum(
+        len(kube.keys(ftc.source.resource)) for kube in fleet.members.values()
+    )
+    expected = 0
+    for key in fleet.host.keys(ftc.federated.resource):
+        fed = fleet.host.get(ftc.federated.resource, key)
+        statuses = fed.get("status", {}).get("clusters", [])
+        assert statuses and all(c["status"] == "OK" for c in statuses), key
+        expected += len(statuses)
+    propagated = {
+        c["cluster"]
+        for c in fleet.host.get(ftc.federated.resource, "default/web-00000")[
+            "status"
+        ]["clusters"]
+    }
+
+    stages = {
+        name: round(timer.stages[name] - stages_before.get(name, 0.0), 3)
+        for name in timer.stages
+    }
+    result = {
+        "metric": f"e2e_objects_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
+        "value": round(N_OBJECTS / total_s, 1),
+        "unit": "objects/s",
+        "detail": {
+            "total_s": round(total_s, 2),
+            "create_s": round(create_s, 2),
+            "stages_s": stages,
+            "member_objects": member_objects,
+            "member_objects_expected": expected,
+            "member_writes_per_sec": round(member_objects / total_s, 1),
+        },
+    }
+    assert member_objects == expected, (member_objects, expected)
+    assert propagated  # first object reached its placed members
+    print(json.dumps(result))
+    print(f"# stages: {stages}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -456,7 +456,7 @@ class SchedulerController:
             # the plugin set, or a select plugin registered mid-tick
             # would narrow on fabricated zero scores.
             plugins = dict(self.webhook_plugins)
-            webhook_eval = self._webhook_eval(plugins)
+            webhook_eval = self._webhook_eval(plugins, units, clusters)
             # Score decoding only matters when a select webhook might
             # consume it (the decode is the engine's main host cost).
             want_scores = any(p.has_select for p in plugins.values())
@@ -473,15 +473,27 @@ class SchedulerController:
         return results
 
     # -- webhook (out-of-process) plugins --------------------------------
-    def _webhook_eval(self, plugins: dict[str, W.WebhookPlugin]):
+    @staticmethod
+    def _sticky_skip(su: T.SchedulingUnit) -> bool:
+        """Plugins never run for a stickily placed object
+        (generic_scheduler.go:103-107)."""
+        return su.sticky_cluster and bool(su.current_clusters)
+
+    def _webhook_eval(
+        self, plugins: dict[str, W.WebhookPlugin], units=(), clusters=()
+    ):
         """Host-side evaluator handed to the engine: AND of the unit's
         enabled webhook filters, sum of its webhook scores, per cluster.
         Any failing webhook call marks the cluster infeasible for this
         tick (the batch-mode analogue of the reference failing the whole
-        per-object schedule and backing off).  Calls fan out over a
-        thread pool per cluster row, and results are memoized by object
-        key so the select-narrowing rerun reuses them.  ``plugins`` is
-        the tick's plugin snapshot."""
+        per-object schedule and backing off).
+
+        Batch-capable plugins are evaluated upfront: ONE POST per plugin
+        per tick ships the whole (units x clusters) grid (vs the
+        reference's O(B x C) HTTP calls, webhook/v1alpha1/plugin.go:77-251).
+        Per-pair servers fall back to thread-pooled calls, memoized by
+        object key so the select-narrowing rerun repeats nothing.
+        ``plugins`` is the tick's plugin snapshot."""
         if not plugins:
             return None
         if self._webhook_pool is None:
@@ -490,6 +502,59 @@ class SchedulerController:
             )
         pool = self._webhook_pool
         cache: dict[str, Optional[tuple]] = {}
+        clusters = list(clusters)
+
+        # -- upfront batched calls ---------------------------------------
+        # plugin name -> key -> bool row / int row; a None row marks a
+        # batch call that failed with a protocol error (all clusters
+        # infeasible for those units this tick).
+        prefilter: dict[str, dict[str, Optional[np.ndarray]]] = {}
+        prescore: dict[str, dict[str, Optional[np.ndarray]]] = {}
+        for name, plugin in plugins.items():
+            if plugin.has_filter:
+                subset = [
+                    su
+                    for su in units
+                    if not self._sticky_skip(su)
+                    and name in (su.enabled_filters or ())
+                ]
+                if subset:
+                    try:
+                        rows = plugin.filter_batch(subset, clusters)
+                    except Exception:
+                        self.metrics.counter(
+                            f"scheduler-{self.ftc.name}.webhook_errors"
+                        )
+                        rows = [None] * len(subset)
+                    if rows is not None:
+                        prefilter[name] = {
+                            su.key: np.asarray(row, bool)
+                            if row is not None
+                            else None
+                            for su, row in zip(subset, rows)
+                        }
+            if plugin.has_score:
+                subset = [
+                    su
+                    for su in units
+                    if not self._sticky_skip(su)
+                    and name in (su.enabled_scores or ())
+                ]
+                if subset:
+                    try:
+                        rows = plugin.score_batch(subset, clusters)
+                    except Exception:
+                        self.metrics.counter(
+                            f"scheduler-{self.ftc.name}.webhook_errors"
+                        )
+                        rows = [None] * len(subset)
+                    if rows is not None:
+                        prescore[name] = {
+                            su.key: np.asarray(row, np.int64)
+                            if row is not None
+                            else None
+                            for su, row in zip(subset, rows)
+                        }
 
         def eval_cluster(su, cluster, filters, scorers):
             score = np.int64(0)
@@ -504,12 +569,10 @@ class SchedulerController:
                 return False, np.int64(0)
             return True, score
 
-        def evaluate(su: T.SchedulingUnit, clusters):
+        def evaluate(su: T.SchedulingUnit, eval_clusters):
             if su.key in cache:
                 return cache[su.key]
-            # Sticky short-circuit: plugins never run for a stickily
-            # placed object (generic_scheduler.go:103-107).
-            if su.sticky_cluster and su.current_clusters:
+            if self._sticky_skip(su):
                 cache[su.key] = None
                 return None
             filters = [
@@ -525,16 +588,49 @@ class SchedulerController:
             if not filters and not scorers:
                 cache[su.key] = None
                 return None
-            rows = list(
-                pool.map(
-                    lambda cluster: eval_cluster(su, cluster, filters, scorers),
-                    clusters,
+            c = len(eval_clusters)
+            ok = np.ones(c, bool)
+            score = np.zeros(c, np.int64)
+            failed = False
+            pair_filters, pair_scorers = [], []
+            for plugin in filters:
+                pre = prefilter.get(plugin.name)
+                if pre is None:
+                    pair_filters.append(plugin)
+                    continue
+                row = pre.get(su.key)
+                if row is None:  # batch protocol error: infeasible tick
+                    failed = True
+                    break
+                ok &= row
+            if not failed:
+                for plugin in scorers:
+                    pre = prescore.get(plugin.name)
+                    if pre is None:
+                        pair_scorers.append(plugin)
+                        continue
+                    row = pre.get(su.key)
+                    if row is None:
+                        failed = True
+                        break
+                    score = score + row
+            if failed:
+                result = (np.zeros(c, bool), np.zeros(c, np.int64))
+                cache[su.key] = result
+                return result
+            if pair_filters or pair_scorers:
+                rows = list(
+                    pool.map(
+                        lambda cluster: eval_cluster(
+                            su, cluster, pair_filters, pair_scorers
+                        ),
+                        eval_clusters,
+                    )
                 )
-            )
-            ok = np.array([r[0] for r in rows], bool)
-            scores = np.array([r[1] for r in rows], np.int64)
-            cache[su.key] = (ok, scores)
-            return ok, scores
+                ok &= np.array([r[0] for r in rows], bool)
+                score = score + np.array([r[1] for r in rows], np.int64)
+            cache[su.key] = (ok, score)
+            return ok, score
 
         return evaluate
 

@@ -100,6 +100,11 @@ class VersionManager:
                     for c, v in sorted(merged.items())
                 ],
             }
+            # Unchanged record: skip the write entirely — a restarted
+            # controller re-syncing a converged world must be read-only
+            # (manager.go's updatedVersionMap equality short-circuit).
+            if cr is not None and cr.get("status") == status:
+                return
             self._write(namespace, name, status, cr)
 
     def delete(self, namespace: str, name: str) -> None:

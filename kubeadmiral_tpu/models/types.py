@@ -112,10 +112,17 @@ class AutoMigrationSpec:
     estimated_capacity: dict[str, int] = field(default_factory=dict)
 
 
-@dataclass
+@dataclass(frozen=True)
 class SchedulingUnit:
     """The per-object scheduling request
-    (reference: framework/types.go:34-73)."""
+    (reference: framework/types.go:34-73).
+
+    Frozen: the engine's cross-tick caches use object identity as a
+    fast-path for "unchanged since last tick", so a unit must never be
+    modified after construction — including its nested dicts.  Derive
+    changed units with ``dataclasses.replace`` and fresh dict values
+    (which is what the controllers do: each reconcile builds new units
+    from the API objects)."""
 
     gvk: str  # "group/version/Kind"
     namespace: str

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from kubeadmiral_tpu.models import types as T
@@ -169,9 +170,39 @@ class _CachedChunk:
     """A previous tick's featurized chunk, patchable row-by-row."""
 
     sigs: list
+    units: list  # identity fast-path: `is`-compare before sig-compare
     inputs: TickInputs
     topo_fp: tuple
     nbytes: int
+    # Device-resident copies of the padded per-object tensors: a clean
+    # re-tick skips the host->device transfer entirely (the dominant
+    # cost over a tunneled TPU backend).
+    device_per_object: Optional[dict] = None
+    padded_shape: Optional[tuple] = None
+    # Previous tick's outputs (device) + decoded results (host) for the
+    # delta fetch: unchanged rows are never pulled off the device again.
+    prev_out: Optional[tuple] = None
+    prev_results: Optional[list] = None
+    # The ClusterView those results were computed against: identical
+    # view + clean hit = identical outputs, no dispatch needed at all.
+    prev_view: Optional[object] = None
+
+
+# jit helpers for the delta fetch -------------------------------------
+@jax.jit
+def _tick_with_delta(inp: TickInputs, psel, prep, pcnt):
+    """The fused tick plus an on-device diff against the previous tick's
+    outputs, in ONE dispatch: over a high-latency link (the tunneled TPU
+    backend) every dispatch costs a round trip, so the changed-rows mask
+    ships with the tick instead of as a follow-up program."""
+    out = schedule_tick.__wrapped__(inp)
+    diff = (out.selected != psel) | (out.replicas != prep) | (out.counted != pcnt)
+    return out, diff.any(axis=1).astype(jnp.int8)
+
+
+@jax.jit
+def _gather_rows(sel, rep, cnt, idx):
+    return sel[idx], rep[idx], cnt[idx]
 
 
 class SchedulerEngine:
@@ -202,6 +233,10 @@ class SchedulerEngine:
         self._chunk_cache: dict[int, _CachedChunk] = {}
         self._cache_used = 0
         self.cache_stats = {"hit": 0, "patch": 0, "miss": 0}
+        # Fetch path counters: "noop" = dispatch skipped entirely
+        # (identical inputs), "skip" = no rows changed (mask only),
+        # "delta" = changed rows gathered, "full" = whole chunk pulled.
+        self.fetch_stats = {"noop": 0, "skip": 0, "delta": 0, "full": 0}
         # Per-stage wall time of the last schedule() call: featurize
         # (host encoding), device (dispatch + on-device compute, incl.
         # host->device input transfer), fetch (device->host result
@@ -279,19 +314,31 @@ class SchedulerEngine:
 
     def _featurize_chunk(
         self, idx: int, chunk, clusters, view: ClusterView, webhook_eval
-    ) -> FeaturizedBatch:
+    ) -> tuple[FeaturizedBatch, str, Optional[_CachedChunk]]:
+        """Returns (batch, status, cache entry); status is one of
+        "hit" (rows unchanged), "patch" (few rows re-featurized),
+        "miss" (full featurize), "nocache" (caching not applicable)."""
         if webhook_eval is not None:
             # Webhook planes are per-tick HTTP results; never cached.
-            return featurize(chunk, clusters, view=view, webhook_eval=webhook_eval)
+            fb = featurize(chunk, clusters, view=view, webhook_eval=webhook_eval)
+            return fb, "nocache", None
 
         topo_fp = self._topo_fingerprint(view)
-        sigs = [featurize_signature(su) for su in chunk]
         cached = self._chunk_cache.get(idx)
+        sigs = None
         if (
             cached is not None
             and cached.topo_fp == topo_fp
-            and len(cached.sigs) == len(sigs)
+            and len(cached.units) == len(chunk)
         ):
+            # Identity fast-path: the controller hands the engine freshly
+            # built (effectively immutable) SchedulingUnits; identical
+            # objects mean identical rows without computing signatures.
+            if all(a is b for a, b in zip(chunk, cached.units)):
+                changed = []
+            else:
+                sigs = [featurize_signature(su) for su in chunk]
+                changed = [i for i, s in enumerate(sigs) if s != cached.sigs[i]]
             refreshed = cached.inputs._replace(
                 alloc=view.alloc,
                 used=view.used,
@@ -299,10 +346,14 @@ class SchedulerEngine:
                 cpu_avail=view.cpu_avail,
             )
             cached.inputs = refreshed
-            changed = [i for i, s in enumerate(sigs) if s != cached.sigs[i]]
             if not changed:
+                cached.units = list(chunk)
                 self.cache_stats["hit"] += 1
-                return FeaturizedBatch(inputs=refreshed, units=list(chunk), view=view)
+                return (
+                    FeaturizedBatch(inputs=refreshed, units=list(chunk), view=view),
+                    "hit",
+                    cached,
+                )
             if len(changed) <= max(1, len(chunk) // 4):
                 sub = featurize(
                     [chunk[i] for i in changed], clusters, view=view
@@ -314,25 +365,46 @@ class SchedulerEngine:
                     np.asarray(arr)[rows] = np.asarray(getattr(sub.inputs, name))
                 for i in changed:
                     cached.sigs[i] = sigs[i]
+                cached.units = list(chunk)
                 self.cache_stats["patch"] += 1
-                return FeaturizedBatch(inputs=refreshed, units=list(chunk), view=view)
+                return (
+                    FeaturizedBatch(inputs=refreshed, units=list(chunk), view=view),
+                    "patch",
+                    cached,
+                )
 
         fb = featurize(chunk, clusters, view=view)
         self.cache_stats["miss"] += 1
         if cached is not None:
             self._cache_used -= cached.nbytes
             del self._chunk_cache[idx]
-        nbytes = sum(
+        host_bytes = sum(
             np.asarray(arr).nbytes
             for name, arr in fb.inputs._asdict().items()
             if name not in _CLUSTER_ONLY_FIELDS
         )
+        # Budget charge covers everything the entry pins, not just the
+        # host arrays: a device-resident copy of the (padded, so up to
+        # 2x along each axis) per-object tensors, plus the previous
+        # tick's device outputs (i8+i32+i8 = 6 bytes/cell).  Decoded
+        # result dicts are small relative to the tensor planes.
+        b = len(chunk)
+        c = np.asarray(fb.inputs.api_ok).shape[1]
+        nbytes = host_bytes * 3 + b * c * 6 * 4
+        entry = None
         if self._cache_used + nbytes <= self.cache_bytes:
-            self._chunk_cache[idx] = _CachedChunk(
-                sigs=sigs, inputs=fb.inputs, topo_fp=topo_fp, nbytes=nbytes
+            if sigs is None:
+                sigs = [featurize_signature(su) for su in chunk]
+            entry = _CachedChunk(
+                sigs=sigs,
+                units=list(chunk),
+                inputs=fb.inputs,
+                topo_fp=topo_fp,
+                nbytes=nbytes,
             )
+            self._chunk_cache[idx] = entry
             self._cache_used += nbytes
-        return fb
+        return fb, "miss", entry
 
     def schedule(
         self,
@@ -361,46 +433,195 @@ class SchedulerEngine:
         for chunk_idx, start in enumerate(range(0, len(units), self.chunk_size)):
             chunk = units[start : start + self.chunk_size]
             t0 = time.perf_counter()
-            fb = self._featurize_chunk(chunk_idx, chunk, clusters, view, webhook_eval)
+            fb, status, entry = self._featurize_chunk(
+                chunk_idx, chunk, clusters, view, webhook_eval
+            )
             padded = _pad_batch(fb.inputs, self._bucket(len(chunk)))
             n_clusters = padded.cluster_valid.shape[0]
             padded = _pad_clusters(
                 padded, _pow2_bucket(n_clusters, self.min_cluster_bucket, 1 << 30)
             )
             t1 = time.perf_counter()
-            out = schedule_tick(padded)
+            timings["featurize"] += t1 - t0
+            # No-op shortcut: a clean cache hit against the very same
+            # cluster view is byte-identical input — the deterministic
+            # tick would reproduce the previous outputs, so skip the
+            # dispatch entirely (the engine-level analogue of the
+            # reference's trigger-hash skip, schedulingtriggers.go:64-67).
+            if (
+                status == "hit"
+                and not want_scores
+                and entry is not None
+                and entry.prev_results is not None
+                and entry.prev_view is view
+                and len(entry.prev_results) == len(chunk)
+            ):
+                self.fetch_stats["noop"] += 1
+                t3 = time.perf_counter()
+                results.extend(
+                    ScheduleResult(dict(r.clusters), dict(r.scores))
+                    for r in entry.prev_results
+                )
+                timings["decode"] += time.perf_counter() - t3
+                continue
+            device_in = self._device_inputs(entry, padded, status)
+            out_shape = np.asarray(padded.api_ok).shape
+            delta_ok = (
+                not want_scores
+                and entry is not None
+                and entry.prev_out is not None
+                and entry.prev_results is not None
+                and len(entry.prev_results) == len(chunk)
+                and entry.prev_out[0].shape == out_shape
+            )
+            if delta_ok:
+                out, mask_dev = _tick_with_delta(device_in, *entry.prev_out)
+            else:
+                out, mask_dev = schedule_tick(device_in), None
             jax.block_until_ready(out)
             t2 = time.perf_counter()
-            selected = np.asarray(out.selected)[: len(chunk)]
-            replicas = np.asarray(out.replicas)[: len(chunk)]
-            counted = np.asarray(out.counted)[: len(chunk)]
-            t3 = time.perf_counter()
-            timings["featurize"] += t1 - t0
             timings["device"] += t2 - t1
-            timings["fetch"] += t3 - t2
-            names = fb.view.names
-            # Vectorized decode: one nonzero over the whole chunk, then
-            # per-row dict(zip(...)) at C speed — no per-placement Python.
-            rows, cols = np.nonzero(selected)
-            bounds = np.searchsorted(rows, np.arange(len(chunk) + 1))
-            reps_obj = replicas[rows, cols].astype(object)
-            reps_obj[counted[rows, cols] == 0] = DUPLICATE
-            names_arr = np.asarray(names, dtype=object)
-            sel_names = names_arr[cols].tolist()
-            reps_list = reps_obj.tolist()
-            score_list = None
-            if want_scores:
-                totals = np.asarray(out.scores)[: len(chunk)]
-                score_list = totals[rows, cols].tolist()
-            for i in range(len(chunk)):
-                s, e = bounds[i], bounds[i + 1]
-                results.append(
-                    ScheduleResult(
-                        clusters=dict(zip(sel_names[s:e], reps_list[s:e])),
-                        scores=dict(zip(sel_names[s:e], score_list[s:e]))
-                        if score_list is not None
-                        else {},
-                    )
+            results.extend(
+                self._fetch_decode(
+                    entry,
+                    out,
+                    mask_dev,
+                    fb.view.names,
+                    len(chunk),
+                    want_scores,
+                    timings,
+                    view,
                 )
-            timings["decode"] += time.perf_counter() - t3
+            )
+        return results
+
+    def _device_inputs(
+        self, entry: Optional[_CachedChunk], padded: TickInputs, status: str
+    ) -> TickInputs:
+        """Per-object tensors live on device across ticks: a clean re-tick
+        ("hit") reuses last tick's device buffers and transfers nothing
+        but the (tiny) cluster-axis tensors.  Patched or fresh chunks are
+        re-uploaded and re-cached."""
+        fields = padded._asdict()
+        per_object = {
+            name: arr
+            for name, arr in fields.items()
+            if name not in _CLUSTER_ONLY_FIELDS
+        }
+        shape = np.asarray(padded.api_ok).shape
+        if (
+            entry is not None
+            and status == "hit"
+            and entry.device_per_object is not None
+            and entry.padded_shape == shape
+        ):
+            per_object = entry.device_per_object
+        else:
+            per_object = jax.device_put(per_object)
+            if entry is not None:
+                entry.device_per_object = per_object
+                entry.padded_shape = shape
+        return TickInputs(
+            **per_object,
+            **{name: fields[name] for name in _CLUSTER_ONLY_FIELDS},
+        )
+
+    def _decode_rows(
+        self, selected, replicas, counted, names, scores=None
+    ) -> list[ScheduleResult]:
+        """Vectorized decode: one nonzero over the rows, then per-row
+        dict(zip(...)) at C speed — no per-placement Python."""
+        rows, cols = np.nonzero(selected)
+        bounds = np.searchsorted(rows, np.arange(selected.shape[0] + 1))
+        reps_obj = replicas[rows, cols].astype(object)
+        reps_obj[counted[rows, cols] == 0] = DUPLICATE
+        names_arr = np.asarray(names, dtype=object)
+        sel_names = names_arr[cols].tolist()
+        reps_list = reps_obj.tolist()
+        score_list = scores[rows, cols].tolist() if scores is not None else None
+        out = []
+        for i in range(selected.shape[0]):
+            s, e = bounds[i], bounds[i + 1]
+            out.append(
+                ScheduleResult(
+                    clusters=dict(zip(sel_names[s:e], reps_list[s:e])),
+                    scores=dict(zip(sel_names[s:e], score_list[s:e]))
+                    if score_list is not None
+                    else {},
+                )
+            )
+        return out
+
+    def _fetch_decode(
+        self, entry, out, mask_dev, names, n: int, want_scores: bool, timings, view
+    ) -> list[ScheduleResult]:
+        """Pull results off the device — as a delta against the previous
+        tick when possible: the on-device row diff (i8[B] mask computed
+        inside the tick dispatch, a few KB to fetch) decides which rows
+        to gather, so a steady-state tick transfers near-nothing
+        (VERDICT r1 #6; the device-side analogue of the reference's
+        trigger-hash skip)."""
+        t2 = time.perf_counter()
+        if mask_dev is not None:
+            mask = np.asarray(mask_dev)[:n]
+            idx = np.nonzero(mask)[0]
+            if idx.size <= max(16, n // 4):
+                new_out = (out.selected, out.replicas, out.counted)
+                if idx.size == 0:
+                    self.fetch_stats["skip"] += 1
+                    merged = entry.prev_results
+                else:
+                    self.fetch_stats["delta"] += 1
+                    k = _pow2_bucket(idx.size, 16, 1 << 30)
+                    padded_idx = np.zeros(k, np.int32)
+                    padded_idx[: idx.size] = idx
+                    sel_k, rep_k, cnt_k = _gather_rows(
+                        out.selected, out.replicas, out.counted, padded_idx
+                    )
+                    sel_k = np.asarray(sel_k)[: idx.size]
+                    rep_k = np.asarray(rep_k)[: idx.size]
+                    cnt_k = np.asarray(cnt_k)[: idx.size]
+                    t3 = time.perf_counter()
+                    timings["fetch"] += t3 - t2
+                    changed_results = self._decode_rows(sel_k, rep_k, cnt_k, names)
+                    merged = list(entry.prev_results)
+                    for row, res in zip(idx.tolist(), changed_results):
+                        merged[row] = res
+                    entry.prev_out = new_out
+                    entry.prev_results = merged
+                    entry.prev_view = view
+                    out_copy = [
+                        ScheduleResult(dict(r.clusters), dict(r.scores))
+                        for r in merged
+                    ]
+                    timings["decode"] += time.perf_counter() - t3
+                    return out_copy
+                entry.prev_out = new_out
+                entry.prev_view = view
+                t3 = time.perf_counter()
+                timings["fetch"] += t3 - t2
+                out_copy = [
+                    ScheduleResult(dict(r.clusters), dict(r.scores))
+                    for r in merged
+                ]
+                timings["decode"] += time.perf_counter() - t3
+                return out_copy
+            # fall through to a full fetch for mass changes
+
+        self.fetch_stats["full"] += 1
+        selected = np.asarray(out.selected)[:n]
+        replicas = np.asarray(out.replicas)[:n]
+        counted = np.asarray(out.counted)[:n]
+        scores = np.asarray(out.scores)[:n] if want_scores else None
+        t3 = time.perf_counter()
+        timings["fetch"] += t3 - t2
+        results = self._decode_rows(selected, replicas, counted, names, scores)
+        if entry is not None and not want_scores:
+            entry.prev_out = (out.selected, out.replicas, out.counted)
+            entry.prev_results = results
+            entry.prev_view = view
+            results = [
+                ScheduleResult(dict(r.clusters), dict(r.scores)) for r in results
+            ]
+        timings["decode"] += time.perf_counter() - t3
         return results

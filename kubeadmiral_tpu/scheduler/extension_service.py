@@ -29,19 +29,50 @@ from typing import Callable, Optional
 Handler = Callable[[dict], dict]
 
 
+def _batch_wrap(fn: Handler, pair_field: str, batch_field: str) -> Handler:
+    """Serve the batch protocol on top of a per-pair handler: the whole
+    (units x clusters) grid is evaluated server-side in ONE HTTP round
+    trip instead of O(B x C) requests."""
+
+    def handler(request: dict) -> dict:
+        units = request.get("schedulingUnits", [])
+        clusters = request.get("clusters", [])
+        rows = [
+            [
+                fn({"schedulingUnit": su, "cluster": cluster}).get(pair_field)
+                for cluster in clusters
+            ]
+            for su in units
+        ]
+        return {batch_field: rows}
+
+    return handler
+
+
 class ExtensionService:
     FILTER_PATH = "/filter"
     SCORE_PATH = "/score"
     SELECT_PATH = "/select"
+    FILTER_BATCH_PATH = "/filter-batch"
+    SCORE_BATCH_PATH = "/score-batch"
 
     def __init__(
         self,
         filter_fn: Optional[Handler] = None,
         score_fn: Optional[Handler] = None,
         select_fn: Optional[Handler] = None,
+        filter_batch_fn: Optional[Handler] = None,
+        score_batch_fn: Optional[Handler] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        serve_batch: bool = True,
     ):
+        """With ``serve_batch`` (default), per-pair handlers also serve
+        their "-batch" sibling endpoints; pass explicit
+        ``filter_batch_fn``/``score_batch_fn`` for a vectorized
+        implementation (e.g. a TPU-backed scorer evaluating the whole
+        grid in one dispatch).  ``serve_batch=False`` emulates a
+        reference-protocol server (per-pair endpoints only)."""
         self.handlers: dict[str, Handler] = {}
         if filter_fn:
             self.handlers[self.FILTER_PATH] = filter_fn
@@ -49,6 +80,18 @@ class ExtensionService:
             self.handlers[self.SCORE_PATH] = score_fn
         if select_fn:
             self.handlers[self.SELECT_PATH] = select_fn
+        if filter_batch_fn:
+            self.handlers[self.FILTER_BATCH_PATH] = filter_batch_fn
+        elif filter_fn and serve_batch:
+            self.handlers[self.FILTER_BATCH_PATH] = _batch_wrap(
+                filter_fn, "selected", "selected"
+            )
+        if score_batch_fn:
+            self.handlers[self.SCORE_BATCH_PATH] = score_batch_fn
+        elif score_fn and serve_batch:
+            self.handlers[self.SCORE_BATCH_PATH] = _batch_wrap(
+                score_fn, "score", "scores"
+            )
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None

@@ -252,7 +252,7 @@ def featurize(
     r = view.alloc.shape[1]
 
     webhook_ok = np.ones((b, c), bool)
-    webhook_scores = np.zeros((b, c), np.int64)
+    webhook_scores = np.zeros((b, c), np.int32)
     if webhook_eval is not None:
         int32_info = np.iinfo(np.int32)
         for i, su in enumerate(units):
@@ -293,7 +293,7 @@ def featurize(
     u_tol, u_taint = len(tol_units), len(view.taint_sets)
     ok_new = np.ones((u_tol, u_taint), bool)
     ok_cur = np.ones((u_tol, u_taint), bool)
-    prefer = np.zeros((u_tol, u_taint), np.int64)
+    prefer = np.zeros((u_tol, u_taint), np.int32)
     for ti, su in enumerate(tol_units):
         tols = su.tolerations
         prefer_tols = [t for t in tols if not t.effect or t.effect == T.PREFER_NO_SCHEDULE]
@@ -339,7 +339,7 @@ def featurize(
         return su.affinity.preferred if su.affinity is not None else ()
 
     pref_ids, pref_units = _dedup(units, pref_key)
-    pref_matrix = np.zeros((len(pref_units), c), np.int64)
+    pref_matrix = np.zeros((len(pref_units), c), np.int32)
     for pi, su in enumerate(pref_units):
         if su.affinity is None or not su.affinity.preferred:
             continue
@@ -394,7 +394,7 @@ def featurize(
                     capacity[i, ci] = cap
 
     current_mask = np.zeros((b, c), bool)
-    current_replicas = np.full((b, c), NIL_REPLICAS, np.int64)
+    current_replicas = np.full((b, c), NIL_REPLICAS, np.int32)
     for i, su in enumerate(units):
         for cname, reps in su.current_clusters.items():
             ci = view.index.get(cname)

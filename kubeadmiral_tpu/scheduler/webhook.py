@@ -24,6 +24,7 @@ the reference's plugin tests do (plugin.go:42-44).
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from dataclasses import dataclass
 from typing import Optional, Protocol
@@ -217,6 +218,11 @@ class WebhookPlugin:
         self.config = config
         self.name = config.name
         self.client = client or UrllibClient()
+        # Extension points whose "-batch" sibling endpoint turned out to
+        # be unavailable (reference-protocol server): fall back to
+        # per-pair calls for this plugin instance's lifetime (instances
+        # are rebuilt on config generation changes).
+        self._batch_unsupported: set[str] = set()
 
     @property
     def has_filter(self) -> bool:
@@ -259,6 +265,73 @@ class WebhookPlugin:
             },
         )
         return int(response.get("score", 0))
+
+    # -- batched protocol -------------------------------------------------
+    # One POST per plugin per tick carrying the whole (units x clusters)
+    # problem — the batch-native extension of the reference's per-pair
+    # protocol (which makes O(B x C) HTTP calls per tick,
+    # plugin.go:77-251).  Servers opt in by serving "<path>-batch";
+    # anything else transparently degrades to per-pair calls.
+
+    def _batch_call(self, kind: str, path: str, units, clusters) -> Optional[dict]:
+        if kind in self._batch_unsupported:
+            return None
+        body = {
+            "schedulingUnits": [scheduling_unit_payload(su) for su in units],
+            "clusters": [cluster_payload(c) for c in clusters],
+        }
+        try:
+            return self._call(path.rstrip("/") + "-batch", body)
+        except WebhookError:
+            raise  # the server answered with a protocol error
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 405, 501):
+                # The endpoint genuinely doesn't exist (reference-
+                # protocol server): remember permanently.
+                self._batch_unsupported.add(kind)
+            return None  # transient HTTP failure: per-pair this tick
+        except Exception:
+            # Transient transport error (timeout, reset) or a fake test
+            # client that doesn't know the URL: fall back to per-pair
+            # calls for THIS tick only and probe again next tick.
+            return None
+
+    @staticmethod
+    def _validated_rows(rows, n_units: int, n_clusters: int, context: str) -> list:
+        """A malformed grid (wrong row count / ragged rows) is a protocol
+        error, not a crash: callers contain WebhookError per plugin."""
+        if len(rows) != n_units or any(len(row) != n_clusters for row in rows):
+            raise WebhookError(
+                f"{context}: bad batch response shape "
+                f"(want {n_units}x{n_clusters})"
+            )
+        return rows
+
+    def filter_batch(
+        self, units: list[T.SchedulingUnit], clusters: list[T.ClusterState]
+    ) -> Optional[list[list[bool]]]:
+        """[len(units)][len(clusters)] feasibility, or None when the
+        server doesn't speak the batch protocol."""
+        response = self._batch_call("filter", self.config.filter_path, units, clusters)
+        if response is None:
+            return None
+        rows = self._validated_rows(
+            response.get("selected", []), len(units), len(clusters),
+            f"{self.name} filter-batch",
+        )
+        return [[bool(x) for x in row] for row in rows]
+
+    def score_batch(
+        self, units: list[T.SchedulingUnit], clusters: list[T.ClusterState]
+    ) -> Optional[list[list[int]]]:
+        response = self._batch_call("score", self.config.score_path, units, clusters)
+        if response is None:
+            return None
+        rows = self._validated_rows(
+            response.get("scores", []), len(units), len(clusters),
+            f"{self.name} score-batch",
+        )
+        return [[int(x) for x in row] for row in rows]
 
     def select(
         self, su: T.SchedulingUnit, cluster_scores: list[tuple[T.ClusterState, int]]

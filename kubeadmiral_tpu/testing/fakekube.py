@@ -285,6 +285,25 @@ class FakeKube:
             for obj in self._store(resource).values():
                 fn(obj)
 
+    # -- persistence ------------------------------------------------------
+    def dump(self) -> dict:
+        """JSON-serializable snapshot of the whole store (etcd's role in
+        the reference: all control-plane state lives in the apiserver, so
+        a controller restart resumes from LIST+WATCH alone)."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "rv": self._rv,
+                "objects": copy.deepcopy(self._objects),
+            }
+
+    @classmethod
+    def restore(cls, snapshot: dict) -> "FakeKube":
+        kube = cls(snapshot.get("name", "host"))
+        kube._rv = int(snapshot["rv"])
+        kube._objects = copy.deepcopy(snapshot["objects"])
+        return kube
+
     # -- watch -----------------------------------------------------------
     def watch(self, resource: str, handler: Handler, replay: bool = True) -> None:
         """Register a handler; with replay, existing objects are delivered
@@ -350,6 +369,20 @@ class ClusterFleet:
         self.host.unwatch_owner(owner)
         for member in self.members.values():
             member.unwatch_owner(owner)
+
+    def dump(self) -> dict:
+        return {
+            "host": self.host.dump(),
+            "members": {n: m.dump() for n, m in self.members.items()},
+        }
+
+    @classmethod
+    def restore(cls, snapshot: dict) -> "ClusterFleet":
+        fleet = cls()
+        fleet.host = FakeKube.restore(snapshot["host"])
+        for name, member_snap in snapshot["members"].items():
+            fleet.members[name] = FakeKube.restore(member_snap)
+        return fleet
 
     def watch_members(self, resource: str, handler: Handler) -> Callable[[], None]:
         """Watch ``resource`` in every current member and return a

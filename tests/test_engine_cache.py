@@ -122,6 +122,58 @@ class TestEngineCache:
         want = SchedulerEngine(chunk_size=32).schedule(churned, clusters)
         results_equal(got, want)
 
+    def test_delta_fetch_paths_engage_and_match(self):
+        """Steady-state re-tick = mask-only fetch; small churn = row
+        gather; both must equal a cache-less engine's results."""
+        units, clusters = make_world()
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)
+        assert engine.fetch_stats == {"noop": 0, "skip": 0, "delta": 0, "full": 2}
+
+        # Identical units + identical cluster view: the dispatch itself
+        # is skipped (trigger-hash-skip analogue).
+        second = engine.schedule(units, clusters)
+        assert engine.fetch_stats["noop"] == 2
+        results_equal(second, SchedulerEngine(chunk_size=32).schedule(units, clusters))
+
+        # Same units but drifted resources: must NOT take the no-op path
+        # (outputs may change), and every chunk must ride a dispatching
+        # path (mask-only, row gather, or full).
+        import dataclasses as _dc
+        drifted = [
+            _dc.replace(cl, available=dict(cl.available)) for cl in clusters
+        ]
+        drifted[0] = _dc.replace(
+            drifted[0], available=parse_resources({"cpu": "1", "memory": "1Gi"})
+        )
+        before = dict(engine.fetch_stats)
+        third = engine.schedule(units, drifted)
+        assert engine.fetch_stats["noop"] == before["noop"]
+        dispatched = sum(
+            engine.fetch_stats[k] - before[k] for k in ("skip", "delta", "full")
+        )
+        assert dispatched == 2
+        results_equal(third, SchedulerEngine(chunk_size=32).schedule(units, drifted))
+
+        churned = list(units)
+        churned[5] = dataclasses.replace(
+            units[5], desired_replicas=37,
+            resource_request=parse_resources({"cpu": "700m"}),
+        )
+        got = engine.schedule(churned, clusters)
+        assert engine.fetch_stats["delta"] >= 1
+        results_equal(got, SchedulerEngine(chunk_size=32).schedule(churned, clusters))
+
+    def test_results_are_caller_owned_copies(self):
+        """Returned dicts must be safe to mutate: the delta path reuses
+        cached decodes internally, so it hands out fresh copies."""
+        units, clusters = make_world(b=8)
+        engine = SchedulerEngine(chunk_size=8)
+        first = engine.schedule(units, clusters)
+        first[0].clusters["poison"] = 1
+        second = engine.schedule(units, clusters)
+        assert "poison" not in second[0].clusters
+
     def test_cache_budget_zero_disables(self):
         units, clusters = make_world()
         engine = SchedulerEngine(chunk_size=32, cache_bytes=0)

@@ -238,6 +238,101 @@ class TestWebhookScheduling:
         finally:
             service.stop()
 
+    def test_batch_protocol_is_one_post_per_plugin_per_tick(self):
+        """A batch-capable server gets the whole (units x clusters) grid
+        in ONE POST per extension point per tick — never O(B x C) calls
+        (the reference's webhook/v1alpha1/plugin.go:77-251 behavior)."""
+
+        class BatchServer(FakeClient):
+            def post(self, url, body, timeout):
+                req = json.loads(body)
+                self.requests.append((url, req))
+                if url.endswith("/filter-batch"):
+                    rows = [
+                        [
+                            c["metadata"]["labels"].get("region") == "eu"
+                            for c in req["clusters"]
+                        ]
+                        for _ in req["schedulingUnits"]
+                    ]
+                    return json.dumps({"selected": rows}).encode()
+                if url.endswith("/score-batch"):
+                    rows = [
+                        [
+                            50 if c["metadata"]["name"] == "c2" else 1
+                            for c in req["clusters"]
+                        ]
+                        for _ in req["schedulingUnits"]
+                    ]
+                    return json.dumps({"scores": rows}).encode()
+                raise AssertionError(f"per-pair call leaked: {url}")
+
+        client = BatchServer({})
+        scheduler = SchedulerController(
+            self.fleet.host, self.ftc, webhook_client=client
+        )
+        self.fleet.host.create(
+            W.SCHEDULER_WEBHOOK_CONFIGS,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulerPluginWebhookConfiguration",
+                "metadata": {"name": "eu-batch"},
+                "spec": {
+                    "urlPrefix": "http://webhook.example",
+                    "filterPath": "/filter",
+                    "scorePath": "/score",
+                    "payloadVersions": ["v1alpha1"],
+                },
+            },
+        )
+        self.create_profile_and_policy("eu-batch", points=("filter", "score"))
+        for i in range(6):
+            self.fleet.host.create(
+                self.ftc.source.resource, make_deployment(name=f"web-{i}")
+            )
+        settle(self.clusterctl, self.federate, scheduler)
+
+        for i in range(6):
+            fed = self.fleet.host.get(
+                self.ftc.federated.resource, f"default/web-{i}"
+            )
+            assert C.get_placement(fed, C.SCHEDULER) == {"c2", "c3"}
+
+        urls = [u for u, _ in client.requests]
+        assert all(u.endswith("-batch") for u in urls), urls
+        # One filter + one score POST per scheduling tick; settle may run
+        # a couple of ticks but never per-(object, cluster) calls.
+        assert len(urls) <= 6, urls
+        biggest = max(
+            len(req["schedulingUnits"]) for _, req in client.requests
+        )
+        assert biggest >= 6  # the whole batch travelled together
+
+    def test_reference_protocol_server_falls_back_to_per_pair(self):
+        """serve_batch=False emulates a reference-protocol server: the
+        client probes the batch endpoint once, then degrades to per-pair
+        calls with identical results."""
+        service = ExtensionService(
+            filter_fn=lambda req: {
+                "selected": req["cluster"]["metadata"]["labels"].get("region")
+                == "eu"
+            },
+            serve_batch=False,
+        )
+        service.start()
+        try:
+            scheduler = SchedulerController(self.fleet.host, self.ftc)
+            self.fleet.host.create(
+                W.SCHEDULER_WEBHOOK_CONFIGS,
+                service.webhook_configuration("eu-only"),
+            )
+            self.create_profile_and_policy("eu-only")
+            self.fleet.host.create(self.ftc.source.resource, make_deployment())
+            settle(self.clusterctl, self.federate, scheduler)
+            assert self.placement() == {"c2", "c3"}
+        finally:
+            service.stop()
+
     def test_unsupported_payload_version_is_not_registered(self):
         scheduler = SchedulerController(self.fleet.host, self.ftc)
         self.fleet.host.create(
