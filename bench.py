@@ -244,7 +244,22 @@ def time_batched(rng, units, clusters, followers):
             detail[stage] = detail.get(stage, 0.0) + secs
     dt = (time.perf_counter() - t0) / TICKS
     placed = sum(1 for r in results if r.clusters)
+
+    # Drift tick: one cluster's resources changed — every row must be
+    # revalidated on device (the full-dispatch path with delta fetch).
+    import dataclasses
+
+    drifted = list(clusters)
+    drifted[0] = dataclasses.replace(
+        drifted[0],
+        available={k: max(0, v // 2) for k, v in drifted[0].available.items()},
+    )
+    t_drift = time.perf_counter()
+    engine.schedule(units, drifted)
+    drift_ms = (time.perf_counter() - t_drift) * 1e3
+
     detail = {k: round(v / TICKS * 1e3, 1) for k, v in detail.items()}
+    detail["drift_tick_ms"] = round(drift_ms, 1)
     detail["cold_tick_ms"] = round(cold_ms, 1)
     detail["featurize_cold_ms"] = cold_featurize_ms
     detail["noop_tick_ms"] = round(noop_ms, 1)

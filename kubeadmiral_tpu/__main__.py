@@ -54,6 +54,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--run-seconds", type=float, default=0.0,
         help="exit after this many seconds (0 = run forever)")
+    parser.add_argument(
+        "--max-pod-listers", type=int, default=4,
+        help="bound on concurrent member pod LISTs (pod informer)",
+    )
+    parser.add_argument(
+        "--enable-pod-pruning", action="store_true", default=True,
+        help="strip cached pods to scheduling-relevant fields",
+    )
+    parser.add_argument(
+        "--no-pod-pruning", dest="enable_pod_pruning", action="store_false",
+    )
+    parser.add_argument(
+        "--enable-profiling", action="store_true",
+        help="serve pprof-style endpoints on --profiling-port "
+        "(/debug/profile, /debug/stacks, /debug/threads); the health "
+        "port always serves them too",
+    )
+    parser.add_argument(
+        "--profiling-port", type=int, default=6060,
+        help="standalone profiling port (reference's :6060)",
+    )
     return parser
 
 
@@ -73,7 +94,13 @@ def main(argv=None) -> int:
     health = HealthCheckRegistry()
     server = HealthServer(health, port=args.port)
     port = server.start()
-    print(f"health endpoints on :{port} (/livez, /readyz)")
+    print(f"health endpoints on :{port} (/livez, /readyz, /debug/*)")
+
+    if args.enable_profiling:
+        from kubeadmiral_tpu.runtime.profiling import ProfilingServer
+
+        prof_server = ProfilingServer(port=args.profiling_port)
+        print(f"profiling endpoints on :{prof_server.start()} (/debug/*)")
 
     elector = LeaderElector(fleet.host, identity=f"manager-{os.getpid()}")
     if args.leader_elect:
@@ -86,6 +113,8 @@ def main(argv=None) -> int:
         enabled=[c for c in args.controllers.split(",") if c],
         health=health,
         cluster_controller_kwargs={"join_timeout": args.cluster_join_timeout},
+        max_pod_listers=args.max_pod_listers,
+        enable_pod_pruning=args.enable_pod_pruning,
     )
     if args.create_crds_for_ftcs:
         for ftc in default_ftcs():

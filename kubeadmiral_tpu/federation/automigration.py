@@ -95,10 +95,15 @@ class AutoMigrationController:
         ftc: FederatedTypeConfig,
         metrics: Optional[Metrics] = None,
         clock=None,
+        pod_informer=None,
     ):
         self.fleet = fleet
         self.host = fleet.host
         self.ftc = ftc
+        # Optional shared PodInformer (runtime/podinformer.py): pruned
+        # per-cluster pod caches instead of scanning full pod objects
+        # (the 50k-pod memory discipline, federatedclient/podinformer.go).
+        self.pod_informer = pod_informer
         self.metrics = metrics or Metrics()
         self._clock = clock or time.time
         self._fed_resource = ftc.federated.resource
@@ -112,6 +117,8 @@ class AutoMigrationController:
         self.host.watch(self._fed_resource, self._on_event, replay=True)
         self._reattach = fleet.watch_members(PODS, self._on_member_pod_event)
         self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+        if self.pod_informer is not None:
+            self.pod_informer.attach()
 
     def _on_event(self, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
@@ -133,6 +140,8 @@ class AutoMigrationController:
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         self._reattach()
+        if self.pod_informer is not None:
+            self.pod_informer.attach()
 
     def run_until_idle(self) -> None:
         while self.worker.step():
@@ -208,7 +217,14 @@ class AutoMigrationController:
                 continue
 
             desired = int(get_path(workload, replicas_path) or 0)
-            pods = pods_for_workload(member, workload)
+            if self.pod_informer is not None:
+                pods = self.pod_informer.pods_for(
+                    cname,
+                    workload["metadata"].get("namespace", ""),
+                    get_path(workload, "spec.selector.matchLabels") or {},
+                )
+            else:
+                pods = pods_for_workload(member, workload)
             unschedulable, next_cross = count_unschedulable_pods(
                 pods, now, threshold
             )

@@ -79,7 +79,14 @@ class HealthServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+                path, _, raw_query = self.path.partition("?")
+                if path.startswith("/debug/"):
+                    # pprof analogue (profiling.py): profile/stacks/threads.
+                    from kubeadmiral_tpu.runtime import profiling
+
+                    if not profiling.respond_debug(self, path, raw_query):
+                        self.send_error(404)
+                    return
                 if path == "/livez":
                     results = registry.livez()
                 elif path == "/readyz":

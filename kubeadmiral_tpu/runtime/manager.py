@@ -83,11 +83,24 @@ class ControllerManager:
         health: Optional[HealthCheckRegistry] = None,
         engine: Optional[SchedulerEngine] = None,
         cluster_controller_kwargs: Optional[dict] = None,
+        max_pod_listers: int = 4,
+        enable_pod_pruning: bool = True,
     ):
         self.fleet = fleet
         self.host = fleet.host
         self.metrics = metrics or Metrics()
         self.health = health or HealthCheckRegistry()
+        # ONE pod informer shared by every per-FTC automigration
+        # controller: pruned per-cluster pod caches with a bounded
+        # cold-LIST semaphore (reference: federatedclient/podinformer.go,
+        # --max-pod-listers / --enable-pod-pruning).
+        from kubeadmiral_tpu.runtime.podinformer import PodInformer
+
+        self.pod_informer = PodInformer(
+            fleet,
+            max_pod_listers=max_pod_listers,
+            enable_pruning=enable_pod_pruning,
+        )
         # One shared XLA engine: FTCs share compile caches and the
         # cluster view (ftcmanager starts schedulers per FTC; the batch
         # engine makes sharing the natural default).
@@ -181,7 +194,8 @@ class ControllerManager:
             )
         if ftc.auto_migration:
             controllers[AUTOMIGRATION] = AutoMigrationController(
-                self.fleet, ftc, metrics=self.metrics
+                self.fleet, ftc, metrics=self.metrics,
+                pod_informer=self.pod_informer,
             )
         if MONITOR_CONTROLLER in self._enabled:
             # Off by default, like the reference's monitor controller.

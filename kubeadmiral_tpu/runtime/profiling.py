@@ -1,0 +1,172 @@
+"""Profiling endpoints — the pprof analogue.
+
+The reference starts ``net/http/pprof`` on :6060 behind
+``--enable-profiling`` (reference:
+cmd/controller-manager/app/controllermanager.go:61-71, blank import
+main.go:21).  The Python control plane's equivalent serves:
+
+* ``GET /debug/profile?seconds=N`` — a SAMPLING profile of every thread
+  in the process (pprof's CPU-profile role): stacks are sampled from
+  ``sys._current_frames()`` at ~100Hz for N seconds (default 5, max
+  120) and aggregated into per-function self/cumulative sample counts.
+  Sampling, not tracing, because a tracer (cProfile) only sees the
+  installing thread — useless for worker-thread controllers — and adds
+  overhead to the very loops being measured.
+* ``GET /debug/stacks`` — current stack of every thread (pprof's
+  ``goroutine?debug=2`` role) — the first thing to pull from a wedged
+  control plane.
+* ``GET /debug/threads`` — thread names/ids/daemon flags.
+
+``respond_debug`` is the shared route handler: the health server mounts
+it so one port serves livez/readyz/debug, and ``ProfilingServer`` runs
+the same routes standalone on a dedicated port (the :6060 layout).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlsplit
+
+_profile_lock = threading.Lock()
+
+
+def collect_profile(
+    seconds: float = 5.0, top: int = 40, hz: float = 100.0
+) -> dict:
+    """Sample every thread's stack for ``seconds``; one profile at a
+    time (overlapping samplers would double-count each other)."""
+    seconds = max(0.1, min(float(seconds), 120.0))
+    if not _profile_lock.acquire(blocking=False):
+        return {"error": "a profile is already running"}
+    try:
+        interval = 1.0 / hz
+        me = threading.get_ident()
+        self_counts: Counter = Counter()
+        cum_counts: Counter = Counter()
+        samples = 0
+        deadline = time.perf_counter() + seconds
+        while time.perf_counter() < deadline:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue  # the sampler itself is noise
+                samples += 1
+                leaf = True
+                seen = set()
+                while frame is not None:
+                    code = frame.f_code
+                    key = f"{code.co_filename}:{code.co_firstlineno}({code.co_name})"
+                    if leaf:
+                        self_counts[key] += 1
+                        leaf = False
+                    if key not in seen:  # count recursion once
+                        seen.add(key)
+                        cum_counts[key] += 1
+                    frame = frame.f_back
+            time.sleep(interval)
+        rows = [
+            {
+                "function": key,
+                "self": self_counts.get(key, 0),
+                "cumulative": cum,
+            }
+            for key, cum in cum_counts.most_common(top)
+        ]
+        return {"seconds": seconds, "samples": samples, "top": rows}
+    finally:
+        _profile_lock.release()
+
+
+def collect_stacks() -> dict:
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for ident, frame in frames.items():
+        stacks[f"{names.get(ident, '?')}-{ident}"] = traceback.format_stack(frame)
+    return {"threads": stacks}
+
+
+def collect_threads() -> dict:
+    return {
+        "threads": [
+            {"name": t.name, "ident": t.ident, "daemon": t.daemon,
+             "alive": t.is_alive()}
+            for t in threading.enumerate()
+        ]
+    }
+
+
+def handle_debug_path(path: str, query: dict) -> Optional[dict]:
+    """Route a /debug/* request; None = not a debug path."""
+    if path == "/debug/profile":
+        return collect_profile(float(query.get("seconds", 5)))
+    if path == "/debug/stacks":
+        return collect_stacks()
+    if path == "/debug/threads":
+        return collect_threads()
+    return None
+
+
+def respond_debug(http_handler, path: str, raw_query: str) -> bool:
+    """Serve a /debug/* route on any BaseHTTPRequestHandler; returns
+    False when the path isn't a debug route (caller handles it).  The
+    single implementation shared by the health server and the
+    standalone profiling server."""
+    query = {k: v[-1] for k, v in parse_qs(raw_query).items()}
+    result = handle_debug_path(path, query)
+    if result is None:
+        return False
+    body = json.dumps(result).encode()
+    http_handler.send_response(200)
+    http_handler.send_header("Content-Type", "application/json")
+    http_handler.send_header("Content-Length", str(len(body)))
+    http_handler.end_headers()
+    http_handler.wfile.write(body)
+    return True
+
+
+class ProfilingServer:
+    """Standalone profiling HTTP server (the reference's :6060)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                split = urlsplit(self.path)
+                if not respond_debug(self, split.path, split.query):
+                    self.send_error(404)
+
+            def log_message(self, *args) -> None:
+                pass
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="profiling-server", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -128,7 +128,9 @@ class TestEngineCache:
         units, clusters = make_world()
         engine = SchedulerEngine(chunk_size=32)
         engine.schedule(units, clusters)
-        assert engine.fetch_stats == {"noop": 0, "skip": 0, "delta": 0, "full": 2}
+        assert engine.fetch_stats == {
+            "noop": 0, "subbatch": 0, "skip": 0, "delta": 0, "full": 2,
+        }
 
         # Identical units + identical cluster view: the dispatch itself
         # is skipped (trigger-hash-skip analogue).
@@ -155,14 +157,43 @@ class TestEngineCache:
         assert dispatched == 2
         results_equal(third, SchedulerEngine(chunk_size=32).schedule(units, drifted))
 
+        # Re-sync to the original cluster list so the next tick compares
+        # against an identical ClusterView object.
+        engine.schedule(units, clusters)
+
+        # Churn with an unchanged cluster view rides the sub-batch path:
+        # only the changed rows are scheduled (row independence).
         churned = list(units)
         churned[5] = dataclasses.replace(
             units[5], desired_replicas=37,
             resource_request=parse_resources({"cpu": "700m"}),
         )
         got = engine.schedule(churned, clusters)
-        assert engine.fetch_stats["delta"] >= 1
+        assert engine.fetch_stats["subbatch"] >= 1
         results_equal(got, SchedulerEngine(chunk_size=32).schedule(churned, clusters))
+
+        # Churn + resource drift in the same tick: every row may change,
+        # so the full dispatch runs with the on-device delta gather.
+        churned2 = list(churned)
+        churned2[7] = dataclasses.replace(
+            churned[7], desired_replicas=11,
+        )
+        drifted = list(clusters)
+        drifted[0] = dataclasses.replace(
+            clusters[0], available=parse_resources({"cpu": "2", "memory": "4Gi"})
+        )
+        before = dict(engine.fetch_stats)
+        got2 = engine.schedule(churned2, drifted)
+        assert engine.fetch_stats["subbatch"] == before["subbatch"]
+        assert (
+            engine.fetch_stats["skip"]
+            + engine.fetch_stats["delta"]
+            + engine.fetch_stats["full"]
+            > before["skip"] + before["delta"] + before["full"]
+        )
+        results_equal(
+            got2, SchedulerEngine(chunk_size=32).schedule(churned2, drifted)
+        )
 
     def test_results_are_caller_owned_copies(self):
         """Returned dicts must be safe to mutate: the delta path reuses

@@ -1,0 +1,158 @@
+"""Pod informer: pruning, lister semaphore, incremental cache, and the
+auto-migration integration (reference: federatedclient/podinformer.go)."""
+
+import json
+
+from kubeadmiral_tpu.runtime.podinformer import PODS, PodInformer, prune_pod
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+
+def fat_pod(name, ns="default", labels=None, node="n1", unschedulable=False):
+    """A pod with the bulk a real pod carries (env/volumes/probes)."""
+    conditions = []
+    if unschedulable:
+        conditions.append(
+            {"type": "PodScheduled", "status": "False",
+             "reason": "Unschedulable", "lastTransitionTime": 100}
+        )
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "labels": labels or {"app": "web"},
+            "annotations": {"huge": "x" * 2000},
+        },
+        "spec": {
+            "nodeName": node,
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "nginx",
+                    "env": [{"name": f"E{i}", "value": "v" * 100} for i in range(20)],
+                    "volumeMounts": [{"name": "data", "mountPath": "/data"}],
+                    "resources": {"requests": {"cpu": "100m", "memory": "128Mi"}},
+                }
+            ],
+            "volumes": [{"name": "data", "emptyDir": {}}],
+        },
+        "status": {"phase": "Running", "conditions": conditions},
+    }
+
+
+class TestPrunePod:
+    def test_strips_bulk_keeps_scheduling_fields(self):
+        pod = fat_pod("p1", unschedulable=True)
+        pruned = prune_pod(pod)
+        assert "annotations" not in pruned["metadata"]
+        assert "env" not in json.dumps(pruned)
+        assert "volumes" not in pruned["spec"]
+        assert pruned["spec"]["nodeName"] == "n1"
+        assert pruned["spec"]["containers"][0]["resources"]["requests"] == {
+            "cpu": "100m", "memory": "128Mi",
+        }
+        assert pruned["status"]["conditions"][0]["reason"] == "Unschedulable"
+        # The pruned pod is dramatically smaller.
+        assert len(json.dumps(pruned)) < len(json.dumps(pod)) / 5
+
+
+class TestPodInformer:
+    def test_cache_fills_and_tracks_events(self):
+        fleet = ClusterFleet()
+        m1 = fleet.add_member("c1")
+        m1.create(PODS, fat_pod("pre"))
+        informer = PodInformer(fleet)
+        informer.attach()
+        assert informer.cache_size("c1") == 1
+
+        m1.create(PODS, fat_pod("live", labels={"app": "db"}))
+        assert informer.cache_size("c1") == 2
+        assert len(informer.pods_for("c1", "default", {"app": "db"})) == 1
+        m1.delete(PODS, "default/live")
+        assert informer.cache_size("c1") == 1
+
+    def test_attach_is_idempotent_and_picks_up_new_members(self):
+        fleet = ClusterFleet()
+        fleet.add_member("c1").create(PODS, fat_pod("a"))
+        informer = PodInformer(fleet)
+        informer.attach()
+        informer.attach()  # no duplicate handlers
+        fleet.member("c1").create(PODS, fat_pod("b"))
+        assert informer.cache_size("c1") == 2
+
+        fleet.add_member("c2").create(PODS, fat_pod("c"))
+        informer.attach()
+        assert informer.cache_size("c2") == 1
+
+    def test_pruning_can_be_disabled(self):
+        fleet = ClusterFleet()
+        fleet.add_member("c1").create(PODS, fat_pod("a"))
+        informer = PodInformer(fleet, enable_pruning=False)
+        informer.attach()
+        (pod,) = informer.pods_for("c1")
+        assert pod["metadata"]["annotations"]["huge"]
+
+
+class TestAutoMigrationWithInformer:
+    def test_estimated_capacity_from_pruned_cache(self):
+        """Auto-migration sees the same unschedulable counts through the
+        pruned informer as through raw pod scans."""
+        import dataclasses
+
+        from kubeadmiral_tpu.federation.automigration import (
+            AutoMigrationController,
+        )
+        from kubeadmiral_tpu.federation import common as C
+        from kubeadmiral_tpu.models.ftc import default_ftcs
+
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        fleet = ClusterFleet()
+        member = fleet.add_member("c1")
+        # Workload with 3 pods, 2 unschedulable past any threshold.
+        member.create(
+            ftc.source.resource,
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {
+                    "replicas": 3,
+                    "selector": {"matchLabels": {"app": "web"}},
+                },
+                "status": {"replicas": 3, "readyReplicas": 1},
+            },
+        )
+        for i, stuck in enumerate((True, True, False)):
+            member.create(PODS, fat_pod(f"p{i}", unschedulable=stuck))
+
+        fed = {
+            "apiVersion": "types.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedDeployment",
+            "metadata": {
+                "name": "web",
+                "namespace": "default",
+                "annotations": {
+                    C.PREFIX + "pod-unschedulable-threshold": "0.001",
+                },
+            },
+            "spec": {
+                "template": {"metadata": {"name": "web"}},
+                "placements": [
+                    {
+                        "controller": C.SCHEDULER,
+                        "placement": [{"cluster": "c1"}],
+                    }
+                ],
+            },
+        }
+        fleet.host.create(ftc.federated.resource, fed)
+
+        informer = PodInformer(fleet)
+        ctl = AutoMigrationController(fleet, ftc, pod_informer=informer)
+        ctl.run_until_idle()
+        got = fleet.host.get(ftc.federated.resource, "default/web")
+        info = json.loads(
+            got["metadata"]["annotations"][C.PREFIX + "auto-migration-info"]
+        )
+        assert info["estimatedCapacity"] == {"c1": 1}

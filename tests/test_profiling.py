@@ -1,0 +1,74 @@
+"""Profiling endpoints (the pprof analogue; reference:
+cmd/controller-manager/app/controllermanager.go:61-71)."""
+
+import json
+import urllib.request
+
+from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
+from kubeadmiral_tpu.runtime.profiling import (
+    ProfilingServer,
+    collect_profile,
+    collect_stacks,
+)
+
+
+def fetch(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestProfiling:
+    def test_collect_profile_samples_other_threads(self):
+        """The sampler must see WORKER threads (a tracing profiler only
+        sees its own thread — the bug this replaced)."""
+        import threading
+
+        stop = threading.Event()
+
+        def busy_loop_for_profile():
+            x = 0
+            while not stop.is_set():
+                x += 1
+
+        t = threading.Thread(target=busy_loop_for_profile, daemon=True)
+        t.start()
+        try:
+            result = collect_profile(seconds=0.3)
+        finally:
+            stop.set()
+            t.join()
+        assert result["seconds"] == 0.3
+        assert result["samples"] > 0
+        assert any(
+            "busy_loop_for_profile" in row["function"] for row in result["top"]
+        ), result["top"][:5]
+
+    def test_collect_stacks_includes_this_thread(self):
+        stacks = collect_stacks()["threads"]
+        assert any("collect_stacks" in "".join(s) for s in stacks.values())
+
+    def test_standalone_server(self):
+        server = ProfilingServer()
+        port = server.start()
+        try:
+            status, threads = fetch(port, "/debug/threads")
+            assert status == 200
+            assert any(t["name"] == "MainThread" for t in threads["threads"])
+            status, stacks = fetch(port, "/debug/stacks")
+            assert status == 200 and stacks["threads"]
+            status, prof = fetch(port, "/debug/profile?seconds=0.2")
+            assert status == 200 and prof["seconds"] == 0.2
+        finally:
+            server.stop()
+
+    def test_health_server_mounts_debug(self):
+        registry = HealthCheckRegistry()
+        server = HealthServer(registry)
+        port = server.start()
+        try:
+            status, threads = fetch(port, "/debug/threads")
+            assert status == 200 and threads["threads"]
+            status, live = fetch(port, "/livez")
+            assert status == 200
+        finally:
+            server.stop()
