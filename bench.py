@@ -42,7 +42,7 @@ N_OBJECTS, N_CLUSTERS = SHAPES.get(CONFIG, SHAPES["3"])
 N_OBJECTS = int(os.environ.get("BENCH_OBJECTS", N_OBJECTS))
 N_CLUSTERS = int(os.environ.get("BENCH_CLUSTERS", N_CLUSTERS))
 TICKS = int(os.environ.get("BENCH_TICKS", 3))
-CHUNK = int(os.environ.get("BENCH_CHUNK", 8192))
+CHUNK = int(os.environ.get("BENCH_CHUNK", 4096))
 
 
 def build_world(rng):

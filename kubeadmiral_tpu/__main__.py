@@ -59,11 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
         help="bound on concurrent member pod LISTs (pod informer)",
     )
     parser.add_argument(
-        "--enable-pod-pruning", action="store_true", default=True,
-        help="strip cached pods to scheduling-relevant fields",
-    )
-    parser.add_argument(
-        "--no-pod-pruning", dest="enable_pod_pruning", action="store_false",
+        "--enable-pod-pruning", action=argparse.BooleanOptionalAction,
+        default=True,
+        help="strip cached pods to scheduling-relevant fields (default on)",
     )
     parser.add_argument(
         "--enable-profiling", action="store_true",

@@ -217,13 +217,17 @@ class AutoMigrationController:
                 continue
 
             desired = int(get_path(workload, replicas_path) or 0)
+            pods = None
             if self.pod_informer is not None:
                 pods = self.pod_informer.pods_for(
                     cname,
                     workload["metadata"].get("namespace", ""),
                     get_path(workload, "spec.selector.matchLabels") or {},
                 )
-            else:
+            if pods is None:
+                # Informer not (yet) watching this cluster (cold attach /
+                # rejoin window): scan the member directly rather than
+                # trusting an empty snapshot.
                 pods = pods_for_workload(member, workload)
             unschedulable, next_cross = count_unschedulable_pods(
                 pods, now, threshold

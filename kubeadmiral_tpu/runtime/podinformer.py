@@ -72,11 +72,16 @@ class PodInformer:
         self.enable_pruning = enable_pruning
         self.max_pod_listers = max(1, max_pod_listers)
         self._lock = threading.Lock()
+        # A cluster key EXISTS in _caches only once its cold LIST+WATCH
+        # replay completed; consumers treat a missing key as "informer
+        # not ready" (pods_for returns None) and fall back to a direct
+        # member scan rather than trusting an empty snapshot.
         self._caches: dict[str, dict[str, dict]] = {}
-        # cluster name -> the member client object watched: a rejoined
+        # cluster name -> (member client, handler) watched: a rejoined
         # cluster gets a NEW client/store, detected by identity, and is
-        # re-listed from scratch.
-        self._watched: dict[str, object] = {}
+        # re-listed from scratch; the old handler is unwatched so its
+        # stream stops.
+        self._watched: dict[str, tuple] = {}
 
     def _transform(self, pod: dict) -> dict:
         return prune_pod(pod) if self.enable_pruning else pod
@@ -90,30 +95,44 @@ class PodInformer:
         fan out across at most ``max_pod_listers`` threads — the
         --max-pod-listers stampede bound."""
         to_watch: list[tuple[str, object]] = []
+        to_unwatch: list[tuple[object, object]] = []
         current = dict(getattr(self.fleet, "members", {}))
         with self._lock:
             for name in list(self._watched):
                 if name not in current:
-                    self._watched.pop(name, None)
+                    to_unwatch.append(self._watched.pop(name))
                     self._caches.pop(name, None)
             for name in current:
                 try:
                     member = self.fleet.member(name)
                 except NotFound:
                     continue
-                if self._watched.get(name) is member:
+                watched = self._watched.get(name)
+                if watched is not None and watched[0] is member:
                     continue  # already watching this exact client
-                self._watched[name] = member
-                self._caches[name] = {}  # rejoin: drop the old snapshot
+                if watched is not None:
+                    to_unwatch.append(watched)  # rejoin: stop the old stream
+                # Drop (don't empty) the snapshot: a missing key means
+                # "not ready", so readers fall back until the replay done.
+                self._caches.pop(name, None)
+                self._watched.pop(name, None)
                 to_watch.append((name, member))
+        for old_member, old_handler in to_unwatch:
+            try:
+                old_member.unwatch(PODS, old_handler)
+            except Exception:
+                pass  # a dead transport can't deliver events anyway
+
         if not to_watch:
             return
 
         def start_watch(item):
             name, member = item
+
             def handler(event: str, pod: dict, _cluster=name, _member=member) -> None:
                 with self._lock:
-                    if self._watched.get(_cluster) is not _member:
+                    watched = self._watched.get(_cluster)
+                    if watched is None or watched[0] is not _member:
                         return  # superseded by a rejoin
                     cache = self._caches.setdefault(_cluster, {})
                     key = obj_key(pod)
@@ -122,8 +141,15 @@ class PodInformer:
                     else:
                         cache[key] = self._transform(pod)
 
-            # The replay IS the cold LIST (LIST+WATCH).
+            with self._lock:
+                self._watched[name] = (member, handler)
+            # The replay IS the cold LIST (LIST+WATCH); both transports
+            # complete the replay before watch() returns.
             member.watch(PODS, handler, replay=True)
+            with self._lock:
+                watched = self._watched.get(name)
+                if watched is not None and watched[0] is member:
+                    self._caches.setdefault(name, {})  # ready (maybe podless)
 
         if len(to_watch) == 1:
             start_watch(to_watch[0])
@@ -142,11 +168,15 @@ class PodInformer:
         cluster: str,
         namespace: Optional[str] = None,
         selector: Optional[dict[str, str]] = None,
-    ) -> list[dict]:
+    ) -> Optional[list[dict]]:
+        """None = informer not (yet) watching this cluster — the caller
+        must fall back to a direct member scan, NOT treat it as 'no
+        pods' (a wrong empty answer would clear auto-migration's
+        estimatedCapacity)."""
         with self._lock:
             cache = self._caches.get(cluster)
             if cache is None:
-                return []
+                return None
             out = []
             for pod in cache.values():
                 meta = pod.get("metadata", {})

@@ -105,7 +105,11 @@ def collect_threads() -> dict:
 def handle_debug_path(path: str, query: dict) -> Optional[dict]:
     """Route a /debug/* request; None = not a debug path."""
     if path == "/debug/profile":
-        return collect_profile(float(query.get("seconds", 5)))
+        try:
+            seconds = float(query.get("seconds", 5))
+        except (TypeError, ValueError):
+            return {"error": f"bad seconds value: {query.get('seconds')!r}"}
+        return collect_profile(seconds)
     if path == "/debug/stacks":
         return collect_stacks()
     if path == "/debug/threads":
