@@ -11,8 +11,11 @@ PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATF
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
-kubeadmiral_tpu/native/libkadmhash.so: kubeadmiral_tpu/native/fnvhash.cpp
-	g++ -O3 -shared -fPIC -o $@ $<
+kubeadmiral_tpu/native/libkadmhash.so: kubeadmiral_tpu/native/fnvhash.cpp kubeadmiral_tpu/native/seqsched.cpp
+	g++ -O3 -shared -fPIC -o $@ $^
+
+bench-e2e:
+	$(PYTEST_ENV) python bench_e2e.py
 
 test:
 	$(PYTEST_ENV) python -m pytest tests/ -q
