@@ -20,10 +20,13 @@ After the cold LIST, per-member watches keep each cache incremental.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Optional
 
 from kubeadmiral_tpu.testing.fakekube import DELETED, NotFound, obj_key
+
+log = logging.getLogger("kubeadmiral.podinformer")
 
 PODS = "v1/pods"
 
@@ -59,6 +62,20 @@ def prune_pod(pod: dict) -> dict:
     }
 
 
+class _WatchState:
+    """One cluster's watch registration.  ``cache`` is staged privately
+    during the cold LIST replay and only published into the informer's
+    ``_caches`` once ``member.watch()`` returns — readers must never see
+    a half-replayed snapshot (pods_for's None contract)."""
+
+    __slots__ = ("member", "handler", "cache")
+
+    def __init__(self, member):
+        self.member = member
+        self.handler: Optional[Callable] = None
+        self.cache: dict[str, dict] = {}
+
+
 class PodInformer:
     """Pruned per-cluster pod caches over a fleet."""
 
@@ -77,11 +94,10 @@ class PodInformer:
         # not ready" (pods_for returns None) and fall back to a direct
         # member scan rather than trusting an empty snapshot.
         self._caches: dict[str, dict[str, dict]] = {}
-        # cluster name -> (member client, handler) watched: a rejoined
-        # cluster gets a NEW client/store, detected by identity, and is
-        # re-listed from scratch; the old handler is unwatched so its
-        # stream stops.
-        self._watched: dict[str, tuple] = {}
+        # cluster name -> _WatchState: a rejoined cluster gets a NEW
+        # client/store, detected by identity, and is re-listed from
+        # scratch; the old handler is unwatched so its stream stops.
+        self._watched: dict[str, _WatchState] = {}
 
     def _transform(self, pod: dict) -> dict:
         return prune_pod(pod) if self.enable_pruning else pod
@@ -94,21 +110,29 @@ class PodInformer:
         ones (a new member object) are re-listed.  Cold LIST+WATCHes
         fan out across at most ``max_pod_listers`` threads — the
         --max-pod-listers stampede bound."""
-        to_watch: list[tuple[str, object]] = []
-        to_unwatch: list[tuple[object, object]] = []
         current = dict(getattr(self.fleet, "members", {}))
+        # Resolve member clients OUTSIDE the lock: HttpFleet.member() can
+        # block on a host apiserver round trip, and this lock is shared
+        # with every pod-event handler across all clusters.  A member
+        # that fails to resolve is simply retried on the next attach.
+        members: dict[str, object] = {}
+        for name in current:
+            try:
+                members[name] = self.fleet.member(name)
+            except NotFound:
+                continue
+            except Exception:
+                log.warning("resolving member client for %s failed", name, exc_info=True)
+        to_watch: list[tuple[str, object]] = []
+        to_unwatch: list[_WatchState] = []
         with self._lock:
             for name in list(self._watched):
                 if name not in current:
                     to_unwatch.append(self._watched.pop(name))
                     self._caches.pop(name, None)
-            for name in current:
-                try:
-                    member = self.fleet.member(name)
-                except NotFound:
-                    continue
+            for name, member in members.items():
                 watched = self._watched.get(name)
-                if watched is not None and watched[0] is member:
+                if watched is not None and watched.member is member:
                     continue  # already watching this exact client
                 if watched is not None:
                     to_unwatch.append(watched)  # rejoin: stop the old stream
@@ -117,9 +141,9 @@ class PodInformer:
                 self._caches.pop(name, None)
                 self._watched.pop(name, None)
                 to_watch.append((name, member))
-        for old_member, old_handler in to_unwatch:
+        for old in to_unwatch:
             try:
-                old_member.unwatch(PODS, old_handler)
+                old.member.unwatch(PODS, old.handler)
             except Exception:
                 pass  # a dead transport can't deliver events anyway
 
@@ -128,28 +152,42 @@ class PodInformer:
 
         def start_watch(item):
             name, member = item
+            state = _WatchState(member)
 
-            def handler(event: str, pod: dict, _cluster=name, _member=member) -> None:
+            def handler(event: str, pod: dict, _state=state, _cluster=name) -> None:
                 with self._lock:
-                    watched = self._watched.get(_cluster)
-                    if watched is None or watched[0] is not _member:
+                    if self._watched.get(_cluster) is not _state:
                         return  # superseded by a rejoin
-                    cache = self._caches.setdefault(_cluster, {})
                     key = obj_key(pod)
                     if event == DELETED:
-                        cache.pop(key, None)
+                        _state.cache.pop(key, None)
                     else:
-                        cache[key] = self._transform(pod)
+                        _state.cache[key] = self._transform(pod)
 
+            state.handler = handler
             with self._lock:
-                self._watched[name] = (member, handler)
+                self._watched[name] = state
             # The replay IS the cold LIST (LIST+WATCH); both transports
-            # complete the replay before watch() returns.
-            member.watch(PODS, handler, replay=True)
+            # complete the replay before watch() returns.  Replay events
+            # accumulate in state.cache (staged, invisible to readers)
+            # and publish atomically below.  A down member must not
+            # abort attach or the caller's event-dispatch context: drop
+            # the registration and retry on the next attach.
+            try:
+                member.watch(PODS, handler, replay=True)
+            except Exception:
+                log.warning("pod watch for %s failed; will retry", name, exc_info=True)
+                with self._lock:
+                    if self._watched.get(name) is state:
+                        del self._watched[name]
+                try:
+                    member.unwatch(PODS, handler)
+                except Exception:
+                    pass
+                return
             with self._lock:
-                watched = self._watched.get(name)
-                if watched is not None and watched[0] is member:
-                    self._caches.setdefault(name, {})  # ready (maybe podless)
+                if self._watched.get(name) is state:
+                    self._caches[name] = state.cache  # ready (maybe podless)
 
         if len(to_watch) == 1:
             start_watch(to_watch[0])

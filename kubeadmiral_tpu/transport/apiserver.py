@@ -25,6 +25,8 @@ apiserver:
 from __future__ import annotations
 
 import bisect
+import hashlib
+import hmac
 import json
 import secrets as pysecrets
 import threading
@@ -38,6 +40,7 @@ from kubeadmiral_tpu.testing.fakekube import (
     Conflict,
     FakeKube,
     NotFound,
+    obj_key as fk_obj_key,
 )
 from kubeadmiral_tpu.transport.paths import parse_path
 
@@ -108,25 +111,31 @@ class KubeApiServer:
         admin_token: Optional[str] = None,
         mint_sa_tokens: bool = False,
         event_log_cap: int = 100_000,
+        sa_signing_key: Optional[str] = None,
     ):
         self.store = store
         self.admin_token = admin_token
         self._tokens: set[str] = set()
+        # Minted tokens are self-authenticating: HMAC(signing key,
+        # secret key + SA name) — the analogue of the real apiserver's
+        # JWT signature.  The type string, annotation and data.token of
+        # a secret are all client-settable (sync can propagate workload
+        # Secrets claiming anything), but a valid HMAC cannot be forged
+        # without the signing key; and because trust is recomputed from
+        # the value itself, a server restarted over a resumed store
+        # (given the same sa_signing_key, like the real apiserver's
+        # --service-account-key-file) re-grants exactly the tokens it
+        # minted and nothing an attacker planted meanwhile.
+        self._sa_key = (sa_signing_key or pysecrets.token_hex(16)).encode()
+        # secret key -> token currently granted, so rotation/annotation
+        # changes revoke the stale value instead of leaking it forever.
+        self._granted: dict[str, str] = {}
         self._log = _EventLog(event_log_cap)
         self._closed = threading.Event()
         self._mint_sa_tokens = mint_sa_tokens
 
-        # Seed accepted tokens from pre-existing service-account token
-        # secrets, then track via the event feed (under the store lock,
-        # so no races with auth).  ONLY type kubernetes.io/service-
-        # account-token secrets count: an ordinary workload Secret that
-        # happens to carry a data.token key (e.g. federated user data)
-        # must never become an apiserver credential.
-        if admin_token is not None:
-            for secret in store.list_view(SECRETS):
-                token = self._sa_token(secret)
-                if token:
-                    self._tokens.add(token)
+        for secret in store.list_view(SECRETS):
+            self._regrant(secret)
         store.watch_all(self._on_store_event)
 
         server = ThreadingHTTPServer((host, port), _Handler)
@@ -142,31 +151,94 @@ class KubeApiServer:
         )
         self._thread.start()
 
-    @staticmethod
-    def _sa_token(secret: dict) -> Optional[str]:
+    def _mint_value(self, secret_key: str, sa_name: str) -> str:
+        """The (deterministic, unforgeable) token for one SA's secret."""
+        msg = f"{secret_key}\x00{sa_name}".encode()
+        return hmac.new(self._sa_key, msg, hashlib.sha256).hexdigest()
+
+    def _trusted_token(self, secret: dict) -> Optional[str]:
+        """The token this secret legitimately carries, or None.
+
+        Mirrors the real token controller's contract: the secret is
+        token-typed, its kubernetes.io/service-account.name annotation
+        references a ServiceAccount that exists, and data.token
+        verifies against the signing key for exactly this (secret, SA)
+        pair.  A federated workload Secret propagated by sync can fake
+        the type, the annotation and the value — but not the HMAC."""
         if secret.get("type") != "kubernetes.io/service-account-token":
             return None
-        return (secret.get("data") or {}).get("token")
+        meta = secret.get("metadata") or {}
+        sa_name = (meta.get("annotations") or {}).get(
+            "kubernetes.io/service-account.name"
+        )
+        if not sa_name:
+            return None
+        namespace = meta.get("namespace", "")
+        sa_key = f"{namespace}/{sa_name}" if namespace else sa_name
+        if self.store.try_get(SERVICE_ACCOUNTS, sa_key) is None:
+            return None
+        token = (secret.get("data") or {}).get("token")
+        expected = self._mint_value(fk_obj_key(secret), sa_name)
+        if not token or not hmac.compare_digest(token, expected):
+            return None
+        return token
+
+    def _regrant(self, secret: dict, deleted: bool = False) -> None:
+        """Recompute one secret's grant, revoking any stale value: the
+        single transition point for grant state, so rotation, annotation
+        changes, SA appearance/disappearance and deletion all converge
+        (no path can leak a previously granted token)."""
+        key = fk_obj_key(secret)
+        new = None if deleted else self._trusted_token(secret)
+        old = self._granted.get(key)
+        if old is not None and old != new:
+            self._tokens.discard(old)
+        if new is None:
+            self._granted.pop(key, None)
+        else:
+            self._granted[key] = new
+            self._tokens.add(new)
+
+    def _secrets_referencing(self, sa: dict) -> list[dict]:
+        """Token-typed secrets annotated with this SA's name."""
+        meta = sa.get("metadata", {})
+        out = []
+        for secret in self.store.list_view(SECRETS):
+            if secret.get("type") != "kubernetes.io/service-account-token":
+                continue
+            smeta = secret.get("metadata") or {}
+            if smeta.get("namespace", "") != meta.get("namespace", ""):
+                continue
+            if (smeta.get("annotations") or {}).get(
+                "kubernetes.io/service-account.name"
+            ) == meta.get("name"):
+                out.append(secret)
+        return out
 
     # -- store event feed (runs under the store lock) --------------------
     def _on_store_event(self, resource: str, event: str, obj: dict, seq: int) -> None:
         self._log.append(resource, event, obj, seq)
-        if resource != SECRETS:
-            if self._mint_sa_tokens and resource == SERVICE_ACCOUNTS and event == ADDED:
+        if resource == SECRETS:
+            self._regrant(obj, deleted=event == "DELETED")
+        elif resource == SERVICE_ACCOUNTS:
+            if event == ADDED and self._mint_sa_tokens:
                 self._mint_token(obj)
-            return
-        token = self._sa_token(obj)
-        if token:
-            if event == "DELETED":
-                self._tokens.discard(token)
-            else:
-                self._tokens.add(token)
+            # Re-evaluate grants of secrets referencing this SA: its
+            # appearance enables boot-trusted secrets that landed first;
+            # its deletion revokes their tokens even while the secret
+            # lingers (a crash between unjoin's SA and secret deletes
+            # must not leave a live credential).
+            for secret in self._secrets_referencing(obj):
+                self._regrant(secret)
 
     def _mint_token(self, sa: dict) -> None:
         """Create a token Secret for a new ServiceAccount — the member-
         side token controller the join handshake waits on (the reference
         reads the SA's token secret, clusterjoin.go:449-529)."""
         meta = sa["metadata"]
+        name = f"{meta['name']}-token"
+        namespace = meta.get("namespace", "")
+        key = f"{namespace}/{name}" if namespace else name
         try:
             self.store.create(
                 SECRETS,
@@ -175,16 +247,19 @@ class KubeApiServer:
                     "kind": "Secret",
                     "type": "kubernetes.io/service-account-token",
                     "metadata": {
-                        "name": f"{meta['name']}-token",
-                        "namespace": meta.get("namespace", ""),
+                        "name": name,
+                        "namespace": namespace,
                         "annotations": {
                             "kubernetes.io/service-account.name": meta["name"]
                         },
                     },
-                    "data": {"token": pysecrets.token_hex(16)},
+                    "data": {"token": self._mint_value(key, meta["name"])},
                 },
             )
         except AlreadyExists:
+            # A lingering secret from a previous SA incarnation carries
+            # the same deterministic value; the caller's regrant loop
+            # re-grants it now that the SA exists again.
             pass
 
     # -- auth ------------------------------------------------------------

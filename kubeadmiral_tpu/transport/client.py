@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import threading
 import time
 from typing import Callable, Optional
@@ -315,7 +316,20 @@ class _ResourceWatch:
         with self._lock:
             handlers = list(self._handlers)
         for handler in handlers:
-            handler(event, obj)
+            # Isolate handler failures from the reflector loop (client-go
+            # informers do the same): one controller's bad handler must
+            # not kill watch delivery for every other handler of this
+            # resource, and an unhandled exception here would silently
+            # end the reflector thread.
+            try:
+                handler(event, obj)
+            except Exception:
+                logging.getLogger("kubeadmiral.transport").exception(
+                    "watch handler failed for %s %s on %s",
+                    event,
+                    key,
+                    self.resource,
+                )
 
     # -- the reflector loop ---------------------------------------------
     def _run(self) -> None:

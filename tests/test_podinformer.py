@@ -156,3 +156,61 @@ class TestAutoMigrationWithInformer:
             got["metadata"]["annotations"][C.PREFIX + "auto-migration-info"]
         )
         assert info["estimatedCapacity"] == {"c1": 1}
+
+
+class _ReplayObservingFleet:
+    """Duck-typed fleet whose single member lets the test observe the
+    informer's read surface MID-replay (between replay events)."""
+
+    def __init__(self, pods, observe, fail_watches=0):
+        self.pods = pods
+        self.observe = observe
+        self.fail_watches = fail_watches
+        self.members = {"c1": object()}
+        self._member = self._Member(self)
+
+    def member(self, name):
+        return self._member
+
+    class _Member:
+        def __init__(self, fleet):
+            self.fleet = fleet
+
+        def watch(self, resource, handler, replay=True):
+            if self.fleet.fail_watches > 0:
+                self.fleet.fail_watches -= 1
+                raise ConnectionError("member down")
+            for pod in self.fleet.pods:
+                handler("ADDED", pod)
+                self.fleet.observe()  # mid-replay: cache must be staged
+
+        def unwatch(self, resource, handler):
+            pass
+
+
+class TestColdReplayStaging:
+    def test_partial_replay_is_invisible(self):
+        """pods_for returns None for the WHOLE cold-replay window: a
+        half-replayed snapshot must never feed auto-migration counts
+        (ADVICE r2: podinformer partial-cache hazard)."""
+        seen = []
+        fleet = _ReplayObservingFleet(
+            [fat_pod(f"p{i}") for i in range(5)],
+            observe=lambda: seen.append(informer.pods_for("c1")),
+        )
+        informer = PodInformer(fleet)
+        informer.attach()
+        assert seen == [None] * 5  # staged during replay
+        assert len(informer.pods_for("c1")) == 5  # published after
+
+    def test_watch_failure_contained_and_retried(self):
+        """A down member must not abort attach(); the next attach
+        retries and succeeds (ADVICE r2: failure containment)."""
+        fleet = _ReplayObservingFleet(
+            [fat_pod("p0")], observe=lambda: None, fail_watches=1
+        )
+        informer = PodInformer(fleet)
+        informer.attach()  # watch raises inside; must not propagate
+        assert informer.pods_for("c1") is None
+        informer.attach()  # retried
+        assert len(informer.pods_for("c1")) == 1

@@ -348,3 +348,246 @@ class TestFactory:
             host.close()
             member_srv.close()
             host_srv.close()
+
+
+class TestTokenTrustBoundary:
+    def test_workload_secret_with_token_type_is_not_a_credential(self):
+        """A client-created Secret merely CLAIMING the service-account-
+        token type (e.g. a federated user Secret propagated by sync)
+        must not become an apiserver credential: only secrets whose
+        kubernetes.io/service-account.name annotation references an
+        existing ServiceAccount count (ADVICE r2)."""
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        try:
+            admin = HttpKube(srv.url, token="sekrit")
+            # No annotation at all.
+            admin.create(
+                "v1/secrets",
+                {"apiVersion": "v1", "kind": "Secret",
+                 "type": "kubernetes.io/service-account-token",
+                 "metadata": {"name": "evil1", "namespace": "default"},
+                 "data": {"token": "evil-token-1"}},
+            )
+            # Annotation referencing a ServiceAccount that doesn't exist.
+            admin.create(
+                "v1/secrets",
+                {"apiVersion": "v1", "kind": "Secret",
+                 "type": "kubernetes.io/service-account-token",
+                 "metadata": {
+                     "name": "evil2", "namespace": "default",
+                     "annotations": {
+                         "kubernetes.io/service-account.name": "ghost"
+                     },
+                 },
+                 "data": {"token": "evil-token-2"}},
+            )
+            for token in ("evil-token-1", "evil-token-2"):
+                bad = HttpKube(srv.url, token=token)
+                with pytest.raises(TransportError, match="401"):
+                    bad.list(DEPLOYMENTS)
+                bad.close()
+            # The genuinely minted token still works.
+            admin.create(
+                "v1/serviceaccounts",
+                {"apiVersion": "v1", "kind": "ServiceAccount",
+                 "metadata": {"name": "bot", "namespace": "sys"}},
+            )
+            minted = admin.get("v1/secrets", "sys/bot-token")
+            good = HttpKube(srv.url, token=minted["data"]["token"])
+            assert good.list(DEPLOYMENTS) == []
+            good.close()
+            admin.close()
+        finally:
+            srv.close()
+
+    def test_revocation_survives_sa_deleted_first(self):
+        """Unjoin deletes the ServiceAccount BEFORE its token secret;
+        the secret's deletion must still revoke the credential."""
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        try:
+            admin = HttpKube(srv.url, token="sekrit")
+            admin.create(
+                "v1/serviceaccounts",
+                {"apiVersion": "v1", "kind": "ServiceAccount",
+                 "metadata": {"name": "bot", "namespace": "sys"}},
+            )
+            token = admin.get("v1/secrets", "sys/bot-token")["data"]["token"]
+            client = HttpKube(srv.url, token=token)
+            assert client.list(DEPLOYMENTS) == []
+            admin.delete("v1/serviceaccounts", "sys/bot")
+            admin.delete("v1/secrets", "sys/bot-token")
+            with pytest.raises(TransportError, match="401"):
+                client.list(DEPLOYMENTS)
+            client.close()
+            admin.close()
+        finally:
+            srv.close()
+
+    def test_restart_regrants_minted_tokens_only(self):
+        """A server restarted over a resumed store (same signing key)
+        re-grants exactly the tokens it minted — and nothing an
+        attacker planted into the store meanwhile (HMAC provenance
+        survives restart; client-settable fields never authenticate)."""
+        store = FakeKube("m")
+        srv1 = KubeApiServer(store, admin_token="sekrit",
+                             mint_sa_tokens=True, sa_signing_key="key-1")
+        admin = HttpKube(srv1.url, token="sekrit")
+        admin.create(
+            "v1/serviceaccounts",
+            {"apiVersion": "v1", "kind": "ServiceAccount",
+             "metadata": {"name": "bot", "namespace": "sys"}},
+        )
+        minted = admin.get("v1/secrets", "sys/bot-token")["data"]["token"]
+        # Attacker-planted token-typed secret lands in the store too.
+        admin.create(
+            "v1/secrets",
+            {"apiVersion": "v1", "kind": "Secret",
+             "type": "kubernetes.io/service-account-token",
+             "metadata": {
+                 "name": "planted", "namespace": "sys",
+                 "annotations": {
+                     "kubernetes.io/service-account.name": "bot"
+                 },
+             },
+             "data": {"token": "attacker-chosen"}},
+        )
+        admin.close()
+        srv1.close()
+
+        srv2 = KubeApiServer(store, admin_token="sekrit",
+                             mint_sa_tokens=True, sa_signing_key="key-1")
+        try:
+            good = HttpKube(srv2.url, token=minted)
+            assert good.list(DEPLOYMENTS) == []
+            good.close()
+            bad = HttpKube(srv2.url, token="attacker-chosen")
+            with pytest.raises(TransportError, match="401"):
+                bad.list(DEPLOYMENTS)
+            bad.close()
+        finally:
+            srv2.close()
+
+        # A restart with a DIFFERENT signing key trusts nothing.
+        srv3 = KubeApiServer(store, admin_token="sekrit",
+                             mint_sa_tokens=True, sa_signing_key="key-2")
+        try:
+            stale = HttpKube(srv3.url, token=minted)
+            with pytest.raises(TransportError, match="401"):
+                stale.list(DEPLOYMENTS)
+            stale.close()
+        finally:
+            srv3.close()
+
+    def test_client_chosen_token_never_authenticates_even_with_sa(self):
+        """The full attack from ADVICE r2: sync propagates BOTH a
+        ServiceAccount and a token-typed Secret with a chosen value.
+        The type, annotation and value are all client-settable; only
+        mint provenance is not — so the chosen value must get 401."""
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        try:
+            admin = HttpKube(srv.url, token="sekrit")
+            admin.create(
+                "v1/serviceaccounts",
+                {"apiVersion": "v1", "kind": "ServiceAccount",
+                 "metadata": {"name": "bot", "namespace": "prod"}},
+            )
+            admin.create(
+                "v1/secrets",
+                {"apiVersion": "v1", "kind": "Secret",
+                 "type": "kubernetes.io/service-account-token",
+                 "metadata": {
+                     "name": "planted", "namespace": "prod",
+                     "annotations": {
+                         "kubernetes.io/service-account.name": "bot"
+                     },
+                 },
+                 "data": {"token": "attacker-chosen"}},
+            )
+            bad = HttpKube(srv.url, token="attacker-chosen")
+            with pytest.raises(TransportError, match="401"):
+                bad.list(DEPLOYMENTS)
+            bad.close()
+            # The server-minted token for the same SA still works.
+            minted = admin.get("v1/secrets", "prod/bot-token")["data"]["token"]
+            good = HttpKube(srv.url, token=minted)
+            assert good.list(DEPLOYMENTS) == []
+            good.close()
+            admin.close()
+        finally:
+            srv.close()
+
+    def test_token_rotation_revokes_stale_value(self):
+        """Overwriting a minted secret's data.token must revoke the old
+        value (no unrevocable lingering credential) and must NOT grant
+        the new, non-minted value."""
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        try:
+            admin = HttpKube(srv.url, token="sekrit")
+            admin.create(
+                "v1/serviceaccounts",
+                {"apiVersion": "v1", "kind": "ServiceAccount",
+                 "metadata": {"name": "bot", "namespace": "sys"}},
+            )
+            secret = admin.get("v1/secrets", "sys/bot-token")
+            old_token = secret["data"]["token"]
+            client = HttpKube(srv.url, token=old_token)
+            assert client.list(DEPLOYMENTS) == []
+            secret["data"]["token"] = "rotated-by-hand"
+            admin.update("v1/secrets", secret)
+            with pytest.raises(TransportError, match="401"):
+                client.list(DEPLOYMENTS)
+            rotated = HttpKube(srv.url, token="rotated-by-hand")
+            with pytest.raises(TransportError, match="401"):
+                rotated.list(DEPLOYMENTS)
+            rotated.close()
+            client.close()
+            admin.close()
+        finally:
+            srv.close()
+
+    def test_sa_deletion_revokes_lingering_token(self):
+        """A crash between unjoin's SA delete and secret delete must not
+        leave a live credential: deleting the SA revokes its tokens."""
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        try:
+            admin = HttpKube(srv.url, token="sekrit")
+            admin.create(
+                "v1/serviceaccounts",
+                {"apiVersion": "v1", "kind": "ServiceAccount",
+                 "metadata": {"name": "bot", "namespace": "sys"}},
+            )
+            token = admin.get("v1/secrets", "sys/bot-token")["data"]["token"]
+            client = HttpKube(srv.url, token=token)
+            assert client.list(DEPLOYMENTS) == []
+            admin.delete("v1/serviceaccounts", "sys/bot")  # secret lingers
+            with pytest.raises(TransportError, match="401"):
+                client.list(DEPLOYMENTS)
+            client.close()
+            admin.close()
+        finally:
+            srv.close()
+
+    def test_namespaceless_serviceaccount_token(self):
+        """SA with no namespace: the grant lookup must use the store's
+        key format (bare name), not '/name'."""
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        try:
+            admin = HttpKube(srv.url, token="sekrit")
+            admin.create(
+                "v1/serviceaccounts",
+                {"apiVersion": "v1", "kind": "ServiceAccount",
+                 "metadata": {"name": "bare"}},
+            )
+            token = admin.get("v1/secrets", "bare-token")["data"]["token"]
+            client = HttpKube(srv.url, token=token)
+            assert client.list(DEPLOYMENTS) == []
+            client.close()
+            admin.close()
+        finally:
+            srv.close()
