@@ -120,3 +120,26 @@ def shard_inputs(inputs: TickInputs, mesh: Mesh) -> TickInputs:
             for arr, sh in zip(inputs, shardings)
         )
     )
+
+
+def field_shardings(mesh: Mesh, names) -> dict[str, NamedSharding]:
+    """NamedShardings for a subset of TickInputs fields by name (the
+    engine shards its cached per-object tensors with exactly the same
+    layout the full tick expects)."""
+    return {
+        name: NamedSharding(mesh, P(*_FIELD_SPECS[name])) for name in names
+    }
+
+
+def grid_sharding(mesh: Mesh) -> NamedSharding:
+    """The [B, C] (objects, clusters) layout shared by all tick outputs."""
+    return NamedSharding(mesh, P(*_OUTPUT_SPEC))
+
+
+def rows_sharding(mesh: Mesh) -> NamedSharding:
+    """[B] per-object vectors (e.g. the delta mask)."""
+    return NamedSharding(mesh, P(OBJECTS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -178,8 +178,16 @@ class KubeApiServer:
         if self.store.try_get(SERVICE_ACCOUNTS, sa_key) is None:
             return None
         token = (secret.get("data") or {}).get("token")
+        # data.token is client-settable: a non-str / non-ASCII value must
+        # read as "untrusted", not raise out of the store event feed (and
+        # permanently crash server restarts over the resumed store).
+        if not isinstance(token, str) or not token:
+            return None
         expected = self._mint_value(fk_obj_key(secret), sa_name)
-        if not token or not hmac.compare_digest(token, expected):
+        try:
+            if not hmac.compare_digest(token, expected):
+                return None
+        except TypeError:
             return None
         return token
 

@@ -591,3 +591,37 @@ class TestTokenTrustBoundary:
             admin.close()
         finally:
             srv.close()
+
+    def test_malformed_token_value_is_untrusted_not_fatal(self):
+        """Non-str / non-ASCII data.token must read as untrusted — and
+        must not crash a server restart over the resumed store."""
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        admin = HttpKube(srv.url, token="sekrit")
+        admin.create(
+            "v1/serviceaccounts",
+            {"apiVersion": "v1", "kind": "ServiceAccount",
+             "metadata": {"name": "bot", "namespace": "sys"}},
+        )
+        for i, bad_token in enumerate((123, "émoji-token-é", None)):
+            admin.create(
+                "v1/secrets",
+                {"apiVersion": "v1", "kind": "Secret",
+                 "type": "kubernetes.io/service-account-token",
+                 "metadata": {
+                     "name": f"weird-{i}", "namespace": "sys",
+                     "annotations": {
+                         "kubernetes.io/service-account.name": "bot"
+                     },
+                 },
+                 "data": {"token": bad_token}},
+            )
+        minted = admin.get("v1/secrets", "sys/bot-token")["data"]["token"]
+        good = HttpKube(srv.url, token=minted)
+        assert good.list(DEPLOYMENTS) == []  # server still serving
+        good.close()
+        admin.close()
+        srv.close()
+        # Restart over the resumed store: must construct cleanly.
+        srv2 = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
+        srv2.close()
