@@ -93,6 +93,113 @@ class TickOutputs(NamedTuple):
     scores: jax.Array     # i32[B,C] post-normalize totals (introspection)
 
 
+def expand_compact(ci) -> TickInputs:
+    """Device-side expansion of CompactInputs into the dense planes the
+    fused tick consumes: vocabulary-table gathers, sparse policy
+    scatters, and the planner tie-break FNV-1 hash — all in HBM, where
+    the [B, C] planes cost bandwidth instead of host-link transfer
+    (scheduler/compact.py explains why this is the 100k x 5k enabler).
+
+    Bit-exact with scheduler/featurize.featurize: the tables are built
+    by the same host matching code, and the FNV continuation reproduces
+    utils/hashing.fnv32_extend + uint32_to_sortable_int32 exactly."""
+    b = ci.gvk_id.shape[0]
+    c = ci.cluster_valid.shape[0]
+
+    api_ok = ci.api_matrix[ci.gvk_id]
+    taint_row = ci.taint_set_id  # i32[C]
+    taint_ok_new = ci.taint_new[ci.tol_id][:, taint_row]
+    taint_ok_cur = ci.taint_cur[ci.tol_id][:, taint_row]
+    taint_counts = ci.taint_prefer[ci.tol_id][:, taint_row]
+    selector_ok = ci.sel_matrix[ci.sel_id]
+    affinity_scores = ci.pref_matrix[ci.pref_id]
+    placement_ok = ci.place_matrix[ci.place_id]
+
+    # Sparse per-(object, cluster) policy entries -> dense grids.  The
+    # EMPTY_SLOT sentinel is out of range for any cluster padding, so
+    # mode='drop' ignores unused entries.
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+
+    def scatter(default, vals, dtype):
+        base = jnp.full((b, c), default, dtype)
+        return base.at[rows, ci.sparse_idx].set(vals.astype(dtype), mode="drop")
+
+    min_replicas = scatter(0, ci.sparse_min, jnp.int32)
+    max_replicas = scatter(INT32_INF, ci.sparse_max, jnp.int32)
+    weights = scatter(0, ci.sparse_weight, jnp.int32)
+    capacity = scatter(INT32_INF, ci.sparse_capacity, jnp.int32)
+    cur_present = ci.sparse_cur != -2  # CUR_ABSENT
+    current_mask = (
+        jnp.zeros((b, c), bool)
+        .at[rows, ci.sparse_idx]
+        .set(cur_present, mode="drop")
+    )
+    current_replicas = scatter(
+        NIL_REPLICAS, jnp.where(ci.sparse_cur >= 0, ci.sparse_cur, NIL_REPLICAS),
+        jnp.int32,
+    )
+
+    # Planner tie-break: continue each cluster name's FNV-1 state over
+    # the object key's bytes (h = h*prime ^ byte, uint32 wraparound),
+    # then map to order-preserving int32 (hashing.py semantics).
+    prime = jnp.uint32(16777619)
+    state0 = jnp.broadcast_to(
+        jnp.asarray(ci.name_hash_state), (b, c)
+    ).astype(jnp.uint32)
+    key_cols = jnp.asarray(ci.key_bytes).T  # [L, B] — scanned xs
+    key_len = jnp.asarray(ci.key_len)
+    n_bytes = key_cols.shape[0]
+
+    def fnv_step(state, xs):
+        byte, j = xs
+        upd = (state * prime) ^ byte.astype(jnp.uint32)[:, None]
+        keep = (j < key_len)[:, None]
+        return jnp.where(keep, upd, state), None
+
+    state, _ = jax.lax.scan(
+        fnv_step, state0, (key_cols, jnp.arange(n_bytes))
+    )
+    tiebreak = jax.lax.bitcast_convert_type(
+        state ^ jnp.uint32(0x80000000), jnp.int32
+    )
+
+    return TickInputs(
+        filter_enabled=ci.filter_enabled,
+        api_ok=api_ok,
+        taint_ok_new=taint_ok_new,
+        taint_ok_cur=taint_ok_cur,
+        selector_ok=selector_ok,
+        placement_has=ci.placement_has,
+        placement_ok=placement_ok,
+        request=ci.request,
+        alloc=ci.alloc,
+        used=ci.used,
+        score_enabled=ci.score_enabled,
+        taint_counts=taint_counts,
+        affinity_scores=affinity_scores,
+        webhook_ok=jnp.ones((b, c), bool),
+        webhook_scores=jnp.zeros((b, c), jnp.int32),
+        max_clusters=ci.max_clusters,
+        mode_divide=ci.mode_divide,
+        sticky=ci.sticky,
+        current_mask=current_mask,
+        current_replicas=current_replicas,
+        total=ci.total,
+        weights_given=ci.weights_given,
+        weights=weights,
+        min_replicas=min_replicas,
+        max_replicas=max_replicas,
+        scale_max=max_replicas,
+        capacity=capacity,
+        keep_unschedulable=ci.keep_unschedulable,
+        avoid_disruption=ci.avoid_disruption,
+        tiebreak=tiebreak,
+        cpu_alloc=ci.cpu_alloc,
+        cpu_avail=ci.cpu_avail,
+        cluster_valid=ci.cluster_valid,
+    )
+
+
 @jax.jit
 def schedule_tick(inp: TickInputs) -> TickOutputs:
     # --- Filter ---

@@ -68,6 +68,50 @@ _FIELD_SPECS: dict[str, tuple[Optional[str], ...]] = {
 
 _OUTPUT_SPEC = (OBJECTS, CLUSTERS)
 
+# Axis layout for the compact input format (scheduler/compact.py):
+# per-object vectors shard over objects, vocabulary tables replicate
+# their vocab axis and shard the cluster axis, taint tables replicate.
+_COMPACT_FIELD_SPECS: dict[str, tuple[Optional[str], ...]] = {
+    "gvk_id": (OBJECTS,),
+    "tol_id": (OBJECTS,),
+    "sel_id": (OBJECTS,),
+    "pref_id": (OBJECTS,),
+    "place_id": (OBJECTS,),
+    "placement_has": (OBJECTS,),
+    "filter_enabled": (OBJECTS, None),
+    "score_enabled": (OBJECTS, None),
+    "request": (OBJECTS, None),
+    "max_clusters": (OBJECTS,),
+    "mode_divide": (OBJECTS,),
+    "sticky": (OBJECTS,),
+    "total": (OBJECTS,),
+    "weights_given": (OBJECTS,),
+    "keep_unschedulable": (OBJECTS,),
+    "avoid_disruption": (OBJECTS,),
+    "sparse_idx": (OBJECTS, None),
+    "sparse_min": (OBJECTS, None),
+    "sparse_max": (OBJECTS, None),
+    "sparse_weight": (OBJECTS, None),
+    "sparse_capacity": (OBJECTS, None),
+    "sparse_cur": (OBJECTS, None),
+    "key_bytes": (OBJECTS, None),
+    "key_len": (OBJECTS,),
+    "api_matrix": (None, CLUSTERS),
+    "taint_new": (None, None),
+    "taint_cur": (None, None),
+    "taint_prefer": (None, None),
+    "sel_matrix": (None, CLUSTERS),
+    "pref_matrix": (None, CLUSTERS),
+    "place_matrix": (None, CLUSTERS),
+    "taint_set_id": (CLUSTERS,),
+    "name_hash_state": (CLUSTERS,),
+    "alloc": (CLUSTERS, None),
+    "used": (CLUSTERS, None),
+    "cpu_alloc": (CLUSTERS,),
+    "cpu_avail": (CLUSTERS,),
+    "cluster_valid": (CLUSTERS,),
+}
+
 
 def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
@@ -129,6 +173,24 @@ def field_shardings(mesh: Mesh, names) -> dict[str, NamedSharding]:
     return {
         name: NamedSharding(mesh, P(*_FIELD_SPECS[name])) for name in names
     }
+
+
+def compact_field_shardings(mesh: Mesh, names) -> dict[str, NamedSharding]:
+    """NamedShardings for CompactInputs fields by name."""
+    return {
+        name: NamedSharding(mesh, P(*_COMPACT_FIELD_SPECS[name]))
+        for name in names
+    }
+
+
+def compact_input_shardings(mesh: Mesh):
+    """The full CompactInputs sharding pytree (imported lazily to avoid
+    a mesh -> scheduler import cycle)."""
+    from kubeadmiral_tpu.scheduler.compact import CompactInputs
+
+    return CompactInputs(
+        **compact_field_shardings(mesh, CompactInputs._fields)
+    )
 
 
 def grid_sharding(mesh: Mesh) -> NamedSharding:

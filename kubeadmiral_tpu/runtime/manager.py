@@ -332,13 +332,19 @@ class ControllerManager:
                 fed_resources = {
                     rt.ftc.federated.resource for rt in self._ftcs.values()
                 }
-            n_objects = sum(
-                len(self.host.keys(r)) for r in fed_resources
-            ) or self.engine.chunk_size
+            all_keys = [k for r in fed_resources for k in self.host.keys(r)]
+            n_objects = len(all_keys) or self.engine.chunk_size
+            # The longest object key picks the compact key-byte bucket.
+            key_len = max((len(k) for k in all_keys), default=32)
+            from kubeadmiral_tpu.scheduler.webhook import SCHEDULER_WEBHOOK_CONFIGS
+
+            webhooks = bool(self.host.list(SCHEDULER_WEBHOOK_CONFIGS))
             self.engine.prewarm(
                 n_objects,
                 max(1, len(clusters)),
                 scalar_resources=scalars,
+                key_len=key_len,
+                webhooks=webhooks,
             )
         except Exception:
             import logging

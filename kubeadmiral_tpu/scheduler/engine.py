@@ -35,10 +35,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeadmiral_tpu.models import types as T
-from kubeadmiral_tpu.ops.pipeline import NIL_REPLICAS, TickInputs, schedule_tick
+from kubeadmiral_tpu.ops.pipeline import (
+    NIL_REPLICAS,
+    TickInputs,
+    expand_compact,
+    schedule_tick,
+)
+from kubeadmiral_tpu.scheduler import compact as Cmp
+from kubeadmiral_tpu.scheduler.compact import (
+    CompactInputs,
+    CompactVocab,
+    VocabOverflow,
+    featurize_compact,
+)
 from kubeadmiral_tpu.scheduler.featurize import (
     ClusterView,
-    FeaturizedBatch,
     featurize,
     featurize_signature,
 )
@@ -199,9 +210,14 @@ class _CachedChunk:
 
     sigs: list
     units: list  # identity fast-path: `is`-compare before sig-compare
-    inputs: TickInputs
+    inputs: object  # TickInputs (dense) or CompactInputs
+    fmt: str  # "compact" | "dense"
     topo_fp: tuple
     nbytes: int
+    # Which CompactVocab instance the cached ids were issued by (0 for
+    # dense): ids are meaningless against a different instance's tables,
+    # even for the same topology fingerprint.
+    vocab_uid: int = 0
     # Device-resident copies of the padded per-object tensors: a clean
     # re-tick skips the host->device transfer entirely (the dominant
     # cost over a tunneled TPU backend).
@@ -252,6 +268,15 @@ def _tick_with_diff(inp: TickInputs, prev: tuple):
         jnp.int8
     ) * _DIFF_SCORES
     return out, mask
+
+
+def _tick_compact_with_diff(ci: CompactInputs, prev: tuple):
+    """The compact-format tick: device-side plane expansion (table
+    gathers, sparse scatters, on-device FNV tie-breaks) feeding the same
+    fused pipeline + diff.  This is the PRIMARY production program — the
+    dense variant serves webhook ticks and vocabulary-overflow
+    fallbacks."""
+    return _tick_with_diff(expand_compact(ci), prev)
 
 
 def _gather_packed(sel, rep, cnt, sco, idx):
@@ -351,6 +376,15 @@ class SchedulerEngine:
         # and the mask is simply ignored.
         self._zero_prev: dict[tuple, tuple] = {}
         self._prewarm_thread: Optional[threading.Thread] = None
+        # Compact-format state: one vocabulary per cluster topology
+        # (None = topology overflowed a cap; dense fallback), kept for a
+        # few recent topologies so an A->B->A flap reuses A's vocabulary
+        # (cache entries record the vocab uid they were built against —
+        # ids from one instance are meaningless in another's tables).
+        # Plus a device-resident copy of the current padded tables keyed
+        # by (vocab uid, version, padded C).
+        self._vocabs: dict[tuple, Optional[CompactVocab]] = {}
+        self._device_tables: Optional[tuple] = None
 
     # -- mesh / program construction -------------------------------------
     def _resolve_mesh(self, mesh):
@@ -375,10 +409,14 @@ class SchedulerEngine:
     def _build_programs(self) -> None:
         if self.mesh is None:
             self._tick = jax.jit(_tick_with_diff)
+            self._tick_compact = jax.jit(_tick_compact_with_diff)
             self._gather = jax.jit(_gather_packed)
             self._gather3 = jax.jit(_gather_packed3)
             self._patch = jax.jit(_patch_rows)
+            self._patch_compact = jax.jit(_patch_rows)
             self._per_object_shardings = None
+            self._per_object_shardings_compact = None
+            self._table_shardings = None
             self._grid_sharding = None
             return
         from kubeadmiral_tpu.parallel import mesh as M
@@ -406,6 +444,20 @@ class SchedulerEngine:
         self._tick = jax.jit(
             _tick_with_diff, in_shardings=in_shardings, out_shardings=out_shardings
         )
+        self._per_object_shardings_compact = M.compact_field_shardings(
+            self.mesh, Cmp.PER_OBJECT_FIELDS
+        )
+        self._table_shardings = M.compact_field_shardings(
+            self.mesh, Cmp.TABLE_FIELDS
+        )
+        self._tick_compact = jax.jit(
+            _tick_compact_with_diff,
+            in_shardings=(
+                M.compact_input_shardings(self.mesh),
+                (grid, grid, grid, grid),
+            ),
+            out_shardings=out_shardings,
+        )
         rep = M.replicated(self.mesh)
         self._gather = jax.jit(
             _gather_packed,
@@ -421,6 +473,11 @@ class SchedulerEngine:
             _patch_rows,
             in_shardings=(self._per_object_shardings, rep, rep),
             out_shardings=self._per_object_shardings,
+        )
+        self._patch_compact = jax.jit(
+            _patch_rows,
+            in_shardings=(self._per_object_shardings_compact, rep, rep),
+            out_shardings=self._per_object_shardings_compact,
         )
 
     def _zeros_for(self, shape: tuple) -> tuple:
@@ -548,16 +605,67 @@ class SchedulerEngine:
         return fp
 
     # -- incremental featurization ---------------------------------------
+    def _vocab_for(self, view: ClusterView, topo_fp: tuple) -> Optional[CompactVocab]:
+        """The (engine-wide) compact vocabulary for this topology; None
+        when the topology itself overflows a cap (dense fallback)."""
+        if topo_fp in self._vocabs:
+            return self._vocabs[topo_fp]
+        try:
+            vocab = CompactVocab(view)
+        except VocabOverflow:
+            vocab = None
+        while len(self._vocabs) >= 4:  # a few recent topologies
+            self._vocabs.pop(next(iter(self._vocabs)))
+        self._vocabs[topo_fp] = vocab
+        return vocab
+
+    def _per_object_fields(self, fmt: str) -> Sequence[str]:
+        if fmt == "compact":
+            return Cmp.PER_OBJECT_FIELDS
+        return [n for n in TickInputs._fields if n not in _CLUSTER_ONLY_FIELDS]
+
+    def _featurize_rows(self, units, clusters, view, vocab, cached):
+        """Featurize just the changed rows in the cached entry's format,
+        aligned to its sparse/key widths; None = cannot patch (widths
+        grew or vocabulary overflowed) — caller does a full miss."""
+        if cached.fmt == "dense":
+            return featurize(units, clusters, view=view).inputs
+        if vocab is None:
+            return None
+        try:
+            sub = featurize_compact(units, view, vocab)
+        except VocabOverflow:
+            return None
+        p_cached = np.asarray(cached.inputs.sparse_idx).shape[1]
+        l_cached = np.asarray(cached.inputs.key_bytes).shape[1]
+        if (
+            np.asarray(sub.sparse_idx).shape[1] > p_cached
+            or np.asarray(sub.key_bytes).shape[1] > l_cached
+        ):
+            return None
+        sub = Cmp.pad_axis1(sub, Cmp.SPARSE_FILLS, p_cached)
+        sub = Cmp.pad_axis1(sub, {"key_bytes": 0}, l_cached)
+        return sub
+
+    def _featurize_full(self, chunk, clusters, view, vocab):
+        """(inputs, fmt): compact unless the vocabulary overflows."""
+        if vocab is not None:
+            try:
+                return featurize_compact(chunk, view, vocab), "compact"
+            except VocabOverflow:
+                pass
+        return featurize(chunk, clusters, view=view).inputs, "dense"
+
     def _featurize_chunk(
-        self, idx: int, chunk, clusters, view: ClusterView, webhook_eval
-    ) -> tuple[FeaturizedBatch, str, Optional[_CachedChunk]]:
-        """Returns (batch, status, cache entry); status is one of
+        self, idx: int, chunk, clusters, view: ClusterView, webhook_eval, vocab
+    ) -> tuple[object, str, Optional[_CachedChunk], str]:
+        """Returns (inputs, status, cache entry, fmt); status is one of
         "hit" (rows unchanged), "patch" (few rows re-featurized),
         "miss" (full featurize), "nocache" (caching not applicable)."""
         if webhook_eval is not None:
             # Webhook planes are per-tick HTTP results; never cached.
             fb = featurize(chunk, clusters, view=view, webhook_eval=webhook_eval)
-            return fb, "nocache", None
+            return fb.inputs, "nocache", None, "dense"
 
         topo_fp = self._topo_fingerprint(view)
         cached = self._chunk_cache.get(idx)
@@ -566,6 +674,10 @@ class SchedulerEngine:
             cached is not None
             and cached.topo_fp == topo_fp
             and len(cached.units) == len(chunk)
+            and (
+                cached.fmt == "dense"
+                or (vocab is not None and cached.vocab_uid == vocab.uid)
+            )
         ):
             # Identity fast-path: the controller hands the engine freshly
             # built (effectively immutable) SchedulingUnits; identical
@@ -585,42 +697,34 @@ class SchedulerEngine:
             if not changed:
                 cached.units = list(chunk)
                 self.cache_stats["hit"] += 1
-                return (
-                    FeaturizedBatch(inputs=refreshed, units=list(chunk), view=view),
-                    "hit",
-                    cached,
-                )
+                return refreshed, "hit", cached, cached.fmt
             if len(changed) <= max(1, len(chunk) // 4):
-                sub = featurize(
-                    [chunk[i] for i in changed], clusters, view=view
+                sub = self._featurize_rows(
+                    [chunk[i] for i in changed], clusters, view, vocab, cached
                 )
-                rows = np.asarray(changed)
-                for name, arr in refreshed._asdict().items():
-                    if name in _CLUSTER_ONLY_FIELDS:
-                        continue
-                    np.asarray(arr)[rows] = np.asarray(getattr(sub.inputs, name))
-                for i in changed:
-                    cached.sigs[i] = sigs[i]
-                cached.units = list(chunk)
-                # Handed to schedule(): the freshly featurized changed
-                # rows enable the sub-batch fast path (row independence).
-                cached.last_patch = (changed, sub.inputs)
-                self.cache_stats["patch"] += 1
-                return (
-                    FeaturizedBatch(inputs=refreshed, units=list(chunk), view=view),
-                    "patch",
-                    cached,
-                )
+                if sub is not None:
+                    rows = np.asarray(changed)
+                    for name in self._per_object_fields(cached.fmt):
+                        np.asarray(getattr(refreshed, name))[rows] = np.asarray(
+                            getattr(sub, name)
+                        )
+                    for i in changed:
+                        cached.sigs[i] = sigs[i]
+                    cached.units = list(chunk)
+                    # Handed to schedule(): the freshly featurized
+                    # changed rows enable the sub-batch fast path.
+                    cached.last_patch = (changed, sub)
+                    self.cache_stats["patch"] += 1
+                    return refreshed, "patch", cached, cached.fmt
 
-        fb = featurize(chunk, clusters, view=view)
+        inputs, fmt = self._featurize_full(chunk, clusters, view, vocab)
         self.cache_stats["miss"] += 1
         if cached is not None:
             self._cache_used -= cached.nbytes
             del self._chunk_cache[idx]
         host_bytes = sum(
-            np.asarray(arr).nbytes
-            for name, arr in fb.inputs._asdict().items()
-            if name not in _CLUSTER_ONLY_FIELDS
+            np.asarray(getattr(inputs, name)).nbytes
+            for name in self._per_object_fields(fmt)
         )
         # Budget charge covers everything the entry pins, not just the
         # host arrays: a device-resident copy of the (padded, so up to
@@ -628,8 +732,11 @@ class SchedulerEngine:
         # tick's device outputs (i8+i32+i8+i32 = 10 bytes/cell).
         # Decoded result dicts are small relative to the tensor planes.
         b = len(chunk)
-        c = np.asarray(fb.inputs.api_ok).shape[1]
-        nbytes = host_bytes * 3 + b * c * 10 * 4
+        c = np.asarray(inputs.cluster_valid).shape[0]
+        # prev_out device planes live at PADDED shape — charge for it.
+        b_pad = _pow2_bucket(b, self.min_bucket, 1 << 30)
+        c_pad = _cluster_bucket(c, self.min_cluster_bucket)
+        nbytes = host_bytes * 3 + b_pad * c_pad * 10
         entry = None
         if self._cache_used + nbytes <= self.cache_bytes:
             if sigs is None:
@@ -637,13 +744,15 @@ class SchedulerEngine:
             entry = _CachedChunk(
                 sigs=sigs,
                 units=list(chunk),
-                inputs=fb.inputs,
+                inputs=inputs,
+                fmt=fmt,
                 topo_fp=topo_fp,
                 nbytes=nbytes,
+                vocab_uid=vocab.uid if (fmt == "compact" and vocab) else 0,
             )
             self._chunk_cache[idx] = entry
             self._cache_used += nbytes
-        return fb, "miss", entry
+        return inputs, "miss", entry, fmt
 
     # -- the tick ---------------------------------------------------------
     def schedule(
@@ -673,11 +782,16 @@ class SchedulerEngine:
         self.timings = timings
         c_bucket, eff_chunk, ladder = self._tick_geometry(len(view.clusters))
         multi_chunk = len(units) > eff_chunk
+        vocab = (
+            self._vocab_for(view, self._topo_fingerprint(view))
+            if webhook_eval is None
+            else None
+        )
         for chunk_idx, start in enumerate(range(0, len(units), eff_chunk)):
             chunk = units[start : start + eff_chunk]
             t0 = time.perf_counter()
-            fb, status, entry = self._featurize_chunk(
-                chunk_idx, chunk, clusters, view, webhook_eval
+            inputs, status, entry, fmt = self._featurize_chunk(
+                chunk_idx, chunk, clusters, view, webhook_eval, vocab
             )
             patch_info = None
             if entry is not None:
@@ -732,11 +846,11 @@ class SchedulerEngine:
                 continue
 
             b_pad = self._bucket_rows(len(chunk), ladder, eff_chunk, multi_chunk)
-            padded = _pad_clusters(_pad_batch(fb.inputs, b_pad), c_bucket)
+            padded = self._pad_for_dispatch(inputs, fmt, b_pad, c_bucket)
             t1 = time.perf_counter()
             timings["featurize"] += t1 - t0
-            device_in = self._device_inputs(entry, padded, status)
-            out_shape = np.asarray(padded.api_ok).shape
+            device_in = self._device_inputs(entry, padded, status, fmt, vocab)
+            out_shape = (b_pad, c_bucket)
             delta_ok = (
                 prev_valid
                 and entry.prev_out is not None
@@ -745,7 +859,8 @@ class SchedulerEngine:
             prev = (
                 entry.prev_out if delta_ok else self._zeros_for(out_shape)
             )
-            out, mask_dev = self._tick(device_in, prev)
+            tick = self._tick_compact if fmt == "compact" else self._tick
+            out, mask_dev = tick(device_in, prev)
             jax.block_until_ready(out)
             t2 = time.perf_counter()
             timings["device"] += t2 - t1
@@ -754,7 +869,7 @@ class SchedulerEngine:
                     entry,
                     out,
                     mask_dev if delta_ok else None,
-                    fb.view.names,
+                    view.names,
                     len(chunk),
                     want_scores,
                     timings,
@@ -764,7 +879,8 @@ class SchedulerEngine:
 
         if pending_sub:
             self._run_sub_batch(
-                pending_sub, chunk_results, view, timings, eff_chunk, ladder, c_bucket
+                pending_sub, chunk_results, view, timings, eff_chunk, ladder,
+                c_bucket, vocab,
             )
 
         results: list[ScheduleResult] = []
@@ -772,37 +888,105 @@ class SchedulerEngine:
             results.extend(part)
         return results
 
+    def _pad_for_dispatch(self, inputs, fmt: str, b_pad: int, c_bucket: int):
+        """Format-aware shape bucketing: the dense format pads its [B, C]
+        planes; the compact one additionally buckets the sparse-entry
+        and key-byte widths (pow2) so those axes don't leak unbounded
+        program shapes either."""
+        if fmt == "dense":
+            return _pad_clusters(_pad_batch(inputs, b_pad), c_bucket)
+        padded = Cmp.pad_rows(inputs, b_pad)
+        p = np.asarray(padded.sparse_idx).shape[1]
+        padded = Cmp.pad_axis1(
+            padded, Cmp.SPARSE_FILLS, _pow2_bucket(p, 8, 1 << 30)
+        )
+        l = np.asarray(padded.key_bytes).shape[1]
+        padded = Cmp.pad_axis1(
+            padded, {"key_bytes": 0}, _pow2_bucket(l, 64, 1 << 30)
+        )
+        # Vocabulary tables (multi-MB at wide C) are NOT padded here:
+        # _tables_device pads them once per actual upload, not per
+        # dispatch — steady state reuses the device copy.
+        return Cmp.pad_clusters(padded, c_bucket, skip=Cmp.TABLE_FIELDS)
+
+    def _tables_device(self, vocab: CompactVocab, c_bucket: int):
+        """Device-resident vocabulary tables, re-uploaded (and re-padded)
+        only when the vocabulary version or cluster padding changes."""
+        key = (vocab.uid, vocab.version, c_bucket)
+        if self._device_tables is not None and self._device_tables[0] == key:
+            return self._device_tables[1]
+        tables = Cmp.pad_tables(vocab.tables(), c_bucket)
+        if self._table_shardings is not None:
+            dev = jax.device_put(tables, self._table_shardings)
+        else:
+            dev = jax.device_put(tables)
+        self._device_tables = (key, dev)
+        return dev
+
     def _run_sub_batch(
-        self, pending, chunk_results, view, timings, eff_chunk, ladder, c_bucket
+        self, pending, chunk_results, view, timings, eff_chunk, ladder,
+        c_bucket, vocab,
     ) -> None:
         """One small dispatch (per eff_chunk-sized slab) for every
         changed row across all patched chunks; results merge into the
-        cached decodes.  Uses the SAME tick program as full dispatches
-        (zero-prev diff, output gather) so no extra shapes compile."""
+        cached decodes.  Uses the SAME tick programs as full dispatches
+        (zero-prev diff, output gather) so no extra shapes compile.
+        Chunks are grouped by format (a dense-fallback chunk can coexist
+        with compact ones)."""
+        compact_group = [p for p in pending if p[1].fmt == "compact"]
+        dense_group = [p for p in pending if p[1].fmt == "dense"]
+        for group, fmt in ((compact_group, "compact"), (dense_group, "dense")):
+            if group:
+                self._run_sub_batch_group(
+                    group, fmt, chunk_results, view, timings, eff_chunk,
+                    ladder, c_bucket, vocab,
+                )
+
+    def _run_sub_batch_group(
+        self, pending, fmt, chunk_results, view, timings, eff_chunk, ladder,
+        c_bucket, vocab,
+    ) -> None:
         t0 = time.perf_counter()
-        per_object = [
-            name for name in TickInputs._fields if name not in _CLUSTER_ONLY_FIELDS
-        ]
+        per_object = self._per_object_fields(fmt)
+        subs = [sub for _, _, _, sub in pending]
+        if fmt == "compact":
+            # Align sparse/key widths across chunks before concatenating.
+            p_max = max(np.asarray(s.sparse_idx).shape[1] for s in subs)
+            l_max = max(np.asarray(s.key_bytes).shape[1] for s in subs)
+            subs = [
+                Cmp.pad_axis1(
+                    Cmp.pad_axis1(s, Cmp.SPARSE_FILLS, p_max),
+                    {"key_bytes": 0},
+                    l_max,
+                )
+                for s in subs
+            ]
         combined = {
-            name: np.concatenate(
-                [np.asarray(getattr(sub, name)) for _, _, _, sub in pending]
-            )
+            name: np.concatenate([np.asarray(getattr(s, name)) for s in subs])
             for name in per_object
         }
         c = len(view.names)
-        inputs = TickInputs(
-            **combined,
+        shared = dict(
             alloc=view.alloc,
             used=view.used,
             cpu_alloc=view.cpu_alloc,
             cpu_avail=view.cpu_avail,
             cluster_valid=np.ones(c, bool),
         )
+        if fmt == "compact":
+            inputs = CompactInputs(
+                **combined,
+                **{name: getattr(subs[0], name) for name in Cmp.TABLE_FIELDS},
+                **shared,
+            )
+        else:
+            inputs = TickInputs(**combined, **shared)
         total = inputs.total.shape[0]
         want_scores = any(e.prev_has_scores for _, e, _, _ in pending)
         decoded: list[ScheduleResult] = []
+        cls = CompactInputs if fmt == "compact" else TickInputs
         for start in range(0, total, eff_chunk):
-            piece = TickInputs(
+            piece = cls(
                 **{
                     name: (
                         np.asarray(arr)[start : start + eff_chunk]
@@ -813,14 +997,16 @@ class SchedulerEngine:
                 }
             )
             n = piece.total.shape[0]
-            padded = _pad_batch(
-                piece, self._bucket_rows(n, ladder, eff_chunk, False)
-            )
-            padded = _pad_clusters(padded, c_bucket)
+            b_pad = self._bucket_rows(n, ladder, eff_chunk, False)
+            padded = self._pad_for_dispatch(piece, fmt, b_pad, c_bucket)
             t1 = time.perf_counter()
             timings["featurize"] += t1 - t0
-            shape = np.asarray(padded.api_ok).shape
-            out, _mask = self._tick(padded, self._zeros_for(shape))
+            shape = (b_pad, c_bucket)
+            if fmt == "compact":
+                device_in = padded._replace(**self._tables_device(vocab, c_bucket))
+                out, _mask = self._tick_compact(device_in, self._zeros_for(shape))
+            else:
+                out, _mask = self._tick(padded, self._zeros_for(shape))
             k = _pow2_bucket(n, 16, 1 << 30)
             idx = np.zeros(k, np.int32)
             idx[:n] = np.arange(n)
@@ -881,20 +1067,41 @@ class SchedulerEngine:
         timings["decode"] += time.perf_counter() - t3
 
     def _device_inputs(
-        self, entry: Optional[_CachedChunk], padded: TickInputs, status: str
-    ) -> TickInputs:
+        self,
+        entry: Optional[_CachedChunk],
+        padded,
+        status: str,
+        fmt: str = "dense",
+        vocab: Optional[CompactVocab] = None,
+    ):
         """Per-object tensors live on device across ticks: a clean re-tick
         ("hit") reuses last tick's device buffers and transfers nothing
         but the (tiny) cluster-axis tensors.  Patched or fresh chunks are
         re-uploaded and re-cached.  Under a mesh the upload lands
-        pre-sharded in the tick's input layout."""
+        pre-sharded in the tick's input layout.  The compact format
+        additionally sources its vocabulary tables from the shared
+        device copy (uploaded once per vocab version)."""
         fields = padded._asdict()
-        per_object = {
-            name: arr
-            for name, arr in fields.items()
-            if name not in _CLUSTER_ONLY_FIELDS
-        }
-        shape = np.asarray(padded.api_ok).shape
+        per_object_names = self._per_object_fields(fmt)
+        per_object = {name: fields[name] for name in per_object_names}
+        # The padded-shape key must capture every per-object axis that
+        # participates in the program shape: (B, C) for dense, plus the
+        # sparse-entry and key-byte widths for compact.
+        b_pad = np.asarray(padded.total).shape[0]
+        c_pad = np.asarray(padded.cluster_valid).shape[0]
+        if fmt == "compact":
+            shape = (
+                b_pad,
+                c_pad,
+                np.asarray(padded.sparse_idx).shape[1],
+                np.asarray(padded.key_bytes).shape[1],
+            )
+            shardings = self._per_object_shardings_compact
+            patch = self._patch_compact
+        else:
+            shape = (b_pad, c_pad)
+            shardings = self._per_object_shardings
+            patch = self._patch
         if (
             entry is not None
             and status == "hit"
@@ -910,26 +1117,32 @@ class SchedulerEngine:
                 src = np.zeros(k, np.int32)
                 src[: len(stale)] = stale
                 # Scatter targets padded out-of-range -> mode='drop'.
-                dst = np.full(k, shape[0], np.int32)
+                dst = np.full(k, b_pad, np.int32)
                 dst[: len(stale)] = stale
                 rows = {
                     name: np.ascontiguousarray(np.asarray(fields[name])[src])
-                    for name in per_object
+                    for name in per_object_names
                 }
-                per_object = self._patch(entry.device_per_object, rows, dst)
+                per_object = patch(entry.device_per_object, rows, dst)
                 entry.device_per_object = per_object
                 entry.stale_rows = None
             else:
                 per_object = entry.device_per_object
         else:
-            if self._per_object_shardings is not None:
-                per_object = jax.device_put(per_object, self._per_object_shardings)
+            if shardings is not None:
+                per_object = jax.device_put(per_object, shardings)
             else:
                 per_object = jax.device_put(per_object)
             if entry is not None:
                 entry.device_per_object = per_object
                 entry.padded_shape = shape
                 entry.stale_rows = None
+        if fmt == "compact":
+            return CompactInputs(
+                **per_object,
+                **self._tables_device(vocab, c_pad),
+                **{name: fields[name] for name in Cmp.CLUSTER_FIELDS},
+            )
         return TickInputs(
             **per_object,
             **{name: fields[name] for name in _CLUSTER_ONLY_FIELDS},
@@ -1067,6 +1280,9 @@ class SchedulerEngine:
         n_objects: int,
         n_clusters: int,
         scalar_resources: Sequence[str] = (),
+        key_len: int = 32,
+        policy_entries: int = 1,
+        webhooks: bool = False,
         wait: bool = False,
     ) -> threading.Thread:
         """Compile the tick/gather programs a (n_objects x n_clusters)
@@ -1080,7 +1296,11 @@ class SchedulerEngine:
         Pass ``scalar_resources`` (e.g. ["nvidia.com/gpu"]) when the
         workload requests extended resources: the request tensor's R
         axis is part of the program shape, so a prewarm without them
-        warms a different program than the real tick uses."""
+        warms a different program than the real tick uses.  Likewise
+        ``key_len`` (longest object key) and ``policy_entries`` (widest
+        per-object policy/current cluster union) pick the compact
+        format's key-byte and sparse-width buckets, and ``webhooks=True``
+        additionally warms the dense program that webhook ticks use."""
 
         def run():
             try:
@@ -1103,15 +1323,32 @@ class SchedulerEngine:
                     )
                     for j in range(max(1, n_clusters))
                 ]
+                # The warm unit reproduces the workload's program-shape
+                # drivers: a key padded to key_len (-> L bucket) and
+                # policy entries over policy_entries clusters (-> P
+                # bucket).
+                name = "prewarm".ljust(max(1, key_len - len("prewarm/")), "x")
                 unit = T.SchedulingUnit(
                     gvk=gvk,
                     namespace="prewarm",
-                    name="prewarm",
+                    name=name,
                     scheduling_mode=T.MODE_DIVIDE,
                     desired_replicas=1,
                     resource_request=T.parse_resources(request),
+                    min_replicas={
+                        f"warm-{j}": 0
+                        for j in range(
+                            min(max(1, policy_entries), len(clusters))
+                        )
+                    },
                 )
-                fb = featurize([unit], clusters)
+                from kubeadmiral_tpu.scheduler.featurize import (
+                    _build_cluster_view,
+                )
+
+                view = _build_cluster_view(clusters, [unit])
+                vocab = CompactVocab(view)
+                ci = featurize_compact([unit], view, vocab)
                 c_bucket, eff_chunk, ladder = self._tick_geometry(len(clusters))
                 if ladder is None:
                     shapes = [
@@ -1124,10 +1361,25 @@ class SchedulerEngine:
                     # lower ones.
                     shapes = ladder
                 for b_pad in shapes:
-                    padded = _pad_clusters(_pad_batch(fb.inputs, b_pad), c_bucket)
-                    shape = np.asarray(padded.api_ok).shape
-                    out, mask = self._tick(padded, self._zeros_for(shape))
+                    # The compact program is the production path; the
+                    # dense variant serves webhook ticks (warmed only
+                    # when the deployment has webhook plugins).
+                    padded = self._pad_for_dispatch(ci, "compact", b_pad, c_bucket)
+                    padded = padded._replace(
+                        **Cmp.pad_tables(vocab.tables(), c_bucket)
+                    )
+                    shape = (b_pad, c_bucket)
+                    out, mask = self._tick_compact(padded, self._zeros_for(shape))
                     jax.block_until_ready(mask)
+                    if webhooks:
+                        dense = featurize([unit], clusters, view=view).inputs
+                        dense_padded = self._pad_for_dispatch(
+                            dense, "dense", b_pad, c_bucket
+                        )
+                        out_d, mask_d = self._tick(
+                            dense_padded, self._zeros_for(shape)
+                        )
+                        jax.block_until_ready(mask_d)
                     idx = np.zeros(16, np.int32)
                     jax.block_until_ready(
                         self._gather(

@@ -1,0 +1,224 @@
+"""Compact featurization parity: expand_compact(featurize_compact(w))
+must reproduce the dense featurizer's planes bit-for-bit, and the fused
+tick must produce identical outputs over either format."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubeadmiral_tpu.models.types import (
+    AutoMigrationSpec,
+    ClusterAffinity,
+    ClusterState,
+    MODE_DIVIDE,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
+    SelectorTerm,
+    SchedulingUnit,
+    Taint,
+    Toleration,
+    parse_resources,
+)
+from kubeadmiral_tpu.ops.pipeline import expand_compact, schedule_tick
+from kubeadmiral_tpu.scheduler.compact import (
+    CompactVocab,
+    VocabOverflow,
+    featurize_compact,
+)
+from kubeadmiral_tpu.scheduler.featurize import _build_cluster_view, featurize
+
+
+def rich_world(b=48, c=14, seed=7):
+    rng = np.random.default_rng(seed)
+    regions = ("us", "eu", "ap")
+    clusters = []
+    for j in range(c):
+        clusters.append(
+            ClusterState(
+                name=f"member-{j:03d}",
+                labels={"region": regions[j % 3], "tier": str(j % 4)},
+                taints=(Taint("dedicated", "batch", "NoSchedule"),)
+                if j % 5 == 0
+                else ((Taint("gpu", "only", "NoExecute"),) if j % 7 == 0 else ()),
+                allocatable=parse_resources(
+                    {"cpu": str(8 + j), "memory": f"{32 + j}Gi",
+                     "nvidia.com/gpu": str(j % 4)}
+                ),
+                available=parse_resources(
+                    {"cpu": str(4 + j // 2), "memory": f"{16 + j}Gi",
+                     "nvidia.com/gpu": str(j % 3)}
+                ),
+                api_resources=frozenset(
+                    {"apps/v1/Deployment"}
+                    | ({"apps/v1/StatefulSet"} if j % 2 else set())
+                ),
+            )
+        )
+    names = [cl.name for cl in clusters]
+    affinity = ClusterAffinity(
+        required=(
+            SelectorTerm(
+                match_expressions=(
+                    SelectorRequirement("region", "In", ("eu", "us")),
+                )
+            ),
+        ),
+        preferred=(
+            PreferredSchedulingTerm(
+                weight=25,
+                preference=SelectorTerm(
+                    match_expressions=(
+                        SelectorRequirement("tier", "In", ("0", "1")),
+                    )
+                ),
+            ),
+        ),
+    )
+    units = []
+    for i in range(b):
+        divide = i % 3 != 0
+        current = {}
+        if i % 4 == 0:
+            picks = rng.integers(0, c, 3)
+            current = {
+                names[int(p)]: (None if i % 8 == 0 else int(rng.integers(1, 9)))
+                for p in picks
+            }
+        units.append(
+            SchedulingUnit(
+                gvk="apps/v1/Deployment" if i % 2 else "apps/v1/StatefulSet",
+                namespace=f"ns-{i % 5}",
+                name=f"w-{i:04d}",
+                scheduling_mode=MODE_DIVIDE if divide else "Duplicate",
+                desired_replicas=(i % 30) + 1 if divide else None,
+                resource_request=parse_resources(
+                    {"cpu": f"{(i % 4) * 150}m", "memory": f"{(i % 6) * 128}Mi",
+                     **({"nvidia.com/gpu": "1"} if i % 6 == 0 else {})}
+                ),
+                tolerations=(Toleration(key="dedicated", operator="Exists"),)
+                if i % 2
+                else (),
+                affinity=affinity if i % 4 == 1 else None,
+                cluster_selector={"region": "eu"} if i % 7 == 0 else {},
+                cluster_names=(names[0], names[3]) if i % 9 == 0 else (),
+                sticky_cluster=i % 11 == 0,
+                current_clusters=current,
+                max_clusters=(i % 5) + 1 if i % 5 == 0 else None,
+                min_replicas={names[1]: 2} if i % 6 == 2 else {},
+                max_replicas={names[2]: 5} if i % 6 == 3 else {},
+                weights={names[1]: 3, names[4]: 7} if i % 6 == 4 else {},
+                avoid_disruption=bool(i % 2),
+                auto_migration=AutoMigrationSpec(
+                    keep_unschedulable_replicas=bool(i % 2),
+                    estimated_capacity={names[i % c]: i % 13},
+                )
+                if i % 5 == 1
+                else None,
+            )
+        )
+    return units, clusters
+
+
+class TestCompactParity:
+    def test_planes_match_dense_bit_for_bit(self):
+        units, clusters = rich_world()
+        view = _build_cluster_view(clusters, units)
+        dense = featurize(units, clusters, view=view).inputs
+        vocab = CompactVocab(view)
+        ci = featurize_compact(units, view, vocab)
+        expanded = expand_compact(ci)
+        for name in dense._fields:
+            want = np.asarray(getattr(dense, name))
+            got = np.asarray(getattr(expanded, name))
+            assert got.shape == want.shape, name
+            np.testing.assert_array_equal(
+                got.astype(np.int64), want.astype(np.int64), err_msg=name
+            )
+
+    def test_tick_outputs_match(self):
+        units, clusters = rich_world(b=32, c=10, seed=11)
+        view = _build_cluster_view(clusters, units)
+        dense_out = schedule_tick(featurize(units, clusters, view=view).inputs)
+        ci = featurize_compact(units, view, CompactVocab(view))
+        compact_out = schedule_tick(expand_compact(ci))
+        for name in dense_out._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(compact_out, name)),
+                np.asarray(getattr(dense_out, name)),
+                err_msg=name,
+            )
+
+    def test_vocab_overflow_raises(self):
+        units, clusters = rich_world(b=8, c=6)
+        view = _build_cluster_view(clusters, units)
+        vocab = CompactVocab(view, sel_cap=1)
+        with pytest.raises(VocabOverflow):
+            featurize_compact(units, view, vocab)
+
+    def test_vocab_grows_in_place_ids_stable(self):
+        """Table growth must not invalidate previously issued ids (the
+        engine caches CompactInputs referencing the same arrays)."""
+        units, clusters = rich_world(b=20, c=8)
+        view = _build_cluster_view(clusters, units)
+        vocab = CompactVocab(view)
+        first = featurize_compact(units[:10], view, vocab)
+        v1 = vocab.version
+        second = featurize_compact(units[10:], view, vocab)
+        assert vocab.version >= v1
+        # first's tables are the same (grown) arrays.
+        assert first.sel_matrix is vocab.sel_matrix
+        out1 = schedule_tick(expand_compact(first))
+        dense1 = schedule_tick(
+            featurize(units[:10], clusters, view=view).inputs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out1.selected), np.asarray(dense1.selected)
+        )
+        out2 = schedule_tick(expand_compact(second))
+        dense2 = schedule_tick(
+            featurize(units[10:], clusters, view=view).inputs
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out2.selected), np.asarray(dense2.selected)
+        )
+
+
+class TestEngineVocabLifecycle:
+    def test_topology_flap_keeps_cached_ids_valid(self):
+        """A -> B -> A cluster-topology flap: chunk caches built against
+        topology A's vocabulary must still decode correctly when A
+        returns (ids are meaningless against a different vocabulary
+        instance — the engine must reuse or invalidate, never mix)."""
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+        units, clusters_a = rich_world(b=40, c=10)
+        clusters_b = [
+            dataclasses.replace(cl, labels={**cl.labels, "flap": "yes"})
+            for cl in clusters_a[:6]
+        ]
+        engine = SchedulerEngine(chunk_size=16, min_bucket=8)
+        first_a = engine.schedule(units, clusters_a)
+        engine.schedule(units[:12], clusters_b)  # fewer chunks: stale tails
+        back_a = engine.schedule(units, clusters_a)
+        fresh = SchedulerEngine(chunk_size=16, min_bucket=8).schedule(
+            units, clusters_a
+        )
+        assert [r.clusters for r in back_a] == [r.clusters for r in fresh]
+        assert [r.clusters for r in first_a] == [r.clusters for r in fresh]
+
+    def test_prewarm_width_hints(self):
+        """key_len / policy_entries hints compile the buckets the real
+        workload will use."""
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+        engine = SchedulerEngine(chunk_size=32, min_bucket=8)
+        engine.prewarm(
+            16, 6, key_len=100, policy_entries=12, webhooks=True, wait=True
+        )
+        units, clusters = rich_world(b=16, c=6)
+        got = engine.schedule(units, clusters)
+        fresh = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units, clusters
+        )
+        assert [r.clusters for r in got] == [r.clusters for r in fresh]
