@@ -50,7 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--members", type=int, default=3,
-        help="number of in-memory member clusters to create (demo mode)",
+        help="number of member clusters to create",
+    )
+    parser.add_argument(
+        "--host-port", type=int, default=0,
+        help="host apiserver port for --transport http (0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--transport", choices=("memory", "http"), default="memory",
+        help="memory = in-process stores (demo); http = a kwok-lite farm "
+        "of real apiserver sockets (REST + watch + bearer auth), with the "
+        "cluster-join handshake run for each member",
     )
     parser.add_argument("--run-seconds", type=float, default=0.0,
         help="exit after this many seconds (0 = run forever)")
@@ -85,9 +95,54 @@ def main(argv=None) -> int:
     from kubeadmiral_tpu.runtime.manager import ControllerManager
     from kubeadmiral_tpu.testing.fakekube import AlreadyExists, ClusterFleet
 
-    fleet = ClusterFleet()
-    for i in range(args.members):
-        fleet.add_member(f"member-{i + 1}")
+    farm = None
+    if args.transport == "http":
+        # Real sockets: a kwok-lite farm (host + member apiservers with
+        # REST/watch/auth), FederatedCluster CRs registered so the
+        # cluster controller performs the real join handshake.
+        from kubeadmiral_tpu.federation.common import FEDERATED_CLUSTERS
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        farm = KwokLiteFarm(host_port=args.host_port)
+        fleet = farm.fleet
+        for i in range(args.members):
+            name = f"member-{i + 1}"
+            member = farm.add_member(name)
+            member.create(
+                "v1/nodes",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Node",
+                    "metadata": {"name": f"{name}-node"},
+                    "spec": {},
+                    "status": {
+                        "allocatable": {"cpu": "32", "memory": "128Gi"},
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                    },
+                },
+            )
+            fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": farm.cluster_spec(name),
+                },
+            )
+        print(f"host apiserver on {farm.host_server.url}")
+        for name, server in farm.member_servers.items():
+            # Demo farm: print the member admin token so quickstart curls
+            # can read the propagated objects (member apiservers require
+            # bearer auth, exactly like real clusters).
+            print(
+                f"member {name} apiserver on {server.url} "
+                f"(admin token: {server.admin_token})"
+            )
+    else:
+        fleet = ClusterFleet()
+        for i in range(args.members):
+            fleet.add_member(f"member-{i + 1}")
 
     health = HealthCheckRegistry()
     server = HealthServer(health, port=args.port)
@@ -136,6 +191,8 @@ def main(argv=None) -> int:
     finally:
         manager.stop()
         server.stop()
+        if farm is not None:
+            farm.close()
     return 0
 
 

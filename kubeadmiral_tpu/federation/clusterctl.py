@@ -187,6 +187,10 @@ class FederatedClusterController:
         # real deployment this comes from discovery documents.
         self.api_resource_probe = api_resource_probe
         self._clock = clock or time.monotonic
+        # member client id -> (probe time, advertised GVKs); see
+        # _discover_api_types.
+        self._api_discovery_cache: dict[int, tuple[float, list[str]]] = {}
+        self._discovery_ttl = max(resync_seconds * 6, 60.0)
         # First join-failure time per cluster, for the join timeout
         # (clusterjoin.go:99-115 checks the Joined condition's
         # lastTransitionTime; conditions here don't carry timestamps, so
@@ -405,10 +409,60 @@ class FederatedClusterController:
             status["resources"] = desired
             changed = True
         api_types = self.api_resource_probe
+        if api_types is None:
+            # Discovery fallback (the reference reads the member's
+            # discovery documents, clusterstatus.go:204-268): probe the
+            # member with a LIST per FTC-registered source type; a type
+            # it serves is advertised in apiResourceTypes, which gates
+            # scheduling per GVK (ops/filters APIResources).
+            api_types = self._discover_api_types(member)
         if api_types is not None and status.get("apiResourceTypes") != api_types:
             status["apiResourceTypes"] = list(api_types)
             changed = True
         return changed
+
+    def _discover_api_types(self, member: FakeKube) -> Optional[list[str]]:
+        from kubeadmiral_tpu.models.ftc import FEDERATED_TYPE_CONFIGS, parse_ftc
+        from kubeadmiral_tpu.testing.fakekube import NotFound
+
+        # A fresh probe round trips once per FTC type; cache per member
+        # client with a TTL so steady-state heartbeats don't re-probe
+        # (the reference reads cheap discovery documents; our transport
+        # has no discovery endpoint, so LIST-probing stands in).
+        now = self._clock()
+        cached = self._api_discovery_cache.get(id(member))
+        if cached is not None and now - cached[0] < self._discovery_ttl:
+            return cached[1]
+        try:
+            ftc_objs = self.host.list_view(FEDERATED_TYPE_CONFIGS)
+        except AttributeError:
+            ftc_objs = self.host.list(FEDERATED_TYPE_CONFIGS)
+        except Exception:
+            return None
+        advertised = []
+        for obj in ftc_objs:
+            try:
+                ftc = parse_ftc(obj)
+            except Exception:
+                continue  # malformed FTC: not a member problem
+            try:
+                probe = getattr(member, "keys", None) or (
+                    member.list_view
+                    if hasattr(member, "list_view")
+                    else member.list
+                )
+                probe(ftc.source.resource)
+            except NotFound:
+                continue  # the member genuinely doesn't serve this type
+            except Exception:
+                # Transient member error: do NOT shrink the advertised
+                # set (a dropped GVK would filter a healthy cluster out
+                # of scheduling); keep whatever was last known.
+                return cached[1] if cached is not None else None
+            advertised.append(ftc.source.gvk)
+        result = sorted(advertised)
+        self._api_discovery_cache[id(member)] = (now, result)
+        return result
 
     # -- removal (controller.go:353-445) ---------------------------------
     def _handle_terminating(self, cluster: dict) -> Result:

@@ -12,6 +12,8 @@ Statuses mirror fedtypesv1a1.PropagationStatus values.
 
 from __future__ import annotations
 
+import copy
+import json
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -135,6 +137,7 @@ class ManagedDispatcher:
         pool: Optional[ThreadPoolExecutor] = None,
         timeout: float = 30.0,
         rollout_overrides: Optional[Callable[[str], list]] = None,
+        inline: bool = False,
     ):
         self.client_for_cluster = client_for_cluster
         self.fed = fed_resource
@@ -143,6 +146,11 @@ class ManagedDispatcher:
         self.skip_adopting = skip_adopting
         self.timeout = timeout
         self.rollout_overrides = rollout_overrides
+        # inline=True runs operations on the caller thread: for local
+        # (in-process store) members the thread fan-out costs more than
+        # the operations themselves; HTTP members keep the per-cluster
+        # parallel dispatch (operation.go:102-123).
+        self._inline = inline
         self._pool = pool
         self._own_pool = pool is None
         self._futures: list[Future] = []
@@ -151,6 +159,11 @@ class ManagedDispatcher:
         self._versions: dict[str, str] = {}
         self._errors: dict[str, str] = {}
         self._resources_updated = False
+        # Desired-object assembly dedup: clusters sharing an override
+        # patch list share ONE assembled object (consumers that mutate —
+        # the retention paths — copy first; create paths hand the shared
+        # object to clients, which serialize/copy on write).
+        self._desired_cache: dict[str, dict] = {}
 
     # -- bookkeeping -----------------------------------------------------
     def record_status(self, cluster: str, status: str) -> None:
@@ -168,6 +181,12 @@ class ManagedDispatcher:
             self._status[cluster] = OK
 
     def _submit(self, fn: Callable[[], None]) -> None:
+        if self._inline:
+            try:
+                fn()
+            except Exception:
+                pass  # op handlers record their own failures
+            return
         if self._pool is None:
             self._pool = ThreadPoolExecutor(max_workers=8)
         self._futures.append(self._pool.submit(fn))
@@ -207,11 +226,25 @@ class ManagedDispatcher:
         return self._resources_updated
 
     # -- desired-object assembly ----------------------------------------
-    def _desired(self, cluster: str) -> dict:
-        obj = self.fed.object_for_cluster(cluster)
+    def _desired(self, cluster: str, mutable: bool = False) -> dict:
+        """Assembled desired object for a cluster.  Clusters whose
+        override patch lists are identical (the common case — overrides
+        come from shared policies) get ONE shared assembly; pass
+        ``mutable=True`` to receive a private copy (retention paths
+        mutate the object in place)."""
         extra = self.rollout_overrides(cluster) if self.rollout_overrides else None
-        obj = self.fed.apply_overrides(obj, cluster, extra)
-        retain.record_propagated_keys(obj)
+        patches = self.fed._ordered_overrides().get(cluster) or ()
+        key = json.dumps([patches, extra], sort_keys=True, default=str)
+        with self._lock:
+            obj = self._desired_cache.get(key)
+        if obj is None:
+            obj = self.fed.object_for_cluster(cluster)
+            obj = self.fed.apply_overrides(obj, cluster, extra)
+            retain.record_propagated_keys(obj)
+            with self._lock:
+                self._desired_cache[key] = obj
+        if mutable:
+            return copy.deepcopy(obj)
         return obj
 
     # -- operations ------------------------------------------------------
@@ -272,7 +305,7 @@ class ManagedDispatcher:
                 f"object has label {C.MANAGED_LABEL}=false",
             )
         try:
-            obj = self._desired(cluster)
+            obj = self._desired(cluster, mutable=True)
         except Exception as e:
             return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
         if adopting:
@@ -327,7 +360,7 @@ class ManagedDispatcher:
                     f"object has label {C.MANAGED_LABEL}=false",
                 )
             try:
-                obj = self._desired(cluster)
+                obj = self._desired(cluster, mutable=True)
             except Exception as e:
                 return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
             try:
@@ -389,7 +422,9 @@ class ManagedDispatcher:
         self.record_status(cluster, UPDATE_TIMED_OUT)
 
         def run() -> None:
-            obj = dict(cluster_obj)
+            # Deep copy: cluster_obj may be a no-copy store VIEW, and a
+            # shallow dict() would mutate the store's nested metadata.
+            obj = copy.deepcopy(cluster_obj)
             labels = obj.get("metadata", {}).get("labels", {})
             labels.pop(C.MANAGED_LABEL, None)
             obj.get("metadata", {}).get("annotations", {}).pop(

@@ -364,6 +364,24 @@ class RolloutPlanner:
         self.revision = revision
         self.targets: list[Target] = []
 
+    @classmethod
+    def from_params(
+        cls, replicas: int, max_surge: int, max_unavailable: int
+    ) -> "RolloutPlanner":
+        """Direct construction from the planning parameters — the shape
+        the reference's table tests build (`&RolloutPlanner{Targets,
+        MaxSurge, MaxUnavailable, Replicas}`, rolloutplan_test.go);
+        production goes through __init__, which derives the fenceposts
+        from the federated object."""
+        planner = cls.__new__(cls)
+        planner.key = "golden"
+        planner.replicas = replicas
+        planner.max_surge = max_surge
+        planner.max_unavailable = max_unavailable
+        planner.revision = "golden-revision"
+        planner.targets = []
+        return planner
+
     def register(self, target: Target) -> None:
         self.targets.append(target)
 

@@ -104,7 +104,16 @@ class SyncController:
         # Events recorded on the federated object are re-targeted to the
         # source object too (util/eventsink DefederatingRecorderMux).
         self.recorder = DefederatingRecorderMux(self.host, f"sync-{ftc.name}")
-        self.pool = ThreadPoolExecutor(max_workers=max_dispatch_workers)
+        # Local (in-process store) fleets dispatch member writes inline:
+        # the per-op thread fan-out costs more than the in-memory ops it
+        # parallelizes.  Network fleets keep the per-cluster parallel
+        # dispatch (operation.go:102-123).
+        self._inline = isinstance(fleet.host, FakeKube)
+        self.pool = (
+            None
+            if self._inline
+            else ThreadPoolExecutor(max_workers=max_dispatch_workers)
+        )
         self.worker = Worker(
             f"sync-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
         )
@@ -146,6 +155,16 @@ class SyncController:
 
     def _member_client(self, cluster: str) -> FakeKube:
         return self.fleet.member(cluster)
+
+    @staticmethod
+    def _member_read(client, resource: str, key: str):
+        """Read-only member lookup: the no-copy view when the client
+        offers one (FakeKube) — the sync hot path reads one member
+        object per (object, cluster) pair, and per-read deep copies
+        dominated its profile.  Consumers must NOT mutate the result
+        (the dispatcher's mutating paths copy first)."""
+        view = getattr(client, "try_get_view", None)
+        return view(resource, key) if view is not None else client.try_get(resource, key)
 
     # -- reconcile -------------------------------------------------------
     def reconcile(self, key: str) -> Result:
@@ -300,6 +319,7 @@ class SyncController:
             replicas_path=self.ftc.path.replicas_spec,
             skip_adopting=not should_adopt_preexisting(fed.obj),
             pool=self.pool,
+            inline=self._inline,
             rollout_overrides=(
                 (
                     lambda c: plans_holder[c].to_overrides()
@@ -327,8 +347,8 @@ class SyncController:
                     )
                 continue
             try:
-                cluster_obj = self._member_client(cname).try_get(
-                    self._target_resource, fed.key
+                cluster_obj = self._member_read(
+                    self._member_client(cname), self._target_resource, fed.key
                 )
             except NotFound:
                 dispatcher.record_error(
@@ -630,6 +650,7 @@ class SyncController:
             self._target_resource,
             replicas_path=self.ftc.path.replicas_spec,
             pool=self.pool,
+            inline=self._inline,
         )
         remaining: list[str] = []
         unreachable: list[str] = []
@@ -643,8 +664,8 @@ class SyncController:
                 unreachable.append(cname)
                 continue
             try:
-                cluster_obj = self._member_client(cname).try_get(
-                    self._target_resource, fed.key
+                cluster_obj = self._member_read(
+                    self._member_client(cname), self._target_resource, fed.key
                 )
             except NotFound:
                 continue  # cluster client gone mid-leave; nothing to delete
@@ -666,7 +687,9 @@ class SyncController:
         still = []
         for c in remaining:
             try:
-                obj = self._member_client(c).try_get(self._target_resource, fed.key)
+                obj = self._member_read(
+                    self._member_client(c), self._target_resource, fed.key
+                )
             except NotFound:
                 continue
             if obj is None:
@@ -678,7 +701,8 @@ class SyncController:
 
     def _remove_managed_labels_everywhere(self, fed: FederatedResource) -> bool:
         dispatcher = D.ManagedDispatcher(
-            self._member_client, fed, self._target_resource, pool=self.pool
+            self._member_client, fed, self._target_resource, pool=self.pool,
+            inline=self._inline,
         )
         all_reachable = True
         for cluster in self._joined_members():
@@ -687,8 +711,8 @@ class SyncController:
                 all_reachable = False  # cannot strip labels there yet
                 continue
             try:
-                cluster_obj = self._member_client(cname).try_get(
-                    self._target_resource, fed.key
+                cluster_obj = self._member_read(
+                    self._member_client(cname), self._target_resource, fed.key
                 )
             except NotFound:
                 continue

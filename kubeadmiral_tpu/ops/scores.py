@@ -55,11 +55,28 @@ def balanced_allocation_score(request, alloc, used):
     return jnp.where((f_cpu >= 1.0) | (f_mem >= 1.0), 0, score)
 
 
+def _floordiv_smallq(num, den):
+    """Exact int64 floor division for non-negative operands whose
+    QUOTIENT is small (here <= 100): an f64 estimate plus one integer
+    correction step.  XLA expands a 64-bit integer divide into a large
+    software sequence (~2s of compile PER SITE on CPU; int64 is
+    emulated on TPU), while the estimate+correct form is a handful of
+    cheap ops.  Exactness: the f64 estimate of a quotient q carries
+    absolute error ~q*2^-52 << 1, so one +/-1 correction against the
+    true integer remainder lands exactly on floor(num/den)."""
+    den = jnp.maximum(den, 1)
+    q = jnp.floor(num.astype(jnp.float64) / den.astype(jnp.float64)).astype(
+        num.dtype
+    )
+    r = num - q * den
+    return q + (r >= den).astype(num.dtype) - (r < 0).astype(num.dtype)
+
+
 def _ratio_score(req, alloc, least: bool):
     zero = alloc == 0
     over = req > alloc
     free = jnp.where(least, alloc - req, req)
-    score = free * MAX_CLUSTER_SCORE // jnp.maximum(alloc, 1)
+    score = _floordiv_smallq(free * MAX_CLUSTER_SCORE, alloc)
     return jnp.where(zero | over, 0, score)
 
 
@@ -83,7 +100,7 @@ def normalize(scores, feasible, reverse: bool):
     max is 0 -> all 100 when reversed, else left as-is."""
     masked = jnp.where(feasible, scores, 0)
     max_count = jnp.max(masked, axis=-1, keepdims=True)
-    scaled = MAX_CLUSTER_SCORE * masked // jnp.maximum(max_count, 1)
+    scaled = _floordiv_smallq(MAX_CLUSTER_SCORE * masked, max_count)
     scaled = jnp.where(reverse, MAX_CLUSTER_SCORE - scaled, scaled)
     untouched = jnp.where(reverse, jnp.full_like(masked, MAX_CLUSTER_SCORE), masked)
     return jnp.where(max_count == 0, untouched, scaled)

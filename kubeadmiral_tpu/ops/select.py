@@ -15,15 +15,23 @@ from kubeadmiral_tpu.ops.planner import INT32_INF
 
 
 def select_topk(scores, feasible, max_clusters):
-    """scores i64[B,C], feasible bool[B,C], max_clusters i32[B] -> bool[B,C]."""
+    """scores i64[B,C], feasible bool[B,C], max_clusters i32[B] -> bool[B,C].
+
+    The sort runs on int32 keys: plugin totals are bounded by 5 x 100
+    (normalized in-tree scores) plus webhook scores clamped to
+    int32max/2 by the featurizer, so every total fits int32 with room —
+    and 64-bit sorts are disproportionately expensive to compile (and,
+    on TPU, to run: int64 is emulated)."""
     c = scores.shape[-1]
     # Rank feasible clusters by score desc, index asc; infeasible last.
-    sort_key = jnp.where(feasible, -scores, jnp.iinfo(jnp.int64).max)
+    sort_key = jnp.where(
+        feasible, -scores.astype(jnp.int32), jnp.iinfo(jnp.int32).max
+    )
     order = jnp.argsort(sort_key, axis=-1, stable=True)
     rank = jnp.argsort(order, axis=-1, stable=True)  # rank[b,c] = position of c
     k = jnp.where(
         max_clusters < 0,
         0,
-        jnp.minimum(max_clusters.astype(jnp.int64), c),
+        jnp.minimum(max_clusters, jnp.int32(c)),
     )
     return feasible & (rank < k[:, None])

@@ -66,6 +66,9 @@ class ExtensionService:
         host: str = "127.0.0.1",
         port: int = 0,
         serve_batch: bool = True,
+        tls_cert_file: str = "",
+        tls_key_file: str = "",
+        tls_client_ca_file: str = "",
     ):
         """With ``serve_batch`` (default), per-pair handlers also serve
         their "-batch" sibling endpoints; pass explicit
@@ -94,13 +97,20 @@ class ExtensionService:
             )
         self._host = host
         self._port = port
+        # TLS serving (the server half of the webhook TLSConfig round
+        # trip): cert+key enable https; a client CA additionally demands
+        # a client certificate (mTLS, TLSConfig.CertData/KeyData).
+        self._tls_cert_file = tls_cert_file
+        self._tls_key_file = tls_key_file
+        self._tls_client_ca_file = tls_client_ca_file
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     @property
     def url_prefix(self) -> str:
         assert self._server is not None, "service not started"
-        return f"http://{self._host}:{self._server.server_address[1]}"
+        scheme = "https" if self._tls_cert_file else "http"
+        return f"{scheme}://{self._host}:{self._server.server_address[1]}"
 
     def start(self) -> int:
         handlers = self.handlers
@@ -128,6 +138,36 @@ class ExtensionService:
                 pass
 
         self._server = ThreadingHTTPServer((self._host, self._port), RequestHandler)
+        if self._tls_cert_file:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self._tls_cert_file, self._tls_key_file or None)
+            if self._tls_client_ca_file:
+                ctx.load_verify_locations(self._tls_client_ca_file)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            # Handshake on the HANDLER thread, not in accept(): with
+            # do_handshake_on_connect a stalled client (port scanner,
+            # plain-HTTP probe) would block the single accept loop and
+            # starve every other webhook call.
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket,
+                server_side=True,
+                do_handshake_on_connect=False,
+            )
+
+            server = self._server
+
+            class _HandshakeHandler(RequestHandler):
+                def setup(self) -> None:
+                    # self.request is the raw (wrapped, un-handshaken)
+                    # SSL socket; self.connection only exists after
+                    # super().setup().
+                    self.request.settimeout(10.0)
+                    self.request.do_handshake()
+                    super().setup()
+
+            server.RequestHandlerClass = _HandshakeHandler
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="extension-service", daemon=True
         )

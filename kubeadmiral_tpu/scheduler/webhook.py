@@ -23,11 +23,17 @@ the reference's plugin tests do (plugin.go:42-44).
 
 from __future__ import annotations
 
+import base64
+import http.client
 import json
+import socket
+import ssl
+import tempfile
 import urllib.error
 import urllib.request
 from dataclasses import dataclass
 from typing import Optional, Protocol
+from urllib.parse import urlsplit
 
 from kubeadmiral_tpu.models import types as T
 
@@ -45,28 +51,147 @@ class WebhookError(Exception):
     pass
 
 
+class WebhookStatusError(Exception):
+    """Non-200 HTTP status from the webhook server.  Deliberately NOT a
+    WebhookError: a 404 on a "-batch" endpoint means "reference-protocol
+    server, fall back to per-pair calls", not a protocol failure."""
+
+    def __init__(self, code: int):
+        super().__init__(f"unexpected status code: {code}")
+        self.code = code
+
+
+@dataclass(frozen=True)
+class WebhookTLSConfig:
+    """spec.tlsConfig (reference:
+    types_schedulerpluginwebhookconfiguration.go:68-90, consumed by
+    scheduler/webhook.go:117-119): CA bundle + optional client cert for
+    mTLS, insecure skip-verify for testing, SNI/verify name override.
+    PEM fields arrive base64-encoded ([]byte JSON encoding)."""
+
+    insecure: bool = False
+    server_name: str = ""
+    ca_data: str = ""    # PEM
+    cert_data: str = ""  # PEM (client certificate)
+    key_data: str = ""   # PEM (client key)
+
+
+def parse_tls_config(raw: Optional[dict]) -> Optional[WebhookTLSConfig]:
+    if not raw:
+        return None
+
+    def pem(field: str) -> str:
+        value = raw.get(field, "")
+        if not value:
+            return ""
+        if "-----BEGIN" in value:
+            return value  # already PEM (convenience for tests/manifests)
+        try:
+            return base64.b64decode(value).decode()
+        except Exception as e:
+            # Silent "" would downgrade to system CAs / no client cert
+            # and every call would fail as a generic transport error;
+            # fail loudly at parse time instead (the config watcher
+            # counts the parse error and skips the plugin).
+            raise ValueError(f"tlsConfig.{field} is not valid base64 PEM: {e}")
+
+    return WebhookTLSConfig(
+        insecure=bool(raw.get("insecure", False)),
+        server_name=raw.get("serverName", ""),
+        ca_data=pem("caData"),
+        cert_data=pem("certData"),
+        key_data=pem("keyData"),
+    )
+
+
 class HTTPClient(Protocol):
-    def post(self, url: str, body: bytes, timeout: float) -> bytes: ...
+    def post(
+        self,
+        url: str,
+        body: bytes,
+        timeout: float,
+        tls: Optional[WebhookTLSConfig] = None,
+    ) -> bytes: ...
 
 
 class UrllibClient:
-    """Default transport: stdlib urllib with the reference's headers."""
+    """Default transport: stdlib http.client with the reference's
+    headers and per-webhook TLS (CA bundle / client cert / insecure /
+    SNI override — webhook.go:117-119 builds the equivalent
+    http.Transport from the config's TLSClientConfig)."""
 
-    def post(self, url: str, body: bytes, timeout: float) -> bytes:
-        req = urllib.request.Request(
-            url,
-            data=body,
-            method="POST",
-            headers={
-                "Content-Type": "application/json",
-                "Accept": "application/json",
-                "User-Agent": "kubeadmiral-tpu-scheduler",
-            },
+    def __init__(self):
+        self._ctx_cache: dict[WebhookTLSConfig, ssl.SSLContext] = {}
+
+    def _context(self, tls: Optional[WebhookTLSConfig]) -> ssl.SSLContext:
+        key = tls or WebhookTLSConfig()
+        ctx = self._ctx_cache.get(key)
+        if ctx is not None:
+            return ctx
+        ctx = ssl.create_default_context(
+            cadata=key.ca_data if key.ca_data else None
         )
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        if key.insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if key.cert_data and key.key_data:
+            # load_cert_chain only takes paths; stage the PEM through a
+            # private temp file.
+            with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
+                f.write(key.cert_data)
+                f.write("\n")
+                f.write(key.key_data)
+                f.flush()
+                ctx.load_cert_chain(f.name)
+        self._ctx_cache[key] = ctx
+        return ctx
+
+    def post(
+        self,
+        url: str,
+        body: bytes,
+        timeout: float,
+        tls: Optional[WebhookTLSConfig] = None,
+    ) -> bytes:
+        split = urlsplit(url)
+        headers = {
+            "Content-Type": "application/json",
+            "Accept": "application/json",
+            "User-Agent": "kubeadmiral-tpu-scheduler",
+        }
+        if split.scheme == "https":
+            ctx = self._context(tls)
+            server_name = (tls.server_name if tls else "") or split.hostname
+            conn = http.client.HTTPSConnection(
+                split.hostname, split.port, timeout=timeout, context=ctx
+            )
+            # SNI / verification-name override (TLSConfig.ServerName):
+            # wrap the socket ourselves so the name presented to the
+            # server (and checked against its cert) is the configured
+            # one, not the dial host.
+            def connect(_conn=conn, _ctx=ctx, _name=server_name):
+                sock = socket.create_connection(
+                    (_conn.host, _conn.port), _conn.timeout
+                )
+                _conn.sock = _ctx.wrap_socket(sock, server_hostname=_name)
+
+            conn.connect = connect
+        else:
+            conn = http.client.HTTPConnection(
+                split.hostname, split.port, timeout=timeout
+            )
+        try:
+            path = split.path or "/"
+            if split.query:
+                path += "?" + split.query
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
             if resp.status != 200:
-                raise WebhookError(f"unexpected status code: {resp.status}")
-            return resp.read()
+                raise WebhookStatusError(resp.status)
+            return data
+        finally:
+            conn.close()
 
 
 # -- payload conversion (adapter.go ConvertSchedulingUnit) ---------------
@@ -159,6 +284,7 @@ class WebhookConfig:
     payload_versions: tuple[str, ...] = (PAYLOAD_VERSION,)
     timeout: float = DEFAULT_TIMEOUT_SECONDS
     generation: int = 1
+    tls: Optional[WebhookTLSConfig] = None
 
 
 _DURATION_UNITS = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
@@ -207,6 +333,7 @@ def parse_webhook_config(obj: dict) -> WebhookConfig:
         payload_versions=tuple(spec.get("payloadVersions", (PAYLOAD_VERSION,))),
         timeout=timeout if timeout else DEFAULT_TIMEOUT_SECONDS,
         generation=obj["metadata"].get("generation", 1),
+        tls=parse_tls_config(spec.get("tlsConfig")),
     )
 
 
@@ -238,8 +365,11 @@ class WebhookPlugin:
 
     def _call(self, path: str, body: dict) -> dict:
         url = self.config.url_prefix.rstrip("/") + "/" + path.lstrip("/")
+        # tls is passed only when configured, so injected fake clients
+        # with the bare (url, body, timeout) signature keep working.
+        kwargs = {"tls": self.config.tls} if self.config.tls is not None else {}
         raw = self.client.post(
-            url, json.dumps(body).encode(), timeout=self.config.timeout
+            url, json.dumps(body).encode(), timeout=self.config.timeout, **kwargs
         )
         response = json.loads(raw)
         if response.get("error"):
@@ -284,7 +414,7 @@ class WebhookPlugin:
             return self._call(path.rstrip("/") + "-batch", body)
         except WebhookError:
             raise  # the server answered with a protocol error
-        except urllib.error.HTTPError as e:
+        except (urllib.error.HTTPError, WebhookStatusError) as e:
             if e.code in (404, 405, 501):
                 # The endpoint genuinely doesn't exist (reference-
                 # protocol server): remember permanently.

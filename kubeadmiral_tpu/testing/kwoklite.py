@@ -31,9 +31,11 @@ class KwokLiteFarm:
     secret-derived member clients) so controllers run over it unmodified.
     """
 
-    def __init__(self, host_token: str | None = None):
+    def __init__(self, host_token: str | None = None, host_port: int = 0):
         self.host_store = FakeKube("host")
-        self.host_server = KubeApiServer(self.host_store, admin_token=host_token)
+        self.host_server = KubeApiServer(
+            self.host_store, admin_token=host_token, port=host_port
+        )
         self.host = HttpKube(self.host_server.url, token=host_token, name="host")
         self.fleet = HttpFleet(self.host)
         self.member_servers: dict[str, KubeApiServer] = {}

@@ -49,3 +49,37 @@ class TestEndToEndSliceHTTP(_BaseSlice):
 
     def teardown_method(self):
         self.farm.close()
+
+
+from test_e2e_slice import TestMultiKindPropagation as _BaseKinds
+
+
+class TestMultiKindPropagationHTTP(_BaseKinds):
+    """The parameterized propagation suite over REAL sockets."""
+
+    def make_fleet(self):
+        self.farm = KwokLiteFarm()
+        return self.farm.fleet
+
+    def add_member(self, name):
+        return self.farm.add_member(name)
+
+    def cluster_spec(self, name):
+        return self.farm.cluster_spec(name)
+
+    def settle(self, *controllers, rounds=30, timeout=60.0, grace=12):
+        deadline = time.monotonic() + timeout
+        idle = 0
+        while time.monotonic() < deadline and idle < grace:
+            progressed = False
+            for c in controllers:
+                while c.worker.step():
+                    progressed = True
+            if progressed:
+                idle = 0
+            else:
+                idle += 1
+                time.sleep(0.05)
+
+    def teardown_method(self):
+        self.farm.close()

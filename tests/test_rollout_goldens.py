@@ -1,0 +1,97 @@
+"""The reference's rollout-planner table tests, replayed bit-for-bit.
+
+Fixtures are machine-translated from
+pkg/controllers/util/rolloutplan_test.go (85 suites, 289 targets across
+TestPlanWholeProcessWithMaxUnavailable/Both/Surge, Creation, Scale,
+EmptyTargets, UnexceptedCases and the 43 recorded production cases of
+TestPlanActualCases) — the federation-wide surge/unavailable budget
+arithmetic is order-sensitive, so self-consistency isn't enough
+(VERDICT r2 #6)."""
+
+import json
+import os
+
+import pytest
+
+from kubeadmiral_tpu.federation.rollout import (
+    RolloutPlan,
+    RolloutPlanner,
+    Target,
+    TargetStatus,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "rollout_goldens.json")
+GOLDENS = json.load(open(FIXTURE))
+
+
+def build_target(spec) -> Target:
+    nums = spec["nums"]
+    updated = spec["updated"]
+    if spec["kind"] == "":
+        replicas, desired, upd, upd_avail, ms, mu = nums
+        cur_new = upd if updated else replicas
+        status = TargetStatus(
+            replicas=replicas, actual_replicas=replicas,
+            available_replicas=replicas, updated_replicas=upd,
+            updated_available_replicas=upd_avail,
+            current_new_replicas=cur_new,
+            current_new_available_replicas=cur_new,
+            updated=updated, max_surge=ms, max_unavailable=mu,
+        )
+    elif spec["kind"] == "WithActualInfo":
+        replicas, desired, upd, upd_avail, actual, avail, ms, mu = nums
+        cur_new = upd if updated else replicas
+        status = TargetStatus(
+            replicas=replicas, actual_replicas=actual,
+            available_replicas=avail, updated_replicas=upd,
+            updated_available_replicas=upd_avail,
+            current_new_replicas=cur_new,
+            current_new_available_replicas=cur_new,
+            updated=updated, max_surge=ms, max_unavailable=mu,
+        )
+    else:  # WithAllInfo
+        (replicas, desired, upd, upd_avail, cur_new, cur_new_avail,
+         actual, avail, ms, mu) = nums
+        status = TargetStatus(
+            replicas=replicas, actual_replicas=actual,
+            available_replicas=avail, updated_replicas=upd,
+            updated_available_replicas=upd_avail,
+            current_new_replicas=cur_new,
+            current_new_available_replicas=cur_new_avail,
+            updated=updated, max_surge=ms, max_unavailable=mu,
+        )
+    return Target(cluster=spec["name"], status=status, desired_replicas=desired)
+
+
+CASES = [
+    (func, suite)
+    for func, data in GOLDENS.items()
+    for suite in data["suites"]
+]
+
+
+@pytest.mark.parametrize(
+    "func,suite", CASES, ids=[f"{f}::{s['name']}" for f, s in CASES]
+)
+def test_reference_golden(func, suite):
+    planner = RolloutPlanner.from_params(
+        suite["replicas"], suite["max_surge"], suite["max_unavailable"]
+    )
+    for spec in suite["targets"]:
+        planner.register(build_target(spec))
+    got = planner.plan()
+    want = {
+        cluster: RolloutPlan(
+            replicas=v[0], max_surge=v[1], max_unavailable=v[2],
+            only_patch_replicas=v[3],
+        )
+        for cluster, v in suite["plans"].items()
+    }
+    assert got == want, f"{func}:{suite['name']}\n got: {got}\nwant: {want}"
+
+
+def test_empty_targets_literal_planners():
+    """TestPlanEmptyTargets constructs planners directly: both (0, 25,
+    replicas=100) and (0, 0) must plan nothing for no targets."""
+    assert RolloutPlanner.from_params(100, 0, 25).plan() == {}
+    assert RolloutPlanner.from_params(0, 0, 0).plan() == {}
