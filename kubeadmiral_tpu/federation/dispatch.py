@@ -12,7 +12,6 @@ Statuses mirror fedtypesv1a1.PropagationStatus values.
 
 from __future__ import annotations
 
-import copy
 import json
 import threading
 import time
@@ -28,7 +27,7 @@ from kubeadmiral_tpu.federation.rollout import (
     MAX_SURGE_PATH,
     MAX_UNAVAILABLE_PATH,
 )
-from kubeadmiral_tpu.utils.unstructured import delete_path, get_path, set_path
+from kubeadmiral_tpu.utils.unstructured import copy_json, delete_path, get_path, set_path
 from kubeadmiral_tpu.federation.resource import (
     FederatedResource,
     has_managed_label,
@@ -244,7 +243,7 @@ class ManagedDispatcher:
             with self._lock:
                 self._desired_cache[key] = obj
         if mutable:
-            return copy.deepcopy(obj)
+            return copy_json(obj)
         return obj
 
     # -- operations ------------------------------------------------------
@@ -424,7 +423,7 @@ class ManagedDispatcher:
         def run() -> None:
             # Deep copy: cluster_obj may be a no-copy store VIEW, and a
             # shallow dict() would mutate the store's nested metadata.
-            obj = copy.deepcopy(cluster_obj)
+            obj = copy_json(cluster_obj)
             labels = obj.get("metadata", {}).get("labels", {})
             labels.pop(C.MANAGED_LABEL, None)
             obj.get("metadata", {}).get("annotations", {}).pop(

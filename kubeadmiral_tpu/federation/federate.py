@@ -12,7 +12,6 @@ deleting the federated object first, gated by a finalizer on the source
 
 from __future__ import annotations
 
-import copy
 from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
@@ -22,7 +21,7 @@ from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
 from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_key
 from kubeadmiral_tpu.utils.jsonpatch import create_merge_patch
-from kubeadmiral_tpu.utils.unstructured import get_path, set_path
+from kubeadmiral_tpu.utils.unstructured import copy_json, get_path, set_path
 
 FEDERATE_FINALIZER = C.PREFIX + "federate-controller"
 NO_FEDERATED_RESOURCE = C.PREFIX + "no-federated-resource"
@@ -156,7 +155,7 @@ def observed_keys(source_map: dict, federated_map: dict) -> str:
 
 
 def template_for_source(source: dict, annotations: dict, labels: dict) -> dict:
-    template = copy.deepcopy(source)
+    template = copy_json(source)
     meta = template.setdefault("metadata", {})
     for field in _PRUNED_META:
         meta.pop(field, None)

@@ -23,7 +23,6 @@ rollback targets.  Mechanics mirrored from the reference:
 
 from __future__ import annotations
 
-import copy
 import threading
 from typing import Optional
 
@@ -36,7 +35,7 @@ from kubeadmiral_tpu.testing.fakekube import (
     NotFound,
 )
 from kubeadmiral_tpu.utils.hashing import fnv32a, stable_json_hash
-from kubeadmiral_tpu.utils.unstructured import get_path
+from kubeadmiral_tpu.utils.unstructured import copy_json, get_path
 
 CONTROLLER_REVISIONS = "apps/v1/controllerrevisions"
 LAST_REVISION_ANNOTATION = C.PREFIX + "last-revision"
@@ -199,7 +198,7 @@ class RevisionManager:
                     "name": name,
                     "labels": _revision_labels(fed_obj),
                 },
-                "data": copy.deepcopy(data),
+                "data": copy_json(data),
                 "revision": number,
             }
             if ns:

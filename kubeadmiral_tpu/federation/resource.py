@@ -9,7 +9,6 @@ pkg/controllers/sync/resource.go:55-473, accessor.go:40-236).
 
 from __future__ import annotations
 
-import copy
 import json
 from typing import Optional
 
@@ -18,7 +17,7 @@ from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.utils.hashing import stable_json_hash
 from kubeadmiral_tpu.utils.jsonpatch import apply_patch
-from kubeadmiral_tpu.utils.unstructured import delete_path, get_path
+from kubeadmiral_tpu.utils.unstructured import copy_json, delete_path, get_path
 
 # Finalizer protecting terminating Jobs/Pods from premature GC
 # (reference: dispatch/retain_terminating.go RetainTerminatingObjectFinalizer).
@@ -63,7 +62,7 @@ class FederatedResource:
         name/namespace/kind stamped from the federated object, finalizers
         stripped (member controllers own them), source-generation
         annotation added, kind-specific field drops applied."""
-        obj = copy.deepcopy(C.template(self.obj)) or {}
+        obj = copy_json(C.template(self.obj)) or {}
         meta = obj.setdefault("metadata", {})
         meta.pop("finalizers", None)
         meta["name"] = self.name

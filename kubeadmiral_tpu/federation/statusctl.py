@@ -17,7 +17,6 @@ to the user:
 
 from __future__ import annotations
 
-import copy
 from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
@@ -31,7 +30,7 @@ from kubeadmiral_tpu.testing.fakekube import (
     NotFound,
     obj_key,
 )
-from kubeadmiral_tpu.utils.unstructured import get_path, set_path
+from kubeadmiral_tpu.utils.unstructured import copy_json, get_path, set_path
 
 class StatusController:
     """Collects member-object fields into the status CR."""
@@ -150,7 +149,7 @@ class StatusController:
                 value = get_path(obj, field)
                 if value is None:
                     continue
-                set_path(collected, field, copy.deepcopy(value))
+                set_path(collected, field, copy_json(value))
             entry["collectedFields"] = collected
             out.append(entry)
         return out

@@ -15,9 +15,10 @@ configs).
 
 from __future__ import annotations
 
-import copy
 import threading
 from typing import Callable, Iterable, Optional
+
+from kubeadmiral_tpu.utils.unstructured import copy_json
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -92,7 +93,7 @@ class FakeKube:
         # ONE snapshot shared by every handler: with a dozen controllers
         # watching, per-handler deep copies dominate the control plane's
         # host time at scale.  Handlers must not mutate delivered objects.
-        snapshot = copy.deepcopy(obj)
+        snapshot = copy_json(obj)
         for handler in handlers:
             handler(event, snapshot)
         for observer in self._all_watchers:
@@ -101,7 +102,7 @@ class FakeKube:
     # -- CRUD ------------------------------------------------------------
     def create(self, resource: str, obj: dict) -> dict:
         with self._lock:
-            obj = copy.deepcopy(obj)
+            obj = copy_json(obj)
             meta = obj.setdefault("metadata", {})
             key = obj_key(obj)
             store = self._store(resource)
@@ -116,14 +117,14 @@ class FakeKube:
             meta.setdefault("uid", f"{self.name}-{resource}-{key}-{self._rv}")
             store[key] = obj
             self._notify(resource, ADDED, obj)
-            return copy.deepcopy(obj)
+            return copy_json(obj)
 
     def get(self, resource: str, key: str) -> dict:
         with self._lock:
             store = self._store(resource)
             if key not in store:
                 raise NotFound(f"{resource} {key} in {self.name}")
-            return copy.deepcopy(store[key])
+            return copy_json(store[key])
 
     def try_get(self, resource: str, key: str) -> Optional[dict]:
         try:
@@ -143,7 +144,7 @@ class FakeKube:
         """Full-object update with optimistic concurrency; removing the
         last finalizer of a deleting object completes the deletion."""
         with self._lock:
-            obj = copy.deepcopy(obj)
+            obj = copy_json(obj)
             key = obj_key(obj)
             store = self._store(resource)
             if key not in store:
@@ -161,7 +162,7 @@ class FakeKube:
             # lets sync push template updates without clobbering
             # member-owned status.
             if "status" in old:
-                obj["status"] = copy.deepcopy(old["status"])
+                obj["status"] = copy_json(old["status"])
             else:
                 obj.pop("status", None)
             if "spec" in old or "spec" in obj:
@@ -175,10 +176,10 @@ class FakeKube:
                 if not meta.get("finalizers"):
                     del store[key]
                     self._notify(resource, DELETED, obj)
-                    return copy.deepcopy(obj)
+                    return copy_json(obj)
             store[key] = obj
             self._notify(resource, MODIFIED, obj)
-            return copy.deepcopy(obj)
+            return copy_json(obj)
 
     def update_status(self, resource: str, obj: dict) -> dict:
         """Status-subresource style update: only .status is applied.
@@ -196,12 +197,12 @@ class FakeKube:
                 raise Conflict(
                     f"{resource} {key}: {sent_rv} != {old['metadata']['resourceVersion']}"
                 )
-            cur = copy.deepcopy(old)
-            cur["status"] = copy.deepcopy(obj.get("status"))
+            cur = copy_json(old)
+            cur["status"] = copy_json(obj.get("status"))
             cur["metadata"]["resourceVersion"] = self._bump()
             store[key] = cur
             self._notify(resource, MODIFIED, cur)
-            return copy.deepcopy(cur)
+            return copy_json(cur)
 
     def delete(self, resource: str, key: str) -> None:
         with self._lock:
@@ -213,7 +214,7 @@ class FakeKube:
                 if not obj["metadata"].get("deletionTimestamp"):
                     # Replace, don't mutate in place: view readers
                     # (try_get_view/list_view) may hold the old dict.
-                    obj = copy.deepcopy(obj)
+                    obj = copy_json(obj)
                     obj["metadata"]["deletionTimestamp"] = "now"
                     obj["metadata"]["resourceVersion"] = self._bump()
                     store[key] = obj
@@ -223,7 +224,7 @@ class FakeKube:
             # Like etcd, deletion advances the revision: the DELETED
             # event must carry a resourceVersion newer than any previous
             # event or watch-resume cursors would skip it.
-            obj = copy.deepcopy(obj)
+            obj = copy_json(obj)
             obj["metadata"]["resourceVersion"] = self._bump()
             self._notify(resource, DELETED, obj)
 
@@ -235,7 +236,7 @@ class FakeKube:
     ) -> list[dict]:
         with self._lock:
             return [
-                copy.deepcopy(obj)
+                copy_json(obj)
                 for obj in self.list_view(resource, namespace, label_selector)
             ]
 
@@ -294,14 +295,14 @@ class FakeKube:
             return {
                 "name": self.name,
                 "rv": self._rv,
-                "objects": copy.deepcopy(self._objects),
+                "objects": copy_json(self._objects),
             }
 
     @classmethod
     def restore(cls, snapshot: dict) -> "FakeKube":
         kube = cls(snapshot.get("name", "host"))
         kube._rv = int(snapshot["rv"])
-        kube._objects = copy.deepcopy(snapshot["objects"])
+        kube._objects = copy_json(snapshot["objects"])
         return kube
 
     # -- watch -----------------------------------------------------------
@@ -312,7 +313,7 @@ class FakeKube:
             self._watchers.setdefault(resource, []).append(handler)
             if replay:
                 for obj in self._store(resource).values():
-                    handler(ADDED, copy.deepcopy(obj))
+                    handler(ADDED, copy_json(obj))
 
     def watch_all(
         self, observer: Callable[[str, str, dict, int], None]

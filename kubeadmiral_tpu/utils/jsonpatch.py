@@ -9,7 +9,8 @@ semantics ("-" appends).
 
 from __future__ import annotations
 
-import copy
+
+from kubeadmiral_tpu.utils.unstructured import copy_json
 from typing import Any
 
 
@@ -113,14 +114,14 @@ def _remove(doc: Any, pointer: str) -> Any:
 
 def apply_patch(obj: dict, patches: list[dict]) -> dict:
     """Apply an RFC6902 patch list to a deep copy of ``obj``."""
-    doc: Any = copy.deepcopy(obj)
+    doc: Any = copy_json(obj)
     for p in patches:
         op = p.get("op")
         path = p.get("path", "")
         if op == "add":
-            doc = _add(doc, path, copy.deepcopy(p.get("value")))
+            doc = _add(doc, path, copy_json(p.get("value")))
         elif op == "replace":
-            doc = _replace(doc, path, copy.deepcopy(p.get("value")))
+            doc = _replace(doc, path, copy_json(p.get("value")))
         elif op == "remove":
             doc = _remove(doc, path)
         elif op == "move":
@@ -128,7 +129,7 @@ def apply_patch(obj: dict, patches: list[dict]) -> dict:
             doc = _remove(doc, p["from"])
             doc = _add(doc, path, value)
         elif op == "copy":
-            value = copy.deepcopy(_get(doc, p["from"]))
+            value = copy_json(_get(doc, p["from"]))
             doc = _add(doc, path, value)
         elif op == "test":
             if _get(doc, path) != p.get("value"):
@@ -146,7 +147,7 @@ def create_merge_patch(source: Any, target: Any) -> Any:
     pkg/controllers/federate/util.go:330-349 CreateMergePatch).
     """
     if not isinstance(source, dict) or not isinstance(target, dict):
-        return copy.deepcopy(target)
+        return copy_json(target)
     patch: dict = {}
     for key, src_val in source.items():
         if key not in target:
@@ -155,15 +156,15 @@ def create_merge_patch(source: Any, target: Any) -> Any:
             patch[key] = create_merge_patch(src_val, target[key])
     for key, tgt_val in target.items():
         if key not in source:
-            patch[key] = copy.deepcopy(tgt_val)
+            patch[key] = copy_json(tgt_val)
     return patch
 
 
 def apply_merge_patch(doc: Any, patch: Any) -> Any:
     """Apply an RFC 7386 merge patch (null deletes keys)."""
     if not isinstance(patch, dict):
-        return copy.deepcopy(patch)
-    result = copy.deepcopy(doc) if isinstance(doc, dict) else {}
+        return copy_json(patch)
+    result = copy_json(doc) if isinstance(doc, dict) else {}
     for key, val in patch.items():
         if val is None:
             result.pop(key, None)
