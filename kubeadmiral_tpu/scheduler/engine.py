@@ -338,6 +338,7 @@ class SchedulerEngine:
         cell_budget: int = 4096 * 512,
         mesh="auto",
         canonical_c: int = 256,
+        vocab_caps: Optional[dict] = None,
     ):
         self.chunk_size = chunk_size
         # XLA compile time for the fused tick grows with the b x C cell
@@ -352,6 +353,17 @@ class SchedulerEngine:
         # short ladder (eff/16, eff/4, eff) instead of free pow2: wide-C
         # programs are the expensive compiles, so their count is capped.
         self.canonical_c = canonical_c
+        # Overriding the compact vocabulary caps is a test/ops knob (e.g.
+        # forcing the dense fallback); production uses CompactVocab's
+        # defaults.  Validate keys here so a typo fails at construction,
+        # not as a TypeError deep inside the first scheduling tick.
+        self._vocab_caps = dict(vocab_caps or {})
+        unknown = set(self._vocab_caps) - Cmp.CAP_NAMES
+        if unknown:
+            raise ValueError(
+                f"unknown vocab_caps keys {sorted(unknown)}; "
+                f"valid: {sorted(Cmp.CAP_NAMES)}"
+            )
         self._view_cache: tuple[Optional[tuple], Optional[ClusterView]] = (None, None)
         self.cache_bytes = cache_bytes
         self._chunk_cache: dict[int, _CachedChunk] = {}
@@ -611,7 +623,7 @@ class SchedulerEngine:
         if topo_fp in self._vocabs:
             return self._vocabs[topo_fp]
         try:
-            vocab = CompactVocab(view)
+            vocab = CompactVocab(view, **self._vocab_caps)
         except VocabOverflow:
             vocab = None
         while len(self._vocabs) >= 4:  # a few recent topologies
@@ -1347,7 +1359,7 @@ class SchedulerEngine:
                 )
 
                 view = _build_cluster_view(clusters, [unit])
-                vocab = CompactVocab(view)
+                vocab = CompactVocab(view, **self._vocab_caps)
                 ci = featurize_compact([unit], view, vocab)
                 c_bucket, eff_chunk, ladder = self._tick_geometry(len(clusters))
                 if ladder is None:

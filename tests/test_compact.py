@@ -222,3 +222,58 @@ class TestEngineVocabLifecycle:
             units, clusters
         )
         assert [r.clusters for r in got] == [r.clusters for r in fresh]
+
+
+class TestDenseFallback:
+    def test_vocab_overflow_falls_back_dense_and_matches(self):
+        """A chunk whose policies exceed a vocabulary cap must schedule
+        through the dense path with identical results — and the engine's
+        fast paths (noop, sub-batch) must keep working on it."""
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+        units, clusters = rich_world(b=36, c=8)
+        tiny = SchedulerEngine(
+            chunk_size=64, min_bucket=8, vocab_caps={"sel_cap": 1}
+        )
+        got = tiny.schedule(units, clusters)
+        assert tiny._chunk_cache[0].fmt == "dense"
+        fresh = SchedulerEngine(chunk_size=64, min_bucket=8).schedule(
+            units, clusters
+        )
+        assert [r.clusters for r in got] == [r.clusters for r in fresh]
+        # noop path on a dense-cached chunk
+        again = tiny.schedule(units, clusters)
+        assert tiny.fetch_stats["noop"] >= 1
+        assert [r.clusters for r in again] == [r.clusters for r in fresh]
+        # sub-batch path on a dense-cached chunk
+        churned = list(units)
+        churned[4] = dataclasses.replace(churned[4], desired_replicas=71)
+        got2 = tiny.schedule(churned, clusters)
+        assert tiny.fetch_stats["subbatch"] >= 1
+        fresh2 = SchedulerEngine(chunk_size=64, min_bucket=8).schedule(
+            churned, clusters
+        )
+        assert [r.clusters for r in got2] == [r.clusters for r in fresh2]
+
+    def test_topology_level_overflow_uses_dense_everywhere(self):
+        """Too many distinct taint sets for the cap: the whole topology
+        schedules dense (vocab None)."""
+        from kubeadmiral_tpu.models.types import Taint
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+        units, clusters = rich_world(b=16, c=8)
+        spiky = [
+            dataclasses.replace(
+                cl, taints=(Taint(f"k{j}", f"v{j}", "PreferNoSchedule"),)
+            )
+            for j, cl in enumerate(clusters)
+        ]
+        tol = SchedulerEngine(
+            chunk_size=32, min_bucket=8, vocab_caps={"taint_cap": 2}
+        )
+        got = tol.schedule(units, spiky)
+        assert tol._chunk_cache[0].fmt == "dense"
+        fresh = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units, spiky
+        )
+        assert [r.clusters for r in got] == [r.clusters for r in fresh]
