@@ -204,6 +204,39 @@ class FakeKube:
             self._notify(resource, MODIFIED, cur)
             return copy_json(cur)
 
+    def batch(self, operations: list) -> list[dict]:
+        """Interface parity with HttpKube.batch: apply many operations,
+        return one {"code", "object"|"status"} entry per operation (the
+        in-process transport has no round trips to amortize, but callers
+        written against the bulk protocol run unmodified)."""
+        results = []
+        for op in operations:
+            verb = op.get("verb")
+            resource = op.get("resource", "")
+            try:
+                if verb == "create":
+                    results.append({"code": 201, "object": self.create(resource, op["object"])})
+                elif verb == "update":
+                    results.append({"code": 200, "object": self.update(resource, op["object"])})
+                elif verb == "update_status":
+                    results.append({"code": 200, "object": self.update_status(resource, op["object"])})
+                elif verb == "delete":
+                    self.delete(resource, op["key"])
+                    results.append({"code": 200, "status": {"status": "Success"}})
+                elif verb == "get":
+                    results.append({"code": 200, "object": self.get(resource, op["key"])})
+                else:
+                    results.append({"code": 400, "status": {"reason": "BadRequest", "message": f"unknown verb {verb!r}"}})
+            except AlreadyExists as e:
+                results.append({"code": 409, "status": {"reason": "AlreadyExists", "message": str(e)}})
+            except Conflict as e:
+                results.append({"code": 409, "status": {"reason": "Conflict", "message": str(e)}})
+            except NotFound as e:
+                results.append({"code": 404, "status": {"reason": "NotFound", "message": str(e)}})
+            except Exception as e:
+                results.append({"code": 400, "status": {"reason": "BadRequest", "message": str(e)}})
+        return results
+
     def delete(self, resource: str, key: str) -> None:
         with self._lock:
             store = self._store(resource)

@@ -370,6 +370,61 @@ class _Handler(BaseHTTPRequestHandler):
         except NotFound as e:
             self._send_status(404, "NotFound", str(e))
 
+    def _serve_batch(self, body: dict) -> None:
+        """POST /batch — one request, many operations (the bulk-write
+        protocol the per-member sync fan-out amortizes its round trips
+        through; extends the apiserver the way the webhook "-batch"
+        endpoints extended the reference's per-pair calls).
+
+        Body: {"operations": [{"verb": create|update|update_status|
+        delete|get, "resource": ..., "object": ...|"key": ...}, ...]}.
+        Response: {"results": [{"code": ..., "object"|"status": ...}]}
+        — one entry per operation, order preserved; each operation
+        succeeds or fails independently (per-object conflict retry stays
+        with the caller)."""
+        store = self.api.store
+        results = []
+        for op in body.get("operations", ()):
+            verb = op.get("verb")
+            resource = op.get("resource", "")
+            try:
+                if verb == "create":
+                    results.append({"code": 201, "object": store.create(resource, op["object"])})
+                elif verb == "update":
+                    results.append({"code": 200, "object": store.update(resource, op["object"])})
+                elif verb == "update_status":
+                    results.append({"code": 200, "object": store.update_status(resource, op["object"])})
+                elif verb == "delete":
+                    store.delete(resource, op["key"])
+                    results.append({"code": 200, "status": {"kind": "Status", "status": "Success"}})
+                elif verb == "get":
+                    results.append({"code": 200, "object": store.get(resource, op["key"])})
+                else:
+                    results.append(self._status_entry(400, "BadRequest", f"unknown verb {verb!r}"))
+            except AlreadyExists as e:
+                results.append(self._status_entry(409, "AlreadyExists", str(e)))
+            except Conflict as e:
+                results.append(self._status_entry(409, "Conflict", str(e)))
+            except NotFound as e:
+                results.append(self._status_entry(404, "NotFound", str(e)))
+            except Exception as e:
+                results.append(self._status_entry(400, "BadRequest", str(e)))
+        self._send_json(200, {"results": results})
+
+    @staticmethod
+    def _status_entry(code: int, reason: str, message: str) -> dict:
+        return {
+            "code": code,
+            "status": {
+                "kind": "Status",
+                "apiVersion": "v1",
+                "status": "Failure",
+                "reason": reason,
+                "message": message,
+                "code": code,
+            },
+        }
+
     def do_POST(self):
         # Drain the body before any error response: leftover body bytes
         # would be parsed as the next request line on this keep-alive
@@ -379,6 +434,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if obj is None:
             self._send_status(400, "BadRequest", "invalid JSON body")
+            return
+        if urlsplit(self.path).path == "/batch":
+            self._serve_batch(obj)
             return
         try:
             parsed, _ = self._route()

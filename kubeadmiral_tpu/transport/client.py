@@ -194,6 +194,18 @@ class HttpKube:
         if status != 200:
             self._raise_for(status, payload, f"delete {resource} {key}")
 
+    def batch(self, operations: list[dict]) -> list[dict]:
+        """POST /batch: many operations, ONE round trip (the bulk-write
+        protocol; see transport/apiserver.py _serve_batch).  Returns one
+        result entry per operation ({"code", "object"|"status"}), order
+        preserved; per-operation failures stay in the results (the
+        caller owns conflict retry), only transport-level failures
+        raise."""
+        status, payload, _ = self._request("POST", "/batch", {"operations": operations})
+        if status != 200:
+            self._raise_for(status, payload, "batch")
+        return payload.get("results", [])
+
     def list(
         self,
         resource: str,

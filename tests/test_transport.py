@@ -625,3 +625,67 @@ class TestTokenTrustBoundary:
         # Restart over the resumed store: must construct cleanly.
         srv2 = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
         srv2.close()
+
+
+class TestBatchEndpoint:
+    """POST /batch: many operations, one round trip; per-operation
+    failures isolated (the bulk-write path the sync fan-out amortizes
+    member writes through)."""
+
+    def test_mixed_batch_over_http(self):
+        store = FakeKube("m")
+        srv = KubeApiServer(store)
+        kube = HttpKube(srv.url)
+        try:
+            dep = lambda name, replicas=1: {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": name, "namespace": "d"},
+                "spec": {"replicas": replicas},
+            }
+            results = kube.batch([
+                {"verb": "create", "resource": DEPLOYMENTS, "object": dep("a")},
+                {"verb": "create", "resource": DEPLOYMENTS, "object": dep("b")},
+                {"verb": "create", "resource": DEPLOYMENTS, "object": dep("a")},
+                {"verb": "get", "resource": DEPLOYMENTS, "key": "d/b"},
+                {"verb": "delete", "resource": DEPLOYMENTS, "key": "d/missing"},
+                {"verb": "bogus"},
+            ])
+            assert [r["code"] for r in results] == [201, 201, 409, 200, 404, 400]
+            assert results[2]["status"]["reason"] == "AlreadyExists"
+            assert results[3]["object"]["metadata"]["name"] == "b"
+            # updates with stale rv fail per-op with Conflict
+            got = results[0]["object"]
+            got["spec"]["replicas"] = 5
+            stale = json.loads(json.dumps(got))
+            stale["metadata"]["resourceVersion"] = "1"
+            r2 = kube.batch([
+                {"verb": "update", "resource": DEPLOYMENTS, "object": got},
+                {"verb": "update", "resource": DEPLOYMENTS, "object": stale},
+            ])
+            assert r2[0]["code"] == 200
+            assert r2[1]["code"] == 409 and r2[1]["status"]["reason"] == "Conflict"
+        finally:
+            kube.close()
+            srv.close()
+
+    def test_fakekube_batch_parity(self):
+        store = FakeKube("m")
+        dep = {"apiVersion": "apps/v1", "kind": "Deployment",
+               "metadata": {"name": "a", "namespace": "d"}, "spec": {}}
+        results = store.batch([
+            {"verb": "create", "resource": DEPLOYMENTS, "object": dep},
+            {"verb": "create", "resource": DEPLOYMENTS, "object": dep},
+            {"verb": "get", "resource": DEPLOYMENTS, "key": "d/a"},
+        ])
+        assert [r["code"] for r in results] == [201, 409, 200]
+
+    def test_batch_requires_auth(self):
+        store = FakeKube("m")
+        srv = KubeApiServer(store, admin_token="sekrit")
+        try:
+            bad = HttpKube(srv.url, token="nope")
+            with pytest.raises(TransportError, match="401"):
+                bad.batch([{"verb": "get", "resource": DEPLOYMENTS, "key": "d/a"}])
+            bad.close()
+        finally:
+            srv.close()
