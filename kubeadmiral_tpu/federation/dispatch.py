@@ -3,9 +3,18 @@
 The reference fans member-cluster writes out to per-cluster goroutines
 with a shared timeout and collects a per-cluster propagation status +
 version map (reference: pkg/controllers/sync/dispatch/operation.go:102-123,
-managed.go:108-655, unmanaged.go).  Here: a bounded thread pool shared by
-a sync controller, one task per (cluster, operation), with the same
-status/version collection.
+managed.go:108-655, unmanaged.go).  Here the write fan-out is routed
+through a *sink*:
+
+* :class:`ImmediateSink` — the goroutine analogue: each operation runs
+  inline (local in-process members) or on a bounded pool (network
+  members), one client round trip per operation.
+* :class:`BatchSink` — the tick-native variant: a whole BatchWorker tick
+  of sync reconciles stages its member writes here, and ``flush()``
+  issues ONE ``client.batch()`` round trip per member cluster covering
+  every staged object (transport/apiserver.py _serve_batch).  Per-op
+  conflict/failure results flow back through the same continuations, so
+  status/version bookkeeping is identical to the immediate path.
 
 Statuses mirror fedtypesv1a1.PropagationStatus values.
 """
@@ -63,6 +72,135 @@ MANAGED_LABEL_FALSE = "ManagedLabelFalse"
 FINALIZER_CHECK_FAILED = "FinalizerCheckFailed"
 
 ADOPTED_ANNOTATION = C.PREFIX + "adopted"
+
+
+# -- sinks ---------------------------------------------------------------
+class ImmediateSink:
+    """One client call per operation, inline or on a pool
+    (operation.go:102-123's per-cluster goroutine fan-out)."""
+
+    def __init__(
+        self,
+        client_for_cluster: Callable[[str], FakeKube],
+        pool: Optional[ThreadPoolExecutor] = None,
+        inline: bool = False,
+    ):
+        self.client_for_cluster = client_for_cluster
+        self._pool = pool
+        self._own_pool = False
+        self._inline = inline
+        self._futures: list[Future] = []
+
+    def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
+        def run() -> None:
+            client = self.client_for_cluster(cluster)
+            try:
+                result = client.batch([op])[0]
+            except Exception as e:  # transport-level failure
+                result = {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+            continuation(result)
+
+        if self._inline:
+            try:
+                run()
+            except Exception:
+                pass  # continuations record their own failures
+            return
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=8)
+            self._own_pool = True
+        self._futures.append(self._pool.submit(run))
+
+    def wait(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        for f in self._futures:
+            try:
+                f.result(timeout=max(0.0, deadline - time.monotonic()))
+            except Exception:  # timeout statuses were pre-recorded
+                pass
+        self._futures.clear()
+        if self._own_pool and self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+            self._own_pool = False
+
+
+class BatchSink:
+    """Stage operations across MANY federated objects, flush ONE
+    ``client.batch()`` per member cluster.  Shared by every dispatcher of
+    a sync BatchWorker tick; the controller flushes before it finalizes
+    any object's status."""
+
+    def __init__(
+        self,
+        client_for_cluster: Callable[[str], FakeKube],
+        pool: Optional[ThreadPoolExecutor] = None,
+    ):
+        self.client_for_cluster = client_for_cluster
+        self._pool = pool
+        self._staged: dict[str, list[tuple[dict, Callable[[dict], None]]]] = {}
+        self.flushed = True
+
+    def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
+        self._staged.setdefault(cluster, []).append((op, continuation))
+        self.flushed = False
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """One batch round trip per member, in parallel across members
+        when a pool is present.  Continuations run on the flushing
+        thread(s); per-op failures stay in the results."""
+        staged, self._staged = self._staged, {}
+        self.flushed = True
+        if not staged:
+            return
+
+        def flush_cluster(cluster: str, entries: list) -> None:
+            try:
+                client = self.client_for_cluster(cluster)
+                results = client.batch([op for op, _ in entries])
+            except Exception as e:
+                results = [
+                    {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+                ] * len(entries)
+            if len(results) < len(entries):
+                # A short results array must not strand the tail at its
+                # pre-recorded *_TIMED_OUT status with no cause.
+                results = list(results) + [
+                    {"code": 500, "status": {"reason": "Transport",
+                                             "message": "batch result missing"}}
+                ] * (len(entries) - len(results))
+            for (_, continuation), result in zip(entries, results):
+                try:
+                    continuation(result)
+                except Exception:
+                    pass  # continuations record their own failures
+
+        if self._pool is not None and len(staged) > 1:
+            deadline = time.monotonic() + timeout
+            futures = [
+                self._pool.submit(flush_cluster, cluster, entries)
+                for cluster, entries in staged.items()
+            ]
+            for f in futures:
+                try:
+                    f.result(timeout=max(0.0, deadline - time.monotonic()))
+                except Exception:
+                    pass
+        else:
+            for cluster, entries in staged.items():
+                flush_cluster(cluster, entries)
+
+    def wait(self, timeout: float) -> None:
+        # Dispatchers sharing this sink call wait() after the controller
+        # has flushed the tick; anything still staged (a mid-reconcile
+        # wait, e.g. the deletion path) flushes now.
+        if not self.flushed:
+            self.flush(timeout)
+
+
+def _result_error(result: dict) -> str:
+    status = result.get("status") or {}
+    return status.get("message") or status.get("reason") or f"code {result.get('code')}"
 
 
 def _set_last_replicaset_name(obj: dict, cluster_obj: dict) -> None:
@@ -124,7 +262,9 @@ class ManagedDispatcher:
     """One sync round's write fan-out (managed.go:77-126).
 
     ``client_for_cluster`` returns the member apiserver handle; failures
-    of individual operations are recorded per cluster, never raised."""
+    of individual operations are recorded per cluster, never raised.
+    ``sink`` routes the writes (shared BatchSink across a tick, or a
+    private ImmediateSink mirroring the reference's goroutines)."""
 
     def __init__(
         self,
@@ -137,6 +277,8 @@ class ManagedDispatcher:
         timeout: float = 30.0,
         rollout_overrides: Optional[Callable[[str], list]] = None,
         inline: bool = False,
+        sink=None,
+        on_written: Optional[Callable[[str, dict], None]] = None,
     ):
         self.client_for_cluster = client_for_cluster
         self.fed = fed_resource
@@ -145,14 +287,8 @@ class ManagedDispatcher:
         self.skip_adopting = skip_adopting
         self.timeout = timeout
         self.rollout_overrides = rollout_overrides
-        # inline=True runs operations on the caller thread: for local
-        # (in-process store) members the thread fan-out costs more than
-        # the operations themselves; HTTP members keep the per-cluster
-        # parallel dispatch (operation.go:102-123).
-        self._inline = inline
-        self._pool = pool
-        self._own_pool = pool is None
-        self._futures: list[Future] = []
+        self._sink = sink or ImmediateSink(client_for_cluster, pool=pool, inline=inline)
+        self._on_written = on_written
         self._lock = threading.Lock()
         self._status: dict[str, str] = {}
         self._versions: dict[str, str] = {}
@@ -179,31 +315,20 @@ class ManagedDispatcher:
             self._versions[cluster] = version
             self._status[cluster] = OK
 
-    def _submit(self, fn: Callable[[], None]) -> None:
-        if self._inline:
-            try:
-                fn()
-            except Exception:
-                pass  # op handlers record their own failures
-            return
-        if self._pool is None:
-            self._pool = ThreadPoolExecutor(max_workers=8)
-        self._futures.append(self._pool.submit(fn))
+    def _record_written(self, cluster: str, obj: dict) -> None:
+        """A real write landed: record version AND surface the written
+        object (its raw resourceVersion feeds the controller's watch-echo
+        suppression).  Version-based skips must NOT come through here —
+        they produce no watch event to suppress."""
+        self._record_version(cluster, object_version(obj))
+        if self._on_written is not None:
+            self._on_written(cluster, obj)
 
     def wait(self) -> bool:
         """Block until every operation finishes or the shared deadline
         passes (managed.go:126-159); returns False when any cluster ended
         in a non-OK, non-waiting state."""
-        deadline = time.monotonic() + self.timeout
-        for f in self._futures:
-            try:
-                f.result(timeout=max(0.0, deadline - time.monotonic()))
-            except Exception:  # timeout statuses were pre-recorded
-                pass
-        self._futures.clear()
-        if self._own_pool and self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None
+        self._sink.wait(self.timeout)
         with self._lock:
             return all(
                 s in (OK, WAITING_FOR_REMOVAL, WAITING)
@@ -251,22 +376,25 @@ class ManagedDispatcher:
         """Create, falling back to adoption-aware update on AlreadyExists
         (managed.go:325-400)."""
         self.record_status(cluster, CREATION_TIMED_OUT)
+        try:
+            obj = self._desired(cluster)
+        except Exception as e:
+            return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
 
-        def run() -> None:
-            try:
-                obj = self._desired(cluster)
-            except Exception as e:
-                return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
-            client = self.client_for_cluster(cluster)
-            try:
-                created = client.create(self.resource, obj)
+        def done(result: dict) -> None:
+            code = result.get("code")
+            if code == 201:
                 self._resources_updated = True
-                self._record_version(cluster, object_version(created))
+                self._record_written(cluster, result["object"])
                 return
-            except AlreadyExists:
-                pass
-            except Exception as e:
-                return self.record_error(cluster, CREATION_FAILED, str(e))
+            if not (
+                code == 409
+                and (result.get("status") or {}).get("reason") == "AlreadyExists"
+            ):
+                return self.record_error(cluster, CREATION_FAILED, _result_error(result))
+            # AlreadyExists: the adoption-aware fallback (rare path, runs
+            # direct client calls on the flushing thread).
+            client = self.client_for_cluster(cluster)
             try:
                 existing = client.get(self.resource, self.fed.key)
             except NotFound as e:
@@ -279,34 +407,38 @@ class ManagedDispatcher:
                 existing.setdefault("metadata", {}).setdefault("annotations", {})[
                     ADOPTED_ANNOTATION
                 ] = "true"
-            self._update_inner(cluster, existing, adopting=True)
+            self._update_now(cluster, existing, adopting=True)
 
-        self._submit(run)
+        self._sink.submit(
+            cluster, {"verb": "create", "resource": self.resource, "object": obj}, done
+        )
 
     def update(self, cluster: str, cluster_obj: dict, recorded_version: str = "") -> None:
         self.record_status(cluster, UPDATE_TIMED_OUT)
-        self._submit(
-            lambda: self._update_inner(cluster, cluster_obj, recorded_version=recorded_version)
-        )
+        self._stage_update(cluster, cluster_obj, recorded_version=recorded_version)
 
-    def _update_inner(
+    def _prepare_update(
         self,
         cluster: str,
         cluster_obj: dict,
         recorded_version: str = "",
         adopting: bool = False,
-    ) -> None:
-        """(managed.go:402-476): retention, version-based skip, write."""
+    ) -> Optional[dict]:
+        """(managed.go:402-476): retention + version-based skip.  Returns
+        the object to write, or None when bookkeeping already settled the
+        cluster (skip or failure, recorded)."""
         if is_explicitly_unmanaged(cluster_obj):
-            return self.record_error(
+            self.record_error(
                 cluster,
                 MANAGED_LABEL_FALSE,
                 f"object has label {C.MANAGED_LABEL}=false",
             )
+            return None
         try:
             obj = self._desired(cluster, mutable=True)
         except Exception as e:
-            return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
+            self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
+            return None
         if adopting:
             ann = cluster_obj.get("metadata", {}).get("annotations", {})
             if ann.get(ADOPTED_ANNOTATION):
@@ -319,24 +451,56 @@ class ManagedDispatcher:
             if self.fed.target_kind == "Deployment":
                 _set_last_replicaset_name(obj, cluster_obj)
         except Exception as e:
-            return self.record_error(cluster, FIELD_RETENTION_FAILED, str(e))
+            self.record_error(cluster, FIELD_RETENTION_FAILED, str(e))
+            return None
 
         if recorded_version and not object_needs_update(
             obj, cluster_obj, recorded_version, self.replicas_path
         ):
             # Current: still record the version so status reflects it.
             self._record_version(cluster, recorded_version)
-            return
+            return None
+        return obj
 
+    def _update_done(self, cluster: str) -> Callable[[dict], None]:
+        def done(result: dict) -> None:
+            if result.get("code") == 200:
+                self._resources_updated = True
+                self._record_written(cluster, result["object"])
+            else:
+                self.record_error(cluster, UPDATE_FAILED, _result_error(result))
+
+        return done
+
+    def _stage_update(
+        self,
+        cluster: str,
+        cluster_obj: dict,
+        recorded_version: str = "",
+        adopting: bool = False,
+    ) -> None:
+        obj = self._prepare_update(cluster, cluster_obj, recorded_version, adopting)
+        if obj is None:
+            return
+        self._sink.submit(
+            cluster,
+            {"verb": "update", "resource": self.resource, "object": obj},
+            self._update_done(cluster),
+        )
+
+    def _update_now(self, cluster: str, cluster_obj: dict, adopting: bool = False) -> None:
+        """Direct (non-staged) update, used by the create fallback which
+        already runs on a flushing thread."""
+        obj = self._prepare_update(cluster, cluster_obj, adopting=adopting)
+        if obj is None:
+            return
         client = self.client_for_cluster(cluster)
         try:
             updated = client.update(self.resource, obj)
-        except (Conflict, NotFound) as e:
-            return self.record_error(cluster, UPDATE_FAILED, str(e))
         except Exception as e:
             return self.record_error(cluster, UPDATE_FAILED, str(e))
         self._resources_updated = True
-        self._record_version(cluster, object_version(updated))
+        self._record_written(cluster, updated)
 
     def patch_and_keep_template(
         self,
@@ -350,91 +514,86 @@ class ManagedDispatcher:
         with ``keep_rollout_settings``, its current replicas/fenceposts)
         (managed.go:483-560 PatchAndKeepTemplate)."""
         self.record_status(cluster, UPDATE_TIMED_OUT)
+        if is_explicitly_unmanaged(cluster_obj):
+            return self.record_error(
+                cluster,
+                MANAGED_LABEL_FALSE,
+                f"object has label {C.MANAGED_LABEL}=false",
+            )
+        try:
+            obj = self._desired(cluster, mutable=True)
+        except Exception as e:
+            return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
+        try:
+            retain.retain_cluster_fields(self.fed.target_kind, obj, cluster_obj)
+            retain.retain_replicas(
+                obj, cluster_obj, self.fed.obj, self.replicas_path
+            )
+            # No _set_last_replicaset_name here: _retain_template just
+            # forced the revision annotations equal, so the real
+            # update() path is where the last-RS marker gets written.
+            _retain_template(
+                obj, cluster_obj, self.replicas_path, keep_rollout_settings
+            )
+        except Exception as e:
+            return self.record_error(cluster, FIELD_RETENTION_FAILED, str(e))
 
-        def run() -> None:
-            if is_explicitly_unmanaged(cluster_obj):
-                return self.record_error(
-                    cluster,
-                    MANAGED_LABEL_FALSE,
-                    f"object has label {C.MANAGED_LABEL}=false",
-                )
-            try:
-                obj = self._desired(cluster, mutable=True)
-            except Exception as e:
-                return self.record_error(cluster, COMPUTE_RESOURCE_FAILED, str(e))
-            try:
-                retain.retain_cluster_fields(self.fed.target_kind, obj, cluster_obj)
-                retain.retain_replicas(
-                    obj, cluster_obj, self.fed.obj, self.replicas_path
-                )
-                # No _set_last_replicaset_name here: _retain_template just
-                # forced the revision annotations equal, so the real
-                # update() path is where the last-RS marker gets written.
-                _retain_template(
-                    obj, cluster_obj, self.replicas_path, keep_rollout_settings
-                )
-            except Exception as e:
-                return self.record_error(cluster, FIELD_RETENTION_FAILED, str(e))
-
-            if recorded_version and not object_needs_update(
-                obj, cluster_obj, recorded_version, self.replicas_path
-            ):
-                self._record_version(cluster, recorded_version)
-                return
-            client = self.client_for_cluster(cluster)
-            try:
-                updated = client.update(self.resource, obj)
-            except Exception as e:
-                return self.record_error(cluster, UPDATE_FAILED, str(e))
-            self._resources_updated = True
-            self._record_version(cluster, object_version(updated))
-
-        self._submit(run)
+        if recorded_version and not object_needs_update(
+            obj, cluster_obj, recorded_version, self.replicas_path
+        ):
+            self._record_version(cluster, recorded_version)
+            return
+        self._sink.submit(
+            cluster,
+            {"verb": "update", "resource": self.resource, "object": obj},
+            self._update_done(cluster),
+        )
 
     def delete(self, cluster: str) -> None:
         """Delete from a member cluster (unmanaged.go Delete): the object
         stays WAITING_FOR_REMOVAL until the member confirms it gone."""
         self.record_status(cluster, DELETION_TIMED_OUT)
 
-        def run() -> None:
-            client = self.client_for_cluster(cluster)
-            try:
-                client.delete(self.resource, self.fed.key)
-            except NotFound:
+        def done(result: dict) -> None:
+            code = result.get("code")
+            if code == 404:
                 with self._lock:
                     self._status.pop(cluster, None)
                 return
-            except Exception as e:
-                return self.record_error(cluster, DELETION_FAILED, str(e))
+            if code != 200:
+                return self.record_error(cluster, DELETION_FAILED, _result_error(result))
             self._resources_updated = True
+            client = self.client_for_cluster(cluster)
             if client.try_get(self.resource, self.fed.key) is None:
                 with self._lock:
                     self._status.pop(cluster, None)
             else:
                 self.record_status(cluster, WAITING_FOR_REMOVAL)
 
-        self._submit(run)
+        self._sink.submit(
+            cluster,
+            {"verb": "delete", "resource": self.resource, "key": self.fed.key},
+            done,
+        )
 
     def remove_managed_label(self, cluster: str, cluster_obj: dict) -> None:
         """Orphaning: strip the managed label + adopted annotation instead
         of deleting (unmanaged.go RemoveManagedLabel)."""
         self.record_status(cluster, UPDATE_TIMED_OUT)
+        # Deep copy: cluster_obj may be a no-copy store VIEW, and a
+        # shallow dict() would mutate the store's nested metadata.
+        obj = copy_json(cluster_obj)
+        labels = obj.get("metadata", {}).get("labels", {})
+        labels.pop(C.MANAGED_LABEL, None)
+        obj.get("metadata", {}).get("annotations", {}).pop(ADOPTED_ANNOTATION, None)
 
-        def run() -> None:
-            # Deep copy: cluster_obj may be a no-copy store VIEW, and a
-            # shallow dict() would mutate the store's nested metadata.
-            obj = copy_json(cluster_obj)
-            labels = obj.get("metadata", {}).get("labels", {})
-            labels.pop(C.MANAGED_LABEL, None)
-            obj.get("metadata", {}).get("annotations", {}).pop(
-                ADOPTED_ANNOTATION, None
-            )
-            client = self.client_for_cluster(cluster)
-            try:
-                client.update(self.resource, obj)
-            except Exception as e:
-                return self.record_error(cluster, UPDATE_FAILED, str(e))
-            with self._lock:
-                self._status.pop(cluster, None)
+        def done(result: dict) -> None:
+            if result.get("code") == 200:
+                with self._lock:
+                    self._status.pop(cluster, None)
+            else:
+                self.record_error(cluster, UPDATE_FAILED, _result_error(result))
 
-        self._submit(run)
+        self._sink.submit(
+            cluster, {"verb": "update", "resource": self.resource, "object": obj}, done
+        )

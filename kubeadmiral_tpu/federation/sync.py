@@ -5,12 +5,23 @@ for each federated object, compute placement ∩ joined clusters, dispatch
 parallel create/update/delete against member apiservers, record per-
 cluster propagation status and object versions, and handle deletion with
 finalizers, orphaning annotations and cluster cascading-delete.
+
+Batching: where the reference runs one goroutine per federated object
+(worker.go:37-174) and one goroutine per member write
+(dispatch/operation.go:102-123), this controller is tick-native — a
+BatchWorker drains every due object, the whole tick shares one
+cluster-list scan and one cross-object :class:`dispatch.BatchSink`, and
+the flush issues ONE bulk write per member cluster.  Echoes of the
+controller's own writes (member events, fed status events) are
+suppressed at the watch boundary so a converged tick stays converged
+instead of re-reconciling itself forever.
 """
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
+from typing import Callable, Optional, Union
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation import dispatch as D
@@ -31,8 +42,9 @@ from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.runtime.eventsink import DefederatingRecorderMux
 from kubeadmiral_tpu.runtime import pending
 from kubeadmiral_tpu.runtime.metrics import Metrics
-from kubeadmiral_tpu.runtime.worker import Result, Worker
+from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
 from kubeadmiral_tpu.testing.fakekube import (
+    DELETED,
     ClusterFleet,
     Conflict,
     FakeKube,
@@ -80,6 +92,39 @@ def is_cascading_delete_enabled(cluster_obj: dict) -> bool:
     return CASCADING_DELETE in cluster_obj.get("metadata", {}).get("annotations", {})
 
 
+def _cluster_lifecycle_sig(cluster_obj: dict) -> tuple:
+    """What about a FederatedCluster makes sync re-reconcile the world:
+    join/ready/terminating/cascading transitions (controller.go:244-260
+    ClusterLifecycleHandlers) — NOT heartbeat timestamp bumps."""
+    return (
+        is_cluster_joined(cluster_obj),
+        is_cluster_ready(cluster_obj),
+        bool(cluster_obj["metadata"].get("deletionTimestamp")),
+        is_cascading_delete_enabled(cluster_obj),
+    )
+
+
+class _TickClusters:
+    """One tick's shared view of the member fleet: the cluster list is
+    scanned ONCE per BatchWorker tick instead of once per object."""
+
+    __slots__ = ("rows", "names")
+
+    def __init__(self, joined: list[dict]):
+        # (name, ready, terminating, cascading) per joined cluster.
+        self.rows = [
+            (
+                c["metadata"]["name"],
+                is_cluster_ready(c),
+                bool(c["metadata"].get("deletionTimestamp")),
+                bool(c["metadata"].get("deletionTimestamp"))
+                and is_cascading_delete_enabled(c),
+            )
+            for c in joined
+        ]
+        self.names = [r[0] for r in self.rows]
+
+
 class SyncController:
     """Per-FTC propagation controller (sync/controller.go:90-135)."""
 
@@ -114,9 +159,20 @@ class SyncController:
             if self._inline
             else ThreadPoolExecutor(max_workers=max_dispatch_workers)
         )
-        self.worker = Worker(
-            f"sync-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        self.worker = BatchWorker(
+            f"sync-{ftc.name}", self.reconcile_batch, metrics=self.metrics, clock=clock
         )
+        # Echo suppression: the thread currently inside reconcile_batch
+        # (in-process stores deliver watch events synchronously on the
+        # writer's thread — any event arriving on it mid-tick was caused
+        # by this controller's own write), plus resourceVersion maps of
+        # this controller's last writes for async transports.
+        self._tick_thread: Optional[int] = None
+        self._own_member_rv: dict[tuple[str, str], str] = {}
+        self._own_fed_rv: dict[str, str] = {}
+        # Last seen lifecycle signature per cluster, so heartbeat-only
+        # cluster updates don't re-enqueue every federated object.
+        self._cluster_sigs: dict[str, tuple] = {}
         # Per-FTC cascading-delete finalizer held on FederatedCluster
         # objects (controller.go:216 cascadingDeleteFinalizer).
         self.cluster_finalizer = C.PREFIX + "cascading-delete-" + ftc.name
@@ -126,7 +182,7 @@ class SyncController:
         # Attached before the cluster watch: its replay fires
         # _on_cluster_event, which re-attaches members, synchronously.
         self._reattach_members = fleet.watch_members(
-            self._target_resource, self._on_member_event
+            self._target_resource, self._on_member_event, named=True
         )
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=True)
@@ -140,17 +196,60 @@ class SyncController:
         return owners
 
     # -- event fan-in ----------------------------------------------------
-    def _on_fed_event(self, event: str, obj: dict) -> None:
-        self.worker.enqueue(obj_key(obj))
+    def _is_own_echo(self) -> bool:
+        return threading.get_ident() == self._tick_thread
 
-    def _on_member_event(self, event: str, obj: dict) -> None:
-        self.worker.enqueue(obj_key(obj))
+    def _on_fed_event(self, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        if event == DELETED:
+            # Cleanup before the echo check: inline deletions deliver
+            # their DELETED event on the tick thread, and the rv entry
+            # must not outlive the object.
+            self._own_fed_rv.pop(key, None)
+            if self._is_own_echo():
+                return
+        elif self._is_own_echo() or self._own_fed_rv.get(key) == str(
+            obj.get("metadata", {}).get("resourceVersion", "")
+        ):
+            return  # our own status/annotation write coming back around
+        self.worker.enqueue(key)
+
+    def _on_member_event(self, cluster: str, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        if event == DELETED:
+            self._own_member_rv.pop((cluster, key), None)
+            if self._is_own_echo():
+                return
+        elif self._is_own_echo() or self._own_member_rv.get((cluster, key)) == str(
+            obj.get("metadata", {}).get("resourceVersion", "")
+        ):
+            return  # echo of our own member write
+        self.worker.enqueue(key)
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         # Cluster lifecycle re-enqueues everything (controller.go:244-260)
-        # and reconciles the per-cluster cascading-delete finalizer.
+        # and reconciles the per-cluster cascading-delete finalizer —
+        # but only on join/ready/terminating transitions, not heartbeats,
+        # and never for this controller's own finalizer writes.
+        if self._is_own_echo():
+            return
+        name = obj["metadata"]["name"]
+        if event == DELETED:
+            self._cluster_sigs.pop(name, None)
+            self.worker.enqueue_all(self.host.keys(self._fed_resource))
+            return
+        sig = _cluster_lifecycle_sig(obj)
+        if self._cluster_sigs.get(name) == sig:
+            # Heartbeat / unrelated metadata bump: no object re-enqueue,
+            # but give the member-watch attach loop its retry channel —
+            # a network fleet may have failed a cluster's attach (join
+            # secret not yet readable) after the signature stabilized.
+            if getattr(self._reattach_members, "pending", None):
+                self._reattach_members()
+            return
+        self._cluster_sigs[name] = sig
         self._reattach_members()
-        self.worker.enqueue(_CLUSTER_KEY_PREFIX + obj["metadata"]["name"])
+        self.worker.enqueue(_CLUSTER_KEY_PREFIX + name)
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
     def _member_client(self, cluster: str) -> FakeKube:
@@ -168,8 +267,66 @@ class SyncController:
 
     # -- reconcile -------------------------------------------------------
     def reconcile(self, key: str) -> Result:
-        if key.startswith(_CLUSTER_KEY_PREFIX):
-            return self._reconcile_cluster(key[len(_CLUSTER_KEY_PREFIX) :])
+        """Single-key compatibility entry: one tick over one key."""
+        return self.reconcile_batch([key])[key]
+
+    def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
+        """One tick: every due key planned against ONE cluster-list scan,
+        member writes staged into ONE BatchSink, flushed as one bulk
+        write per member, then per-object status finalized."""
+        results: dict[str, Result] = {}
+        self._tick_thread = threading.get_ident()
+        try:
+            fed_keys: list[str] = []
+            for key in keys:
+                if key.startswith(_CLUSTER_KEY_PREFIX):
+                    results[key] = self._reconcile_cluster(
+                        key[len(_CLUSTER_KEY_PREFIX) :]
+                    )
+                else:
+                    fed_keys.append(key)
+            if not fed_keys:
+                return results
+            ctx = _TickClusters(
+                [
+                    c
+                    for c in self.host.list_view(FEDERATED_CLUSTERS)
+                    if is_cluster_joined(c)
+                ]
+            )
+            sink = D.BatchSink(self._member_client, pool=self.pool)
+            finishers: list[tuple[str, Callable[[], Result]]] = []
+            for key in fed_keys:
+                # Per-key isolation: one poison object backs off alone
+                # (worker.go:119-131 semantics), the rest of the tick
+                # proceeds and still flushes.
+                try:
+                    out = self._plan_one(key, ctx, sink)
+                except Exception:
+                    self.metrics.counter(f"sync-{self.ftc.name}.plan_panic")
+                    results[key] = Result.retry()
+                    continue
+                if isinstance(out, Result):
+                    results[key] = out
+                else:
+                    finishers.append((key, out))
+            sink.flush()
+            for key, finish in finishers:
+                try:
+                    results[key] = finish()
+                except Exception:
+                    self.metrics.counter(f"sync-{self.ftc.name}.finish_panic")
+                    results[key] = Result.retry()
+        finally:
+            self._tick_thread = None
+        return results
+
+    def _plan_one(
+        self, key: str, ctx: _TickClusters, sink: D.BatchSink
+    ) -> Union[Result, Callable[[], Result]]:
+        """Everything up to (and including) staging one object's member
+        writes; returns a finisher to run after the sink flushes, or a
+        settled Result for the early-exit paths."""
         fed_obj = self.host.try_get(self._fed_resource, key)
         if fed_obj is None:
             return Result.ok()
@@ -218,8 +375,14 @@ class SyncController:
                 fed_obj["metadata"]["resourceVersion"] = updated["metadata"][
                     "resourceVersion"
                 ]
+                self._record_own_fed(updated)
 
-        return self._sync_to_clusters(fed, collision_count)
+        return self._sync_to_clusters(fed, collision_count, ctx, sink)
+
+    def _record_own_fed(self, obj: dict) -> None:
+        self._own_fed_rv[obj_key(obj)] = str(
+            obj.get("metadata", {}).get("resourceVersion", "")
+        )
 
     # -- cluster cascading-delete finalizer (controller.go:1050-1196) ----
     def _reconcile_cluster(self, name: str) -> Result:
@@ -286,16 +449,18 @@ class SyncController:
         except NotFound:
             return None
         fed_obj["metadata"]["resourceVersion"] = updated["metadata"]["resourceVersion"]
+        self._record_own_fed(updated)
         return fed_obj
 
     # -- the propagation round (controller.go:425-596) -------------------
     def _sync_to_clusters(
-        self, fed: FederatedResource, collision_count: Optional[int] = None
-    ) -> Result:
-        # list_view: read-only fan-out, no mutation/retention of the dicts.
-        clusters = self.host.list_view(FEDERATED_CLUSTERS)
-        joined = [c for c in clusters if is_cluster_joined(c)]
-        selected = fed.compute_placement([c["metadata"]["name"] for c in joined])
+        self,
+        fed: FederatedResource,
+        collision_count: Optional[int],
+        ctx: _TickClusters,
+        sink: D.BatchSink,
+    ) -> Callable[[], Result]:
+        selected = fed.compute_placement(ctx.names)
 
         recorded = self.versions.get(
             fed.namespace, fed.name, fed.template_version(), fed.override_version()
@@ -312,14 +477,18 @@ class SyncController:
             and not fed.obj.get("spec", {}).get("retainReplicas")
         )
         plans_holder: dict[str, R.RolloutPlan] = {}
+        fed_key = fed.key
         dispatcher = D.ManagedDispatcher(
             self._member_client,
             fed,
             self._target_resource,
             replicas_path=self.ftc.path.replicas_spec,
             skip_adopting=not should_adopt_preexisting(fed.obj),
-            pool=self.pool,
-            inline=self._inline,
+            sink=sink,
+            on_written=lambda cluster, obj: self._own_member_rv.__setitem__(
+                (cluster, fed_key),
+                str(obj.get("metadata", {}).get("resourceVersion", "")),
+            ),
             rollout_overrides=(
                 (
                     lambda c: plans_holder[c].to_overrides()
@@ -334,13 +503,10 @@ class SyncController:
         # deferred until after rollout planning.
         rollout_ops: list[tuple[str, Optional[dict], bool, bool]] = []
 
-        for cluster in joined:
-            cname = cluster["metadata"]["name"]
-            terminating = bool(cluster["metadata"].get("deletionTimestamp"))
-            cascading = terminating and is_cascading_delete_enabled(cluster)
+        for cname, ready, terminating, cascading in ctx.rows:
             should_be_deleted = cname not in selected or cascading
 
-            if not is_cluster_ready(cluster):
+            if not ready:
                 if not should_be_deleted:
                     dispatcher.record_error(
                         cname, D.CLUSTER_NOT_READY, "cluster not ready"
@@ -425,48 +591,53 @@ class SyncController:
                     continue
                 dispatcher.update(cname, cluster_obj, version)
 
-        ok = dispatcher.wait()
+        def finish() -> Result:
+            """Runs after the tick's sink flushes: status/version
+            bookkeeping over the completed dispatch round."""
+            ok = dispatcher.wait()
 
-        # Record versions (an optimization; failures tolerated —
-        # controller.go:568-576).
-        self.versions.update(
-            fed.namespace,
-            fed.name,
-            fed.template_version(),
-            fed.override_version(),
-            sorted(selected),
-            dispatcher.version_map,
-        )
+            # Record versions (an optimization; failures tolerated —
+            # controller.go:568-576).
+            self.versions.update(
+                fed.namespace,
+                fed.name,
+                fed.template_version(),
+                fed.override_version(),
+                sorted(selected),
+                dispatcher.version_map,
+            )
 
-        status_map = dispatcher.status_map
-        reason = AGGREGATE_SUCCESS if ok else CHECK_CLUSTERS
-        if not ok:
-            failed = sorted(
-                c for c, s in status_map.items()
-                if s not in (D.OK, D.WAITING, D.WAITING_FOR_REMOVAL)
+            status_map = dispatcher.status_map
+            reason = AGGREGATE_SUCCESS if ok else CHECK_CLUSTERS
+            if not ok:
+                failed = sorted(
+                    c for c, s in status_map.items()
+                    if s not in (D.OK, D.WAITING, D.WAITING_FOR_REMOVAL)
+                )
+                self.recorder.event(
+                    fed.obj,
+                    "Warning",
+                    "PropagationFailed",
+                    f"failed clusters: {', '.join(failed)}",
+                )
+            status_result = self._set_federated_status(
+                fed, reason, status_map, collision_count
             )
-            self.recorder.event(
-                fed.obj,
-                "Warning",
-                "PropagationFailed",
-                f"failed clusters: {', '.join(failed)}",
-            )
-        status_result = self._set_federated_status(
-            fed, reason, status_map, collision_count
-        )
-        if not status_result.success:
-            return status_result
-        # The syncing feedback annotation is a separate (non-status)
-        # write: UpdateStatus ignores annotations (controller.go:686-718).
-        self._set_syncing_annotation(fed, status_map)
-        if not ok:
-            return Result.retry()
-        if D.WAITING_FOR_REMOVAL in status_map.values():
-            # A member object is finalizer-gated mid-removal; no host
-            # event will fire when it finishes, so revisit on a timer
-            # (controller.go recheckAfterDispatchDelay).
-            return Result.after(10.0)
-        return Result.ok()
+            if not status_result.success:
+                return status_result
+            # The syncing feedback annotation is a separate (non-status)
+            # write: UpdateStatus ignores annotations (controller.go:686-718).
+            self._set_syncing_annotation(fed, status_map)
+            if not ok:
+                return Result.retry()
+            if D.WAITING_FOR_REMOVAL in status_map.values():
+                # A member object is finalizer-gated mid-removal; no host
+                # event will fire when it finishes, so revisit on a timer
+                # (controller.go recheckAfterDispatchDelay).
+                return Result.after(10.0)
+            return Result.ok()
+
+        return finish
 
     def _plan_rollout(
         self,
@@ -562,7 +733,9 @@ class SyncController:
                 c for t, c in sorted(old_conditions.items()) if t != "Propagation"
             ] + [{"type": "Propagation", "status": new_status, "reason": reason}]
             try:
-                self.host.update_status(self._fed_resource, obj)
+                updated = self.host.update_status(self._fed_resource, obj)
+                if isinstance(updated, dict):
+                    self._record_own_fed(updated)
                 return Result.ok()
             except NotFound:
                 return Result.ok()
@@ -607,7 +780,9 @@ class SyncController:
                 return
             ann[C.SOURCE_FEEDBACK_SYNCING] = syncing
             try:
-                self.host.update(self._fed_resource, obj)
+                updated = self.host.update(self._fed_resource, obj)
+                if isinstance(updated, dict):
+                    self._record_own_fed(updated)
                 return
             except NotFound:
                 return

@@ -66,29 +66,30 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
-def build(force: bool = False) -> bool:
-    """Compile the shared library; True on success.  The output lands in
-    a temp file first and is renamed into place, so concurrent builders
-    (parallel test workers, several controller processes) never dlopen a
-    half-written library."""
-    if (
-        os.path.exists(LIBRARY)
-        and not force
-        and all(
-            os.path.getmtime(LIBRARY) >= os.path.getmtime(src)
-            for src in SOURCES
-        )
+def _compile(sources: list[str], library: str, extra_flags: list[str]) -> bool:
+    """Compile ``sources`` into ``library`` when the sources are newer;
+    True when a usable library is in place afterwards.  The output lands
+    in a temp file first and is renamed into place, so concurrent
+    builders (parallel test workers, several controller processes) never
+    dlopen a half-written library.  A prebuilt library with no sources
+    on disk (a packaged install) is accepted as-is."""
+    present = [src for src in sources if os.path.exists(src)]
+    if os.path.exists(library) and (
+        not present
+        or all(os.path.getmtime(library) >= os.path.getmtime(src) for src in present)
     ):
         return True
-    tmp = f"{LIBRARY}.{os.getpid()}.tmp"
+    if len(present) != len(sources):
+        return False  # stale/no library and sources incomplete
+    tmp = f"{library}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, *SOURCES],
+            ["g++", "-O3", "-shared", "-fPIC", *extra_flags, "-o", tmp, *sources],
             check=True,
             capture_output=True,
             timeout=120,
         )
-        os.replace(tmp, LIBRARY)
+        os.replace(tmp, library)
         return True
     except Exception:
         try:
@@ -96,6 +97,56 @@ def build(force: bool = False) -> bool:
         except OSError:
             pass
         return False
+
+
+def build(force: bool = False) -> bool:
+    """Compile the ctypes hot-path library; True on success."""
+    if force:
+        try:
+            os.unlink(LIBRARY)
+        except OSError:
+            pass
+    return _compile(SOURCES, LIBRARY, [])
+
+
+FASTCOPY_SOURCE = os.path.join(_DIR, "fastcopy.cpp")
+FASTCOPY_LIBRARY = os.path.join(_DIR, "_kadmfastcopy.so")
+
+_fastcopy_mod = None
+_fastcopy_failed = False
+
+
+def load_fastcopy():
+    """Build (if needed) and import the _kadmfastcopy CPython extension;
+    returns its ``copy`` callable, or None when no toolchain/headers are
+    available — callers fall back to the pure-Python copier."""
+    global _fastcopy_mod, _fastcopy_failed
+    if _fastcopy_mod is not None or _fastcopy_failed:
+        return getattr(_fastcopy_mod, "copy", None)
+    with _lock:
+        if _fastcopy_mod is not None or _fastcopy_failed:
+            return getattr(_fastcopy_mod, "copy", None)
+        try:
+            import sysconfig
+
+            include = sysconfig.get_paths()["include"]
+            if not _compile(
+                [FASTCOPY_SOURCE], FASTCOPY_LIBRARY, [f"-I{include}"]
+            ):
+                _fastcopy_failed = True
+                return None
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "_kadmfastcopy", FASTCOPY_LIBRARY
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _fastcopy_mod = mod
+        except Exception:
+            _fastcopy_failed = True
+            return None
+    return _fastcopy_mod.copy
 
 
 def load() -> Optional[ctypes.CDLL]:

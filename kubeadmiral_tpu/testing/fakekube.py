@@ -15,6 +15,7 @@ configs).
 
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Callable, Iterable, Optional
 
@@ -418,17 +419,24 @@ class ClusterFleet:
             fleet.members[name] = FakeKube.restore(member_snap)
         return fleet
 
-    def watch_members(self, resource: str, handler: Handler) -> Callable[[], None]:
+    def watch_members(
+        self, resource: str, handler: Handler, named: bool = False
+    ) -> Callable[[], None]:
         """Watch ``resource`` in every current member and return a
         re-attach callable for members added later — the
-        FederatedInformer lifecycle (federatedinformer.go:151-250)."""
+        FederatedInformer lifecycle (federatedinformer.go:151-250).
+        With ``named``, the handler receives ``(cluster, event, obj)``."""
         attached: set[str] = set()
 
         def attach() -> None:
             for name, kube in list(self.members.items()):
                 if name not in attached:
                     attached.add(name)
-                    kube.watch(resource, handler, replay=False)
+                    kube.watch(
+                        resource,
+                        functools.partial(handler, name) if named else handler,
+                        replay=False,
+                    )
 
         attach()
         return attach

@@ -19,6 +19,7 @@ FederatedCluster ``spec.apiEndpoint`` + the join secret's token
 
 from __future__ import annotations
 
+import functools
 import http.client
 import json
 import logging
@@ -457,10 +458,13 @@ class HttpFleet:
         for client in self.members.values():
             client.unwatch_owner(owner)
 
-    def watch_members(self, resource: str, handler: Handler) -> Callable[[], None]:
+    def watch_members(
+        self, resource: str, handler: Handler, named: bool = False
+    ) -> Callable[[], None]:
         attached: set[str] = set()
 
         def attach() -> None:
+            pending: set[str] = set()
             for cluster in self.host.list(C.FEDERATED_CLUSTERS):
                 name = cluster["metadata"]["name"]
                 if name in attached:
@@ -468,11 +472,21 @@ class HttpFleet:
                 try:
                     client = self.factory.client_for(cluster)
                 except NotFound:
-                    continue  # not joined yet; reattached on next event
+                    # Not joined yet (join secret unreadable); surfaced
+                    # via attach.pending so watchers keep retrying even
+                    # after the cluster's lifecycle state stabilizes.
+                    pending.add(name)
+                    continue
                 attached.add(name)
                 self.members[name] = client
-                client.watch(resource, handler, replay=False)
+                client.watch(
+                    resource,
+                    functools.partial(handler, name) if named else handler,
+                    replay=False,
+                )
+            attach.pending = pending
 
+        attach.pending = set()
         attach()
         return attach
 

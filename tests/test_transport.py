@@ -6,6 +6,7 @@ label-selector lists, LIST+WATCH with resourceVersion resume and 410
 relist, bearer-token auth with service-account token minting.
 """
 
+import json
 import threading
 import time
 
