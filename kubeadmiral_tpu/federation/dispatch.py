@@ -135,11 +135,18 @@ class BatchSink:
         self,
         client_for_cluster: Callable[[str], FakeKube],
         pool: Optional[ThreadPoolExecutor] = None,
+        thread_registry: Optional[set] = None,
     ):
         self.client_for_cluster = client_for_cluster
         self._pool = pool
         self._staged: dict[str, list[tuple[dict, Callable[[dict], None]]]] = {}
         self.flushed = True
+        # Threads currently executing this sink's writes.  In-process
+        # member stores deliver watch events synchronously on the writing
+        # thread, so the owning controller treats events on these threads
+        # as echoes of its own writes (the pool-flush analogue of the
+        # tick-thread check).
+        self.thread_registry = thread_registry if thread_registry is not None else set()
 
     def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
         self._staged.setdefault(cluster, []).append((op, continuation))
@@ -155,6 +162,7 @@ class BatchSink:
             return
 
         def flush_cluster(cluster: str, entries: list) -> None:
+            self.thread_registry.add(threading.get_ident())
             try:
                 client = self.client_for_cluster(cluster)
                 results = client.batch([op for op, _ in entries])
@@ -189,6 +197,7 @@ class BatchSink:
         else:
             for cluster, entries in staged.items():
                 flush_cluster(cluster, entries)
+        self.thread_registry.clear()
 
     def wait(self, timeout: float) -> None:
         # Dispatchers sharing this sink call wait() after the controller
@@ -358,7 +367,10 @@ class ManagedDispatcher:
         mutate the object in place)."""
         extra = self.rollout_overrides(cluster) if self.rollout_overrides else None
         patches = self.fed._ordered_overrides().get(cluster) or ()
-        key = json.dumps([patches, extra], sort_keys=True, default=str)
+        if not patches and not extra:
+            key = ""  # the common no-override case skips key serialization
+        else:
+            key = json.dumps([patches, extra], sort_keys=True, default=str)
         with self._lock:
             obj = self._desired_cache.get(key)
         if obj is None:

@@ -50,11 +50,14 @@ class FederatedResource:
         return self.ftc.source.kind
 
     # -- placement -------------------------------------------------------
-    def compute_placement(self, joined_clusters: list[str]) -> set[str]:
+    def compute_placement(self, joined_clusters) -> set[str]:
         """Union of placements across controllers ∩ joined clusters
-        (resource.go ComputePlacement + placement.go union)."""
+        (resource.go ComputePlacement + placement.go union).  Accepts a
+        prebuilt set to keep per-object work off the O(fleet) path."""
         placed = C.all_placement_clusters(self.obj)
-        return placed & set(joined_clusters)
+        if not isinstance(joined_clusters, (set, frozenset)):
+            joined_clusters = set(joined_clusters)
+        return placed & joined_clusters
 
     # -- per-cluster desired object --------------------------------------
     def object_for_cluster(self, cluster: str) -> dict:

@@ -214,10 +214,39 @@ _KIND_RETAINERS = {
 }
 
 
-def retain_cluster_fields(kind: str, desired: dict, cluster_obj: dict) -> None:
+def _retain_whole_status(desired: dict, cluster_obj: dict) -> None:
+    """Keep the member-written ``status`` in the desired object.  For
+    kinds whose status is NOT a subresource an update would wipe it;
+    the member (e.g. the Argo workflow-controller) owns it
+    (retain.go:624-636 retainArgoWorkflow)."""
+    if "status" in cluster_obj:
+        desired["status"] = cluster_obj["status"]
+    else:
+        desired.pop("status", None)
+
+
+# Per-GVK retention registry — the "extensible framework to support
+# CRDs" the reference leaves as a TODO (retain.go:89-91): CRDs register
+# an apiVersion/Kind-keyed retainer; the Argo Workflow rule ships as the
+# built-in precedent.
+_GVK_RETAINERS: dict[str, callable] = {
+    "argoproj.io/v1alpha1/Workflow": _retain_whole_status,
+}
+
+
+def register_gvk_retainer(gvk: str, retainer) -> None:
+    """Register a CRD retention rule keyed by "group/version/Kind";
+    called as retainer(desired, cluster_obj) after the generic pass."""
+    _GVK_RETAINERS[gvk] = retainer
+
+
+def retain_cluster_fields(
+    kind: str, desired: dict, cluster_obj: dict, gvk: str = ""
+) -> None:
     """The dispatcher's pre-update pass (retain.go:49-97): resourceVersion
     + finalizers from the cluster object, tombstoned label/annotation
-    merge, then kind-specific rules."""
+    merge, then kind-specific rules, then any registered per-GVK CRD rule
+    (retain.go:88-94; Workflow built in)."""
     meta = desired.setdefault("metadata", {})
     meta["resourceVersion"] = cluster_obj.get("metadata", {}).get("resourceVersion")
     finalizers = cluster_obj.get("metadata", {}).get("finalizers")
@@ -229,6 +258,9 @@ def retain_cluster_fields(kind: str, desired: dict, cluster_obj: dict) -> None:
     retainer = _KIND_RETAINERS.get(kind)
     if retainer is not None:
         retainer(desired, cluster_obj)
+    gvk_retainer = _GVK_RETAINERS.get(gvk or desired.get("apiVersion", "") + "/" + kind)
+    if gvk_retainer is not None:
+        gvk_retainer(desired, cluster_obj)
 
 
 def retain_replicas(

@@ -106,23 +106,23 @@ def _cluster_lifecycle_sig(cluster_obj: dict) -> tuple:
 
 class _TickClusters:
     """One tick's shared view of the member fleet: the cluster list is
-    scanned ONCE per BatchWorker tick instead of once per object."""
+    scanned ONCE per BatchWorker tick instead of once per object, and
+    per-object work is O(candidate clusters), not O(all clusters)."""
 
-    __slots__ = ("rows", "names")
+    __slots__ = ("flags", "joined_set")
 
     def __init__(self, joined: list[dict]):
-        # (name, ready, terminating, cascading) per joined cluster.
-        self.rows = [
-            (
-                c["metadata"]["name"],
+        # name -> (ready, terminating, cascading) per joined cluster.
+        self.flags = {
+            c["metadata"]["name"]: (
                 is_cluster_ready(c),
                 bool(c["metadata"].get("deletionTimestamp")),
                 bool(c["metadata"].get("deletionTimestamp"))
                 and is_cascading_delete_enabled(c),
             )
             for c in joined
-        ]
-        self.names = [r[0] for r in self.rows]
+        }
+        self.joined_set = frozenset(self.flags)
 
 
 class SyncController:
@@ -168,8 +168,17 @@ class SyncController:
         # by this controller's own write), plus resourceVersion maps of
         # this controller's last writes for async transports.
         self._tick_thread: Optional[int] = None
+        self._flush_threads: set[int] = set()
         self._own_member_rv: dict[tuple[str, str], str] = {}
         self._own_fed_rv: dict[str, str] = {}
+        # Live index of which member clusters hold each object (fed by
+        # the member watches + this controller's own writes) — the
+        # informer-cache analogue that lets a reconcile visit only
+        # candidate clusters instead of scanning the whole fleet.  It is
+        # an accelerator, not the source of truth: restart-safe deletion
+        # candidates come from the fed object's persisted status.clusters.
+        self._member_index: dict[str, set[str]] = {}
+        self._index_lock = threading.Lock()
         # Last seen lifecycle signature per cluster, so heartbeat-only
         # cluster updates don't re-enqueue every federated object.
         self._cluster_sigs: dict[str, tuple] = {}
@@ -181,8 +190,13 @@ class SyncController:
         # particular must observe member progress between dispatches.
         # Attached before the cluster watch: its replay fires
         # _on_cluster_event, which re-attaches members, synchronously.
+        # replay=True: existing member objects stream through the handler
+        # at attach, populating the member index — the informer's initial
+        # LIST, without which pre-existing managed objects in clusters
+        # outside the current placement would never be visited for
+        # cleanup (federatedinformer.go:151-250).
         self._reattach_members = fleet.watch_members(
-            self._target_resource, self._on_member_event, named=True
+            self._target_resource, self._on_member_event, named=True, replay=True
         )
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=True)
@@ -197,7 +211,8 @@ class SyncController:
 
     # -- event fan-in ----------------------------------------------------
     def _is_own_echo(self) -> bool:
-        return threading.get_ident() == self._tick_thread
+        ident = threading.get_ident()
+        return ident == self._tick_thread or ident in self._flush_threads
 
     def _on_fed_event(self, event: str, obj: dict) -> None:
         key = obj_key(obj)
@@ -216,14 +231,24 @@ class SyncController:
 
     def _on_member_event(self, cluster: str, event: str, obj: dict) -> None:
         key = obj_key(obj)
+        # Index maintenance runs for EVERY event, echoes included.
         if event == DELETED:
+            with self._index_lock:
+                held = self._member_index.get(key)
+                if held is not None:
+                    held.discard(cluster)
+                    if not held:
+                        self._member_index.pop(key, None)
             self._own_member_rv.pop((cluster, key), None)
             if self._is_own_echo():
                 return
-        elif self._is_own_echo() or self._own_member_rv.get((cluster, key)) == str(
-            obj.get("metadata", {}).get("resourceVersion", "")
-        ):
-            return  # echo of our own member write
+        else:
+            with self._index_lock:
+                self._member_index.setdefault(key, set()).add(cluster)
+            if self._is_own_echo() or self._own_member_rv.get((cluster, key)) == str(
+                obj.get("metadata", {}).get("resourceVersion", "")
+            ):
+                return  # echo of our own member write
         self.worker.enqueue(key)
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
@@ -294,7 +319,11 @@ class SyncController:
                     if is_cluster_joined(c)
                 ]
             )
-            sink = D.BatchSink(self._member_client, pool=self.pool)
+            sink = D.BatchSink(
+                self._member_client,
+                pool=self.pool,
+                thread_registry=self._flush_threads,
+            )
             finishers: list[tuple[str, Callable[[], Result]]] = []
             for key in fed_keys:
                 # Per-key isolation: one poison object backs off alone
@@ -343,8 +372,13 @@ class SyncController:
         except KeyError:
             return Result.ok()  # not initialized by federate yet
 
-        if self._ensure_finalizer(fed_obj) is None:
-            return Result.retry()  # conflict adding finalizer
+        # Pre-dispatch metadata: the sync finalizer (MUST be persisted
+        # before any member write — controller.go:389-397) and the
+        # revision annotations land in ONE host update instead of two.
+        fins = fed_obj["metadata"].setdefault("finalizers", [])
+        dirty = C.SYNC_FINALIZER not in fins
+        if dirty:
+            fins.append(C.SYNC_FINALIZER)
 
         collision_count = None
         if self.revisions is not None:
@@ -357,7 +391,6 @@ class SyncController:
             except RevisionSyncError:
                 return Result.retry()
             ann = fed_obj["metadata"].setdefault("annotations", {})
-            dirty = False
             for key_, value in (
                 (LAST_REVISION_ANNOTATION, last_rev),
                 (CURRENT_REVISION_ANNOTATION, current_rev),
@@ -365,17 +398,17 @@ class SyncController:
                 if value and ann.get(key_) != value:
                     ann[key_] = value
                     dirty = True
-            if dirty:
-                try:
-                    updated = self.host.update(self._fed_resource, fed_obj)
-                except Conflict:
-                    return Result.retry()
-                except NotFound:
-                    return Result.ok()
-                fed_obj["metadata"]["resourceVersion"] = updated["metadata"][
-                    "resourceVersion"
-                ]
-                self._record_own_fed(updated)
+        if dirty:
+            try:
+                updated = self.host.update(self._fed_resource, fed_obj)
+            except Conflict:
+                return Result.retry()
+            except NotFound:
+                return Result.ok()
+            fed_obj["metadata"]["resourceVersion"] = updated["metadata"][
+                "resourceVersion"
+            ]
+            self._record_own_fed(updated)
 
         return self._sync_to_clusters(fed, collision_count, ctx, sink)
 
@@ -437,21 +470,6 @@ class SyncController:
             pass
         return Result.ok()
 
-    def _ensure_finalizer(self, fed_obj: dict) -> Optional[dict]:
-        fins = fed_obj["metadata"].setdefault("finalizers", [])
-        if C.SYNC_FINALIZER in fins:
-            return fed_obj
-        fins.append(C.SYNC_FINALIZER)
-        try:
-            updated = self.host.update(self._fed_resource, fed_obj)
-        except Conflict:
-            return None
-        except NotFound:
-            return None
-        fed_obj["metadata"]["resourceVersion"] = updated["metadata"]["resourceVersion"]
-        self._record_own_fed(updated)
-        return fed_obj
-
     # -- the propagation round (controller.go:425-596) -------------------
     def _sync_to_clusters(
         self,
@@ -460,7 +478,7 @@ class SyncController:
         ctx: _TickClusters,
         sink: D.BatchSink,
     ) -> Callable[[], Result]:
-        selected = fed.compute_placement(ctx.names)
+        selected = fed.compute_placement(ctx.joined_set)
 
         recorded = self.versions.get(
             fed.namespace, fed.name, fed.template_version(), fed.override_version()
@@ -478,6 +496,14 @@ class SyncController:
         )
         plans_holder: dict[str, R.RolloutPlan] = {}
         fed_key = fed.key
+
+        def on_written(cluster: str, obj: dict) -> None:
+            self._own_member_rv[(cluster, fed_key)] = str(
+                obj.get("metadata", {}).get("resourceVersion", "")
+            )
+            with self._index_lock:
+                self._member_index.setdefault(fed_key, set()).add(cluster)
+
         dispatcher = D.ManagedDispatcher(
             self._member_client,
             fed,
@@ -485,10 +511,7 @@ class SyncController:
             replicas_path=self.ftc.path.replicas_spec,
             skip_adopting=not should_adopt_preexisting(fed.obj),
             sink=sink,
-            on_written=lambda cluster, obj: self._own_member_rv.__setitem__(
-                (cluster, fed_key),
-                str(obj.get("metadata", {}).get("resourceVersion", "")),
-            ),
+            on_written=on_written,
             rollout_overrides=(
                 (
                     lambda c: plans_holder[c].to_overrides()
@@ -503,7 +526,25 @@ class SyncController:
         # deferred until after rollout planning.
         rollout_ops: list[tuple[str, Optional[dict], bool, bool]] = []
 
-        for cname, ready, terminating, cascading in ctx.rows:
+        # Candidate clusters — O(selected + previously-placed), not
+        # O(fleet): selected placements, clusters named in the object's
+        # persisted propagation status (the durable record of where it
+        # was last dispatched, surviving restarts and template-version
+        # bumps that invalidate the version record), and the live member
+        # index (foreign-created managed objects seen by the watches).
+        candidates = set(selected)
+        for entry in fed.obj.get("status", {}).get("clusters", ()):
+            cname = entry.get("cluster")
+            if cname:
+                candidates.add(cname)
+        with self._index_lock:
+            candidates.update(self._member_index.get(fed_key, ()))
+
+        for cname in sorted(candidates):
+            flags = ctx.flags.get(cname)
+            if flags is None:
+                continue  # not a joined cluster (or left the federation)
+            ready, terminating, cascading = flags
             should_be_deleted = cname not in selected or cascading
 
             if not ready:

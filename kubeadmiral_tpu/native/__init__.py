@@ -66,7 +66,9 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
-def _compile(sources: list[str], library: str, extra_flags: list[str]) -> bool:
+def _compile(
+    sources: list[str], library: str, extra_flags: list[str], force: bool = False
+) -> bool:
     """Compile ``sources`` into ``library`` when the sources are newer;
     True when a usable library is in place afterwards.  The output lands
     in a temp file first and is renamed into place, so concurrent
@@ -74,7 +76,7 @@ def _compile(sources: list[str], library: str, extra_flags: list[str]) -> bool:
     dlopen a half-written library.  A prebuilt library with no sources
     on disk (a packaged install) is accepted as-is."""
     present = [src for src in sources if os.path.exists(src)]
-    if os.path.exists(library) and (
+    if not force and os.path.exists(library) and (
         not present
         or all(os.path.getmtime(library) >= os.path.getmtime(src) for src in present)
     ):
@@ -100,13 +102,10 @@ def _compile(sources: list[str], library: str, extra_flags: list[str]) -> bool:
 
 
 def build(force: bool = False) -> bool:
-    """Compile the ctypes hot-path library; True on success."""
-    if force:
-        try:
-            os.unlink(LIBRARY)
-        except OSError:
-            pass
-    return _compile(SOURCES, LIBRARY, [])
+    """Compile the ctypes hot-path library; True on success.  A forced
+    rebuild still goes through the tmp+rename path, so a failed compile
+    leaves the previous working library in place."""
+    return _compile(SOURCES, LIBRARY, [], force=force)
 
 
 FASTCOPY_SOURCE = os.path.join(_DIR, "fastcopy.cpp")

@@ -420,12 +420,15 @@ class ClusterFleet:
         return fleet
 
     def watch_members(
-        self, resource: str, handler: Handler, named: bool = False
+        self, resource: str, handler: Handler, named: bool = False,
+        replay: bool = False,
     ) -> Callable[[], None]:
         """Watch ``resource`` in every current member and return a
         re-attach callable for members added later — the
         FederatedInformer lifecycle (federatedinformer.go:151-250).
-        With ``named``, the handler receives ``(cluster, event, obj)``."""
+        With ``named``, the handler receives ``(cluster, event, obj)``;
+        with ``replay``, existing objects stream through as ADDED (the
+        informer's initial LIST)."""
         attached: set[str] = set()
 
         def attach() -> None:
@@ -435,7 +438,7 @@ class ClusterFleet:
                     kube.watch(
                         resource,
                         functools.partial(handler, name) if named else handler,
-                        replay=False,
+                        replay=replay,
                     )
 
         attach()

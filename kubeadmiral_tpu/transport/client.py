@@ -459,7 +459,8 @@ class HttpFleet:
             client.unwatch_owner(owner)
 
     def watch_members(
-        self, resource: str, handler: Handler, named: bool = False
+        self, resource: str, handler: Handler, named: bool = False,
+        replay: bool = False,
     ) -> Callable[[], None]:
         attached: set[str] = set()
 
@@ -482,7 +483,7 @@ class HttpFleet:
                 client.watch(
                     resource,
                     functools.partial(handler, name) if named else handler,
-                    replay=False,
+                    replay=replay,
                 )
             attach.pending = pending
 

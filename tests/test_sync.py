@@ -136,6 +136,50 @@ class TestRetention:
         assert desired["spec"]["clusterIP"] == "10.0.0.7"
         assert desired["spec"]["ports"][0]["nodePort"] == 31234
 
+    def test_argo_workflow_retains_member_status(self):
+        # retain.go:624-636: Workflow status is NOT a subresource — an
+        # update would wipe the workflow-controller's progress.
+        desired = {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Workflow",
+            "metadata": {},
+            "spec": {"entrypoint": "main"},
+        }
+        cluster = {
+            "metadata": {"resourceVersion": "9"},
+            "status": {"phase": "Running", "nodes": {"n1": {"phase": "Pending"}}},
+        }
+        retain.retain_cluster_fields("Workflow", desired, cluster)
+        assert desired["status"]["phase"] == "Running"
+        assert desired["metadata"]["resourceVersion"] == "9"
+        # No member status: a stale desired status must not be pushed.
+        desired2 = {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Workflow",
+            "metadata": {},
+            "status": {"phase": "Stale"},
+        }
+        retain.retain_cluster_fields("Workflow", desired2, {"metadata": {}})
+        assert "status" not in desired2
+
+    def test_gvk_retainer_registry_extensible(self):
+        calls = []
+        retain.register_gvk_retainer(
+            "example.io/v1/Widget", lambda d, c: calls.append((d, c))
+        )
+        try:
+            desired = {"apiVersion": "example.io/v1", "kind": "Widget", "metadata": {}}
+            cluster = {"metadata": {"resourceVersion": "2"}}
+            retain.retain_cluster_fields("Widget", desired, cluster)
+            assert calls == [(desired, cluster)]
+            # Explicit gvk argument wins over apiVersion+kind inference.
+            retain.retain_cluster_fields(
+                "Other", {"metadata": {}}, cluster, gvk="example.io/v1/Widget"
+            )
+            assert len(calls) == 2
+        finally:
+            retain._GVK_RETAINERS.pop("example.io/v1/Widget", None)
+
     def test_serviceaccount_retains_generated_secrets(self):
         desired = {"metadata": {}}
         cluster = {
