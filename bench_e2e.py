@@ -209,11 +209,15 @@ def main():
         name: round(timer.stages[name] - stages_before.get(name, 0.0), 3)
         for name in timer.stages
     }
+    from kubeadmiral_tpu.bench_support import bench_platform
+
     result = {
         "metric": f"e2e_objects_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
         "value": round(N_OBJECTS / total_s, 1),
         "unit": "objects/s",
         "detail": {
+            "platform": bench_platform(),
+            "platform_error": os.environ.get("BENCH_PLATFORM_ERROR"),
             "total_s": round(total_s, 2),
             "create_s": round(create_s, 2),
             "stages_s": stages,
@@ -229,4 +233,6 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    from kubeadmiral_tpu.bench_support import run_resilient
+
+    run_resilient(main, __file__)

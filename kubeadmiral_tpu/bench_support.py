@@ -140,3 +140,103 @@ def sequential_schedule(
         )
         results.append(schedule_one(problem))
     return results
+
+
+# -- bench platform resilience (shared by bench.py / bench_e2e.py) ------
+# The round-3 lesson: a wedged TPU relay zeroed the round's evidence.
+# Probe the chip from a sacrificial subprocess with retries+backoff; on
+# persistent unavailability re-exec the bench on CPU with a structured
+# "cpu-fallback" label instead of crashing.
+
+_PROBE_SNIPPET = (
+    "import jax; d = jax.devices(); "
+    "assert d[0].platform == 'tpu', f'resolved platform {d[0].platform}'; "
+    "print(float(jax.numpy.ones((128, 128)).sum()), d[0].platform)"
+)
+
+
+def probe_tpu(attempts: int, probe_timeout: float) -> str:
+    """Try to claim the chip from a throwaway subprocess; returns '' on
+    success or the last failure description.  If the relay is wedged the
+    subprocess (not the bench) hangs and is killed at the timeout."""
+    import subprocess
+    import sys
+    import time
+
+    err = "no attempts made"
+    for attempt in range(attempts):
+        if attempt:
+            backoff = min(60.0, 15.0 * (2 ** (attempt - 1)))
+            print(
+                f"# tpu probe retry {attempt + 1}/{attempts} in {backoff:.0f}s: {err}",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(backoff)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True,
+                timeout=probe_timeout,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            err = f"chip claim hung > {probe_timeout:.0f}s (relay wedged?)"
+            continue
+        if proc.returncode == 0:
+            return ""
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-1:] or ["unknown"]
+        err = f"probe rc={proc.returncode}: {tail[0][:300]}"
+    return err
+
+
+def exec_cpu_fallback(script_path: str, reason: str) -> None:
+    """Replace this process with a CPU-platform run of ``script_path``;
+    the child emits the structured artifact (platform: cpu-fallback)."""
+    import os
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # axon plugin must not register
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_PLATFORM"] = "cpu-fallback"
+    env["BENCH_PLATFORM_ERROR"] = reason[:500]
+    print(f"# falling back to CPU: {reason}", file=sys.stderr, flush=True)
+    os.execve(sys.executable, [sys.executable, os.path.abspath(script_path)], env)
+
+
+def run_resilient(main, script_path: str) -> None:
+    """The bench entrypoint wrapper: probe-gate the TPU, fall back to
+    CPU on unavailability (including mid-run chip loss), never rc=1 for
+    platform problems."""
+    import os
+
+    if os.environ.get("BENCH_PLATFORM") or not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        main()
+        return
+    reason = probe_tpu(
+        attempts=int(os.environ.get("BENCH_TPU_ATTEMPTS", 3)),
+        probe_timeout=float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", 240)),
+    )
+    if reason:
+        exec_cpu_fallback(script_path, reason)
+    try:
+        main()
+    except Exception as e:  # chip lost mid-run: degrade, don't crash
+        msg = f"{type(e).__name__}: {e}"
+        lowered = msg.lower()
+        if any(s in lowered for s in ("unavailable", "deadline", "backend", "axon", "tpu")):
+            exec_cpu_fallback(script_path, msg)
+        raise
+
+
+def bench_platform() -> str:
+    """The platform label for bench artifacts."""
+    import os
+
+    label = os.environ.get("BENCH_PLATFORM")
+    if label:
+        return label
+    import jax
+
+    return jax.default_backend()
