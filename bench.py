@@ -343,7 +343,7 @@ def main():
         vs = batched_rate / native_rate
         detail["native_baseline_ms"] = None
 
-    from kubeadmiral_tpu.bench_support import bench_platform
+    from kubeadmiral_tpu.bench_support import bench_platform_detail
 
     result = {
         "metric": f"objects_scheduled_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
@@ -352,8 +352,7 @@ def main():
         "vs_baseline": round(vs, 2),
         "detail": {
             "config": CONFIG,
-            "platform": bench_platform(),
-            "platform_error": os.environ.get("BENCH_PLATFORM_ERROR"),
+            **bench_platform_detail(),
             "tick_ms": round(tick_seconds * 1e3, 1),
             "stage_ms": detail,
             "baseline": "native-seqsched(g++ -O3)"

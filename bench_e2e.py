@@ -209,15 +209,14 @@ def main():
         name: round(timer.stages[name] - stages_before.get(name, 0.0), 3)
         for name in timer.stages
     }
-    from kubeadmiral_tpu.bench_support import bench_platform
+    from kubeadmiral_tpu.bench_support import bench_platform_detail
 
     result = {
         "metric": f"e2e_objects_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
         "value": round(N_OBJECTS / total_s, 1),
         "unit": "objects/s",
         "detail": {
-            "platform": bench_platform(),
-            "platform_error": os.environ.get("BENCH_PLATFORM_ERROR"),
+            **bench_platform_detail(),
             "total_s": round(total_s, 2),
             "create_s": round(create_s, 2),
             "stages_s": stages,

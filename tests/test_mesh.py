@@ -39,13 +39,9 @@ def unsharded(batch):
     return schedule_tick(batch)
 
 
-@pytest.mark.parametrize(
-    "objects_axis,clusters_axis",
-    [(1, 1), (4, 2), (2, 4), (8, 1), (1, 8)],
-)
-def test_sharded_tick_matches_unsharded(
-    batch, unsharded, objects_axis, clusters_axis
-):
+def _assert_sharded_matches(batch, unsharded, objects_axis, clusters_axis):
+    """One sharded-vs-unsharded parity harness shared by every shape and
+    mesh layout below (and mirrored by dryrun_multichip's large case)."""
     n = objects_axis * clusters_axis
     devices = jax.devices()
     if len(devices) < n:
@@ -69,8 +65,28 @@ def test_sharded_tick_matches_unsharded(
         )
 
 
+@pytest.mark.parametrize(
+    "objects_axis,clusters_axis",
+    [(1, 1), (4, 2), (2, 4), (8, 1), (1, 8)],
+)
+def test_sharded_tick_matches_unsharded(
+    batch, unsharded, objects_axis, clusters_axis
+):
+    _assert_sharded_matches(batch, unsharded, objects_axis, clusters_axis)
+
+
 def test_make_mesh_default_layout():
     devices = jax.devices()
     mesh = M.make_mesh(devices)
     assert mesh.axis_names == (M.OBJECTS, M.CLUSTERS)
     assert mesh.devices.size == len(devices)
+
+
+def test_sharded_tick_matches_unsharded_with_volume():
+    """Cluster-axis collectives (normalize maxima, top-K, planner scans)
+    with real per-shard volume: 512x128 over the full 8-device mesh —
+    the CI-sized sibling of the dryrun's 2048x512 case (VERDICT r3 #5)."""
+    batch = _example_batch(b=512, c=128)
+    unsharded = schedule_tick(batch)
+    assert int(np.asarray(unsharded.selected).sum()) > 0
+    _assert_sharded_matches(batch, unsharded, 4, 2)
