@@ -40,6 +40,26 @@ def _pod_scheduled_condition(pod: dict) -> Optional[dict]:
     return None
 
 
+def _transition_time(value) -> float:
+    """Condition timestamps as seconds: accepts the monotonic floats the
+    in-process tests use AND the RFC3339 strings real pods carry
+    (metav1.Time in automigration/util.go)."""
+    if not value:
+        return 0.0
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        pass
+    import datetime
+
+    try:
+        return datetime.datetime.fromisoformat(
+            str(value).replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return 0.0
+
+
 def count_unschedulable_pods(
     pods: list[dict], now: float, threshold: float
 ) -> tuple[int, Optional[float]]:
@@ -57,7 +77,7 @@ def count_unschedulable_pods(
             or cond.get("reason") != "Unschedulable"
         ):
             continue
-        since = float(cond.get("lastTransitionTime", 0) or 0)
+        since = _transition_time(cond.get("lastTransitionTime", 0))
         crossing_in = since + threshold - now
         if crossing_in <= 0:
             count += 1

@@ -484,17 +484,46 @@ class FederatedClusterController:
         if joined is not None and joined.get("status") == "True" and performed:
             member = self._member(name)
             if member is not None and member.healthy:
-                for res in (SERVICE_ACCOUNTS, SECRETS):
-                    for key in list(member.keys(res)):
-                        if key.startswith(FED_SYSTEM_NAMESPACE + "/"):
-                            try:
-                                member.delete(res, key)
-                            except NotFound:
-                                pass
+                # Deletion order keeps our own credential alive until the
+                # last call: plain secrets first, then the namespace,
+                # then ServiceAccounts LAST — deleting the SA revokes the
+                # token this very client authenticates with (the member's
+                # token controller also GCs the "<sa>-token" secret, so
+                # nothing must come after).
+                prefix = FED_SYSTEM_NAMESPACE + "/"
+                sa_keys = [
+                    k for k in member.keys(SERVICE_ACCOUNTS) if k.startswith(prefix)
+                ]
+                token_names = {k.split("/", 1)[1] + "-token" for k in sa_keys}
+                for key in member.keys(SECRETS):
+                    if key.startswith(prefix) and key.split("/", 1)[1] not in token_names:
+                        try:
+                            member.delete(SECRETS, key)
+                        except NotFound:
+                            pass
                 try:
                     member.delete(NAMESPACES, FED_SYSTEM_NAMESPACE)
                 except NotFound:
                     pass
+                for key in sa_keys:
+                    try:
+                        member.delete(SERVICE_ACCOUNTS, key)
+                    except NotFound:
+                        pass
+                    except Exception:
+                        # The first SA delete revoked our token: the rest
+                        # (if any) are unreachable now; the member-side
+                        # token GC already handled their secrets' grants.
+                        break
+                # Bare-store members (no token controller) still need the
+                # token secrets gone; over HTTP this 401s harmlessly.
+                for tname in token_names:
+                    try:
+                        member.delete(SECRETS, prefix + tname)
+                    except NotFound:
+                        pass  # already GC'd with its SA
+                    except Exception:
+                        break  # our credential died with our own SA
 
         cluster["metadata"]["finalizers"] = []
         try:

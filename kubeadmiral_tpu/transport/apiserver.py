@@ -29,6 +29,7 @@ import hashlib
 import hmac
 import json
 import secrets as pysecrets
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -138,7 +139,28 @@ class KubeApiServer:
             self._regrant(secret)
         store.watch_all(self._on_store_event)
 
-        server = ThreadingHTTPServer((host, port), _Handler)
+        class _TrackingServer(ThreadingHTTPServer):
+            """Tracks live per-connection sockets so close() can sever
+            kept-alive connections — shutdown() alone only stops NEW
+            accepts, and a crashed/"unreachable" member must look dead
+            to clients holding pooled connections too."""
+
+            def __init__(self_srv, *a, **kw):
+                self_srv.live_sockets = set()
+                self_srv.live_lock = threading.Lock()
+                super().__init__(*a, **kw)
+
+            def process_request(self_srv, request, client_address):
+                with self_srv.live_lock:
+                    self_srv.live_sockets.add(request)
+                super().process_request(request, client_address)
+
+            def close_request(self_srv, request):
+                with self_srv.live_lock:
+                    self_srv.live_sockets.discard(request)
+                super().close_request(request)
+
+        server = _TrackingServer((host, port), _Handler)
         server.daemon_threads = True
         server.api = self  # type: ignore[attr-defined]
         self._server = server
@@ -231,11 +253,25 @@ class KubeApiServer:
         elif resource == SERVICE_ACCOUNTS:
             if event == ADDED and self._mint_sa_tokens:
                 self._mint_token(obj)
+            if event == "DELETED" and self._mint_sa_tokens:
+                # Token-controller garbage collection: a deleted SA's
+                # token secrets go with it (k8s's legacy token cleanup).
+                # Without this, unjoin cleanup could never remove the
+                # credential it is itself authenticating with — deleting
+                # the SA first revokes the token and every subsequent
+                # member call 401s.  Matched by type + SA annotation
+                # (never by name convention: a sync-propagated workload
+                # secret named "<sa>-token" must survive).
+                for secret in self._secrets_referencing(obj):
+                    try:
+                        self.store.delete(SECRETS, fk_obj_key(secret))
+                    except NotFound:
+                        pass
             # Re-evaluate grants of secrets referencing this SA: its
             # appearance enables boot-trusted secrets that landed first;
-            # its deletion revokes their tokens even while the secret
-            # lingers (a crash between unjoin's SA and secret deletes
-            # must not leave a live credential).
+            # its deletion revokes their tokens even while a secret
+            # lingers (crash between SA handling and secret GC, or a
+            # non-minting server — no live credential either way).
             for secret in self._secrets_referencing(obj):
                 self._regrant(secret)
 
@@ -285,6 +321,20 @@ class KubeApiServer:
             self._log.cond.notify_all()  # release idle watch streams
         self._server.shutdown()
         self._server.server_close()
+        # Sever kept-alive connections: a closed server must be
+        # unreachable, not half-alive through pooled client sockets.
+        with self._server.live_lock:
+            sockets = list(self._server.live_sockets)
+            self._server.live_sockets.clear()
+        for sock in sockets:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class _Handler(BaseHTTPRequestHandler):

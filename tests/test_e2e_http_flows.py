@@ -1,0 +1,296 @@
+"""Socket-level e2e for cluster lifecycle, auto-migration and scheduling
+profiles (VERDICT r3 #8) — the flows the reference runs as e2e suites
+(reference: test/e2e/federatedcluster/, test/e2e/automigration/,
+test/e2e/schedulingprofile/), here driven against the kwok-lite farm:
+every apiserver a real HTTP server, member clients built from join
+secrets, watches over chunked streams.
+"""
+
+import json
+import time
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.automigration import (
+    POD_UNSCHEDULABLE_THRESHOLD,
+    AutoMigrationController,
+)
+from kubeadmiral_tpu.federation.clusterctl import (
+    CLUSTER_UID_ANNOTATION,
+    FED_SYSTEM_NAMESPACE,
+    FEDERATED_CLUSTERS,
+    JOINED,
+    NAMESPACES,
+    NODES,
+    FederatedClusterController,
+    get_condition,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.models import profile as PR
+from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+from test_e2e_slice import deployment_ftc, make_deployment, make_node
+
+PODS = "v1/pods"
+
+
+from test_e2e_http_scale import settle as _settle_list
+
+
+def settle_http(*controllers, timeout=60.0, grace=12):
+    """Drive controllers to quiescence over async HTTP watches (shared
+    deadline/idle-grace loop from the scale suite)."""
+    _settle_list(controllers, timeout=timeout, grace=grace)
+
+
+class _FarmTest:
+    def setup_method(self):
+        self.farm = KwokLiteFarm()
+        self.fleet = self.farm.fleet
+
+    def teardown_method(self):
+        self.farm.close()
+
+    def make_cluster(self, name, taints=None, conditions=None):
+        obj = {
+            "apiVersion": "core.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedCluster",
+            "metadata": {"name": name},
+            "spec": self.farm.cluster_spec(name),
+        }
+        if taints:
+            obj["spec"]["taints"] = taints
+        if conditions:
+            obj["status"] = {"conditions": conditions}
+        return obj
+
+
+class TestClusterLifecycleHTTP(_FarmTest):
+    """Join handshake, readiness + resource aggregation, removal —
+    reference: test/e2e/federatedcluster/{join,clusterstatus,unjoin}.go,
+    over real sockets with SA-token minting."""
+
+    def test_join_collects_status_and_unjoin_cleans_up(self):
+        gvk = "apps/v1/Deployment"
+        ctl = FederatedClusterController(self.fleet, api_resource_probe=[gvk])
+        member = self.farm.add_member("c1")
+        member.create(NODES, make_node("n1", "48", "96Gi"))
+        member.create(NODES, make_node("n2", "16", "32Gi"))
+        self.fleet.host.create(FEDERATED_CLUSTERS, self.make_cluster("c1"))
+        settle_http(ctl)
+
+        cluster = self.fleet.host.get(FEDERATED_CLUSTERS, "c1")
+        # Joined + Ready conditions (clusterjoin.go / clusterstatus.go).
+        assert get_condition(cluster, JOINED)["status"] == "True"
+        assert get_condition(cluster, "Ready")["status"] == "True"
+        # The member-side system namespace is stamped with the cluster
+        # UID — the ownership handshake that makes re-joins idempotent
+        # and foreign ownership detectable.
+        ns = member.get(NAMESPACES, FED_SYSTEM_NAMESPACE)
+        assert ns["metadata"]["annotations"][CLUSTER_UID_ANNOTATION] == (
+            cluster["metadata"]["uid"]
+        )
+        # Aggregated schedulable resources from the Node objects served
+        # over HTTP (clusterstatus.go collectClusterStatus).
+        res = cluster["status"]["resources"]
+        assert res["schedulableNodes"] == 2
+        assert res["allocatable"]["cpu"] in ("64", "64000m")
+        # API types advertised through the FTC probe gate scheduling.
+        assert gvk in cluster["status"]["apiResourceTypes"]
+
+        # Unjoin: deleting the FederatedCluster runs the cleanup
+        # finalizer — member system namespace removed, then the object
+        # actually disappears from the host (federatedcluster_controller
+        # handleTerminatingCluster).
+        self.fleet.host.delete(FEDERATED_CLUSTERS, "c1")
+        settle_http(ctl)
+        assert self.fleet.host.try_get(FEDERATED_CLUSTERS, "c1") is None
+        assert member.try_get(NAMESPACES, FED_SYSTEM_NAMESPACE) is None
+
+    def test_unreachable_member_goes_not_ready(self):
+        ctl = FederatedClusterController(
+            self.fleet, api_resource_probe=[], clock=time.monotonic
+        )
+        member = self.farm.add_member("c1")
+        member.create(NODES, make_node("n1", "8", "16Gi"))
+        self.fleet.host.create(FEDERATED_CLUSTERS, self.make_cluster("c1"))
+        settle_http(ctl)
+        assert (
+            get_condition(self.fleet.host.get(FEDERATED_CLUSTERS, "c1"), "Ready")[
+                "status"
+            ]
+            == "True"
+        )
+        # Kill the member apiserver: the next heartbeat must flip the
+        # cluster to not-Ready/offline instead of wedging the controller.
+        self.farm.member_servers["c1"].close()
+        ctl.worker.enqueue("c1")  # force the heartbeat now, not at resync
+        settle_http(ctl)
+        ready = get_condition(self.fleet.host.get(FEDERATED_CLUSTERS, "c1"), "Ready")
+        assert ready["status"] != "True"
+
+
+class TestAutoMigrationHTTP(_FarmTest):
+    """Unschedulable pods in a member surface as estimatedCapacity on
+    the federated object — reference: test/e2e/automigration/auto_migration.go,
+    with the pod informer reading member pods over HTTP."""
+
+    def test_stuck_pods_write_estimated_capacity(self):
+        ftc = deployment_ftc()
+        now = [1000.0]
+        ctl = AutoMigrationController(self.fleet, ftc, clock=lambda: now[0])
+        ready = [
+            {"type": "Joined", "status": "True"},
+            {"type": "Ready", "status": "True"},
+        ]
+        members = {}
+        for name in ("c1", "c2"):
+            members[name] = self.farm.add_member(name)
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS, self.make_cluster(name, conditions=ready)
+            )
+
+        def member_deploy(desired, ready_reps):
+            return {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {
+                    "name": "web",
+                    "namespace": "default",
+                    "labels": {C.MANAGED_LABEL: "true"},
+                },
+                "spec": {
+                    "replicas": desired,
+                    "selector": {"matchLabels": {"app": "web"}},
+                },
+                "status": {"readyReplicas": ready_reps},
+            }
+
+        def pod(name, unschedulable):
+            obj = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": "default",
+                    "labels": {"app": "web"},
+                },
+                "spec": {},
+                "status": {"phase": "Pending"},
+            }
+            if unschedulable:
+                obj["status"]["conditions"] = [
+                    {
+                        "type": "PodScheduled",
+                        "status": "False",
+                        "reason": "Unschedulable",
+                        "lastTransitionTime": "1970-01-01T00:00:00Z",
+                    }
+                ]
+            return obj
+
+        members["c1"].create(ftc.source.resource, member_deploy(3, 1))
+        members["c1"].create(PODS, pod("p1", True))
+        members["c1"].create(PODS, pod("p2", True))
+        members["c2"].create(ftc.source.resource, member_deploy(2, 2))
+
+        fed = {
+            "apiVersion": "types.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedDeployment",
+            "metadata": {
+                "name": "web",
+                "namespace": "default",
+                "annotations": {
+                    pending.PENDING_CONTROLLERS: json.dumps([]),
+                    POD_UNSCHEDULABLE_THRESHOLD: "30s",
+                },
+            },
+            "spec": {
+                "template": {"apiVersion": "apps/v1", "kind": "Deployment"},
+                "placements": [
+                    {
+                        "controller": C.SCHEDULER,
+                        "placement": [{"cluster": "c1"}, {"cluster": "c2"}],
+                    }
+                ],
+            },
+        }
+        self.fleet.host.create(ftc.federated.resource, fed)
+        now[0] += 60.0  # past the unschedulable threshold
+        settle_http(ctl)
+
+        got = self.fleet.host.get(ftc.federated.resource, "default/web")
+        info = json.loads(got["metadata"]["annotations"][C.AUTO_MIGRATION_INFO])
+        assert info["estimatedCapacity"] == {"c1": 1}
+
+
+class TestSchedulingProfileHTTP(_FarmTest):
+    """SchedulingProfile plugin-set switches observed through real
+    placement — reference: test/e2e/schedulingprofile/."""
+
+    def setup_method(self):
+        super().setup_method()
+        ftc = deployment_ftc(pipeline=(("kubeadmiral.io/global-scheduler",),))
+        self.ftc = ftc
+        gvk = "apps/v1/Deployment"
+        self.clusterctl = FederatedClusterController(
+            self.fleet, api_resource_probe=[gvk]
+        )
+        self.federate = FederateController(self.fleet.host, ftc)
+        self.scheduler = SchedulerController(self.fleet.host, ftc)
+        for name in ("c1", "c2", "c3"):
+            member = self.farm.add_member(name)
+            member.create(NODES, make_node("n1", "64", "128Gi"))
+            taints = (
+                [{"key": "dedicated", "value": "infra", "effect": "NoSchedule"}]
+                if name == "c1"
+                else None
+            )
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS, self.make_cluster(name, taints=taints)
+            )
+
+    def placement(self):
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        return C.get_placement(fed, C.SCHEDULER)
+
+    def test_profile_switch_admits_tainted_cluster(self):
+        # Default profile: the taint filter excludes c1.
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {"schedulingMode": "Duplicate"},
+            },
+        )
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        settle_http(self.clusterctl, self.federate, self.scheduler)
+        assert self.placement() == {"c2", "c3"}
+
+        # A profile disabling the taint plugins re-schedules onto c1 too
+        # (the profile generation is part of the trigger hash).
+        self.fleet.host.create(
+            PR.SCHEDULING_PROFILES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "SchedulingProfile",
+                "metadata": {"name": "no-taints"},
+                "spec": {
+                    "plugins": {
+                        "filter": {"disabled": [{"name": T.TAINT_TOLERATION}]},
+                        "score": {"disabled": [{"name": T.TAINT_TOLERATION}]},
+                    }
+                },
+            },
+        )
+        policy = self.fleet.host.get(PROPAGATION_POLICIES, "default/pp")
+        policy["spec"]["schedulingProfile"] = "no-taints"
+        self.fleet.host.update(PROPAGATION_POLICIES, policy)
+        settle_http(self.clusterctl, self.federate, self.scheduler)
+        assert self.placement() == {"c1", "c2", "c3"}

@@ -403,8 +403,10 @@ class TestTokenTrustBoundary:
             srv.close()
 
     def test_revocation_survives_sa_deleted_first(self):
-        """Unjoin deletes the ServiceAccount BEFORE its token secret;
-        the secret's deletion must still revoke the credential."""
+        """Deleting the ServiceAccount revokes the credential AND
+        garbage-collects its minted token secret (the token-controller
+        GC real apiservers perform) — no live credential or orphaned
+        secret remains regardless of deletion order."""
         store = FakeKube("m")
         srv = KubeApiServer(store, admin_token="sekrit", mint_sa_tokens=True)
         try:
@@ -418,7 +420,52 @@ class TestTokenTrustBoundary:
             client = HttpKube(srv.url, token=token)
             assert client.list(DEPLOYMENTS) == []
             admin.delete("v1/serviceaccounts", "sys/bot")
-            admin.delete("v1/secrets", "sys/bot-token")
+            # Token secret GC'd with its SA; the credential is dead.
+            assert admin.try_get("v1/secrets", "sys/bot-token") is None
+            with pytest.raises(TransportError, match="401"):
+                client.list(DEPLOYMENTS)
+            client.close()
+            admin.close()
+        finally:
+            srv.close()
+
+    def test_sa_delete_revokes_while_secret_lingers(self):
+        """The regrant-on-SA-delete safety net, independent of the token
+        GC: on a non-minting server a trusted token secret outlives its
+        deleted SA — the credential must die the moment the SA does."""
+        import hashlib as _hashlib
+        import hmac as _hmac
+
+        store = FakeKube("m")
+        signing_key = "k" * 32
+        token = _hmac.new(
+            signing_key.encode(), b"sys/bot-token\x00bot", _hashlib.sha256
+        ).hexdigest()
+        store.create(
+            "v1/serviceaccounts",
+            {"apiVersion": "v1", "kind": "ServiceAccount",
+             "metadata": {"name": "bot", "namespace": "sys"}},
+        )
+        store.create(
+            "v1/secrets",
+            {"apiVersion": "v1", "kind": "Secret",
+             "type": "kubernetes.io/service-account-token",
+             "metadata": {"name": "bot-token", "namespace": "sys",
+                          "annotations": {"kubernetes.io/service-account.name": "bot"}},
+             "data": {"token": token}},
+        )
+        srv = KubeApiServer(
+            store, admin_token="sekrit", mint_sa_tokens=False,
+            sa_signing_key=signing_key,
+        )
+        try:
+            client = HttpKube(srv.url, token=token)
+            assert client.list(DEPLOYMENTS) == []
+            admin = HttpKube(srv.url, token="sekrit")
+            admin.delete("v1/serviceaccounts", "sys/bot")
+            # No GC on a non-minting server: the secret lingers...
+            assert admin.try_get("v1/secrets", "sys/bot-token") is not None
+            # ...but the credential is already dead.
             with pytest.raises(TransportError, match="401"):
                 client.list(DEPLOYMENTS)
             client.close()
