@@ -491,39 +491,47 @@ class FederatedClusterController:
                 # token controller also GCs the "<sa>-token" secret, so
                 # nothing must come after).
                 prefix = FED_SYSTEM_NAMESPACE + "/"
-                sa_keys = [
-                    k for k in member.keys(SERVICE_ACCOUNTS) if k.startswith(prefix)
-                ]
+
+                def member_delete(res: str, key: str) -> bool:
+                    """False = our credential is gone (expected once our
+                    own SA is deleted); transient member errors RAISE so
+                    the Worker retries with the finalizer still held —
+                    cleanup must never silently half-finish."""
+                    try:
+                        member.delete(res, key)
+                    except NotFound:
+                        pass
+                    except Exception as e:
+                        msg = str(e)
+                        if "401" in msg or "Unauthorized" in msg:
+                            return False
+                        raise
+                    return True
+
+                own_sa = prefix + f"kubeadmiral-{name}"
+                # Our own SA goes LAST: deleting it revokes the very
+                # token this client authenticates with.
+                sa_keys = sorted(
+                    (k for k in member.keys(SERVICE_ACCOUNTS) if k.startswith(prefix)),
+                    key=lambda k: (k == own_sa, k),
+                )
                 token_names = {k.split("/", 1)[1] + "-token" for k in sa_keys}
                 for key in member.keys(SECRETS):
                     if key.startswith(prefix) and key.split("/", 1)[1] not in token_names:
-                        try:
-                            member.delete(SECRETS, key)
-                        except NotFound:
-                            pass
-                try:
-                    member.delete(NAMESPACES, FED_SYSTEM_NAMESPACE)
-                except NotFound:
-                    pass
+                        member_delete(SECRETS, key)
+                member_delete(NAMESPACES, FED_SYSTEM_NAMESPACE)
+                revoked = False
                 for key in sa_keys:
-                    try:
-                        member.delete(SERVICE_ACCOUNTS, key)
-                    except NotFound:
-                        pass
-                    except Exception:
-                        # The first SA delete revoked our token: the rest
-                        # (if any) are unreachable now; the member-side
-                        # token GC already handled their secrets' grants.
+                    if not member_delete(SERVICE_ACCOUNTS, key):
+                        revoked = True
                         break
-                # Bare-store members (no token controller) still need the
-                # token secrets gone; over HTTP this 401s harmlessly.
-                for tname in token_names:
-                    try:
-                        member.delete(SECRETS, prefix + tname)
-                    except NotFound:
-                        pass  # already GC'd with its SA
-                    except Exception:
-                        break  # our credential died with our own SA
+                if not revoked:
+                    # Bare-store members (no token controller GC) still
+                    # need the token secrets gone; over HTTP our own
+                    # token secret went with our SA above.
+                    for tname in token_names:
+                        if not member_delete(SECRETS, prefix + tname):
+                            break
 
         cluster["metadata"]["finalizers"] = []
         try:

@@ -25,6 +25,7 @@ compiles them in a background thread before the first real tick.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -358,6 +359,14 @@ class SchedulerEngine:
         # defaults.  Validate keys here so a typo fails at construction,
         # not as a TypeError deep inside the first scheduling tick.
         self._vocab_caps = dict(vocab_caps or {})
+        # Chunk pipelining depth: with depth D the engine keeps up to D
+        # chunks' programs in flight, featurizing/decoding on the host
+        # while the device computes (double buffering at D=2).  Default 1
+        # (strictly sequential): unbounded dispatch-ahead measured SLOWER
+        # over the tunneled single chip (transfers queue behind every
+        # outstanding program); bounded depth is the on-pod optimization,
+        # flip KT_PIPELINE_DEPTH=2 to measure on real hardware.
+        self.pipeline_depth = max(1, int(os.environ.get("KT_PIPELINE_DEPTH", "1")))
         unknown = set(self._vocab_caps) - Cmp.CAP_NAMES
         if unknown:
             raise ValueError(
@@ -790,6 +799,7 @@ class SchedulerEngine:
         # strictly sequential per chunk.
         chunk_results: list[Optional[list[ScheduleResult]]] = []
         pending_sub: list[tuple[int, _CachedChunk, list[int], TickInputs]] = []
+        pending_fetch: list[tuple] = []
         timings = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
         self.timings = timings
         c_bucket, eff_chunk, ladder = self._tick_geometry(len(view.clusters))
@@ -873,6 +883,27 @@ class SchedulerEngine:
             )
             tick = self._tick_compact if fmt == "compact" else self._tick
             out, mask_dev = tick(device_in, prev)
+            if self.pipeline_depth > 1:
+                # Async dispatch: leave the program in flight and go
+                # featurize the next chunk; the wait lands in the fetch
+                # stage when this chunk is drained.
+                timings["device"] += time.perf_counter() - t1
+                pending_fetch.append(
+                    (
+                        len(chunk_results),
+                        entry,
+                        out,
+                        mask_dev if delta_ok else None,
+                        len(chunk),
+                    )
+                )
+                chunk_results.append(None)
+                if len(pending_fetch) >= self.pipeline_depth:
+                    self._drain_fetch(
+                        pending_fetch.pop(0), chunk_results, view,
+                        want_scores, timings,
+                    )
+                continue
             jax.block_until_ready(out)
             t2 = time.perf_counter()
             timings["device"] += t2 - t1
@@ -889,6 +920,10 @@ class SchedulerEngine:
                 )
             )
 
+        while pending_fetch:
+            self._drain_fetch(
+                pending_fetch.pop(0), chunk_results, view, want_scores, timings
+            )
         if pending_sub:
             self._run_sub_batch(
                 pending_sub, chunk_results, view, timings, eff_chunk, ladder,
@@ -1185,6 +1220,15 @@ class SchedulerEngine:
                 )
             )
         return out
+
+    def _drain_fetch(
+        self, item, chunk_results, view, want_scores: bool, timings
+    ) -> None:
+        """Complete one in-flight pipelined chunk (see pipeline_depth)."""
+        slot, entry, out, mask_dev, n = item
+        chunk_results[slot] = self._fetch_decode(
+            entry, out, mask_dev, view.names, n, want_scores, timings, view
+        )
 
     def _fetch_decode(
         self, entry, out, mask_dev, names, n: int, want_scores: bool, timings, view

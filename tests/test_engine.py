@@ -198,6 +198,32 @@ def test_chunking_large_batch():
         assert sum(res.clusters.values()) == i % 13
 
 
+def test_pipelined_chunks_match_sequential():
+    """KT_PIPELINE_DEPTH=2 keeps chunks in flight while the host
+    featurizes/decodes; outputs must be identical to the strictly
+    sequential dispatch, across cold and churn ticks."""
+    clusters = [mk_cluster(f"c{i}") for i in range(7)]
+    units = [
+        mk_unit(
+            f"obj-{i}",
+            scheduling_mode=MODE_DIVIDE,
+            desired_replicas=(i % 13) + 1,
+            avoid_disruption=False,
+        )
+        for i in range(50)
+    ]
+    seq = SchedulerEngine(chunk_size=16, min_bucket=8)
+    piped = SchedulerEngine(chunk_size=16, min_bucket=8)
+    piped.pipeline_depth = 3
+    assert seq.schedule(units, clusters) == piped.schedule(units, clusters)
+    import dataclasses
+
+    churned = list(units)
+    churned[5] = dataclasses.replace(churned[5], desired_replicas=40)
+    churned[30] = dataclasses.replace(churned[30], desired_replicas=2)
+    assert seq.schedule(churned, clusters) == piped.schedule(churned, clusters)
+
+
 def test_empty_inputs():
     assert ENGINE.schedule([], [mk_cluster("a")]) == []
     [res] = ENGINE.schedule([mk_unit("web")], [])
