@@ -244,6 +244,10 @@ class _CachedChunk:
     # next time the device copy is actually needed, instead of paying a
     # full chunk re-upload after every churn tick.
     stale_rows: Optional[list] = None
+    # Rows whose prev_out device planes are outdated (their decisions
+    # were merged host-side by the sub-batch pass): the next delta fetch
+    # force-gathers them, everything else still rides the device diff.
+    stale_out_rows: Optional[list] = None
 
 
 def _tick_with_diff(inp: TickInputs, prev: tuple):
@@ -771,6 +775,33 @@ class SchedulerEngine:
                 nbytes=nbytes,
                 vocab_uid=vocab.uid if (fmt == "compact" and vocab) else 0,
             )
+            prev_names = getattr(cached.prev_view, "names", None) if cached else None
+            if (
+                cached is not None
+                and cached.fmt == fmt
+                and len(cached.units) == len(chunk)
+                and cached.prev_results is not None
+                and len(cached.prev_results) == len(chunk)
+                and prev_names is not None
+                and list(prev_names) == list(view.names)
+            ):
+                # Carry the previous tick's outputs across the miss —
+                # reached on topology-changing re-featurizes with a
+                # stable fleet (label/taint churn) and on mass row churn
+                # past the patch threshold; capacity-only drift is a
+                # cache HIT and rides the hit-path delta machinery.  The
+                # delta fetch diffs NEW device outputs against the
+                # carried planes, transferring only rows whose decisions
+                # actually moved (VERDICT r3 #3).
+                # Sound ONLY while the cluster-name order is unchanged:
+                # the diff mask compares raw output columns, so a
+                # renamed/reordered fleet with a coincidentally identical
+                # output pattern would otherwise reuse decodes that map
+                # indices to the WRONG cluster names.
+                entry.prev_out = cached.prev_out
+                entry.prev_results = cached.prev_results
+                entry.prev_has_scores = cached.prev_has_scores
+                entry.stale_out_rows = cached.stale_out_rows
             self._chunk_cache[idx] = entry
             self._cache_used += nbytes
         return inputs, "miss", entry, fmt
@@ -1101,13 +1132,17 @@ class SchedulerEngine:
             # The device input copy is stale for the patched rows —
             # record them for lazy scatter-repair (a drift tick after a
             # churn tick must not pay a full chunk re-upload).  prev_out
-            # no longer matches prev_results (the delta path's baseline
-            # invariant) — drop it; the next full dispatch does one full
-            # fetch.
+            # rows for the patched objects no longer match prev_results;
+            # KEEP the planes and record the rows instead of dropping
+            # them (VERDICT r3 #3): the next full dispatch (a drift
+            # tick) then delta-fetches — device diff for the untouched
+            # rows, forced gather for these.
             entry.stale_rows = sorted(
                 set(entry.stale_rows or ()) | set(changed_rows)
             )
-            entry.prev_out = None
+            entry.stale_out_rows = sorted(
+                set(entry.stale_out_rows or ()) | set(changed_rows)
+            )
             chunk_results[slot] = [
                 ScheduleResult(dict(r.clusters), dict(r.scores)) for r in merged
             ]
@@ -1247,6 +1282,15 @@ class SchedulerEngine:
             relevant = mask & _DIFF_PLACEMENT
             if entry.prev_has_scores:
                 relevant = relevant | (mask & _DIFF_SCORES)
+            if entry.stale_out_rows:
+                # prev_out rows patched by a sub-batch tick: the device
+                # diff compares against pre-patch outputs there, so
+                # force-fetch them regardless of what the mask says.
+                stale = np.asarray(
+                    [r for r in entry.stale_out_rows if r < n], np.int64
+                )
+                if stale.size:
+                    relevant[stale] |= _DIFF_PLACEMENT
             idx = np.nonzero(relevant)[0]
             if idx.size <= max(16, n // 4):
                 new_out = (out.selected, out.replicas, out.counted, out.scores)
@@ -1286,6 +1330,7 @@ class SchedulerEngine:
                     for row, res in zip(idx.tolist(), changed_results):
                         merged[row] = res
                     entry.prev_out = new_out
+                    entry.stale_out_rows = None
                     entry.prev_results = merged
                     entry.prev_view = view
                     out_copy = [
@@ -1295,6 +1340,7 @@ class SchedulerEngine:
                     timings["decode"] += time.perf_counter() - t3
                     return out_copy
                 entry.prev_out = new_out
+                entry.stale_out_rows = None
                 entry.prev_view = view
                 t3 = time.perf_counter()
                 timings["fetch"] += t3 - t2
@@ -1321,6 +1367,7 @@ class SchedulerEngine:
             # inputs, and the next tick's no-op shortcut would replay
             # stale placements (ADVICE r2).
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+            entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
             entry.prev_view = view

@@ -328,3 +328,87 @@ class TestLazyDeviceRepair:
             churned2, drifted
         )
         results_equal(got2, fresh2)
+
+
+def test_drift_after_churn_fetches_delta_not_full():
+    """The bench's steady sequence: cold tick, a churned tick (sub-batch
+    merge patches prev_results host-side), then a cluster drift.  The
+    drift dispatch must DELTA-fetch: prev_out survives the sub-batch
+    pass, with the patched rows force-gathered via stale_out_rows
+    (VERDICT r3 #3 — this was the "6 full of 21" fetch profile)."""
+    units, clusters = make_world(b=48, c=10)
+    engine = SchedulerEngine(min_bucket=8)
+    fresh = SchedulerEngine(min_bucket=8)
+    engine.schedule(units, clusters)
+
+    # Churn a couple of rows: rides the sub-batch path.
+    churned = list(units)
+    churned[3] = dataclasses.replace(churned[3], desired_replicas=40)
+    churned[17] = dataclasses.replace(churned[17], desired_replicas=1)
+    engine.schedule(churned, clusters)
+    assert engine.fetch_stats["subbatch"] >= 1, engine.fetch_stats
+    full_before = engine.fetch_stats["full"]
+
+    drifted = [
+        dataclasses.replace(
+            c, available={k: max(0, v // 2) for k, v in c.available.items()}
+        )
+        if i == 0
+        else c
+        for i, c in enumerate(clusters)
+    ]
+    got = engine.schedule(churned, drifted)
+    assert got == fresh.schedule(churned, drifted)  # exactness first
+    assert engine.fetch_stats["delta"] >= 1, engine.fetch_stats
+    assert engine.fetch_stats["full"] == full_before, engine.fetch_stats
+
+
+def test_label_churn_miss_carries_prev_outputs():
+    """A topology-changing miss with unchanged cluster names (label flip
+    on one cluster) keeps the previous outputs armed: the re-dispatch
+    skips or delta-fetches instead of refetching the whole chunk."""
+    units, clusters = make_world(b=48, c=10)
+    engine = SchedulerEngine(min_bucket=8)
+    fresh = SchedulerEngine(min_bucket=8)
+    engine.schedule(units, clusters)
+    full_before = engine.fetch_stats["full"]
+
+    relabeled = [
+        dataclasses.replace(c, labels=dict(c.labels, extra="yes"))
+        if i == 1
+        else c
+        for i, c in enumerate(clusters)
+    ]
+    got = engine.schedule(units, relabeled)
+    assert got == fresh.schedule(units, relabeled)
+    assert engine.cache_stats["miss"] >= 2, engine.cache_stats  # topo miss
+    assert engine.fetch_stats["full"] == full_before, engine.fetch_stats
+    assert (
+        engine.fetch_stats["delta"] + engine.fetch_stats["skip"] >= 1
+    ), engine.fetch_stats
+
+
+def test_renamed_fleet_never_reuses_stale_decodes():
+    """A different fleet with a coincidentally identical output PATTERN
+    must not ride the carried-prev delta path: decodes map column
+    indices to names, so the carry is gated on unchanged name order."""
+    units, _ = make_world(b=4, c=2)
+    engine = SchedulerEngine(min_bucket=8)
+    fleet_a = [
+        ClusterState(
+            name=n,
+            labels={},
+            allocatable=parse_resources({"cpu": "64", "memory": "256Gi"}),
+            available=parse_resources({"cpu": "32", "memory": "128Gi"}),
+            api_resources=frozenset({"apps/v1/Deployment"}),
+        )
+        for n in ("slow", "fast")
+    ]
+    fleet_b = [dataclasses.replace(c, name=n) for c, n in zip(fleet_a, ("small", "big"))]
+    res_a = engine.schedule(units, fleet_a)
+    res_b = engine.schedule(units, fleet_b)
+    names_b = {n for r in res_b for n in r.clusters}
+    assert names_b <= {"small", "big"}, names_b
+    fresh = SchedulerEngine(min_bucket=8)
+    assert res_b == fresh.schedule(units, fleet_b)
+    assert res_a != res_b  # same pattern, different names
