@@ -92,6 +92,54 @@ def is_cascading_delete_enabled(cluster_obj: dict) -> bool:
     return CASCADING_DELETE in cluster_obj.get("metadata", {}).get("annotations", {})
 
 
+def _apply_desired_status(
+    obj: dict,
+    reason: str,
+    status_map: dict[str, str],
+    collision_count: Optional[int],
+) -> bool:
+    """Write the desired propagation status shape into ``obj`` in place;
+    True when anything changed (controller.go:637-721's diff) — ONE
+    definition shared by the optimistic batched write and the
+    synchronous conflict-retry fallback."""
+    desired_clusters = [
+        {"cluster": c, "status": s} for c, s in sorted(status_map.items())
+    ]
+    status = obj.setdefault("status", {})
+    old_conditions = {c.get("type"): c for c in status.get("conditions", [])}
+    prop = old_conditions.get("Propagation", {})
+    new_status = "True" if reason == AGGREGATE_SUCCESS else "False"
+    changed = (
+        status.get("clusters") != desired_clusters
+        or prop.get("reason") != reason
+        or prop.get("status") != new_status
+    )
+    if collision_count is not None and status.get("collisionCount") != collision_count:
+        status["collisionCount"] = collision_count
+        changed = True
+    if not changed:
+        return False
+    status["clusters"] = desired_clusters
+    status["conditions"] = [
+        c for t, c in sorted(old_conditions.items()) if t != "Propagation"
+    ] + [{"type": "Propagation", "status": new_status, "reason": reason}]
+    return True
+
+
+def _syncing_value(status_map: dict[str, str], generation: int) -> str:
+    """The sourcefeedback syncing annotation payload
+    (sourcefeedback/syncing.go PopulateSyncingAnnotation)."""
+    return C.compact_json(
+        {
+            "generation": None,
+            "fedGeneration": generation,
+            "clusters": [
+                {"name": c, "status": s} for c, s in sorted(status_map.items())
+            ],
+        }
+    )
+
+
 def _cluster_lifecycle_sig(cluster_obj: dict) -> tuple:
     """What about a FederatedCluster makes sync re-reconcile the world:
     join/ready/terminating/cascading transitions (controller.go:244-260
@@ -123,6 +171,50 @@ class _TickClusters:
             for c in joined
         }
         self.joined_set = frozenset(self.flags)
+
+
+class _HostBatch:
+    """Host-side write staging for one BatchWorker tick: every object's
+    status/annotation update rides ONE ``host.batch()`` round trip per
+    drain instead of one round trip per write.  Callbacks may stage
+    follow-up ops (the syncing annotation uses the resourceVersion the
+    status write returned), so ``flush`` drains until quiescent.
+    Per-op conflicts fall back to the caller's synchronous retry loops."""
+
+    def __init__(self, host):
+        self.host = host
+        self._ops: list[tuple[dict, Callable[[dict], None], Optional[Callable[[], None]]]] = []
+
+    def stage(
+        self,
+        op: dict,
+        on_result: Callable[[dict], None],
+        on_panic: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self._ops.append((op, on_result, on_panic))
+
+    def flush(self) -> None:
+        while self._ops:
+            ops, self._ops = self._ops, []
+            try:
+                results = self.host.batch([op for op, _, _ in ops])
+            except Exception as e:
+                results = [
+                    {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+                ] * len(ops)
+            if len(results) < len(ops):
+                results = list(results) + [
+                    {"code": 500, "status": {"reason": "Transport",
+                                             "message": "batch result missing"}}
+                ] * (len(ops) - len(results))
+            for (_, on_result, on_panic), result in zip(ops, results):
+                try:
+                    on_result(result)
+                except Exception:
+                    # A callback (or its synchronous fallback) died: the
+                    # object must RETRY, not silently pass as finished.
+                    if on_panic is not None:
+                        on_panic()
 
 
 class SyncController:
@@ -324,7 +416,7 @@ class SyncController:
                 pool=self.pool,
                 thread_registry=self._flush_threads,
             )
-            finishers: list[tuple[str, Callable[[], Result]]] = []
+            finishers: list[tuple[str, Callable[..., Result]]] = []
             for key in fed_keys:
                 # Per-key isolation: one poison object backs off alone
                 # (worker.go:119-131 semantics), the rest of the tick
@@ -340,22 +432,27 @@ class SyncController:
                 else:
                     finishers.append((key, out))
             sink.flush()
+            hb = _HostBatch(self.host)
             for key, finish in finishers:
                 try:
-                    results[key] = finish()
+                    results[key] = finish(hb, results, key)
                 except Exception:
                     self.metrics.counter(f"sync-{self.ftc.name}.finish_panic")
                     results[key] = Result.retry()
+            # One bulk host round trip (plus follow-ups) finalizes every
+            # object's status + syncing annotation.
+            hb.flush()
         finally:
             self._tick_thread = None
         return results
 
     def _plan_one(
         self, key: str, ctx: _TickClusters, sink: D.BatchSink
-    ) -> Union[Result, Callable[[], Result]]:
+    ) -> Union[Result, Callable[..., Result]]:
         """Everything up to (and including) staging one object's member
-        writes; returns a finisher to run after the sink flushes, or a
-        settled Result for the early-exit paths."""
+        writes; returns a finisher ``finish(hb, results, key)`` to run
+        after the sink flushes, or a settled Result for the early-exit
+        paths."""
         fed_obj = self.host.try_get(self._fed_resource, key)
         if fed_obj is None:
             return Result.ok()
@@ -632,9 +729,11 @@ class SyncController:
                     continue
                 dispatcher.update(cname, cluster_obj, version)
 
-        def finish() -> Result:
+        def finish(hb: _HostBatch, results: dict, key: str) -> Result:
             """Runs after the tick's sink flushes: status/version
-            bookkeeping over the completed dispatch round."""
+            bookkeeping over the completed dispatch round.  Host writes
+            are staged into ``hb``; callbacks downgrade ``results[key]``
+            on persistent failure."""
             ok = dispatcher.wait()
 
             # Record versions (an optimization; failures tolerated —
@@ -661,14 +760,9 @@ class SyncController:
                     "PropagationFailed",
                     f"failed clusters: {', '.join(failed)}",
                 )
-            status_result = self._set_federated_status(
-                fed, reason, status_map, collision_count
+            self._stage_status_writes(
+                hb, fed, reason, status_map, collision_count, results, key
             )
-            if not status_result.success:
-                return status_result
-            # The syncing feedback annotation is a separate (non-status)
-            # write: UpdateStatus ignores annotations (controller.go:686-718).
-            self._set_syncing_annotation(fed, status_map)
             if not ok:
                 return Result.retry()
             if D.WAITING_FOR_REMOVAL in status_map.values():
@@ -733,6 +827,100 @@ class SyncController:
         dispatcher.delete(cluster)
 
     # -- status ----------------------------------------------------------
+    def _stage_status_writes(
+        self,
+        hb: _HostBatch,
+        fed: FederatedResource,
+        reason: str,
+        status_map: dict[str, str],
+        collision_count: Optional[int],
+        results: dict,
+        key: str,
+    ) -> None:
+        """Stage the status-subresource write (and, chained on its new
+        resourceVersion, the syncing annotation) into the tick's host
+        batch.  The in-hand object is the optimistic base; a conflict
+        falls back to the synchronous read-retry loops."""
+        obj = fed.obj
+        if not _apply_desired_status(obj, reason, status_map, collision_count):
+            self._stage_annotation(hb, fed, obj, status_map, results, key)
+            return
+
+        def on_panic() -> None:
+            self.metrics.counter(f"sync-{self.ftc.name}.host_write_panic")
+            results[key] = Result.retry()
+
+        def on_status(result: dict) -> None:
+            code = result.get("code")
+            if code == 200:
+                updated = result["object"]
+                self._record_own_fed(updated)
+                obj["metadata"]["resourceVersion"] = updated["metadata"][
+                    "resourceVersion"
+                ]
+                self._stage_annotation(hb, fed, obj, status_map, results, key)
+            elif code == 404:
+                pass  # object gone: nothing to finalize
+            else:
+                # Conflict (or transport trouble): the synchronous
+                # read-retry loops own this object's finalization.
+                r = self._set_federated_status(
+                    fed, reason, status_map, collision_count
+                )
+                if not r.success:
+                    results[key] = Result.retry()
+                else:
+                    self._set_syncing_annotation(fed, status_map)
+
+        hb.stage(
+            {"verb": "update_status", "resource": self._fed_resource, "object": obj},
+            on_status,
+            on_panic,
+        )
+
+    def _stage_annotation(
+        self,
+        hb: _HostBatch,
+        fed: FederatedResource,
+        obj: dict,
+        status_map: dict[str, str],
+        results: dict,
+        key: str,
+    ) -> None:
+        """The syncing feedback annotation is a separate (non-status)
+        write: UpdateStatus ignores annotations (controller.go:686-718)."""
+        syncing = _syncing_value(status_map, obj["metadata"].get("generation", 1))
+        ann = obj["metadata"].setdefault("annotations", {})
+        prior = ann.get(C.SOURCE_FEEDBACK_SYNCING)
+        if prior == syncing:
+            return
+        ann[C.SOURCE_FEEDBACK_SYNCING] = syncing
+
+        def on_panic() -> None:
+            self.metrics.counter(f"sync-{self.ftc.name}.host_write_panic")
+            results[key] = Result.retry()
+
+        def on_ann(result: dict) -> None:
+            code = result.get("code")
+            if code == 200:
+                self._record_own_fed(result["object"])
+            elif code != 404:
+                # Undo the optimistic in-hand mutation FIRST: the
+                # fallback's cheap steady-state exit consults this very
+                # dict and would otherwise see the desired value as
+                # already present and skip the conflict-retry loop.
+                if prior is None:
+                    ann.pop(C.SOURCE_FEEDBACK_SYNCING, None)
+                else:
+                    ann[C.SOURCE_FEEDBACK_SYNCING] = prior
+                self._set_syncing_annotation(fed, status_map)
+
+        hb.stage(
+            {"verb": "update", "resource": self._fed_resource, "object": obj},
+            on_ann,
+            on_panic,
+        )
+
     def _set_federated_status(
         self,
         fed: FederatedResource,
@@ -743,36 +931,12 @@ class SyncController:
         """Write status.clusters + the Propagated condition (and the
         revision collisionCount, when history is on) via the status
         subresource, with conflict-retry (controller.go:637-721)."""
-        desired_clusters = [
-            {"cluster": c, "status": s} for c, s in sorted(status_map.items())
-        ]
         for _ in range(5):
             obj = self.host.try_get(self._fed_resource, fed.key)
             if obj is None:
                 return Result.ok()
-            status = obj.setdefault("status", {})
-            old_conditions = {
-                c.get("type"): c for c in status.get("conditions", [])
-            }
-            prop = old_conditions.get("Propagation", {})
-            new_status = "True" if reason == AGGREGATE_SUCCESS else "False"
-            changed = (
-                status.get("clusters") != desired_clusters
-                or prop.get("reason") != reason
-                or prop.get("status") != new_status
-            )
-            if (
-                collision_count is not None
-                and status.get("collisionCount") != collision_count
-            ):
-                status["collisionCount"] = collision_count
-                changed = True
-            if not changed:
+            if not _apply_desired_status(obj, reason, status_map, collision_count):
                 return Result.ok()
-            status["clusters"] = desired_clusters
-            status["conditions"] = [
-                c for t, c in sorted(old_conditions.items()) if t != "Propagation"
-            ] + [{"type": "Propagation", "status": new_status, "reason": reason}]
             try:
                 updated = self.host.update_status(self._fed_resource, obj)
                 if isinstance(updated, dict):
@@ -792,30 +956,20 @@ class SyncController:
         (sourcefeedback/syncing.go PopulateSyncingAnnotation); best-effort
         with conflict-refresh."""
 
-        def desired(generation: int) -> str:
-            return C.compact_json(
-                {
-                    "generation": None,
-                    "fedGeneration": generation,
-                    "clusters": [
-                        {"name": c, "status": s}
-                        for c, s in sorted(status_map.items())
-                    ],
-                }
-            )
-
         # Cheap steady-state exit using the in-hand object: no refetch
         # (a full deep copy per tick) when the annotation is current.
         in_hand = fed.obj.get("metadata", {})
         if in_hand.get("annotations", {}).get(
             C.SOURCE_FEEDBACK_SYNCING
-        ) == desired(in_hand.get("generation", 1)):
+        ) == _syncing_value(status_map, in_hand.get("generation", 1)):
             return
         for _ in range(5):
             obj = self.host.try_get(self._fed_resource, fed.key)
             if obj is None:
                 return
-            syncing = desired(obj["metadata"].get("generation", 1))
+            syncing = _syncing_value(
+                status_map, obj["metadata"].get("generation", 1)
+            )
             ann = obj["metadata"].setdefault("annotations", {})
             if ann.get(C.SOURCE_FEEDBACK_SYNCING) == syncing:
                 return
