@@ -101,7 +101,7 @@ class FakeKube:
             observer(resource, event, snapshot, self._rv)
 
     # -- CRUD ------------------------------------------------------------
-    def create(self, resource: str, obj: dict) -> dict:
+    def create(self, resource: str, obj: dict, _copy_result: bool = True) -> dict:
         with self._lock:
             obj = copy_json(obj)
             meta = obj.setdefault("metadata", {})
@@ -118,7 +118,7 @@ class FakeKube:
             meta.setdefault("uid", f"{self.name}-{resource}-{key}-{self._rv}")
             store[key] = obj
             self._notify(resource, ADDED, obj)
-            return copy_json(obj)
+            return copy_json(obj) if _copy_result else obj
 
     def get(self, resource: str, key: str) -> dict:
         with self._lock:
@@ -141,7 +141,7 @@ class FakeKube:
         with self._lock:
             return self._store(resource).get(key)
 
-    def update(self, resource: str, obj: dict) -> dict:
+    def update(self, resource: str, obj: dict, _copy_result: bool = True) -> dict:
         """Full-object update with optimistic concurrency; removing the
         last finalizer of a deleting object completes the deletion."""
         with self._lock:
@@ -177,12 +177,14 @@ class FakeKube:
                 if not meta.get("finalizers"):
                     del store[key]
                     self._notify(resource, DELETED, obj)
-                    return copy_json(obj)
+                    return copy_json(obj) if _copy_result else obj
             store[key] = obj
             self._notify(resource, MODIFIED, obj)
-            return copy_json(obj)
+            return copy_json(obj) if _copy_result else obj
 
-    def update_status(self, resource: str, obj: dict) -> dict:
+    def update_status(
+        self, resource: str, obj: dict, _copy_result: bool = True
+    ) -> dict:
         """Status-subresource style update: only .status is applied.
         Optimistic concurrency applies as on the main resource — without
         it, two controllers read-modify-writing different parts of the
@@ -203,24 +205,31 @@ class FakeKube:
             cur["metadata"]["resourceVersion"] = self._bump()
             store[key] = cur
             self._notify(resource, MODIFIED, cur)
-            return copy_json(cur)
+            return copy_json(cur) if _copy_result else cur
 
     def batch(self, operations: list) -> list[dict]:
         """Interface parity with HttpKube.batch: apply many operations,
         return one {"code", "object"|"status"} entry per operation (the
         in-process transport has no round trips to amortize, but callers
-        written against the bulk protocol run unmodified)."""
+        written against the bulk protocol run unmodified).
+
+        Write-verb result objects are store VIEWS, not copies — the bulk
+        path's contract is read-only results (over HTTP they are fresh
+        JSON parses; here aliasing saves a deep copy per operation on
+        the control plane's hottest write path).  Callers must copy
+        anything they retain and mutate.  ``get`` results remain copies
+        (they flow to general read consumers)."""
         results = []
         for op in operations:
             verb = op.get("verb")
             resource = op.get("resource", "")
             try:
                 if verb == "create":
-                    results.append({"code": 201, "object": self.create(resource, op["object"])})
+                    results.append({"code": 201, "object": self.create(resource, op["object"], _copy_result=False)})
                 elif verb == "update":
-                    results.append({"code": 200, "object": self.update(resource, op["object"])})
+                    results.append({"code": 200, "object": self.update(resource, op["object"], _copy_result=False)})
                 elif verb == "update_status":
-                    results.append({"code": 200, "object": self.update_status(resource, op["object"])})
+                    results.append({"code": 200, "object": self.update_status(resource, op["object"], _copy_result=False)})
                 elif verb == "delete":
                     self.delete(resource, op["key"])
                     results.append({"code": 200, "status": {"status": "Success"}})

@@ -439,11 +439,13 @@ class _Handler(BaseHTTPRequestHandler):
             resource = op.get("resource", "")
             try:
                 if verb == "create":
-                    results.append({"code": 201, "object": store.create(resource, op["object"])})
+                    # View results (_copy_result=False): serialized into
+                    # the response immediately, never retained or mutated.
+                    results.append({"code": 201, "object": store.create(resource, op["object"], _copy_result=False)})
                 elif verb == "update":
-                    results.append({"code": 200, "object": store.update(resource, op["object"])})
+                    results.append({"code": 200, "object": store.update(resource, op["object"], _copy_result=False)})
                 elif verb == "update_status":
-                    results.append({"code": 200, "object": store.update_status(resource, op["object"])})
+                    results.append({"code": 200, "object": store.update_status(resource, op["object"], _copy_result=False)})
                 elif verb == "delete":
                     store.delete(resource, op["key"])
                     results.append({"code": 200, "status": {"kind": "Status", "status": "Success"}})
