@@ -311,6 +311,8 @@ class FederateController:
         host.watch(self._fed_resource, self._on_event, replay=True)
 
     def _on_event(self, event: str, obj: dict) -> None:
+        if self.worker.is_own_thread():
+            return  # echo of this controller's own source/fed write
         self.worker.enqueue(obj_key(obj))
 
     def run_until_idle(self) -> None:
@@ -373,27 +375,47 @@ class FederateController:
                 self.host.delete(self._fed_resource, obj_key(fed_obj))
             except NotFound:
                 pass
-        # Requeue until the federated object finishes terminating.
+            # A finalizer-free federated object is gone right away (its
+            # DELETED event is our own echo, suppressed): release the
+            # source NOW instead of waiting for a requeue that nothing
+            # would trigger.
+            if self.host.try_get(self._fed_resource, obj_key(fed_obj)) is None:
+                return self._handle_terminating_source(source, None)
+        # Requeue until the federated object finishes terminating
+        # (sync's finalizer removal fires a foreign DELETED event too).
         return Result.after(1.0)
 
     def _create(self, source: dict) -> Result:
         fed_obj = new_federated_object(self.ftc, source)
         try:
-            self.host.create(self._fed_resource, fed_obj)
+            created = self.host.create(self._fed_resource, fed_obj)
         except Conflict:
             return Result.retry()
         except Exception:
             return Result.retry()
-        return Result.ok()
+        # The ADDED echo is suppressed (own thread): stamp the initial
+        # scheduling feedback on the source now, as the echo-driven
+        # second reconcile used to.
+        return self._sync_feedback(source, created)
 
     def _update(self, source: dict, fed_obj: dict) -> Result:
         if not update_federated_object(fed_obj, self.ftc, source):
             return self._sync_feedback(source, fed_obj)
         try:
-            self.host.update(self._fed_resource, fed_obj)
+            updated = self.host.update(self._fed_resource, fed_obj)
         except (Conflict, NotFound):
             return Result.retry()
-        return Result.ok()
+        # Server-set fields (rv AND generation — the fedGeneration the
+        # feedback annotation records) must come from the stored object.
+        fed_obj["metadata"]["resourceVersion"] = updated["metadata"][
+            "resourceVersion"
+        ]
+        if "generation" in updated.get("metadata", {}):
+            fed_obj["metadata"]["generation"] = updated["metadata"]["generation"]
+        # Continue straight to the feedback pass: the write's own echo
+        # is suppressed (is_own_thread), so nothing else would requeue
+        # this key to mirror feedback onto the source.
+        return self._sync_feedback(source, fed_obj)
 
     def _sync_feedback(self, source: dict, fed_obj: dict) -> Result:
         """Write scheduling feedback (computed from the federated object's

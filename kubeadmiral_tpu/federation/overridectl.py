@@ -21,6 +21,7 @@ from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
 from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_key
 from kubeadmiral_tpu.utils.labels import match_terms, matches_selector_set
+from kubeadmiral_tpu.utils.unstructured import copy_json
 
 OVERRIDE_POLICIES = "core.kubeadmiral.io/v1alpha1/overridepolicies"
 CLUSTER_OVERRIDE_POLICIES = "core.kubeadmiral.io/v1alpha1/clusteroverridepolicies"
@@ -114,6 +115,8 @@ class OverrideController:
 
     # -- event fan-in (controller.go:226-252) ----------------------------
     def _on_object_event(self, event: str, obj: dict) -> None:
+        if self.worker.is_own_thread():
+            return  # echo of this controller's own spec.overrides write
         self.worker.enqueue(obj_key(obj))
 
     def _on_policy_event(self, event: str, obj: dict) -> None:
@@ -194,41 +197,52 @@ class OverrideController:
     # -- reconcile (controller.go:254-377) -------------------------------
     def reconcile(self, key: str) -> Result:
         self.metrics.counter("override.throughput")
-        fed_obj = self.host.try_get(self._fed_resource, key)
-        if fed_obj is None or fed_obj["metadata"].get("deletionTimestamp"):
+        # View read: the steady-state reconcile (overrides already
+        # current, nothing pending) touches nothing and pays no copy.
+        view = self._try_get_view(key)
+        if view is None or view["metadata"].get("deletionTimestamp"):
             return Result.ok()
 
         try:
-            if not pending.dependencies_fulfilled(fed_obj, self.name):
+            if not pending.dependencies_fulfilled(view, self.name):
                 return Result.ok()
         except KeyError:
             return Result.ok()  # not initialized by federate yet
 
         try:
-            policies = self._matched_policies(fed_obj)
+            policies = self._matched_policies(view)
         except PolicyResolutionError:
             # A dangling policy reference: nothing to do until the policy
             # appears (its creation re-enqueues us).
             return Result.ok()
 
-        clusters = self._placed_clusters(fed_obj)
+        clusters = self._placed_clusters(view)
         overrides: dict[str, list] = {}
         for policy in policies:
             merge_overrides(overrides, parse_overrides(policy, clusters))
 
-        current = C.get_overrides(fed_obj, self.name)
-        needs_update = current != overrides
+        needs_update = C.get_overrides(view, self.name) != overrides
+        if not needs_update and not pending.would_update(
+            view, self.name, False, self.ftc.controller_groups
+        ):
+            return Result.ok()
+
+        fed_obj = copy_json(view)
         if needs_update:
             C.set_overrides(fed_obj, self.name, overrides)
-
-        pending_updated = pending.update_pending(
+        pending.update_pending(
             fed_obj, self.name, needs_update, self.ftc.controller_groups
         )
-        if needs_update or pending_updated:
-            try:
-                self.host.update(self._fed_resource, fed_obj)
-            except Conflict:
-                return Result.retry()
-            except NotFound:
-                return Result.ok()
+        try:
+            self.host.update(self._fed_resource, fed_obj)
+        except Conflict:
+            return Result.retry()
+        except NotFound:
+            return Result.ok()
         return Result.ok()
+
+    def _try_get_view(self, key: str):
+        """No-copy read when the store offers one (FakeKube); HTTP
+        clients return fresh parses either way."""
+        getter = getattr(self.host, "try_get_view", self.host.try_get)
+        return getter(self._fed_resource, key)

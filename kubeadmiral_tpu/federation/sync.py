@@ -259,7 +259,6 @@ class SyncController:
         # writer's thread — any event arriving on it mid-tick was caused
         # by this controller's own write), plus resourceVersion maps of
         # this controller's last writes for async transports.
-        self._tick_thread: Optional[int] = None
         self._flush_threads: set[int] = set()
         self._own_member_rv: dict[tuple[str, str], str] = {}
         self._own_fed_rv: dict[str, str] = {}
@@ -303,8 +302,14 @@ class SyncController:
 
     # -- event fan-in ----------------------------------------------------
     def _is_own_echo(self) -> bool:
-        ident = threading.get_ident()
-        return ident == self._tick_thread or ident in self._flush_threads
+        # Worker-tracked reconcile threads + the BatchSink's pool-flush
+        # threads: in-process stores deliver watch events synchronously
+        # on the writing thread, so an event on any of these is an echo
+        # of this controller's own write.
+        return (
+            self.worker.is_own_thread()
+            or threading.get_ident() in self._flush_threads
+        )
 
     def _on_fed_event(self, event: str, obj: dict) -> None:
         key = obj_key(obj)
@@ -392,7 +397,10 @@ class SyncController:
         member writes staged into ONE BatchSink, flushed as one bulk
         write per member, then per-object status finalized."""
         results: dict[str, Result] = {}
-        self._tick_thread = threading.get_ident()
+        # Mark this thread for echo suppression even when called
+        # directly (tests, the reconcile() compat wrapper) rather than
+        # through BatchWorker.step.
+        ident = self.worker._enter()
         try:
             fed_keys: list[str] = []
             for key in keys:
@@ -443,7 +451,7 @@ class SyncController:
             # object's status + syncing annotation.
             hb.flush()
         finally:
-            self._tick_thread = None
+            self.worker._exit(ident)
         return results
 
     def _plan_one(

@@ -63,6 +63,41 @@ def _downstream(all_groups: Sequence[Sequence[str]], current: str) -> Controller
     return []
 
 
+def _next_groups(
+    obj: dict,
+    to_remove: str,
+    set_downstream: bool,
+    all_groups: Sequence[Sequence[str]],
+) -> list:
+    """The pending groups after one controller's pass — the ONE
+    definition shared by the mutating update and the probe."""
+    groups = get_pending(obj)
+    current = list(groups[0]) if groups else []
+    rest = groups[1:] if groups else []
+    if to_remove in current:
+        current.remove(to_remove)
+    if set_downstream:
+        rest = _downstream(all_groups, to_remove)
+    return [current] + list(rest)
+
+
+def would_update(
+    obj: dict,
+    to_remove: str,
+    set_downstream: bool,
+    all_groups: Sequence[Sequence[str]],
+) -> bool:
+    """Non-mutating probe of :func:`update_pending`: True when applying
+    it would change the annotation — lets view-read reconciles skip the
+    copy + write entirely in the steady state."""
+    encoded = json.dumps(
+        normalize(_next_groups(obj, to_remove, set_downstream, all_groups)),
+        separators=(",", ":"),
+    )
+    ann = obj.get("metadata", {}).get("annotations", {})
+    return ann.get(PENDING_CONTROLLERS) != encoded
+
+
 def update_pending(
     obj: dict,
     to_remove: str,
@@ -72,11 +107,4 @@ def update_pending(
     """Remove ``to_remove`` from the current group; when the controller
     changed the object (``set_downstream``), re-arm everything after its
     group in ``all_groups``.  Returns True when the annotation changed."""
-    groups = get_pending(obj)
-    current = list(groups[0]) if groups else []
-    rest = groups[1:] if groups else []
-    if to_remove in current:
-        current.remove(to_remove)
-    if set_downstream:
-        rest = _downstream(all_groups, to_remove)
-    return set_pending(obj, [current] + rest)
+    return set_pending(obj, _next_groups(obj, to_remove, set_downstream, all_groups))

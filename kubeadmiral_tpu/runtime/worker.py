@@ -55,6 +55,27 @@ class _WorkerBase:
         self.metrics = metrics or null_metrics()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # Threads currently inside a reconcile (ident -> depth).  An
+        # in-process store delivers watch events synchronously on the
+        # writing thread, so an event arriving on one of these threads
+        # mid-reconcile is an echo of this controller's OWN write —
+        # handlers consult is_own_thread() to skip the self-requeue.
+        self._active: dict[int, int] = {}
+
+    def is_own_thread(self) -> bool:
+        return threading.get_ident() in self._active
+
+    def _enter(self) -> int:
+        ident = threading.get_ident()
+        self._active[ident] = self._active.get(ident, 0) + 1
+        return ident
+
+    def _exit(self, ident: int) -> None:
+        depth = self._active.get(ident, 1) - 1
+        if depth <= 0:
+            self._active.pop(ident, None)
+        else:
+            self._active[ident] = depth
 
     def enqueue(self, key: str, delay: float = 0.0) -> None:
         self.queue.add(key, delay)
@@ -103,6 +124,7 @@ class Worker(_WorkerBase):
         return True
 
     def _dispatch(self, key: str) -> None:
+        ident = self._enter()
         try:
             with self.metrics.timer(f"{self.name}.latency"):
                 result = self._reconcile(key)
@@ -110,6 +132,8 @@ class Worker(_WorkerBase):
             self.metrics.counter(f"{self.name}.panic")
             traceback.print_exc()
             result = Result.retry()
+        finally:
+            self._exit(ident)
         self.metrics.counter(f"{self.name}.throughput")
         self._requeue(key, result)
 
@@ -138,6 +162,7 @@ class BatchWorker(_WorkerBase):
         keys = self.queue.drain_due()
         if not keys:
             return False
+        ident = self._enter()
         try:
             with self.metrics.timer(f"{self.name}.tick_latency"):
                 results = self._reconcile_batch(keys)
@@ -145,6 +170,8 @@ class BatchWorker(_WorkerBase):
             self.metrics.counter(f"{self.name}.panic")
             traceback.print_exc()
             results = {k: Result.retry() for k in keys}
+        finally:
+            self._exit(ident)
         self.metrics.counter(f"{self.name}.throughput", len(keys))
         for key in keys:
             result = results.get(key, Result.ok())
