@@ -286,6 +286,7 @@ def time_batched(rng, units, clusters, followers):
     detail["noop_tick_ms"] = round(noop_ms, 1)
     detail["cache"] = dict(engine.cache_stats)
     detail["fetch_paths"] = dict(engine.fetch_stats)
+    detail["program_shapes"] = sorted(map(list, engine.program_shapes))
     return dt, placed, detail
 
 

@@ -371,6 +371,11 @@ class SchedulerEngine:
         # outstanding program); bounded depth is the on-pod optimization,
         # flip KT_PIPELINE_DEPTH=2 to measure on real hardware.
         self.pipeline_depth = max(1, int(os.environ.get("KT_PIPELINE_DEPTH", "1")))
+        # Distinct (fmt, rows, clusters) program shapes dispatched — the
+        # observable program count the bucket ladder promises to bound
+        # (each unique shape is one XLA compile, amortized by the
+        # persistent cache).
+        self.program_shapes: set[tuple] = set()
         unknown = set(self._vocab_caps) - Cmp.CAP_NAMES
         if unknown:
             raise ValueError(
@@ -913,6 +918,7 @@ class SchedulerEngine:
                 entry.prev_out if delta_ok else self._zeros_for(out_shape)
             )
             tick = self._tick_compact if fmt == "compact" else self._tick
+            self.program_shapes.add((fmt, b_pad, c_bucket))
             out, mask_dev = tick(device_in, prev)
             if self.pipeline_depth > 1:
                 # Async dispatch: leave the program in flight and go
