@@ -753,6 +753,7 @@ class SyncController:
                 fed.override_version(),
                 sorted(selected),
                 dispatcher.version_map,
+                batch=hb,
             )
 
             status_map = dispatcher.status_map

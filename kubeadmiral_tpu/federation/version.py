@@ -69,11 +69,16 @@ class VersionManager:
         override_version: str,
         selected_clusters: list[str],
         version_map: dict[str, str],
+        batch=None,
     ) -> None:
         """Merge the dispatch round's versions and persist
         (manager.go:152-215, updateClusterVersions:448-463): versions for
         unselected clusters are dropped; clusters the round produced no
-        version for keep their old record only if still selected."""
+        version for keep their old record only if still selected.  With
+        ``batch`` (a sync-tick host batch exposing ``stage(op, cb)``),
+        the persist rides the tick's bulk host round trip; conflicts
+        fall back to the direct write (recording is an optimization —
+        failures are tolerated either way)."""
         with self._lock:
             cr = self._load_locked(namespace, name)
             old_versions: dict[str, str] = {}
@@ -105,7 +110,7 @@ class VersionManager:
             # (manager.go's updatedVersionMap equality short-circuit).
             if cr is not None and cr.get("status") == status:
                 return
-            self._write(namespace, name, status, cr)
+            self._write(namespace, name, status, cr, batch)
 
     def delete(self, namespace: str, name: str) -> None:
         key = self._cr_key(namespace, name)
@@ -131,7 +136,12 @@ class VersionManager:
         return cr
 
     def _write(
-        self, namespace: str, name: str, status: dict, existing: Optional[dict]
+        self,
+        namespace: str,
+        name: str,
+        status: dict,
+        existing: Optional[dict],
+        batch=None,
     ) -> None:
         key = self._cr_key(namespace, name)
         if existing is None:
@@ -143,6 +153,22 @@ class VersionManager:
             }
             if namespace:
                 cr["metadata"]["namespace"] = namespace
+            if batch is not None:
+
+                def on_create(result: dict) -> None:
+                    if result.get("code") == 201:
+                        with self._lock:
+                            self._cache[key] = result["object"]
+                    else:
+                        # AlreadyExists (stale cache) or transport: the
+                        # direct path re-loads and settles it.
+                        self._retry_direct(namespace, name, status)
+
+                batch.stage(
+                    {"verb": "create", "resource": self.resource, "object": cr},
+                    on_create,
+                )
+                return
             try:
                 self._cache[key] = self.host.create(self.resource, cr)
             except AlreadyExists:
@@ -155,6 +181,21 @@ class VersionManager:
             return
         cr = dict(existing)
         cr["status"] = status
+        if batch is not None:
+
+            def on_update(result: dict) -> None:
+                if result.get("code") == 200:
+                    with self._lock:
+                        self._cache[key] = result["object"]
+                else:
+                    with self._lock:
+                        self._cache.pop(key, None)
+
+            batch.stage(
+                {"verb": "update_status", "resource": self.resource, "object": cr},
+                on_update,
+            )
+            return
         try:
             # Status subresource: plain updates ignore .status.
             self._cache[key] = self.host.update_status(self.resource, cr)
@@ -162,3 +203,13 @@ class VersionManager:
             # Version recording is an optimization (manager.go callers
             # tolerate failure); drop the cache so the next get reloads.
             self._cache.pop(key, None)
+
+    def _retry_direct(self, namespace: str, name: str, status: dict) -> None:
+        """Batched create lost a race: settle through the direct path."""
+        key = self._cr_key(namespace, name)
+        with self._lock:
+            self._cache.pop(key, None)
+            current = self.host.try_get(self.resource, key)
+            if current is not None:
+                self._cache[key] = current
+            self._write(namespace, name, status, current)
