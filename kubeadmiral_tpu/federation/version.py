@@ -156,13 +156,21 @@ class VersionManager:
             if batch is not None:
 
                 def on_create(result: dict) -> None:
-                    if result.get("code") == 201:
+                    code = result.get("code")
+                    if code == 201:
                         with self._lock:
                             self._cache[key] = result["object"]
-                    else:
-                        # AlreadyExists (stale cache) or transport: the
-                        # direct path re-loads and settles it.
+                    elif code == 409:
+                        # AlreadyExists: the cache was stale; re-load and
+                        # settle through the update path.
                         self._retry_direct(namespace, name, status)
+                    else:
+                        # Transport trouble: recording is an optimization
+                        # — drop the cache like the update path does;
+                        # retrying N keys synchronously under the lock
+                        # against a failing host would stall the tick.
+                        with self._lock:
+                            self._cache.pop(key, None)
 
                 batch.stage(
                     {"verb": "create", "resource": self.resource, "object": cr},

@@ -145,6 +145,13 @@ class KubeApiServer:
             accepts, and a crashed/"unreachable" member must look dead
             to clients holding pooled connections too."""
 
+            # http.server's default backlog of 5 resets fresh
+            # connections under a mutation storm (every write rides a
+            # new connection by design; an overflowed accept queue +
+            # syncookies RSTs the first payload).  A control plane's
+            # apiserver must absorb bursts.
+            request_queue_size = 128
+
             def __init__(self_srv, *a, **kw):
                 self_srv.live_sockets = set()
                 self_srv.live_lock = threading.Lock()

@@ -169,3 +169,159 @@ class TestThreadStress:
             assert not panic_count, (
                 f"{ctl.worker.name}: {panic_count} reconcile panics"
             )
+
+
+class TestThreadStressHTTP:
+    """The same storm over REAL sockets: watch reader threads deliver
+    events asynchronously, sync's member writes flush through the
+    BatchSink's pool (thread_registry echo suppression), and the host
+    batch carries status/annotation/version writes — the round-4
+    threading surface under fire."""
+
+    def test_concurrent_controllers_survive_storm_over_sockets(self):
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        ftc = dataclasses.replace(
+            next(f for f in default_ftcs() if f.name == "deployments.apps"),
+            controllers=(("kubeadmiral.io/global-scheduler",),),
+        )
+        farm = KwokLiteFarm()
+        fleet = farm.fleet
+        try:
+            for name in ("c1", "c2"):
+                member = farm.add_member(name)
+                member.create(NODES, make_node("n1", "64", "128Gi"))
+                fleet.host.create(
+                    FEDERATED_CLUSTERS,
+                    {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                     "kind": "FederatedCluster",
+                     "metadata": {"name": name},
+                     "spec": farm.cluster_spec(name)},
+                )
+            fleet.host.create(
+                PROPAGATION_POLICIES,
+                {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                 "kind": "PropagationPolicy",
+                 "metadata": {"name": "pp", "namespace": "default"},
+                 "spec": {"schedulingMode": "Divide"}},
+            )
+            controllers = [
+                FederatedClusterController(
+                    fleet, api_resource_probe=["apps/v1/Deployment"],
+                    resync_seconds=0.5,
+                ),
+                FederateController(fleet.host, ftc),
+                SchedulerController(fleet.host, ftc),
+                SyncController(fleet, ftc),
+            ]
+            for ctl in controllers:
+                ctl.worker.run(workers=2)
+
+            fuzz_errors: list[BaseException] = []
+
+            def fuzz(seed: int):
+                rng = random.Random(seed)
+                try:
+                    for _ in range(40):
+                        name = f"app-{seed}-{rng.randint(0, 7)}"
+                        action = rng.random()
+                        try:
+                            if action < 0.55:
+                                fleet.host.create(
+                                    ftc.source.resource,
+                                    make_deployment(
+                                        name=name, replicas=rng.randint(1, 20)
+                                    ),
+                                )
+                            elif action < 0.85:
+                                obj = fleet.host.try_get(
+                                    ftc.source.resource, f"default/{name}"
+                                )
+                                if obj is not None:
+                                    obj["spec"]["replicas"] = rng.randint(1, 20)
+                                    fleet.host.update(ftc.source.resource, obj)
+                            else:
+                                fleet.host.delete(
+                                    ftc.source.resource, f"default/{name}"
+                                )
+                        except (AlreadyExists, Conflict, NotFound):
+                            pass  # expected races
+                        time.sleep(0.002)
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    fuzz_errors.append(e)
+
+            threads = [
+                threading.Thread(target=fuzz, args=(seed,), daemon=True)
+                for seed in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads), (
+                "fuzz thread wedged mid-storm (transport hang?)"
+            )
+            if fuzz_errors:
+                hs = farm.host_server
+                diag = {
+                    "listener_thread_alive": hs._thread.is_alive(),
+                    "listen_fd": None,
+                    "healthy_probe": None,
+                }
+                try:
+                    diag["listen_fd"] = hs._server.socket.fileno()
+                except Exception as e:
+                    diag["listen_fd"] = f"err {e}"
+                try:
+                    diag["healthy_probe"] = fleet.host.healthy
+                except Exception as e:
+                    diag["healthy_probe"] = f"err {e}"
+                raise AssertionError(f"fuzz errors {fuzz_errors[:2]} diag={diag}")
+
+            def divergence():
+                sources = {}
+                for key in fleet.host.keys(ftc.source.resource):
+                    obj = fleet.host.try_get(ftc.source.resource, key)
+                    if obj is not None:  # tolerate in-flight deletions
+                        sources[key] = obj
+                for key, src in sources.items():
+                    fed = fleet.host.try_get(ftc.federated.resource, key)
+                    if fed is None:
+                        return f"{key}: no federated object"
+                    placed = C.get_placement(fed, C.SCHEDULER)
+                    if not placed:
+                        return f"{key}: never scheduled"
+                    total = 0
+                    for cname in placed:
+                        member_obj = fleet.member(cname).try_get(
+                            ftc.source.resource, key
+                        )
+                        if member_obj is None:
+                            return f"{key}: missing in {cname}"
+                        total += member_obj["spec"].get("replicas", 0)
+                    if total != src["spec"]["replicas"]:
+                        return f"{key}: {total} != {src['spec']['replicas']}"
+                return None
+
+            deadline = time.monotonic() + 90
+            last = "never checked"
+            while time.monotonic() < deadline:
+                time.sleep(0.5)
+                last = divergence()
+                if last is None:
+                    break
+            assert last is None, last
+            for ctl in controllers:
+                panic_count = ctl.metrics.counters.get(
+                    f"{ctl.worker.name}.panic", 0
+                )
+                assert not panic_count, (
+                    f"{ctl.worker.name}: {panic_count} reconcile panics"
+                )
+        finally:
+            # Workers stop BEFORE the servers close, whatever failed —
+            # live reconciles against a closed farm flood the log and
+            # hide the real failure.
+            for ctl in locals().get("controllers", ()):
+                ctl.worker.stop()
+            farm.close()
