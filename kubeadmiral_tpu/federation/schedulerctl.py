@@ -30,6 +30,7 @@ from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.models.types import parse_resources
 from kubeadmiral_tpu.runtime import pending
 from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.hostbatch import HostBatch
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
 from kubeadmiral_tpu.scheduler.engine import ScheduleResult, SchedulerEngine
 from kubeadmiral_tpu.scheduler import webhook as W
@@ -468,8 +469,25 @@ class SchedulerController:
             )
         self.metrics.counter(f"scheduler-{self.ftc.name}.scheduled", len(units))
 
-        for (key, fed_obj, policy, trigger), outcome in zip(to_schedule, outcomes):
-            results[key] = self._persist(key, fed_obj, policy, trigger, outcome)
+        hb = HostBatch(self.host)
+        try:
+            for (key, fed_obj, policy, trigger), outcome in zip(
+                to_schedule, outcomes
+            ):
+                # Per-key isolation: one poison object backs off alone;
+                # every already-staged placement still flushes.
+                try:
+                    results[key] = self._persist(
+                        key, fed_obj, policy, trigger, outcome, hb, results
+                    )
+                except Exception:
+                    self.metrics.counter(
+                        f"scheduler-{self.ftc.name}.persist_panic"
+                    )
+                    results[key] = Result.retry()
+        finally:
+            # ONE bulk host round trip persists every placement.
+            hb.flush()
         return results
 
     # -- webhook (out-of-process) plugins --------------------------------
@@ -753,6 +771,8 @@ class SchedulerController:
         policy: P.PolicySpec,
         trigger: str,
         outcome: ScheduleResult,
+        hb: HostBatch,
+        results: dict,
     ) -> Result:
         modified = C.set_placement(fed_obj, self.name, outcome.cluster_set)
 
@@ -788,13 +808,23 @@ class SchedulerController:
 
         ann[C.SCHEDULING_TRIGGER_HASH] = trigger
         pending.update_pending(fed_obj, self.name, modified, self.ftc.controller_groups)
-        try:
-            self.host.update(self._resource, fed_obj)
-        except Conflict:
-            return Result.retry()
-        except NotFound:
-            return Result.ok()
+
+        def on_persist(result: dict) -> None:
+            code = result.get("code")
+            if code in (200, 404):
+                return  # persisted, or object gone
+            # Conflict (or transport): requeue with backoff; the next
+            # tick re-reads the object, recomputes the trigger hash and
+            # reschedules — the batch analogue of the reference's
+            # per-object retry loop.
+            results[key] = Result.retry()
+
+        def on_panic() -> None:
+            results[key] = Result.retry()
+
+        hb.stage(
+            {"verb": "update", "resource": self._resource, "object": fed_obj},
+            on_persist,
+            on_panic,
+        )
         return Result.ok()
-    # NOTE: conflicts requeue with backoff; the next tick re-reads the
-    # object, recomputes the trigger hash and reschedules — the batch
-    # analogue of the reference's per-object retry loop.

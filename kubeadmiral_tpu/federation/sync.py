@@ -41,6 +41,7 @@ from kubeadmiral_tpu.federation.version import VersionManager
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.runtime.eventsink import DefederatingRecorderMux
 from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime.hostbatch import HostBatch
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
 from kubeadmiral_tpu.testing.fakekube import (
@@ -171,50 +172,6 @@ class _TickClusters:
             for c in joined
         }
         self.joined_set = frozenset(self.flags)
-
-
-class _HostBatch:
-    """Host-side write staging for one BatchWorker tick: every object's
-    status/annotation update rides ONE ``host.batch()`` round trip per
-    drain instead of one round trip per write.  Callbacks may stage
-    follow-up ops (the syncing annotation uses the resourceVersion the
-    status write returned), so ``flush`` drains until quiescent.
-    Per-op conflicts fall back to the caller's synchronous retry loops."""
-
-    def __init__(self, host):
-        self.host = host
-        self._ops: list[tuple[dict, Callable[[dict], None], Optional[Callable[[], None]]]] = []
-
-    def stage(
-        self,
-        op: dict,
-        on_result: Callable[[dict], None],
-        on_panic: Optional[Callable[[], None]] = None,
-    ) -> None:
-        self._ops.append((op, on_result, on_panic))
-
-    def flush(self) -> None:
-        while self._ops:
-            ops, self._ops = self._ops, []
-            try:
-                results = self.host.batch([op for op, _, _ in ops])
-            except Exception as e:
-                results = [
-                    {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
-                ] * len(ops)
-            if len(results) < len(ops):
-                results = list(results) + [
-                    {"code": 500, "status": {"reason": "Transport",
-                                             "message": "batch result missing"}}
-                ] * (len(ops) - len(results))
-            for (_, on_result, on_panic), result in zip(ops, results):
-                try:
-                    on_result(result)
-                except Exception:
-                    # A callback (or its synchronous fallback) died: the
-                    # object must RETRY, not silently pass as finished.
-                    if on_panic is not None:
-                        on_panic()
 
 
 class SyncController:
@@ -440,7 +397,7 @@ class SyncController:
                 else:
                     finishers.append((key, out))
             sink.flush()
-            hb = _HostBatch(self.host)
+            hb = HostBatch(self.host)
             for key, finish in finishers:
                 try:
                     results[key] = finish(hb, results, key)
@@ -737,7 +694,7 @@ class SyncController:
                     continue
                 dispatcher.update(cname, cluster_obj, version)
 
-        def finish(hb: _HostBatch, results: dict, key: str) -> Result:
+        def finish(hb: HostBatch, results: dict, key: str) -> Result:
             """Runs after the tick's sink flushes: status/version
             bookkeeping over the completed dispatch round.  Host writes
             are staged into ``hb``; callbacks downgrade ``results[key]``
@@ -838,7 +795,7 @@ class SyncController:
     # -- status ----------------------------------------------------------
     def _stage_status_writes(
         self,
-        hb: _HostBatch,
+        hb: HostBatch,
         fed: FederatedResource,
         reason: str,
         status_map: dict[str, str],
@@ -889,7 +846,7 @@ class SyncController:
 
     def _stage_annotation(
         self,
-        hb: _HostBatch,
+        hb: HostBatch,
         fed: FederatedResource,
         obj: dict,
         status_map: dict[str, str],
