@@ -22,6 +22,10 @@ import time
 
 N_OBJECTS = int(os.environ.get("BENCH_E2E_OBJECTS", 1000))
 N_CLUSTERS = int(os.environ.get("BENCH_E2E_CLUSTERS", 50))
+# "inproc" (default): the in-memory ClusterFleet.  "http": every
+# apiserver a real socket server (kwok-lite farm) — measures the
+# transport path the bulk-write batching exists for.
+TRANSPORT = os.environ.get("BENCH_E2E_TRANSPORT", "inproc")
 
 
 class StageTimer:
@@ -32,6 +36,26 @@ class StageTimer:
         self.controllers = named_controllers
 
     def settle(self, max_rounds=10_000):
+        if TRANSPORT == "http":
+            # Watch events arrive asynchronously over sockets: quiesce
+            # only after `grace` consecutive idle polls.
+            deadline = time.monotonic() + 600.0
+            idle = 0
+            while time.monotonic() < deadline and idle < 12:
+                progressed = False
+                for name, ctl in self.controllers:
+                    t0 = time.perf_counter()
+                    stepped = True
+                    while stepped:
+                        stepped = ctl.worker.step()
+                        progressed |= stepped
+                    self.stages[name] += time.perf_counter() - t0
+                if progressed:
+                    idle = 0
+                else:
+                    idle += 1
+                    time.sleep(0.05)
+            return
         for _ in range(max_rounds):
             progressed = False
             for name, ctl in self.controllers:
@@ -49,6 +73,10 @@ class StageTimer:
 
 def main():
     import dataclasses
+
+    from kubeadmiral_tpu.runtime.gctune import tune_gc_for_service
+
+    tune_gc_for_service()
 
     from kubeadmiral_tpu.federation.clusterctl import (
         FEDERATED_CLUSTERS,
@@ -73,7 +101,14 @@ def main():
             ("kubeadmiral.io/overridepolicy-controller",),
         ),
     )
-    fleet = ClusterFleet()
+    farm = None
+    if TRANSPORT == "http":
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        farm = KwokLiteFarm()
+        fleet = farm.fleet
+    else:
+        fleet = ClusterFleet()
     gvk = "apps/v1/Deployment"
 
     named = [
@@ -86,8 +121,11 @@ def main():
     ]
     timer = StageTimer(named)
 
+    members = {}
     for j in range(N_CLUSTERS):
-        member = fleet.add_member(f"m-{j:04d}")
+        name_j = f"m-{j:04d}"
+        member = farm.add_member(name_j) if farm else fleet.add_member(name_j)
+        members[name_j] = member
         member.create(
             NODES,
             {
@@ -106,8 +144,8 @@ def main():
             {
                 "apiVersion": "core.kubeadmiral.io/v1alpha1",
                 "kind": "FederatedCluster",
-                "metadata": {"name": f"m-{j:04d}", "labels": {"tier": str(j % 3)}},
-                "spec": {},
+                "metadata": {"name": name_j, "labels": {"tier": str(j % 3)}},
+                "spec": farm.cluster_spec(name_j) if farm else {},
             },
         )
     fleet.host.create(
@@ -190,7 +228,7 @@ def main():
     # zero-replica clusters, so the expected count comes from the actual
     # placements, not N x C.)
     member_objects = sum(
-        len(kube.keys(ftc.source.resource)) for kube in fleet.members.values()
+        len(kube.keys(ftc.source.resource)) for kube in members.values()
     )
     expected = 0
     for key in fleet.host.keys(ftc.federated.resource):
@@ -212,10 +250,14 @@ def main():
     from kubeadmiral_tpu.bench_support import bench_platform_detail
 
     result = {
-        "metric": f"e2e_objects_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
+        "metric": (
+            f"e2e_objects_per_sec_{N_OBJECTS}x{N_CLUSTERS}"
+            + ("_http" if TRANSPORT == "http" else "")
+        ),
         "value": round(N_OBJECTS / total_s, 1),
         "unit": "objects/s",
         "detail": {
+            "transport": TRANSPORT,
             **bench_platform_detail(),
             "total_s": round(total_s, 2),
             "create_s": round(create_s, 2),
@@ -229,6 +271,8 @@ def main():
     assert propagated  # first object reached its placed members
     print(json.dumps(result))
     print(f"# stages: {stages}", file=sys.stderr)
+    if farm is not None:
+        farm.close()
 
 
 if __name__ == "__main__":

@@ -173,3 +173,20 @@ def overrides_for_cluster(fed_obj: dict, cluster: str) -> list:
             if c.get("cluster") == cluster:
                 patches.extend(c.get("patches", []))
     return patches
+
+
+def cluster_lifecycle_sig(cluster_obj: dict) -> tuple:
+    """What about a FederatedCluster justifies re-reconciling the world:
+    join/ready/terminating transitions (the reference's
+    ClusterLifecycleHandlers, controller.go:244-260) — NOT heartbeat
+    bumps.  Controllers keep a name->sig map and fan out only on
+    change."""
+    conds = {
+        c.get("type"): c.get("status")
+        for c in cluster_obj.get("status", {}).get("conditions", [])
+    }
+    return (
+        conds.get("Joined") == "True",
+        conds.get("Ready") == "True",
+        bool(cluster_obj["metadata"].get("deletionTimestamp")),
+    )

@@ -54,6 +54,7 @@ class NamespaceAutoPropagationController:
         self._resource = ftc.federated.resource
 
         host.watch(self._resource, self._on_object_event, replay=True)
+        self._cluster_sigs: dict[str, tuple] = {}
         host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
 
     def _on_object_event(self, event: str, obj: dict) -> None:
@@ -61,7 +62,16 @@ class NamespaceAutoPropagationController:
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         # Cluster membership changes re-place every namespace
-        # (controller.go reconcileAll on cluster add/delete).
+        # (controller.go reconcileAll on cluster add/delete) — gated on
+        # lifecycle transitions so heartbeats don't re-place the world.
+        sig = C.cluster_lifecycle_sig(obj)
+        name = obj["metadata"]["name"]
+        if event == "DELETED":
+            self._cluster_sigs.pop(name, None)
+        elif self._cluster_sigs.get(name) == sig:
+            return
+        else:
+            self._cluster_sigs[name] = sig
         self.worker.enqueue_all(self.host.keys(self._resource))
 
     def _should_propagate(self, fed_ns: dict) -> bool:

@@ -56,6 +56,7 @@ class StatusController:
         self.worker = Worker(
             f"status-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
         )
+        self._cluster_sigs: dict[str, tuple] = {}
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
         self._reattach = fleet.watch_members(
@@ -69,6 +70,14 @@ class StatusController:
         self.worker.enqueue(obj_key(obj))
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
+        sig = C.cluster_lifecycle_sig(obj)
+        name = obj["metadata"]["name"]
+        if event == "DELETED":
+            self._cluster_sigs.pop(name, None)  # re-creation must fan out
+        elif self._cluster_sigs.get(name) == sig:
+            return  # heartbeat bump: nothing placement-relevant changed
+        else:
+            self._cluster_sigs[name] = sig
         self._reattach()
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
@@ -360,6 +369,7 @@ class StatusAggregator:
         self.worker = Worker(
             f"statusagg-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
         )
+        self._cluster_sigs: dict[str, tuple] = {}
         self.host.watch(self._fed_resource, self._on_event, replay=True)
         self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
         self._reattach = fleet.watch_members(self._target_resource, self._on_event)
@@ -368,6 +378,14 @@ class StatusAggregator:
         self.worker.enqueue(obj_key(obj))
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
+        sig = C.cluster_lifecycle_sig(obj)
+        name = obj["metadata"]["name"]
+        if event == "DELETED":
+            self._cluster_sigs.pop(name, None)
+        elif self._cluster_sigs.get(name) == sig:
+            return
+        else:
+            self._cluster_sigs[name] = sig
         self._reattach()
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 

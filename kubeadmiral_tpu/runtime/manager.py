@@ -304,6 +304,9 @@ class ControllerManager:
         thread(s) (the reference's N goroutines per ReconcileWorker).
         Controllers started later — new/changed FTCs — are threaded as
         they appear."""
+        from kubeadmiral_tpu.runtime.gctune import tune_gc_for_service
+
+        tune_gc_for_service()
         self._threaded_workers = workers_per_controller
         # Pre-warm the engine's XLA programs for the current topology in
         # a background thread: the first real scheduling tick should hit

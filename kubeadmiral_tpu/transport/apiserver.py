@@ -346,6 +346,10 @@ class KubeApiServer:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Nagle + delayed ACK turns every response on a kept-alive
+    # connection into a ~40 ms stall (the response spans multiple small
+    # writes); an apiserver's latency budget is microseconds.
+    disable_nagle_algorithm = True
 
     @property
     def api(self) -> KubeApiServer:

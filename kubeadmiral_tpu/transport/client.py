@@ -23,6 +23,7 @@ import functools
 import http.client
 import json
 import logging
+import socket as pysocket
 import threading
 import time
 from typing import Callable, Optional
@@ -55,6 +56,19 @@ class Gone(Exception):
     """410: watch resourceVersion expired — relist."""
 
 
+class _NoDelayConnection(http.client.HTTPConnection):
+    """HTTPConnection with TCP_NODELAY: requests are small multi-write
+    payloads, and Nagle + the peer's delayed ACK add ~40 ms per call on
+    kept-alive connections."""
+
+    def connect(self):
+        super().connect()
+        try:
+            self.sock.setsockopt(pysocket.IPPROTO_TCP, pysocket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
 class HttpKube:
     """One apiserver client; duck-types FakeKube."""
 
@@ -85,7 +99,7 @@ class HttpKube:
     def _conn(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = http.client.HTTPConnection(self._netloc, timeout=self._timeout)
+            conn = _NoDelayConnection(self._netloc, timeout=self._timeout)
             self._local.conn = conn
         return conn
 
@@ -100,7 +114,7 @@ class HttpKube:
             # surface spurious AlreadyExists/Conflict/NotFound to callers
             # that treat those as genuine races.  A localhost handshake
             # costs microseconds; ambiguity costs correctness.
-            conn = http.client.HTTPConnection(self._netloc, timeout=self._timeout)
+            conn = _NoDelayConnection(self._netloc, timeout=self._timeout)
             try:
                 conn.request(method, path, body=payload, headers=self._headers())
                 resp = conn.getresponse()
@@ -444,14 +458,42 @@ class HttpFleet:
         self.host = host
         self.factory = factory or FederatedClientFactory(host)
         self.members: dict[str, HttpKube] = {}
+        # Invalidate cached member clients on cluster deletion/endpoint
+        # change (see member()).
+        host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_change, replay=False)
 
     def member(self, name: str) -> HttpKube:
+        # Cache hit first: resolving through the host costs TWO round
+        # trips (cluster + join secret) and sits on the sync dispatcher's
+        # hottest path.  The fleet's own FederatedClusters watch (below)
+        # pops entries on deletion and spec changes, so a removed
+        # cluster raises NotFound on the next call (ClusterFleet.member
+        # parity) and endpoint/credential rotation rebuilds the client —
+        # the reference's informer-backed FederatedClientFactory caches
+        # the same way (federatedclient/client.go:48-386).
+        client = self.members.get(name)
+        if client is not None:
+            return client
         cluster = self.host.try_get(C.FEDERATED_CLUSTERS, name)
         if cluster is None:
             raise NotFound(f"cluster {name}")
         client = self.factory.client_for(cluster)
         self.members[name] = client
         return client
+
+    def _on_cluster_change(self, event: str, obj: dict) -> None:
+        name = obj["metadata"]["name"]
+        if event == DELETED:
+            self.members.pop(name, None)
+            return
+        cached = self.members.get(name)
+        if cached is None:
+            return
+        # Endpoint moved: drop the stale client (the factory re-reads
+        # the join secret on the next member() miss).
+        endpoint = (obj.get("spec") or {}).get("apiEndpoint")
+        if endpoint and f"//{cached._netloc}" not in endpoint:
+            self.members.pop(name, None)
 
     def unwatch_owner(self, owner: object) -> None:
         self.host.unwatch_owner(owner)
