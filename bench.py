@@ -327,6 +327,9 @@ def time_python_oracle(units, clusters, sample=200):
 
 
 def main():
+    from kubeadmiral_tpu.runtime.gctune import tune_gc_for_service
+
+    tune_gc_for_service()
     rng = np.random.default_rng(20260729)
     units, clusters, followers = build_world(rng)
 
