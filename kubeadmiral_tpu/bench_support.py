@@ -226,11 +226,12 @@ def run_resilient(main, script_path: str) -> None:
         import re
 
         msg = f"{type(e).__name__}: {e}"
-        # Whole-token match: "tpu" as a bare substring lives inside
-        # "output", which would relabel genuine code bugs as platform
-        # failures and hide them behind a green cpu-fallback artifact.
+        # Whole-token match, unambiguous platform markers ONLY: generic
+        # words ("backend", "deadline", bare-substring "tpu" inside
+        # "output") would relabel genuine code bugs as platform failures
+        # and hide them behind a green cpu-fallback artifact.
         if re.search(
-            r"\b(unavailable|deadline_exceeded|deadline|backend|axon|tpu|pjrt)\b",
+            r"\b(unavailable|deadline[_ ]exceeded|axon|tpu|pjrt)\b",
             msg,
             re.IGNORECASE,
         ):

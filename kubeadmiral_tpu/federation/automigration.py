@@ -40,10 +40,15 @@ def _pod_scheduled_condition(pod: dict) -> Optional[dict]:
     return None
 
 
-def _transition_time(value) -> float:
+def _transition_time(value) -> Optional[float]:
     """Condition timestamps as seconds: accepts the monotonic floats the
     in-process tests use AND the RFC3339 strings real pods carry
-    (metav1.Time in automigration/util.go)."""
+    (metav1.Time in automigration/util.go).  Malformed timestamps yield
+    None — the caller skips the condition rather than treating the pod
+    as unschedulable-since-epoch (which would silently migrate on
+    garbage input).  A MISSING timestamp still maps to 0.0 — Go's
+    metav1.Time zero value — matching the reference's time.Since(zero)
+    behavior."""
     if not value:
         return 0.0
     try:
@@ -57,7 +62,7 @@ def _transition_time(value) -> float:
             str(value).replace("Z", "+00:00")
         ).timestamp()
     except ValueError:
-        return 0.0
+        return None
 
 
 def count_unschedulable_pods(
@@ -78,6 +83,8 @@ def count_unschedulable_pods(
         ):
             continue
         since = _transition_time(cond.get("lastTransitionTime", 0))
+        if since is None:  # malformed timestamp: not yet crossed
+            continue
         crossing_in = since + threshold - now
         if crossing_in <= 0:
             count += 1

@@ -162,26 +162,38 @@ class BatchSink:
             return
 
         def flush_cluster(cluster: str, entries: list) -> None:
-            self.thread_registry.add(threading.get_ident())
+            # Register only our own ident and remove only what we added:
+            # with BatchWorker(workers>1) two concurrent ticks flush their
+            # own sinks into a SHARED registry, so a blanket clear() here
+            # would wipe the other tick's in-flight registrations and its
+            # member-write echoes would re-enqueue keys.
+            ident = threading.get_ident()
+            added = ident not in self.thread_registry
+            if added:
+                self.thread_registry.add(ident)
             try:
-                client = self.client_for_cluster(cluster)
-                results = client.batch([op for op, _ in entries])
-            except Exception as e:
-                results = [
-                    {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
-                ] * len(entries)
-            if len(results) < len(entries):
-                # A short results array must not strand the tail at its
-                # pre-recorded *_TIMED_OUT status with no cause.
-                results = list(results) + [
-                    {"code": 500, "status": {"reason": "Transport",
-                                             "message": "batch result missing"}}
-                ] * (len(entries) - len(results))
-            for (_, continuation), result in zip(entries, results):
                 try:
-                    continuation(result)
-                except Exception:
-                    pass  # continuations record their own failures
+                    client = self.client_for_cluster(cluster)
+                    results = client.batch([op for op, _ in entries])
+                except Exception as e:
+                    results = [
+                        {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+                    ] * len(entries)
+                if len(results) < len(entries):
+                    # A short results array must not strand the tail at its
+                    # pre-recorded *_TIMED_OUT status with no cause.
+                    results = list(results) + [
+                        {"code": 500, "status": {"reason": "Transport",
+                                                 "message": "batch result missing"}}
+                    ] * (len(entries) - len(results))
+                for (_, continuation), result in zip(entries, results):
+                    try:
+                        continuation(result)
+                    except Exception:
+                        pass  # continuations record their own failures
+            finally:
+                if added:
+                    self.thread_registry.discard(ident)
 
         if self._pool is not None and len(staged) > 1:
             deadline = time.monotonic() + timeout
@@ -197,7 +209,6 @@ class BatchSink:
         else:
             for cluster, entries in staged.items():
                 flush_cluster(cluster, entries)
-        self.thread_registry.clear()
 
     def wait(self, timeout: float) -> None:
         # Dispatchers sharing this sink call wait() after the controller

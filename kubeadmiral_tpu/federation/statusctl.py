@@ -75,7 +75,17 @@ class StatusController:
         if event == "DELETED":
             self._cluster_sigs.pop(name, None)  # re-creation must fan out
         elif self._cluster_sigs.get(name) == sig:
-            return  # heartbeat bump: nothing placement-relevant changed
+            # Heartbeat bump: nothing placement-relevant changed, but a
+            # transiently failed member-watch attach still needs its
+            # retry channel (mirrors sync's heartbeat-path check).
+            # Unlike sync, these watches attach with replay=False, so a
+            # late success re-delivers nothing — fan the fed objects out
+            # to pick up statuses that accrued while unattached.
+            if getattr(self._reattach, "pending", None):
+                self._reattach()
+                if not getattr(self._reattach, "pending", None):
+                    self.worker.enqueue_all(self.host.keys(self._fed_resource))
+            return
         else:
             self._cluster_sigs[name] = sig
         self._reattach()
@@ -383,6 +393,11 @@ class StatusAggregator:
         if event == "DELETED":
             self._cluster_sigs.pop(name, None)
         elif self._cluster_sigs.get(name) == sig:
+            if getattr(self._reattach, "pending", None):
+                self._reattach()  # retry a transiently failed attach
+                if not getattr(self._reattach, "pending", None):
+                    # replay=False: late attach re-delivers nothing.
+                    self.worker.enqueue_all(self.host.keys(self._fed_resource))
             return
         else:
             self._cluster_sigs[name] = sig
