@@ -184,17 +184,16 @@ def build_world(rng):
     return units, clusters, followers
 
 
-def follower_union(results, followers):
+def follower_index(followers):
     """Follower scheduling: placement = union of the leaders' clusters
     (reference: pkg/controllers/follower/controller.go:95-521 writes
     spec.follows so follower placement covers its leaders).  Bench
-    models each follower following its 3 preceding leaders."""
-    for i in followers:
-        union: dict = {}
-        for leader in range(max(0, i - 3), i):
-            union.update(results[leader].clusters)
-        results[i].clusters = {c: None for c in union}
-    return results
+    models each follower following its 3 preceding leaders; the union
+    itself is the engine-side incremental capability (ops/follower.py),
+    driven by the tick's changed-row set."""
+    from kubeadmiral_tpu.ops.follower import FollowerIndex
+
+    return FollowerIndex({i: range(max(0, i - 3), i) for i in followers})
 
 
 def churn(rng, units, fraction=0.01):
@@ -218,6 +217,7 @@ def time_batched(rng, units, clusters, followers):
     from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
 
     engine = SchedulerEngine(chunk_size=CHUNK)
+    fidx = follower_index(followers) if followers else None
     # Pre-warm exactly as the production manager does at start
     # (ControllerManager.run): the ladder's tick/gather programs compile
     # (or load from the persistent cache) BEFORE the first real tick.
@@ -234,15 +234,15 @@ def time_batched(rng, units, clusters, followers):
     # Cold tick: featurizes from scratch, uploads everything, fetches
     # everything — against prewarmed programs.
     t_cold = time.perf_counter()
-    engine.schedule(units, clusters)
+    engine.schedule(units, clusters, follower_index=fidx)
     cold_ms = (time.perf_counter() - t_cold) * 1e3
     cold_featurize_ms = round(engine.timings["featurize"] * 1e3, 1)
     # One churned tick outside the timing loop (first sub-batch shapes).
     units = churn(rng, units)
-    engine.schedule(units, clusters)
+    engine.schedule(units, clusters, follower_index=fidx)
     # No-op tick: byte-identical world — the engine's trigger-skip path.
     t_noop = time.perf_counter()
-    engine.schedule(units, clusters)
+    engine.schedule(units, clusters, follower_index=fidx)
     noop_ms = (time.perf_counter() - t_noop) * 1e3
 
     # Timed ticks: full-batch revalidation with 1% churn.  Same work
@@ -253,13 +253,7 @@ def time_batched(rng, units, clusters, followers):
     t0 = time.perf_counter()
     for _ in range(TICKS):
         units = churn(rng, units)
-        results = engine.schedule(units, clusters)
-        if followers:
-            t_f = time.perf_counter()
-            results = follower_union(results, followers)
-            detail["follower"] = detail.get("follower", 0.0) + (
-                time.perf_counter() - t_f
-            )
+        results = engine.schedule(units, clusters, follower_index=fidx)
         for stage, secs in engine.timings.items():
             detail[stage] = detail.get(stage, 0.0) + secs
     dt = (time.perf_counter() - t0) / TICKS
@@ -275,7 +269,7 @@ def time_batched(rng, units, clusters, followers):
         available={k: max(0, v // 2) for k, v in drifted[0].available.items()},
     )
     t_drift = time.perf_counter()
-    engine.schedule(units, drifted)
+    engine.schedule(units, drifted, follower_index=fidx)
     drift_ms = (time.perf_counter() - t_drift) * 1e3
 
     detail = {k: round(v / TICKS * 1e3, 1) for k, v in detail.items()}
@@ -287,25 +281,70 @@ def time_batched(rng, units, clusters, followers):
     detail["cache"] = dict(engine.cache_stats)
     detail["fetch_paths"] = dict(engine.fetch_stats)
     detail["program_shapes"] = sorted(map(list, engine.program_shapes))
-    return dt, placed, detail
+    # The units/results of the LAST timed tick: the parity check runs
+    # the sequential baseline over this exact world.
+    return dt, placed, detail, units, results
+
+
+def _fingerprint_native(sel, rep, cnt) -> np.ndarray:
+    """Per-row placement fingerprint of a native output chunk:
+    (n selected, Σcol, Σcol², Σreplicas, Σreplicas·(col+1)) — position-
+    and value-sensitive, so it catches any per-object divergence in the
+    selected set or the per-cluster replica assignment."""
+    c = sel.shape[1]
+    cols = np.arange(c, dtype=np.int64)
+    selb = sel.astype(np.int64)
+    cntb = cnt.astype(np.int64)
+    return np.stack(
+        [
+            selb.sum(1),
+            (selb * cols).sum(1),
+            (selb * cols * cols).sum(1),
+            (rep * cntb).sum(1),
+            (rep * cntb * (cols + 1)).sum(1),
+        ],
+        axis=1,
+    )
+
+
+def _fingerprint_results(results, names) -> np.ndarray:
+    """The same fingerprint computed from the batched tick's decoded
+    ScheduleResults."""
+    name_idx = {n: i for i, n in enumerate(names)}
+    out = np.zeros((len(results), 5), np.int64)
+    for i, r in enumerate(results):
+        n = s = s2 = rs = rc = 0
+        for cname, repv in r.clusters.items():
+            ci = name_idx[cname]
+            n += 1
+            s += ci
+            s2 += ci * ci
+            if repv is not None:
+                rs += repv
+                rc += repv * (ci + 1)
+        out[i] = (n, s, s2, rs, rc)
+    return out
 
 
 def time_native_baseline(units, clusters):
     """The compiled sequential scheduler over the full batch, fed
     pre-featurized, pre-marshalled arrays (neither featurization nor
-    numpy dtype conversion is charged to it)."""
+    numpy dtype conversion is charged to it).  Also returns the per-row
+    placement fingerprints for the batched-vs-native parity check
+    (computed outside the timed window)."""
     from kubeadmiral_tpu.native import load as native_load
     from kubeadmiral_tpu.native.seqsched import prepare, run
     from kubeadmiral_tpu.scheduler.featurize import featurize
 
     if native_load() is None:
-        return None, 0
+        return None, 0, None
     # Stream chunk by chunk (featurize+prepare excluded from the timed
     # window): materializing every dense chunk up front would hold
     # ~250 MB x chunks in RAM at the 100k x 5k config.
     total = 0.0
     placed = 0
     view = None
+    fingerprints = []
     for start in range(0, len(units), CHUNK):
         chunk = units[start : start + CHUNK]
         fb = featurize(chunk, clusters, view=view)
@@ -315,7 +354,28 @@ def time_native_baseline(units, clusters):
         out = run(prepared)
         total += time.perf_counter() - t0
         placed += int((out[0].sum(axis=1) > 0).sum())
-    return total, placed
+        fingerprints.append(_fingerprint_native(*out))
+    return total, placed, np.concatenate(fingerprints)
+
+
+def parity_check(results, native_fps, names, followers) -> dict:
+    """Batched-vs-native placement parity at the full bench shape
+    (VERDICT r4 #4): per-object selected set + per-cluster replica
+    assignment must agree.  Follower rows are excluded — their placement
+    is the post-schedule leader union, which only the batched path
+    applies (the reference's follower controller does it outside the
+    scheduler too)."""
+    got = _fingerprint_results(results, names)
+    mask = np.ones(len(results), bool)
+    if followers:
+        mask[np.asarray(followers)] = False
+    agree = (got[mask] == native_fps[mask]).all(axis=1)
+    mismatches = int((~agree).sum())
+    return {
+        "parity": mismatches == 0,
+        "parity_rows_checked": int(mask.sum()),
+        "parity_mismatches": mismatches,
+    }
 
 
 def time_python_oracle(units, clusters, sample=200):
@@ -333,8 +393,14 @@ def main():
     rng = np.random.default_rng(20260729)
     units, clusters, followers = build_world(rng)
 
-    tick_seconds, placed, detail = time_batched(rng, units, clusters, followers)
-    native_seconds, native_placed = time_native_baseline(units, clusters)
+    tick_seconds, placed, detail, final_units, final_results = time_batched(
+        rng, units, clusters, followers
+    )
+    # Baseline runs over the exact world the batched path last decided
+    # (the final churned tick), so placements are directly comparable.
+    native_seconds, native_placed, native_fps = time_native_baseline(
+        final_units, clusters
+    )
 
     batched_rate = N_OBJECTS / tick_seconds
     if native_seconds is not None:
@@ -348,6 +414,17 @@ def main():
         detail["native_baseline_ms"] = None
 
     from kubeadmiral_tpu.bench_support import bench_platform_detail
+
+    parity = (
+        parity_check(
+            final_results,
+            native_fps,
+            [c.name for c in clusters],
+            followers,
+        )
+        if native_fps is not None
+        else {"parity": None}
+    )
 
     result = {
         "metric": f"objects_scheduled_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
@@ -364,6 +441,8 @@ def main():
             else "python-oracle",
             "baseline_objects_per_sec": round(native_rate, 1),
             "placed": placed,
+            "native_placed": native_placed,
+            **parity,
         },
     }
     print(json.dumps(result))

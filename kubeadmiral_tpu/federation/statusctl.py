@@ -32,6 +32,24 @@ from kubeadmiral_tpu.testing.fakekube import (
 )
 from kubeadmiral_tpu.utils.unstructured import copy_json, get_path, set_path
 
+
+def _retry_pending_attach(reattach, worker, host, fed_resource) -> None:
+    """Heartbeat-path retry for transiently failed member-watch attaches
+    (mirrors sync's check).  These watches attach with replay=False, so a
+    late success re-delivers nothing — whenever the pending set SHRANK
+    (not only when it drained: other clusters may still be unjoined),
+    fan the fed objects out to pick up statuses that accrued while
+    unattached."""
+    before = getattr(reattach, "pending", None)
+    if not before:
+        return
+    before = set(before)
+    reattach()
+    after = set(getattr(reattach, "pending", None) or ())
+    if before - after:
+        worker.enqueue_all(host.keys(fed_resource))
+
+
 class StatusController:
     """Collects member-object fields into the status CR."""
 
@@ -77,14 +95,10 @@ class StatusController:
         elif self._cluster_sigs.get(name) == sig:
             # Heartbeat bump: nothing placement-relevant changed, but a
             # transiently failed member-watch attach still needs its
-            # retry channel (mirrors sync's heartbeat-path check).
-            # Unlike sync, these watches attach with replay=False, so a
-            # late success re-delivers nothing — fan the fed objects out
-            # to pick up statuses that accrued while unattached.
-            if getattr(self._reattach, "pending", None):
-                self._reattach()
-                if not getattr(self._reattach, "pending", None):
-                    self.worker.enqueue_all(self.host.keys(self._fed_resource))
+            # retry channel.
+            _retry_pending_attach(
+                self._reattach, self.worker, self.host, self._fed_resource
+            )
             return
         else:
             self._cluster_sigs[name] = sig
@@ -393,11 +407,9 @@ class StatusAggregator:
         if event == "DELETED":
             self._cluster_sigs.pop(name, None)
         elif self._cluster_sigs.get(name) == sig:
-            if getattr(self._reattach, "pending", None):
-                self._reattach()  # retry a transiently failed attach
-                if not getattr(self._reattach, "pending", None):
-                    # replay=False: late attach re-delivers nothing.
-                    self.worker.enqueue_all(self.host.keys(self._fed_resource))
+            _retry_pending_attach(
+                self._reattach, self.worker, self.host, self._fed_resource
+            )
             return
         else:
             self._cluster_sigs[name] = sig

@@ -215,16 +215,27 @@ void dynamic_weights(const World& w, const std::vector<int>& selected,
     for (int j : selected) weights_out[j] = 0;
     return;
   }
-  int64_t max_w = 0, other = 0;
-  int max_j = -1;
-  for (int j : selected) {  // deterministic first-max, selection order
+  int64_t other = 0;
+  for (int j : selected) {
     int64_t wgt = round_half((double)tmp[j] / tmp_sum * 1000);
-    if (wgt > max_w) {
-      max_w = wgt;
-      max_j = j;
-    }
     weights_out[j] = wgt;
     other += wgt;
+  }
+  // Rounding residual to the max-weight cluster, first by CLUSTER INDEX
+  // on ties — the canonical rule shared with ops/weights.py and the
+  // python oracle (the reference's own pick is Go-map-order dependent,
+  // rsp.go:248-272).  `selected` arrives score-ranked, so scan a sorted
+  // copy; picking the first max in ranked order diverges from the
+  // batched kernel whenever scores reorder tied-weight clusters.
+  std::vector<int> by_index(selected);
+  std::sort(by_index.begin(), by_index.end());
+  int64_t max_w = 0;
+  int max_j = -1;
+  for (int j : by_index) {
+    if (weights_out[j] > max_w) {
+      max_w = weights_out[j];
+      max_j = j;
+    }
   }
   if (max_j >= 0) weights_out[max_j] += 1000 - other;
 }

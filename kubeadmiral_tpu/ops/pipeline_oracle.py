@@ -126,13 +126,23 @@ def _dynamic_weights(p: OracleProblem, selected: list[int]) -> dict[int, int]:
     if tmp_sum <= 0:
         return {c: 0 for c in selected}
     weights = {}
-    max_w, max_c, other = 0, None, 0
-    for c in selected:  # deterministic first-max (Go iterates a map here)
+    other = 0
+    for c in selected:
         w = round_half(tmp[c] / tmp_sum * 1000)
-        if w > max_w:
-            max_w, max_c = w, c
         weights[c] = w
         other += w
+    # Rounding residual goes to the max-weight cluster, first by CLUSTER
+    # INDEX on ties — the device kernel's canonical choice
+    # (ops/weights.py).  The reference's own pick is Go-map-iteration-
+    # order dependent (rsp.go:248-272), so any deterministic rule is
+    # faithful; all three implementations (device, this oracle, the C++
+    # baseline) must share ONE rule or large-shape parity breaks on
+    # score-ordered vs index-ordered selections (found by the r5 bench
+    # parity check at 10k x 500).
+    max_w, max_c = 0, None
+    for c in sorted(selected):
+        if weights[c] > max_w:
+            max_w, max_c = weights[c], c
     if max_c is not None:
         weights[max_c] += 1000 - other
     return weights

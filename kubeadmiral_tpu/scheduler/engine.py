@@ -69,15 +69,47 @@ _DIFF_PLACEMENT = 1
 _DIFF_SCORES = 2
 
 
-@dataclass
+class _FrozenDict(dict):
+    """Read-only mapping for shared ScheduleResults.  The engine returns
+    its cached decodes BY REFERENCE — rebuilding 100k result dicts every
+    tick was the config-5 host floor (VERDICT r4 #1a) — so the handed-out
+    mappings refuse mutation instead of being defensively copied."""
+
+    __slots__ = ()
+
+    def _blocked(self, *a, **k):
+        raise TypeError(
+            "ScheduleResult mappings are read-only views of the engine "
+            "cache; build a new dict instead of mutating"
+        )
+
+    __setitem__ = __delitem__ = __ior__ = _blocked
+    clear = pop = popitem = setdefault = update = _blocked
+
+    def __reduce__(self):  # deepcopy/pickle detach to a plain dict
+        return (dict, (dict(self),))
+
+
+@dataclass(frozen=True)
 class ScheduleResult:
     """Placement decision for one object: cluster -> replicas (None in
     Duplicate mode), mirroring core.ScheduleResult.SuggestedClusters.
     ``scores`` carries the post-normalize totals of the selected clusters
-    (consumed by webhook select plugins)."""
+    (consumed by webhook select plugins).
+
+    Frozen, with read-only mappings: results returned by
+    :meth:`SchedulerEngine.schedule` share the engine's cached decodes,
+    so neither the attributes nor the dicts may be mutated — derive
+    changed placements with a fresh ``ScheduleResult``."""
 
     clusters: dict[str, Optional[int]]
     scores: dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if type(self.clusters) is not _FrozenDict:
+            object.__setattr__(self, "clusters", _FrozenDict(self.clusters))
+        if type(self.scores) is not _FrozenDict:
+            object.__setattr__(self, "scores", _FrozenDict(self.scores))
 
     @property
     def cluster_set(self) -> set[str]:
@@ -397,6 +429,10 @@ class SchedulerEngine:
         # host->device input transfer), fetch (device->host result
         # transfer), decode (placement dict construction).
         self.timings: dict[str, float] = {}
+        # Global row indices whose placement may have changed in the
+        # last schedule() call ([] = none, None = unknown/all); set by
+        # every call including the empty-batch early return.
+        self.last_changed: Optional[list[int]] = None
 
         self.mesh = self._resolve_mesh(mesh)
         self._build_programs()
@@ -699,7 +735,6 @@ class SchedulerEngine:
 
         topo_fp = self._topo_fingerprint(view)
         cached = self._chunk_cache.get(idx)
-        sigs = None
         if (
             cached is not None
             and cached.topo_fp == topo_fp
@@ -709,14 +744,15 @@ class SchedulerEngine:
                 or (vocab is not None and cached.vocab_uid == vocab.uid)
             )
         ):
-            # Identity fast-path: the controller hands the engine freshly
-            # built (effectively immutable) SchedulingUnits; identical
-            # objects mean identical rows without computing signatures.
-            if all(a is b for a, b in zip(chunk, cached.units)):
-                changed = []
-            else:
-                sigs = [featurize_signature(su) for su in chunk]
-                changed = [i for i, s in enumerate(sigs) if s != cached.sigs[i]]
+            # Identity fast-path, per ROW: identical objects mean
+            # identical rows without computing signatures (SchedulingUnit
+            # is immutable), so a 1%-churn tick signature-checks only the
+            # replaced objects — not the whole chunk.
+            changed = [
+                i
+                for i, (a, b) in enumerate(zip(chunk, cached.units))
+                if a is not b and featurize_signature(a) != cached.sigs[i]
+            ]
             refreshed = cached.inputs._replace(
                 alloc=view.alloc,
                 used=view.used,
@@ -739,7 +775,7 @@ class SchedulerEngine:
                             getattr(sub, name)
                         )
                     for i in changed:
-                        cached.sigs[i] = sigs[i]
+                        cached.sigs[i] = featurize_signature(chunk[i])
                     cached.units = list(chunk)
                     # Handed to schedule(): the freshly featurized
                     # changed rows enable the sub-batch fast path.
@@ -769,10 +805,8 @@ class SchedulerEngine:
         nbytes = host_bytes * 3 + b_pad * c_pad * 10
         entry = None
         if self._cache_used + nbytes <= self.cache_bytes:
-            if sigs is None:
-                sigs = [featurize_signature(su) for su in chunk]
             entry = _CachedChunk(
-                sigs=sigs,
+                sigs=[featurize_signature(su) for su in chunk],
                 units=list(chunk),
                 inputs=inputs,
                 fmt=fmt,
@@ -819,13 +853,19 @@ class SchedulerEngine:
         view: Optional[ClusterView] = None,
         webhook_eval=None,
         want_scores: bool = False,
+        follower_index=None,
     ) -> list[ScheduleResult]:
         """``want_scores`` additionally decodes per-cluster score dicts
         (only webhook select plugins consume them).  Scores ride the
         same cache/delta machinery as placements — a want_scores
-        consumer pays score decoding, not a fast-path bypass."""
+        consumer pays score decoding, not a fast-path bypass.
+
+        ``follower_index`` (an :class:`ops.follower.FollowerIndex`)
+        applies follower-scheduling unions over the returned rows
+        incrementally, driven by this tick's changed-row set."""
         units = list(units)
         if not units:
+            self.last_changed = []
             return []
         if view is None:
             view = self._cached_view(units, clusters)
@@ -834,6 +874,10 @@ class SchedulerEngine:
         # behind every outstanding program), so keep dispatch->pull
         # strictly sequential per chunk.
         chunk_results: list[Optional[list[ScheduleResult]]] = []
+        # Per chunk: LOCAL row indices whose placement may have changed
+        # this tick ([] = none, None = unknown/all) — consumed by
+        # follower union and exposed as ``last_changed``.
+        chunk_changed: list[Optional[list[int]]] = []
         pending_sub: list[tuple[int, _CachedChunk, list[int], TickInputs]] = []
         pending_fetch: list[tuple] = []
         timings = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
@@ -872,14 +916,10 @@ class SchedulerEngine:
             if status == "hit" and prev_valid and entry.prev_view is view:
                 self.fetch_stats["noop"] += 1
                 timings["featurize"] += time.perf_counter() - t0
-                t3 = time.perf_counter()
-                chunk_results.append(
-                    [
-                        ScheduleResult(dict(r.clusters), dict(r.scores))
-                        for r in entry.prev_results
-                    ]
-                )
-                timings["decode"] += time.perf_counter() - t3
+                # Shared by reference: results are frozen (see
+                # ScheduleResult), so no defensive copy.
+                chunk_results.append(entry.prev_results)
+                chunk_changed.append([])
                 continue
 
             # Sub-batch fast path: the tick is row-independent (every
@@ -899,6 +939,7 @@ class SchedulerEngine:
                     (len(chunk_results), entry, changed_rows, sub_inputs)
                 )
                 chunk_results.append(None)  # filled by the sub-batch pass
+                chunk_changed.append(list(changed_rows))
                 self.fetch_stats["subbatch"] += 1
                 timings["featurize"] += time.perf_counter() - t0
                 continue
@@ -935,31 +976,33 @@ class SchedulerEngine:
                     )
                 )
                 chunk_results.append(None)
+                chunk_changed.append(None)  # filled by the drain
                 if len(pending_fetch) >= self.pipeline_depth:
                     self._drain_fetch(
-                        pending_fetch.pop(0), chunk_results, view,
-                        want_scores, timings,
+                        pending_fetch.pop(0), chunk_results, chunk_changed,
+                        view, want_scores, timings,
                     )
                 continue
             jax.block_until_ready(out)
             t2 = time.perf_counter()
             timings["device"] += t2 - t1
-            chunk_results.append(
-                self._fetch_decode(
-                    entry,
-                    out,
-                    mask_dev if delta_ok else None,
-                    view.names,
-                    len(chunk),
-                    want_scores,
-                    timings,
-                    view,
-                )
+            part, changed = self._fetch_decode(
+                entry,
+                out,
+                mask_dev if delta_ok else None,
+                view.names,
+                len(chunk),
+                want_scores,
+                timings,
+                view,
             )
+            chunk_results.append(part)
+            chunk_changed.append(changed)
 
         while pending_fetch:
             self._drain_fetch(
-                pending_fetch.pop(0), chunk_results, view, want_scores, timings
+                pending_fetch.pop(0), chunk_results, chunk_changed, view,
+                want_scores, timings,
             )
         if pending_sub:
             self._run_sub_batch(
@@ -970,6 +1013,21 @@ class SchedulerEngine:
         results: list[ScheduleResult] = []
         for part in chunk_results:
             results.extend(part)
+        # Global row indices whose placement may have changed this tick
+        # (None = unknown: at least one chunk was fully re-decoded).
+        # Incremental consumers (follower union, persist) key off this.
+        if any(ch is None for ch in chunk_changed):
+            self.last_changed: Optional[list[int]] = None
+        else:
+            self.last_changed = [
+                slot * eff_chunk + row
+                for slot, ch in enumerate(chunk_changed)
+                for row in ch
+            ]
+        if follower_index is not None:
+            t_f = time.perf_counter()
+            follower_index.apply(results, self.last_changed)
+            timings["follower"] = time.perf_counter() - t_f
         return results
 
     def _pad_for_dispatch(self, inputs, fmt: str, b_pad: int, c_bucket: int):
@@ -1149,9 +1207,9 @@ class SchedulerEngine:
             entry.stale_out_rows = sorted(
                 set(entry.stale_out_rows or ()) | set(changed_rows)
             )
-            chunk_results[slot] = [
-                ScheduleResult(dict(r.clusters), dict(r.scores)) for r in merged
-            ]
+            # Shared by reference (frozen results): the cached list is
+            # fresh this tick and rows are immutable.
+            chunk_results[slot] = merged
         timings["decode"] += time.perf_counter() - t3
 
     def _device_inputs(
@@ -1250,31 +1308,34 @@ class SchedulerEngine:
         reps_list = reps_obj.tolist()
         score_list = scores[rows, cols].tolist() if scores is not None else None
         out = []
+        empty = _FrozenDict()
         for i in range(selected.shape[0]):
             s, e = bounds[i], bounds[i + 1]
             out.append(
                 ScheduleResult(
-                    clusters=dict(zip(sel_names[s:e], reps_list[s:e])),
-                    scores=dict(zip(sel_names[s:e], score_list[s:e]))
+                    clusters=_FrozenDict(zip(sel_names[s:e], reps_list[s:e])),
+                    scores=_FrozenDict(zip(sel_names[s:e], score_list[s:e]))
                     if score_list is not None
-                    else {},
+                    else empty,
                 )
             )
         return out
 
     def _drain_fetch(
-        self, item, chunk_results, view, want_scores: bool, timings
+        self, item, chunk_results, chunk_changed, view, want_scores: bool, timings
     ) -> None:
         """Complete one in-flight pipelined chunk (see pipeline_depth)."""
         slot, entry, out, mask_dev, n = item
-        chunk_results[slot] = self._fetch_decode(
+        chunk_results[slot], chunk_changed[slot] = self._fetch_decode(
             entry, out, mask_dev, view.names, n, want_scores, timings, view
         )
 
     def _fetch_decode(
         self, entry, out, mask_dev, names, n: int, want_scores: bool, timings, view
-    ) -> list[ScheduleResult]:
-        """Pull results off the device — as a delta against the previous
+    ) -> tuple[list[ScheduleResult], Optional[list[int]]]:
+        """Returns (results, changed-local-rows or None for all).
+
+        Pull results off the device — as a delta against the previous
         tick when possible: the on-device row diff (i8[B] mask computed
         inside the tick dispatch, a few KB to fetch) decides which rows
         to gather, so a steady-state tick transfers near-nothing
@@ -1332,30 +1393,21 @@ class SchedulerEngine:
                         if planes == 4
                         else None,
                     )
+                    idx_rows = idx.tolist()
                     merged = list(entry.prev_results)
-                    for row, res in zip(idx.tolist(), changed_results):
+                    for row, res in zip(idx_rows, changed_results):
                         merged[row] = res
                     entry.prev_out = new_out
                     entry.stale_out_rows = None
                     entry.prev_results = merged
                     entry.prev_view = view
-                    out_copy = [
-                        ScheduleResult(dict(r.clusters), dict(r.scores))
-                        for r in merged
-                    ]
                     timings["decode"] += time.perf_counter() - t3
-                    return out_copy
+                    return merged, idx_rows
                 entry.prev_out = new_out
                 entry.stale_out_rows = None
                 entry.prev_view = view
-                t3 = time.perf_counter()
-                timings["fetch"] += t3 - t2
-                out_copy = [
-                    ScheduleResult(dict(r.clusters), dict(r.scores))
-                    for r in merged
-                ]
-                timings["decode"] += time.perf_counter() - t3
-                return out_copy
+                timings["fetch"] += time.perf_counter() - t2
+                return merged, []
             # fall through to a full fetch for mass changes
 
         self.fetch_stats["full"] += 1
@@ -1371,17 +1423,15 @@ class SchedulerEngine:
             # ticks): a tick that patched cached rows but skipped this
             # store would leave prev_results describing pre-patch
             # inputs, and the next tick's no-op shortcut would replay
-            # stale placements (ADVICE r2).
+            # stale placements (ADVICE r2).  The caller shares the
+            # stored list's rows — frozen results make that safe.
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
             entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
             entry.prev_view = view
-            results = [
-                ScheduleResult(dict(r.clusters), dict(r.scores)) for r in results
-            ]
         timings["decode"] += time.perf_counter() - t3
-        return results
+        return results, None
 
     # -- compile pre-warming ----------------------------------------------
     def prewarm(

@@ -195,13 +195,22 @@ class TestEngineCache:
             got2, SchedulerEngine(chunk_size=32).schedule(churned2, drifted)
         )
 
-    def test_results_are_caller_owned_copies(self):
-        """Returned dicts must be safe to mutate: the delta path reuses
-        cached decodes internally, so it hands out fresh copies."""
+    def test_results_are_immutable_shared_views(self):
+        """The engine shares cached decodes by reference (copying every
+        row per tick was the config-5 host floor), so the returned
+        results must refuse mutation — both the mappings and the
+        attributes — to protect the cache."""
+        import dataclasses
+
+        import pytest
+
         units, clusters = make_world(b=8)
         engine = SchedulerEngine(chunk_size=8)
         first = engine.schedule(units, clusters)
-        first[0].clusters["poison"] = 1
+        with pytest.raises(TypeError):
+            first[0].clusters["poison"] = 1
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            first[0].clusters = {}
         second = engine.schedule(units, clusters)
         assert "poison" not in second[0].clusters
 
