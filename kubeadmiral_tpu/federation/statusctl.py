@@ -21,37 +21,45 @@ from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime.hostbatch import HostBatch
+from kubeadmiral_tpu.runtime.informer import MemberStore
 from kubeadmiral_tpu.runtime.metrics import Metrics
-from kubeadmiral_tpu.runtime.worker import Result, Worker
-from kubeadmiral_tpu.testing.fakekube import (
-    AlreadyExists,
-    ClusterFleet,
-    Conflict,
-    NotFound,
-    obj_key,
-)
+from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet, obj_key
 from kubeadmiral_tpu.utils.unstructured import copy_json, get_path, set_path
 
 
-def _retry_pending_attach(reattach, worker, host, fed_resource) -> None:
+def _retry_pending_attach(store: MemberStore, worker, host, fed_resource) -> None:
     """Heartbeat-path retry for transiently failed member-watch attaches
-    (mirrors sync's check).  These watches attach with replay=False, so a
-    late success re-delivers nothing — whenever the pending set SHRANK
-    (not only when it drained: other clusters may still be unjoined),
-    fan the fed objects out to pick up statuses that accrued while
-    unattached."""
-    before = getattr(reattach, "pending", None)
+    (mirrors sync's check).  On success, replay streams the cluster's
+    EXISTING member objects through the store handler (enqueuing their
+    keys), but fed objects with nothing propagated to the newly attached
+    cluster still hold stale 'cluster unavailable' entries — whenever
+    the pending set SHRANK (not only when it drained), fan everything
+    out."""
+    before = store.pending
     if not before:
         return
-    before = set(before)
-    reattach()
-    after = set(getattr(reattach, "pending", None) or ())
-    if before - after:
+    store.reattach()
+    if before - store.pending:
         worker.enqueue_all(host.keys(fed_resource))
 
 
+def _view_read(client, resource: str, key: str) -> Optional[dict]:
+    """No-copy read when the client offers one; consumers must not
+    mutate the result."""
+    view = getattr(client, "try_get_view", None)
+    return view(resource, key) if view is not None else client.try_get(resource, key)
+
+
 class StatusController:
-    """Collects member-object fields into the status CR."""
+    """Collects member-object fields into the status CR.
+
+    Batch-tick shape: member objects come from a :class:`MemberStore`
+    (cached informer stores — zero member round trips per reconcile,
+    reference: status/controller.go:291-450 reading FederatedInformer
+    caches), and one tick's status-CR writes ride a single
+    ``host.batch()`` round trip through :class:`HostBatch`."""
 
     name = "status-controller"
 
@@ -71,38 +79,73 @@ class StatusController:
         self._fed_resource = ftc.federated.resource
         self._target_resource = ftc.source.resource
         self._status_resource = ftc.status.resource
-        self.worker = Worker(
-            f"status-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        self.worker = BatchWorker(
+            f"status-{ftc.name}",
+            self.reconcile_batch,
+            metrics=self.metrics,
+            clock=clock,
         )
         self._cluster_sigs: dict[str, tuple] = {}
+        # Skip cache: fingerprint of the clusterStatus+labels this
+        # controller last wrote (or verified) per key — an unchanged
+        # world costs zero host reads (this controller is the status
+        # CR's only writer).
+        self._last_written: dict[str, tuple] = {}
+        # resourceVersions of this controller's own status-CR writes —
+        # echo suppression for the drift-repair watch below.
+        self._own_status_rv: dict[str, str] = {}
+        self.store = MemberStore(
+            fleet, self._target_resource, on_event=self._on_member_event
+        )
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
-        self._reattach = fleet.watch_members(
-            self._target_resource, self._on_member_event
-        )
+        # Drift repair: a status CR deleted or modified out-of-band must
+        # invalidate the skip cache, or the fingerprint check would
+        # never rewrite it while the member world stays quiescent.
+        self.host.watch(self._status_resource, self._on_status_event, replay=False)
 
     def _on_fed_event(self, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
 
-    def _on_member_event(self, event: str, obj: dict) -> None:
+    def _on_member_event(self, cluster: str, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
+
+    def _on_status_event(self, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        if event == "DELETED":
+            self._own_status_rv.pop(key, None)
+            if self.worker.is_own_thread():
+                return  # echo of this controller's own delete
+        elif self.worker.is_own_thread() or self._own_status_rv.get(key) == str(
+            obj.get("metadata", {}).get("resourceVersion", "")
+        ):
+            return  # echo of this controller's own write
+        self._last_written.pop(key, None)
+        self.worker.enqueue(key)
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         sig = C.cluster_lifecycle_sig(obj)
         name = obj["metadata"]["name"]
         if event == "DELETED":
             self._cluster_sigs.pop(name, None)  # re-creation must fan out
+            # Tear down the removed cluster's store: it must report
+            # 'cluster unavailable', not serve frozen last-known state.
+            # No reattach here — it would re-add the evicted cluster.
+            self.store.evict(name)
+            self.worker.enqueue_all(self.host.keys(self._fed_resource))
+            return
         elif self._cluster_sigs.get(name) == sig:
             # Heartbeat bump: nothing placement-relevant changed, but a
             # transiently failed member-watch attach still needs its
             # retry channel.
             _retry_pending_attach(
-                self._reattach, self.worker, self.host, self._fed_resource
+                self.store, self.worker, self.host, self._fed_resource
             )
             return
         else:
             self._cluster_sigs[name] = sig
-        self._reattach()
+        self.store.readmit(name)  # a re-created cluster lifts its eviction
+        self.store.reattach()
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
     def run_until_idle(self) -> None:
@@ -110,79 +153,135 @@ class StatusController:
             pass
 
     # -- reconcile (status/controller.go:291-450) ------------------------
-    def reconcile(self, key: str) -> Result:
+    def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
+        """One tick: every due key's status CR recomputed against the
+        member store, all host writes staged into ONE batch."""
+        results: dict[str, Result] = {}
+        hb = HostBatch(self.host)
+        for key in keys:
+            try:
+                self._plan_one(key, hb, results)
+            except Exception:
+                self.metrics.counter("status.plan_panic")
+                results[key] = Result.retry()
+        hb.flush()
+        return results
+
+    def _plan_one(self, key: str, hb: HostBatch, results: dict) -> None:
         self.metrics.counter("status.throughput")
-        fed_obj = self.host.try_get(self._fed_resource, key)
+        fed_obj = _view_read(self.host, self._fed_resource, key)
+
+        def on_panic(_key=key) -> None:
+            self._last_written.pop(_key, None)
+            results[_key] = Result.retry()
 
         if fed_obj is None or fed_obj["metadata"].get("deletionTimestamp"):
             # Federated object gone: drop the status CR.
-            try:
-                self.host.delete(self._status_resource, key)
-            except NotFound:
-                pass
-            return Result.ok()
+            self._last_written.pop(key, None)
+
+            def on_delete(result, _key=key) -> None:
+                if result.get("code") not in (200, 404):
+                    results[_key] = Result.retry()
+
+            hb.stage(
+                {"verb": "delete", "resource": self._status_resource, "key": key},
+                on_delete,
+                on_panic,
+            )
+            return
 
         cluster_status = self._cluster_statuses(fed_obj, key)
-        desired = {
-            "apiVersion": self.ftc.status.api_version,
-            "kind": self.ftc.status.kind,
-            "metadata": {
-                "name": fed_obj["metadata"]["name"],
-                "labels": dict(fed_obj["metadata"].get("labels", {}) or {}),
-            },
-            "clusterStatus": cluster_status,
-        }
-        if fed_obj["metadata"].get("namespace"):
-            desired["metadata"]["namespace"] = fed_obj["metadata"]["namespace"]
+        labels = dict(fed_obj["metadata"].get("labels", {}) or {})
+        fp = (C.compact_json(cluster_status), C.compact_json(labels))
+        if self._last_written.get(key) == fp:
+            return  # nothing changed since our last verified write
 
-        existing = self.host.try_get(self._status_resource, key)
+        existing = _view_read(self.host, self._status_resource, key)
         if existing is None:
-            try:
-                self.host.create(self._status_resource, desired)
-            except AlreadyExists:
-                return Result.retry()
-            return Result.ok()
+            desired = {
+                "apiVersion": self.ftc.status.api_version,
+                "kind": self.ftc.status.kind,
+                "metadata": {"name": fed_obj["metadata"]["name"], "labels": labels},
+                "clusterStatus": cluster_status,
+            }
+            if fed_obj["metadata"].get("namespace"):
+                desired["metadata"]["namespace"] = fed_obj["metadata"]["namespace"]
+
+            def on_create(result, _key=key, _fp=fp) -> None:
+                if result.get("code") == 201:
+                    self._last_written[_key] = _fp
+                    self._record_own(_key, result.get("object"))
+                else:
+                    results[_key] = Result.retry()
+
+            hb.stage(
+                {
+                    "verb": "create",
+                    "resource": self._status_resource,
+                    "object": desired,
+                },
+                on_create,
+                on_panic,
+            )
+            return
 
         if (
-            existing.get("clusterStatus") != cluster_status
-            or (existing["metadata"].get("labels") or {})
-            != desired["metadata"]["labels"]
+            existing.get("clusterStatus") == cluster_status
+            and (existing["metadata"].get("labels") or {}) == labels
         ):
-            existing["clusterStatus"] = cluster_status
-            existing["metadata"]["labels"] = desired["metadata"]["labels"]
-            try:
-                self.host.update(self._status_resource, existing)
-            except Conflict:
-                return Result.retry()
-            except NotFound:
-                return Result.retry()
-        return Result.ok()
+            self._last_written[key] = fp
+            return
+
+        # ``existing`` is a view: rebuild the changed layers, share the
+        # rest (every store write deep-copies on entry).
+        updated = dict(existing)
+        meta = dict(existing["metadata"])
+        meta["labels"] = labels
+        updated["metadata"] = meta
+        updated["clusterStatus"] = cluster_status
+
+        def on_update(result, _key=key, _fp=fp) -> None:
+            if result.get("code") == 200:
+                self._last_written[_key] = _fp
+                self._record_own(_key, result.get("object"))
+            else:  # conflict / gone / transport: re-read next pass
+                self._last_written.pop(_key, None)
+                results[_key] = Result.retry()
+
+        hb.stage(
+            {"verb": "update", "resource": self._status_resource, "object": updated},
+            on_update,
+            on_panic,
+        )
+
+    def _record_own(self, key: str, obj) -> None:
+        if isinstance(obj, dict):
+            rv = obj.get("metadata", {}).get("resourceVersion")
+            if rv is not None:
+                self._own_status_rv[key] = str(rv)
 
     def _cluster_statuses(self, fed_obj: dict, key: str) -> list[dict]:
         """Per placed cluster, the collected dotted fields
-        (status/controller.go:491-560 clusterStatuses)."""
+        (status/controller.go:491-560 clusterStatuses) — read from the
+        member store, not the member apiservers."""
         placed = sorted(C.all_placement_clusters(fed_obj))
         out = []
         for cname in placed:
             entry: dict = {"clusterName": cname}
-            try:
-                member = self.fleet.member(cname)
-            except NotFound:
-                entry["error"] = "cluster unavailable"
-                out.append(entry)
-                continue
-            # View read: only the collected fields are retained, deep-
-            # copied below (copying whole member objects per cluster per
-            # round dominated status collection at scale).
-            obj = member.try_get_view(self._target_resource, key)
+            obj = self.store.get(cname, key)
             if obj is None:
-                continue  # not propagated yet: skip silently
+                if not self.store.attached(cname):
+                    entry["error"] = "cluster unavailable"
+                    out.append(entry)
+                continue  # attached but not propagated yet: skip silently
             collected: dict = {}
             for field in self.ftc.status_collection_fields:
                 value = get_path(obj, field)
                 if value is None:
                     continue
-                set_path(collected, field, copy_json(value))
+                # Values alias the store view; every downstream write
+                # path (fp serialization, host.batch) copies on entry.
+                set_path(collected, field, value)
             entry["collectedFields"] = collected
             out.append(entry)
         return out
@@ -372,7 +471,12 @@ AGGREGATION_PLUGINS: dict[str, Callable] = {
 
 
 class StatusAggregator:
-    """Folds member statuses back onto the source object."""
+    """Folds member statuses back onto the source object.
+
+    Batch-tick shape mirrors :class:`StatusController`: member objects
+    come from the cached :class:`MemberStore` (reference: the aggregator
+    reads FederatedInformer caches, statusaggregator/controller.go:291-399)
+    and one tick's source writes share one ``host.batch()`` round trip."""
 
     name = "status-aggregator"
 
@@ -390,15 +494,23 @@ class StatusAggregator:
         self._fed_resource = ftc.federated.resource
         self._target_resource = ftc.source.resource
         self.plugin = AGGREGATION_PLUGINS.get(ftc.source.gvk)
-        self.worker = Worker(
-            f"statusagg-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
+        self.worker = BatchWorker(
+            f"statusagg-{ftc.name}",
+            self.reconcile_batch,
+            metrics=self.metrics,
+            clock=clock,
         )
         self._cluster_sigs: dict[str, tuple] = {}
+        self.store = MemberStore(
+            fleet, self._target_resource, on_event=self._on_member_event
+        )
         self.host.watch(self._fed_resource, self._on_event, replay=True)
         self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
-        self._reattach = fleet.watch_members(self._target_resource, self._on_event)
 
     def _on_event(self, event: str, obj: dict) -> None:
+        self.worker.enqueue(obj_key(obj))
+
+    def _on_member_event(self, cluster: str, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
@@ -406,14 +518,18 @@ class StatusAggregator:
         name = obj["metadata"]["name"]
         if event == "DELETED":
             self._cluster_sigs.pop(name, None)
+            self.store.evict(name)
+            self.worker.enqueue_all(self.host.keys(self._fed_resource))
+            return
         elif self._cluster_sigs.get(name) == sig:
             _retry_pending_attach(
-                self._reattach, self.worker, self.host, self._fed_resource
+                self.store, self.worker, self.host, self._fed_resource
             )
             return
         else:
             self._cluster_sigs[name] = sig
-        self._reattach()
+        self.store.readmit(name)  # a re-created cluster lifts its eviction
+        self.store.reattach()
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
     def run_until_idle(self) -> None:
@@ -421,14 +537,26 @@ class StatusAggregator:
             pass
 
     # -- reconcile (statusaggregator/controller.go:291-399) --------------
-    def reconcile(self, key: str) -> Result:
+    def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
+        results: dict[str, Result] = {}
+        hb = HostBatch(self.host)
+        for key in keys:
+            try:
+                self._plan_one(key, hb, results)
+            except Exception:
+                self.metrics.counter("statusagg.plan_panic")
+                results[key] = Result.retry()
+        hb.flush()
+        return results
+
+    def _plan_one(self, key: str, hb: HostBatch, results: dict) -> None:
         self.metrics.counter("statusagg.throughput")
-        source = self.host.try_get(self._target_resource, key)
-        fed_obj = self.host.try_get(self._fed_resource, key)
+        source = _view_read(self.host, self._target_resource, key)
+        fed_obj = _view_read(self.host, self._fed_resource, key)
         if source is None or fed_obj is None:
-            return Result.ok()
+            return
         if source["metadata"].get("deletionTimestamp"):
-            return Result.ok()
+            return
 
         cluster_objs: dict[str, dict] = {}
         up_to_date = True
@@ -437,14 +565,7 @@ class StatusAggregator:
             for c in (fed_obj.get("status", {}) or {}).get("clusters", [])
         }
         for cname in sorted(C.all_placement_clusters(fed_obj)):
-            try:
-                member = self.fleet.member(cname)
-            except NotFound:
-                up_to_date = False
-                continue
-            # View read: aggregation plugins only read fields; any status
-            # they return is deep-copied by the store on write.
-            obj = member.try_get_view(self._target_resource, key)
+            obj = self.store.get(cname, key)
             if obj is None:
                 up_to_date = False
                 continue
@@ -452,16 +573,41 @@ class StatusAggregator:
                 up_to_date = False
             cluster_objs[cname] = obj
 
+        def on_panic(_key=key) -> None:
+            results[_key] = Result.retry()
+
+        def on_write(result, _key=key) -> None:
+            if result.get("code") not in (200, 404):
+                results[_key] = Result.retry()
+
         plugin = self.plugin
         if plugin is not None:
             new_status = plugin(source, cluster_objs, up_to_date)
             if new_status is not None and new_status != source.get("status"):
-                source["status"] = new_status
-                try:
-                    self.host.update_status(self._target_resource, source)
-                except (Conflict, NotFound):
-                    return Result.retry()
-            return Result.ok()
+                # Status subresource write: only .status is applied, so a
+                # minimal object (key + optimistic resourceVersion) rides
+                # the batch instead of a deep copy of the source.
+                patch = {
+                    "apiVersion": source.get("apiVersion"),
+                    "kind": source.get("kind"),
+                    "metadata": {
+                        "name": source["metadata"]["name"],
+                        "resourceVersion": source["metadata"].get("resourceVersion"),
+                    },
+                    "status": new_status,
+                }
+                if source["metadata"].get("namespace"):
+                    patch["metadata"]["namespace"] = source["metadata"]["namespace"]
+                hb.stage(
+                    {
+                        "verb": "update_status",
+                        "resource": self._target_resource,
+                        "object": patch,
+                    },
+                    on_write,
+                    on_panic,
+                )
+            return
 
         # No plugin: record statuses in the sourcefeedback annotation
         # (sourcefeedback/status.go).
@@ -474,11 +620,16 @@ class StatusAggregator:
                 ]
             }
         )
-        ann = source["metadata"].setdefault("annotations", {})
-        if ann.get(C.SOURCE_FEEDBACK_STATUS) != feedback:
-            ann[C.SOURCE_FEEDBACK_STATUS] = feedback
-            try:
-                self.host.update(self._target_resource, source)
-            except (Conflict, NotFound):
-                return Result.retry()
-        return Result.ok()
+        if (source["metadata"].get("annotations") or {}).get(
+            C.SOURCE_FEEDBACK_STATUS
+        ) == feedback:
+            return
+        updated = copy_json(source)
+        updated["metadata"].setdefault("annotations", {})[
+            C.SOURCE_FEEDBACK_STATUS
+        ] = feedback
+        hb.stage(
+            {"verb": "update", "resource": self._target_resource, "object": updated},
+            on_write,
+            on_panic,
+        )

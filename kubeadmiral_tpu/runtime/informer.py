@@ -69,6 +69,89 @@ class Informer:
             return list(self._cache)
 
 
+class MemberStore:
+    """Per-cluster member-object caches fed by replayed member watches —
+    the FederatedReadOnlyStore the status controllers read instead of
+    issuing one member GET per (object x cluster) per reconcile
+    (reference: pkg/controllers/util/federatedinformer.go:121-132; the
+    status controller builds clusterStatus from cached informers,
+    status/controller.go:291-450).
+
+    Entries are store views (in-process fleets) or fresh watch-frame
+    parses (HTTP): consumers must treat them as read-only and copy
+    anything they retain and mutate.
+    """
+
+    def __init__(self, fleet, resource: str, on_event=None):
+        self.fleet = fleet
+        self.resource = resource
+        self._lock = threading.Lock()
+        self._objs: dict[str, dict[str, dict]] = {}  # cluster -> key -> obj
+        # Set BEFORE the watch attaches: replayed initial-LIST events
+        # arrive synchronously from inside watch_members.
+        self._on_event = on_event
+        self._attach = fleet.watch_members(
+            resource, self._handle, named=True, replay=True
+        )
+
+    def _handle(self, cluster: str, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        with self._lock:
+            if event == DELETED:
+                held = self._objs.get(cluster)
+                if held is not None:
+                    held.pop(key, None)
+            else:
+                self._objs.setdefault(cluster, {})[key] = obj
+        cb = self._on_event
+        if cb is not None:
+            cb(cluster, event, obj)
+
+    def reattach(self) -> None:
+        """Attach watches for clusters that joined after construction."""
+        self._attach()
+
+    def evict(self, cluster: str) -> None:
+        """Drop a removed cluster's watch and cached objects (the
+        FederatedInformer remove-cluster lifecycle): without this, the
+        store would keep serving a deleted cluster's last-known objects
+        as live.  Sticky: reattach() skips the cluster until
+        readmit(cluster) lifts the eviction (a re-created cluster's
+        lifecycle event does that)."""
+        detach = getattr(self._attach, "detach", None)
+        if detach is not None:
+            detach(cluster)
+        with self._lock:
+            self._objs.pop(cluster, None)
+
+    def readmit(self, cluster: str) -> None:
+        """Lift an eviction after the cluster's object re-appeared."""
+        readmit = getattr(self._attach, "readmit", None)
+        if readmit is not None:
+            readmit(cluster)
+
+    @property
+    def pending(self) -> set:
+        """Clusters whose watch attach failed transiently (HTTP fleets:
+        join secret not yet readable) — the retry channel."""
+        return set(getattr(self._attach, "pending", None) or ())
+
+    def attached(self, cluster: str) -> bool:
+        att = getattr(self._attach, "attached", None)
+        if att is not None:
+            return cluster in att
+        try:  # fleets predating the attached-set contract
+            self.fleet.member(cluster)
+            return True
+        except Exception:
+            return False
+
+    def get(self, cluster: str, key: str) -> Optional[dict]:
+        with self._lock:
+            held = self._objs.get(cluster)
+            return None if held is None else held.get(key)
+
+
 class FederatedInformer:
     """Per-ready-cluster informers for one target resource."""
 

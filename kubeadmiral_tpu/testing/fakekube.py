@@ -439,16 +439,36 @@ class ClusterFleet:
         with ``replay``, existing objects stream through as ADDED (the
         informer's initial LIST)."""
         attached: set[str] = set()
+        detached: set[str] = set()
+        wrapped: dict[str, Handler] = {}
 
         def attach() -> None:
             for name, kube in list(self.members.items()):
-                if name not in attached:
+                if name not in attached and name not in detached:
                     attached.add(name)
-                    kube.watch(
-                        resource,
-                        functools.partial(handler, name) if named else handler,
-                        replay=replay,
-                    )
+                    h = functools.partial(handler, name) if named else handler
+                    wrapped[name] = h
+                    kube.watch(resource, h, replay=replay)
 
+        def detach(name: str) -> None:
+            """Tear down one cluster's watch (the FederatedInformer
+            remove-cluster lifecycle, federatedinformer.go:151-250).
+            Sticky: attach() skips the cluster until readmit(name) —
+            the fleet keeps removed members' kube handles, so a plain
+            re-attach would silently resurrect the watch."""
+            attached.discard(name)
+            detached.add(name)
+            h = wrapped.pop(name, None)
+            kube = self.members.get(name)
+            if h is not None and kube is not None:
+                kube.unwatch(resource, h)
+
+        def readmit(name: str) -> None:
+            """Lift a detach (the cluster's object re-appeared)."""
+            detached.discard(name)
+
+        attach.attached = attached
+        attach.detach = detach
+        attach.readmit = readmit
         attach()
         return attach

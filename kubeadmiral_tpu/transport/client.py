@@ -505,12 +505,14 @@ class HttpFleet:
         replay: bool = False,
     ) -> Callable[[], None]:
         attached: set[str] = set()
+        detached: set[str] = set()
+        wrapped: dict[str, tuple[HttpKube, Handler]] = {}
 
         def attach() -> None:
             pending: set[str] = set()
             for cluster in self.host.list(C.FEDERATED_CLUSTERS):
                 name = cluster["metadata"]["name"]
-                if name in attached:
+                if name in attached or name in detached:
                     continue
                 try:
                     client = self.factory.client_for(cluster)
@@ -522,14 +524,31 @@ class HttpFleet:
                     continue
                 attached.add(name)
                 self.members[name] = client
-                client.watch(
-                    resource,
-                    functools.partial(handler, name) if named else handler,
-                    replay=replay,
-                )
+                h = functools.partial(handler, name) if named else handler
+                wrapped[name] = (client, h)
+                client.watch(resource, h, replay=replay)
             attach.pending = pending
 
+        def detach(name: str) -> None:
+            """Tear down one cluster's watch (the FederatedInformer
+            remove-cluster lifecycle) — the stream would otherwise keep
+            feeding stale objects after the cluster left the federation.
+            Sticky until readmit(name), mirroring ClusterFleet."""
+            attached.discard(name)
+            detached.add(name)
+            entry = wrapped.pop(name, None)
+            if entry is not None:
+                client, h = entry
+                client.unwatch(resource, h)
+
+        def readmit(name: str) -> None:
+            """Lift a detach (the cluster's object re-appeared)."""
+            detached.discard(name)
+
         attach.pending = set()
+        attach.attached = attached
+        attach.detach = detach
+        attach.readmit = readmit
         attach()
         return attach
 

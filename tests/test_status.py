@@ -135,6 +135,85 @@ class TestStatusController:
         ctl.run_until_idle()
         assert fleet.host.try_get(ftc.status.resource, "default/web") is None
 
+    def test_removed_cluster_reported_unavailable(self):
+        """A cluster leaving the federation must not keep serving its
+        frozen last-known member status as live (MemberStore evict)."""
+        fleet = fleet_with(("c1", "c2"))
+        ftc = deployment_ftc()
+        ctl = StatusController(fleet, ftc)
+        fleet.member("c2").create(ftc.source.resource, member_deployment())
+        fleet.host.create(ftc.federated.resource, make_fed())
+        ctl.run_until_idle()
+        by = {
+            e["clusterName"]: e
+            for e in fleet.host.get(ftc.status.resource, "default/web")[
+                "clusterStatus"
+            ]
+        }
+        assert "error" not in by["c2"]
+
+        fleet.host.delete(C.FEDERATED_CLUSTERS, "c2")
+        ctl.run_until_idle()
+        by = {
+            e["clusterName"]: e
+            for e in fleet.host.get(ftc.status.resource, "default/web")[
+                "clusterStatus"
+            ]
+        }
+        assert by["c2"].get("error") == "cluster unavailable"
+
+    def test_eviction_survives_later_reattach(self):
+        """A deleted cluster must stay evicted across reattach() calls
+        triggered by OTHER clusters' lifecycle events, and come back only
+        when its FederatedCluster is re-created."""
+        fleet = fleet_with(("c1", "c2"))
+        ftc = deployment_ftc()
+        ctl = StatusController(fleet, ftc)
+        fleet.member("c2").create(ftc.source.resource, member_deployment())
+        fleet.host.create(ftc.federated.resource, make_fed())
+        ctl.run_until_idle()
+
+        fleet.host.delete(C.FEDERATED_CLUSTERS, "c2")
+        ctl.run_until_idle()
+        # A third cluster joins: the reattach MUST NOT resurrect c2's
+        # watch (its kube handle is still in fleet.members).
+        fleet.add_member("c3")
+        fleet.host.create(C.FEDERATED_CLUSTERS, make_cluster("c3"))
+        ctl.run_until_idle()
+        by = {
+            e["clusterName"]: e
+            for e in fleet.host.get(ftc.status.resource, "default/web")[
+                "clusterStatus"
+            ]
+        }
+        assert by["c2"].get("error") == "cluster unavailable"
+
+        # Re-creating c2 lifts the eviction.
+        fleet.host.create(C.FEDERATED_CLUSTERS, make_cluster("c2"))
+        ctl.run_until_idle()
+        by = {
+            e["clusterName"]: e
+            for e in fleet.host.get(ftc.status.resource, "default/web")[
+                "clusterStatus"
+            ]
+        }
+        assert "error" not in by["c2"]
+        assert by["c2"]["collectedFields"]["status"]["replicas"] == 3
+
+    def test_external_status_cr_deletion_recreated(self):
+        """An out-of-band status-CR deletion invalidates the skip cache
+        (level-triggered self-heal survives the fingerprint fast path)."""
+        fleet = fleet_with(("c1",))
+        ftc = deployment_ftc()
+        ctl = StatusController(fleet, ftc)
+        fleet.member("c1").create(ftc.source.resource, member_deployment())
+        fleet.host.create(ftc.federated.resource, make_fed(clusters=("c1",)))
+        ctl.run_until_idle()
+        assert fleet.host.try_get(ftc.status.resource, "default/web") is not None
+        fleet.host.delete(ftc.status.resource, "default/web")
+        ctl.run_until_idle()
+        assert fleet.host.try_get(ftc.status.resource, "default/web") is not None
+
     def test_unavailable_cluster_reported(self):
         fleet = fleet_with(("c1",))
         ftc = deployment_ftc()
