@@ -104,10 +104,6 @@ void distribute(const std::vector<int>& order, const std::vector<Pref>& prefs,
   }
 }
 
-int64_t round_half(double x) {
-  return (int64_t)std::copysign(std::floor(std::fabs(x) + 0.5), x);
-}
-
 struct Object {
   // Views into the batch arrays for one object (row i).
   const uint8_t *filter_enabled, *score_enabled;
@@ -136,14 +132,29 @@ bool fits(const Object& o, const World& w, int j) {
   return true;
 }
 
+// Smallest multiple-of-8 shift with (cap >> s) < 2^26 — the shared
+// range reduction of the exact integer balanced score (ops/scores.py).
+static int balanced_shift(int64_t cap) {
+  int s = 0;
+  for (int k = 0; k < 5; ++k)
+    if (cap >= ((int64_t)1 << (26 + 8 * k))) s += 8;
+  return s;
+}
+
+// Exact integer balanced-allocation score, bit-identical to the device
+// kernel and the Python oracle on every backend (float forms diverge:
+// axon TPUs demote f64 to f32, flipping scores at integer boundaries).
 int64_t balanced_score(const Object& o, const World& w, int j) {
-  auto frac = [](int64_t req, int64_t cap) {
-    return cap == 0 ? 1.0 : (double)req / (double)cap;
-  };
-  double f_cpu = frac(w.used[j * w.r + 0] + o.request[0], w.alloc[j * w.r + 0]);
-  double f_mem = frac(w.used[j * w.r + 1] + o.request[1], w.alloc[j * w.r + 1]);
-  if (f_cpu >= 1 || f_mem >= 1) return 0;
-  return (int64_t)((1 - std::fabs(f_cpu - f_mem)) * kMaxScore);
+  int64_t ac = w.alloc[j * w.r + 0], am = w.alloc[j * w.r + 1];
+  int64_t rc = w.used[j * w.r + 0] + o.request[0];
+  int64_t rm = w.used[j * w.r + 1] + o.request[1];
+  if (ac == 0 || am == 0 || rc >= ac || rm >= am) return 0;
+  int s_cpu = balanced_shift(ac), s_mem = balanced_shift(am);
+  ac >>= s_cpu; rc >>= s_cpu;
+  am >>= s_mem; rm >>= s_mem;
+  int64_t total = std::max<int64_t>(ac * am, 1);
+  int64_t diff_num = std::llabs(rc * am - rm * ac);
+  return kMaxScore * (total - diff_num) / total;
 }
 
 int64_t ratio_score(const Object& o, const World& w, int j, bool least) {
@@ -182,31 +193,37 @@ void normalize_add(std::vector<int64_t>& totals,
   }
 }
 
-// rsp.go CalcWeightLimit + AvailableToPercentage over the selection.
+// Round-half-away-from-zero of num/den for non-negative integers — the
+// exact shared rule of the device kernel (ops/weights.py) and the
+// Python oracle (float forms diverge on axon TPUs: f64 -> f32).
+static int64_t round_half_div(int64_t num, int64_t den) {
+  return (2 * num + den) / (2 * den);
+}
+
+// rsp.go CalcWeightLimit + AvailableToPercentage over the selection, in
+// exact integer arithmetic (x1.4 supply limit as 1400/1000).
 void dynamic_weights(const World& w, const std::vector<int>& selected,
                      std::vector<int64_t>& weights_out) {
   int n = (int)selected.size();
   int64_t alloc_sum = 0;
   for (int j : selected) alloc_sum += w.cpu_alloc[j];
-  std::vector<double> limit(w.c, 0);
+  std::vector<int64_t> limit(w.c, 0);
   if (alloc_sum == 0) {
-    for (int j : selected) limit[j] = (double)round_half(1000.0 / n);
+    for (int j : selected) limit[j] = round_half_div(1000, n);
   } else {
     for (int j : selected)
-      limit[j] =
-          (double)round_half((double)w.cpu_alloc[j] / alloc_sum * 1000 * 1.4);
+      limit[j] = round_half_div(w.cpu_alloc[j] * 1400, alloc_sum);
   }
   int64_t avail_sum = 0;
   for (int j : selected)
     if (w.cpu_avail[j] > 0) avail_sum += w.cpu_avail[j];
   std::vector<int64_t> tmp(w.c, 0);
   if (avail_sum == 0) {
-    for (int j : selected) tmp[j] = round_half(1000.0 / n);
+    for (int j : selected) tmp[j] = round_half_div(1000, n);
   } else {
     for (int j : selected) {
       int64_t avail = std::max(w.cpu_avail[j], (int64_t)0);
-      tmp[j] = std::min(round_half((double)avail / avail_sum * 1000),
-                        (int64_t)limit[j]);
+      tmp[j] = std::min(round_half_div(avail * 1000, avail_sum), limit[j]);
     }
   }
   int64_t tmp_sum = 0;
@@ -217,7 +234,7 @@ void dynamic_weights(const World& w, const std::vector<int>& selected,
   }
   int64_t other = 0;
   for (int j : selected) {
-    int64_t wgt = round_half((double)tmp[j] / tmp_sum * 1000);
+    int64_t wgt = round_half_div(tmp[j] * 1000, tmp_sum);
     weights_out[j] = wgt;
     other += wgt;
   }

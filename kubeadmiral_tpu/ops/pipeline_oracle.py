@@ -10,7 +10,6 @@ scheduler" baseline.
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, field
 
 from kubeadmiral_tpu.ops.planner_oracle import ClusterPref, PlanInput, plan as planner
@@ -65,15 +64,32 @@ def _fits(p: OracleProblem, c: int) -> bool:
     return True
 
 
-def _balanced(p: OracleProblem, c: int) -> int:
-    def frac(req, cap):
-        return 1.0 if cap == 0 else req / cap
+def _balanced_shift(cap: int) -> int:
+    """Smallest multiple-of-8 shift with (cap >> s) < 2^26 — the shared
+    range reduction of the exact balanced score (ops/scores.py)."""
+    s = 0
+    for k in range(5):
+        if cap >= 1 << (26 + 8 * k):
+            s += 8
+    return s
 
-    f_cpu = frac(p.used[c][0] + p.request[0], p.alloc[c][0])
-    f_mem = frac(p.used[c][1] + p.request[1], p.alloc[c][1])
-    if f_cpu >= 1 or f_mem >= 1:
+
+def _balanced(p: OracleProblem, c: int) -> int:
+    """Exact integer balanced-allocation score — bit-identical to the
+    device kernel (ops/scores.py balanced_allocation_score) and the C++
+    baseline on every backend; see the kernel docstring for why float
+    forms diverge (axon f64->f32 demotion)."""
+    ac, am = p.alloc[c][0], p.alloc[c][1]
+    rc = p.used[c][0] + p.request[0]
+    rm = p.used[c][1] + p.request[1]
+    if ac == 0 or am == 0 or rc >= ac or rm >= am:
         return 0
-    return int((1 - abs(f_cpu - f_mem)) * MAX_SCORE)
+    s_cpu, s_mem = _balanced_shift(ac), _balanced_shift(am)
+    ac, rc = ac >> s_cpu, rc >> s_cpu
+    am, rm = am >> s_mem, rm >> s_mem
+    total = max(ac * am, 1)
+    diff_num = abs(rc * am - rm * ac)
+    return MAX_SCORE * (total - diff_num) // total
 
 
 def _ratio(p: OracleProblem, c: int, least: bool) -> int:
@@ -104,22 +120,33 @@ def _normalize(scores: dict[int, int], reverse: bool) -> dict[int, int]:
     return out
 
 
+def round_half_div(num: int, den: int) -> int:
+    """Round-half-away-from-zero of num/den for non-negative integers —
+    the exact shared rule of the device kernel (ops/weights.py), this
+    oracle, and the C++ baseline (float forms diverge on axon TPUs,
+    which demote f64 to f32)."""
+    return (2 * num + den) // (2 * den)
+
+
 def _dynamic_weights(p: OracleProblem, selected: list[int]) -> dict[int, int]:
-    """rsp.go CalcWeightLimit + AvailableToPercentage over the selection."""
+    """rsp.go CalcWeightLimit + AvailableToPercentage over the selection,
+    in exact integer arithmetic (x1.4 supply limit as 1400/1000)."""
     n = len(selected)
     alloc_sum = sum(p.cpu_alloc[c] for c in selected)
     if alloc_sum == 0:
-        limit = {c: round_half(1000 / n) for c in selected}
+        limit = {c: round_half_div(1000, n) for c in selected}
     else:
         limit = {
-            c: round_half(p.cpu_alloc[c] / alloc_sum * 1000 * 1.4) for c in selected
+            c: round_half_div(p.cpu_alloc[c] * 1400, alloc_sum) for c in selected
         }
     avail_sum = sum(p.cpu_avail[c] for c in selected if p.cpu_avail[c] > 0)
     if avail_sum == 0:
-        tmp = {c: round_half(1000 / n) for c in selected}
+        tmp = {c: round_half_div(1000, n) for c in selected}
     else:
         tmp = {
-            c: min(round_half(max(p.cpu_avail[c], 0) / avail_sum * 1000), limit[c])
+            c: min(
+                round_half_div(max(p.cpu_avail[c], 0) * 1000, avail_sum), limit[c]
+            )
             for c in selected
         }
     tmp_sum = sum(tmp.values())
@@ -128,7 +155,7 @@ def _dynamic_weights(p: OracleProblem, selected: list[int]) -> dict[int, int]:
     weights = {}
     other = 0
     for c in selected:
-        w = round_half(tmp[c] / tmp_sum * 1000)
+        w = round_half_div(tmp[c] * 1000, tmp_sum)
         weights[c] = w
         other += w
     # Rounding residual goes to the max-weight cluster, first by CLUSTER
@@ -146,10 +173,6 @@ def _dynamic_weights(p: OracleProblem, selected: list[int]) -> dict[int, int]:
     if max_c is not None:
         weights[max_c] += 1000 - other
     return weights
-
-
-def round_half(x: float) -> int:
-    return int(math.copysign(math.floor(abs(x) + 0.5), x))
 
 
 def schedule_one(p: OracleProblem) -> dict[int, int | None]:

@@ -44,15 +44,52 @@ def _requested_totals(request, alloc, used):
     return alloc_cpu, alloc_mem, req_cpu, req_mem
 
 
+# Range-reduction thresholds for the exact balanced-allocation score:
+# the smallest shift s (multiple of 8) with (x >> s) < 2^26 keeps the
+# cross products below 2^52 so 100*(T-D) fits int64 exactly.
+_BALANCED_SHIFT_THRESHOLDS = tuple(1 << (26 + 8 * k) for k in range(5))
+
+
+def _balanced_range_shift(cap):
+    s = jnp.zeros_like(cap)
+    for t in _BALANCED_SHIFT_THRESHOLDS:
+        s = s + 8 * (cap >= t).astype(cap.dtype)
+    return s
+
+
 def balanced_allocation_score(request, alloc, used):
     """(1 - |cpuFraction - memFraction|) * 100, 0 if either fraction >= 1
-    (balanced_allocation.go:45-78); fraction of zero capacity counts as 1."""
+    (balanced_allocation.go:45-78); fraction of zero capacity counts as 1.
+
+    Computed in EXACT integer arithmetic:
+    |rc/ac - rm/am| = |rc*am - rm*ac| / (ac*am), with both resource
+    pairs range-shifted so the products fit int64, then one small-
+    quotient floor division.  Float forms diverge across backends —
+    axon TPUs demote f64 to f32, and the truncation of (1-diff)*100
+    flips scores near integer boundaries (~1e-5 of pairs at bench
+    shapes), which the r5 on-chip parity check caught as a batched-vs-
+    native placement mismatch.  At exact integer boundaries the
+    reference's value is itself f64-rounding dependent; this rational
+    semantics is applied identically in the device kernel, the Python
+    oracle (ops/pipeline_oracle.py), and the C++ baseline
+    (native/seqsched.cpp), so parity is bit-exact on every backend."""
     alloc_cpu, alloc_mem, req_cpu, req_mem = _requested_totals(request, alloc, used)
-    f_cpu = jnp.where(alloc_cpu == 0, 1.0, req_cpu / jnp.maximum(alloc_cpu, 1))
-    f_mem = jnp.where(alloc_mem == 0, 1.0, req_mem / jnp.maximum(alloc_mem, 1))
-    diff = jnp.abs(f_cpu - f_mem)
-    score = ((1.0 - diff) * MAX_CLUSTER_SCORE).astype(jnp.int64)
-    return jnp.where((f_cpu >= 1.0) | (f_mem >= 1.0), 0, score)
+    infeasible = (
+        (alloc_cpu == 0)
+        | (alloc_mem == 0)
+        | (req_cpu >= alloc_cpu)
+        | (req_mem >= alloc_mem)
+    )
+    s_cpu = _balanced_range_shift(alloc_cpu)
+    s_mem = _balanced_range_shift(alloc_mem)
+    ac = jnp.right_shift(alloc_cpu, s_cpu)
+    rc = jnp.right_shift(req_cpu, s_cpu)
+    am = jnp.right_shift(alloc_mem, s_mem)
+    rm = jnp.right_shift(req_mem, s_mem)
+    total = jnp.maximum(ac * am, 1)
+    diff_num = jnp.abs(rc * am - rm * ac)
+    score = _floordiv_smallq(MAX_CLUSTER_SCORE * (total - diff_num), total)
+    return jnp.where(infeasible, 0, score)
 
 
 def _floordiv_smallq(num, den):

@@ -7,20 +7,33 @@ weighted by their share of available CPU, clamped by an allocatable-share
 limit (x1.4), then re-normalized to sum to 1000 with the rounding residual
 handed to the heaviest cluster.
 
-All rounding is "half away from zero" (Go math.Round), computed in f64.
-CPU values here are Quantity.Value() cores (ceiling), as in the reference.
+All rounding is "half away from zero" (Go math.Round), computed in EXACT
+integer arithmetic: round_half(num/den) = (2*num + den) // (2*den) for
+non-negative operands, with the x1.4 supply limit as the rational
+1400/1000.  The reference computes these in f64; axon TPUs demote f64 to
+f32, and a float formulation flips weights by one at half-boundaries,
+which cascades into different replica plans (caught by the r5 on-chip
+batched-vs-native parity check).  The same exact rule is implemented in
+the Python oracle (ops/pipeline_oracle.py) and the C++ baseline
+(native/seqsched.cpp).  CPU values here are Quantity.Value() cores
+(ceiling), as in the reference.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-SUM_WEIGHT = 1000.0
-SUPPLY_LIMIT = 1.4
+from kubeadmiral_tpu.ops.scores import _floordiv_smallq
+
+SUM_WEIGHT = 1000
+# SUM_WEIGHT * 1.4 as an exact rational (rsp.go:183-213 supplyLimitRatio).
+SUPPLY_LIMIT_NUM = 1400
 
 
-def _round_half_away(x):
-    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+def _round_half_div(num, den):
+    """Round-half-away-from-zero of num/den for non-negative integers:
+    floor((2*num + den) / (2*den)), exact on every backend."""
+    return _floordiv_smallq(2 * num + den, 2 * den)
 
 
 def dynamic_weights(selected, cpu_alloc, cpu_avail):
@@ -29,38 +42,38 @@ def dynamic_weights(selected, cpu_alloc, cpu_avail):
     Weights are zero outside the selection mask.
     """
     sel = selected
-    n = jnp.maximum(jnp.sum(sel, axis=-1, keepdims=True), 1).astype(jnp.float64)
+    n = jnp.maximum(jnp.sum(sel, axis=-1, keepdims=True), 1).astype(jnp.int64)
 
     # CalcWeightLimit: allocatable-CPU share * 1000 * 1.4 (rsp.go:183-213).
-    alloc = jnp.where(sel, cpu_alloc[None, :], 0).astype(jnp.float64)
+    alloc = jnp.where(sel, cpu_alloc[None, :], 0)
     alloc_sum = jnp.sum(alloc, axis=-1, keepdims=True)
-    equal = _round_half_away(SUM_WEIGHT / n)
+    equal = _round_half_div(jnp.full_like(n, SUM_WEIGHT), n)
     limit = jnp.where(
         alloc_sum == 0,
         equal,
-        _round_half_away(alloc / jnp.maximum(alloc_sum, 1.0) * SUM_WEIGHT * SUPPLY_LIMIT),
+        _round_half_div(alloc * SUPPLY_LIMIT_NUM, jnp.maximum(alloc_sum, 1)),
     )
 
     # AvailableToPercentage (rsp.go:215-272): available-CPU share, clamped.
-    avail = jnp.where(sel, cpu_avail[None, :], 0).astype(jnp.float64)
-    avail_pos = jnp.maximum(avail, 0.0)
+    avail = jnp.where(sel, cpu_avail[None, :], 0)
+    avail_pos = jnp.maximum(avail, 0)
     avail_sum = jnp.sum(avail_pos, axis=-1, keepdims=True)
     tmp = jnp.where(
         avail_sum == 0,
         equal,
         jnp.minimum(
-            _round_half_away(avail_pos / jnp.maximum(avail_sum, 1.0) * SUM_WEIGHT),
+            _round_half_div(avail_pos * SUM_WEIGHT, jnp.maximum(avail_sum, 1)),
             limit,
         ),
     )
-    tmp = jnp.where(sel, tmp, 0.0)
+    tmp = jnp.where(sel, tmp, 0)
     tmp_sum = jnp.sum(tmp, axis=-1, keepdims=True)
     weight = jnp.where(
         tmp_sum > 0,
-        _round_half_away(tmp / jnp.maximum(tmp_sum, 1.0) * SUM_WEIGHT),
-        0.0,
+        _round_half_div(tmp * SUM_WEIGHT, jnp.maximum(tmp_sum, 1)),
+        0,
     )
-    weight = jnp.where(sel, weight, 0.0)
+    weight = jnp.where(sel, weight, 0)
 
     # Residual of the second rounding pass goes to the heaviest cluster
     # (first index on ties; the reference's pick is map-order dependent).

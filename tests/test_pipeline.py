@@ -176,3 +176,63 @@ def test_tick_matches_oracle(c):
                 assert g == NIL, f"case {i} cluster {j}: {g} != nil\n{p}"
             else:
                 assert g == w, f"case {i} cluster {j}: {g} != {w}\n{p}\n{want}"
+
+
+class TestExactIntegerScoreMath:
+    """The balanced score and dynamic weights are defined as exact
+    rationals (not f64), because axon TPUs demote f64 to f32 and float
+    truncation flips values at integer boundaries (caught by the r5
+    on-chip batched-vs-native parity check).  Pin the boundary values
+    all three implementations (device / oracle / C++) must share."""
+
+    def test_balanced_score_integer_boundary(self):
+        import jax.numpy as jnp
+
+        from kubeadmiral_tpu.ops.pipeline_oracle import _balanced
+        from kubeadmiral_tpu.ops.scores import balanced_allocation_score
+
+        # f_cpu = 1/2, f_mem = 2/25 -> diff = 0.42 exactly -> score 58.
+        # An f64 formulation truncates (1-0.42)*100 = 57.999... to 57.
+        request = jnp.array([[1, 2]], dtype=jnp.int64)
+        alloc = jnp.array([[2, 25]], dtype=jnp.int64)
+        used = jnp.array([[0, 0]], dtype=jnp.int64)
+        dev_score = int(balanced_allocation_score(request, alloc, used)[0, 0])
+        assert dev_score == 58
+
+        class P:  # _balanced reads request/alloc/used only
+            request = [1, 2]
+            alloc = [[2, 25]]
+            used = [[0, 0]]
+
+        assert _balanced(P, 0) == 58
+
+    def test_balanced_score_range_reduction_large_quantities(self):
+        import jax.numpy as jnp
+
+        from kubeadmiral_tpu.ops.scores import balanced_allocation_score
+
+        # Memory in bytes at Ti scale: the cross products only fit int64
+        # after the range shift; the exact path must not overflow.
+        ac, am = 512_000, 2 * 1024**4  # 512 cores, 2Ti
+        rc, rm = 256_000, 1024**4  # half of each -> diff 0, score 100
+        request = jnp.array([[rc, rm]], dtype=jnp.int64)
+        alloc = jnp.array([[ac, am]], dtype=jnp.int64)
+        used = jnp.array([[0, 0]], dtype=jnp.int64)
+        assert int(balanced_allocation_score(request, alloc, used)[0, 0]) == 100
+
+    def test_round_half_away_rule(self):
+        import jax.numpy as jnp
+
+        from kubeadmiral_tpu.ops.pipeline_oracle import round_half_div
+        from kubeadmiral_tpu.ops.weights import _round_half_div
+
+        cases = [(125, 2, 63), (1000, 3, 333), (1000, 7, 143), (62, 4, 16)]
+        for num, den, want in cases:
+            assert round_half_div(num, den) == want, (num, den)
+            got = int(
+                _round_half_div(
+                    jnp.array([num], dtype=jnp.int64),
+                    jnp.array([den], dtype=jnp.int64),
+                )[0]
+            )
+            assert got == want, (num, den, got)
