@@ -115,6 +115,14 @@ class SchedulerController:
         # name -> WebhookPlugin, maintained from config watch events
         # (scheduler.go s.webhookPlugins sync.Map).
         self.webhook_plugins: dict[str, W.WebhookPlugin] = {}
+        # (namespace, name) -> parsed PolicySpec, invalidated by policy
+        # watch events (see _policy_for / _on_policy_event).  The epoch
+        # counter closes the read-then-cache race: an event landing
+        # between a tick's try_get and its cache store bumps the epoch,
+        # and the store is skipped (caching the pre-event spec would
+        # pin it forever, since the trigger hash would keep matching).
+        self._policy_cache: dict[tuple[str, str], P.PolicySpec] = {}
+        self._policy_epoch: dict[tuple[str, str], int] = {}
 
         host.watch(self._resource, self._on_object_event, replay=True)
         host.watch(P.PROPAGATION_POLICIES, self._on_policy_event, replay=False)
@@ -146,6 +154,11 @@ class SchedulerController:
         # (schedulingtriggers.go enqueueFederatedObjectsForPolicy).
         pname = obj["metadata"]["name"]
         pns = obj["metadata"].get("namespace", "")
+        # Event-invalidated parse cache: the next tick re-reads + re-
+        # parses this policy once instead of once per bound object.
+        key = (pns, pname)
+        self._policy_epoch[key] = self._policy_epoch.get(key, 0) + 1
+        self._policy_cache.pop(key, None)
         self._enqueue_objects_for_policies({(pns, pname)})
 
     def _on_profile_event(self, event: str, obj: dict) -> None:
@@ -216,9 +229,21 @@ class SchedulerController:
         if key is None:
             return None
         ns, name = key
+        # Watch-invalidated cache (_on_policy_event): thousands of
+        # objects bind the same few policies, and per-object
+        # try_get+parse was a top host cost of the scheduling tick.
+        hit = self._policy_cache.get((ns, name))
+        if hit is not None:
+            return hit
+        epoch = self._policy_epoch.get((ns, name), 0)
         resource = P.PROPAGATION_POLICIES if ns else P.CLUSTER_PROPAGATION_POLICIES
         obj = self.host.try_get(resource, f"{ns}/{name}" if ns else name)
-        return P.parse_policy(obj) if obj else None
+        if obj is None:
+            return None
+        spec = P.parse_policy(obj)
+        if self._policy_epoch.get((ns, name), 0) == epoch:
+            self._policy_cache[(ns, name)] = spec
+        return spec
 
     def _profile_for(self, policy: P.PolicySpec) -> Optional[PR.ProfileSpec]:
         """Cluster-scoped SchedulingProfile named by the policy
@@ -250,6 +275,7 @@ class SchedulerController:
         policy: P.PolicySpec,
         clusters_hash: str,
         profile: Optional[PR.ProfileSpec] = None,
+        request: Optional[dict[str, int]] = None,
     ) -> str:
         ann = fed_obj["metadata"].get("annotations", {})
         scheduling_annotations = {
@@ -262,7 +288,9 @@ class SchedulerController:
         trigger = {
             "annotations": scheduling_annotations,
             "replicas": replicas,
-            "request": extract_pod_resource_request(C.template(fed_obj)),
+            "request": request
+            if request is not None
+            else extract_pod_resource_request(C.template(fed_obj)),
             "policy": [policy.namespace, policy.name, policy.generation],
             # Unlike the reference (schedulingtriggers.go hashes only the
             # policy), the profile and webhook-config generations are
@@ -286,6 +314,7 @@ class SchedulerController:
         fed_obj: dict,
         policy: P.PolicySpec,
         profile: Optional[PR.ProfileSpec] = None,
+        request: Optional[dict[str, int]] = None,
     ) -> T.SchedulingUnit:
         template = C.template(fed_obj)
         meta = fed_obj["metadata"]
@@ -368,7 +397,9 @@ class SchedulerController:
             labels=dict(template.get("metadata", {}).get("labels", {})),
             annotations=dict(template.get("metadata", {}).get("annotations", {})),
             desired_replicas=desired,
-            resource_request=extract_pod_resource_request(template),
+            resource_request=request
+            if request is not None
+            else extract_pod_resource_request(template),
             current_clusters=current,
             auto_migration=auto,
             scheduling_mode=mode,
@@ -433,7 +464,12 @@ class SchedulerController:
                     results[key] = Result.ok()
                     continue
                 profile = profile_for(policy)
-                trigger = self._trigger_hash(fed_obj, policy, clusters_hash, profile)
+                # One template walk feeds both the trigger hash and the
+                # scheduling unit (it was the tick's top repeated cost).
+                request = extract_pod_resource_request(C.template(fed_obj))
+                trigger = self._trigger_hash(
+                    fed_obj, policy, clusters_hash, profile, request=request
+                )
                 if fed_obj["metadata"].get("annotations", {}).get(C.SCHEDULING_TRIGGER_HASH) == trigger:
                     # Skip scheduling, but still advance the pipeline:
                     # template-only changes re-arm pending-controllers
@@ -442,7 +478,9 @@ class SchedulerController:
                     # (scheduler.go:423-434).
                     results[key] = self._advance_pipeline(fed_obj, modified=False)
                     continue
-                units.append(self._scheduling_unit(fed_obj, policy, profile))
+                units.append(
+                    self._scheduling_unit(fed_obj, policy, profile, request=request)
+                )
             except Exception:
                 self.metrics.counter(f"scheduler-{self.ftc.name}.unit_errors")
                 results[key] = Result.retry()

@@ -94,13 +94,17 @@ def balanced_allocation_score(request, alloc, used):
 
 def _floordiv_smallq(num, den):
     """Exact int64 floor division for non-negative operands whose
-    QUOTIENT is small (here <= 100): an f64 estimate plus one integer
-    correction step.  XLA expands a 64-bit integer divide into a large
-    software sequence (~2s of compile PER SITE on CPU; int64 is
-    emulated on TPU), while the estimate+correct form is a handful of
-    cheap ops.  Exactness: the f64 estimate of a quotient q carries
-    absolute error ~q*2^-52 << 1, so one +/-1 correction against the
-    true integer remainder lands exactly on floor(num/den)."""
+    QUOTIENT is small (callers: scores <= 100, weights._round_half_div
+    <= ~1401): an f64 estimate plus one integer correction step.  XLA
+    expands a 64-bit integer divide into a large software sequence
+    (~2s of compile PER SITE on CPU; int64 is emulated on TPU), while
+    the estimate+correct form is a handful of cheap ops.  Exactness:
+    the float estimate of a quotient q carries absolute error ~q*eps
+    (eps = 2^-52 in f64; 2^-23 if the backend demotes f64 to f32, as
+    axon TPUs do), so the error stays << 1 for q up to ~2^20 and one
+    +/-1 correction against the true integer remainder lands exactly
+    on floor(num/den).  Do NOT narrow the correction without
+    re-deriving that bound for every caller's quotient range."""
     den = jnp.maximum(den, 1)
     q = jnp.floor(num.astype(jnp.float64) / den.astype(jnp.float64)).astype(
         num.dtype

@@ -12,6 +12,7 @@ scheduler's resource math inherits.
 
 from __future__ import annotations
 
+import functools
 import math
 import re
 from fractions import Fraction
@@ -123,9 +124,26 @@ def parse_quantity(text: "str | int | float") -> Quantity:
 
 def cpu_to_millis(text: "str | int | float") -> int:
     """CPU quantity -> millicores (the scheduler's CPU unit)."""
+    if isinstance(text, str):
+        return _cpu_millis_cached(text)
     return parse_quantity(text).milli_value()
 
 
 def to_int_value(text: "str | int | float") -> int:
     """Memory/storage/scalar quantity -> integer units (bytes for memory)."""
+    if isinstance(text, str):
+        return _int_value_cached(text)
+    return parse_quantity(text).value()
+
+
+# Quantity strings repeat massively across objects ("50m", "256Gi", node
+# sizes): the Fraction parse dominated scheduling-unit construction at
+# 10k-object batches, and the string -> int mappings are pure.
+@functools.lru_cache(maxsize=16384)
+def _cpu_millis_cached(text: str) -> int:
+    return parse_quantity(text).milli_value()
+
+
+@functools.lru_cache(maxsize=16384)
+def _int_value_cached(text: str) -> int:
     return parse_quantity(text).value()

@@ -1,0 +1,137 @@
+"""Opportunistic on-chip bench capture (VERDICT r4 #2).
+
+The tunneled TPU relay has intermittent uptime windows (r2: up; r3:
+wedged mid-round; r4: down all round).  A once-at-end-of-round bench
+run wastes any window that opens earlier, so this watcher probes the
+relay periodically through the build and, on the FIRST successful
+claim, runs the bench configs back-to-back on the chip and writes one
+``BENCH_DETAIL_c{N}_tpu.json`` artifact per config that succeeds
+on-chip.
+
+Single-tenancy discipline (BASELINE.md): the probe is one sacrificial
+subprocess with a timeout, never concurrent with another claim; the
+bench runs are sequential; nothing else may touch the chip while this
+script is active.
+
+Usage:  python tpu_capture.py            # defaults: configs 3,4,5
+        TPU_CAPTURE_CONFIGS=3,4 TPU_CAPTURE_DEADLINE_S=14400 \
+            TPU_CAPTURE_INTERVAL_S=600 python tpu_capture.py
+
+The capture loop is dependency-injected (probe / runner / clock) so the
+mechanism is testable without a chip: tests/test_tpu_capture.py drives
+it with fakes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def default_probe() -> bool:
+    """One bounded single-tenant chip probe in a throwaway subprocess."""
+    from kubeadmiral_tpu.bench_support import probe_tpu
+
+    return probe_tpu(attempts=1, probe_timeout=120.0) == ""
+
+
+def default_runner(config: str) -> dict | None:
+    """Run bench.py for one config on the chip; returns the parsed
+    artifact on an on-chip success, None otherwise (a cpu-fallback
+    artifact is NOT captured — the whole point is TPU evidence)."""
+    env = dict(os.environ)
+    env["BENCH_CONFIG"] = config
+    # One probe attempt: the watcher already established the window;
+    # if the chip vanished, fail fast and resume watching.
+    env.setdefault("BENCH_TPU_ATTEMPTS", "1")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=float(os.environ.get("TPU_CAPTURE_BENCH_TIMEOUT_S", 7200)),
+        )
+    except subprocess.TimeoutExpired:
+        # Relay wedged mid-run (the r3 scenario): resume watching, do
+        # not kill the watcher.
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                artifact = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if artifact.get("detail", {}).get("platform") == "tpu":
+                return artifact
+    return None
+
+
+def capture_loop(
+    configs,
+    probe=default_probe,
+    runner=default_runner,
+    sleep=time.sleep,
+    clock=time.monotonic,
+    interval_s: float = 600.0,
+    deadline_s: float = 6 * 3600.0,
+    write_dir: str = REPO,
+) -> dict[str, str]:
+    """Watch for a relay window; on the first claim, capture every
+    config sequentially.  Returns {config: artifact_path} for captures.
+    A config that fails on-chip mid-window is retried in the next
+    window; captured configs are never re-run."""
+    captured: dict[str, str] = {}
+    start = clock()
+    while clock() - start < deadline_s:
+        remaining = [c for c in configs if c not in captured]
+        if not remaining:
+            break
+        if probe():
+            for config in remaining:
+                artifact = runner(config)
+                if artifact is None:
+                    # Chip lost mid-window: back to watching.
+                    print(
+                        f"# capture: config {config} lost the chip; rewatching",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    break
+                path = os.path.join(write_dir, f"BENCH_DETAIL_c{config}_tpu.json")
+                with open(path, "w") as f:
+                    json.dump(artifact, f)
+                    f.write("\n")
+                captured[config] = path
+                print(f"# capture: config {config} -> {path}", file=sys.stderr)
+            else:
+                break  # every remaining config captured in this window
+        sleep(interval_s)
+    return captured
+
+
+def main() -> int:
+    configs = [
+        c.strip()
+        for c in os.environ.get("TPU_CAPTURE_CONFIGS", "3,4,5").split(",")
+        if c.strip()
+    ]
+    captured = capture_loop(
+        configs,
+        interval_s=float(os.environ.get("TPU_CAPTURE_INTERVAL_S", 600)),
+        deadline_s=float(os.environ.get("TPU_CAPTURE_DEADLINE_S", 6 * 3600)),
+    )
+    print(json.dumps({"captured": captured}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
