@@ -1,0 +1,44 @@
+"""Standalone member-apiserver process for the kwok-lite farm.
+
+The reference's KWOK provider spawns each fake cluster as separate
+processes (reference: test/e2e/framework/clusterprovider/kwokprovider.go:70-260
+via kwokctl — one apiserver + etcd per cluster).  The single-process
+farm serializes every member apiserver and every controller on one GIL,
+which BASELINE.md identified as the remaining HTTP-e2e ceiling; running
+members here, as real subprocesses, removes that artifact from the
+measurement.
+
+Protocol: configuration arrives via environment (KWOK_NAME, KWOK_TOKEN,
+KWOK_PORT); once the server is listening, one JSON line {"url": ...} is
+printed to stdout; the process exits when stdin reaches EOF (the parent
+holds the pipe, so farm teardown — or a parent crash — reaps the child
+without pid bookkeeping).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    from kubeadmiral_tpu.testing.fakekube import FakeKube
+    from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+
+    name = os.environ.get("KWOK_NAME", "member")
+    token = os.environ.get("KWOK_TOKEN") or None
+    port = int(os.environ.get("KWOK_PORT", "0"))
+    store = FakeKube(name)
+    server = KubeApiServer(
+        store, admin_token=token, port=port, mint_sa_tokens=True
+    )
+    print(json.dumps({"url": server.url}), flush=True)
+    try:
+        sys.stdin.read()  # block until the parent closes the pipe
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

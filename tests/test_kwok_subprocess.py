@@ -1,0 +1,111 @@
+"""Multi-process kwok-lite farm: member apiservers as real subprocesses
+(VERDICT r4 #6 — the reference's kwokctl model, one process per fake
+cluster, kwokprovider.go:70-260)."""
+
+import json
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.statusctl import StatusController
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+
+def settle(*controllers, rounds=400):
+    import time
+
+    idle = 0
+    while idle < 10 and rounds:
+        rounds -= 1
+        progressed = False
+        for ctl in controllers:
+            while ctl.worker.step():
+                progressed = True
+        if progressed:
+            idle = 0
+        else:
+            idle += 1
+            time.sleep(0.05)
+
+
+def test_subprocess_members_propagate_and_collect():
+    farm = KwokLiteFarm(member_subprocess=True)
+    try:
+        fleet = farm.fleet
+        admins = {}
+        for name in ("p1", "p2"):
+            admins[name] = farm.add_member(name)
+            fleet.host.create(
+                C.FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": farm.cluster_spec(name),
+                    "status": {
+                        "conditions": [
+                            {"type": "Joined", "status": "True"},
+                            {"type": "Ready", "status": "True"},
+                        ]
+                    },
+                },
+            )
+        assert len(farm.member_procs) == 2
+        pids = {p.pid for p in farm.member_procs.values()}
+        assert len(pids) == 2  # really separate processes
+
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        sync = SyncController(fleet, ftc)
+        status = StatusController(fleet, ftc)
+
+        fed = {
+            "apiVersion": "types.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedDeployment",
+            "metadata": {
+                "name": "web",
+                "namespace": "default",
+                "annotations": {pending.PENDING_CONTROLLERS: json.dumps([])},
+            },
+            "spec": {
+                "template": {
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "metadata": {"name": "web", "namespace": "default"},
+                    "spec": {
+                        "replicas": 2,
+                        "template": {
+                            "metadata": {"labels": {"app": "web"}},
+                            "spec": {"containers": [{"name": "c", "image": "nginx"}]},
+                        },
+                    },
+                },
+                "placements": [
+                    {
+                        "controller": C.SCHEDULER,
+                        "placement": [{"cluster": "p1"}, {"cluster": "p2"}],
+                    }
+                ],
+            },
+        }
+        fleet.host.create(ftc.federated.resource, fed)
+        settle(sync, status)
+
+        # Propagated into both member processes (read via admin clients).
+        for name, admin in admins.items():
+            obj = admin.try_get(ftc.source.resource, "default/web")
+            assert obj is not None, name
+            assert obj["metadata"]["labels"][C.MANAGED_LABEL] == "true"
+
+        # Member status flows back into the status CR over the sockets.
+        obj = admins["p1"].get(ftc.source.resource, "default/web")
+        obj["status"] = {"replicas": 2, "readyReplicas": 2}
+        admins["p1"].update_status(ftc.source.resource, obj)
+        settle(sync, status)
+        cr = fleet.host.get(ftc.status.resource, "default/web")
+        by = {e["clusterName"]: e for e in cr["clusterStatus"]}
+        assert by["p1"]["collectedFields"]["status"]["readyReplicas"] == 2
+    finally:
+        farm.close()
+    for proc in farm.member_procs.values():
+        assert proc.poll() is not None  # reaped on close
