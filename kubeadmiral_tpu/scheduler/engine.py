@@ -464,10 +464,16 @@ class SchedulerEngine:
         # power-of-two grid whose axes divide every row/cluster bucket
         # (non-pow2 device counts leave the remainder idle; explicit
         # meshes are validated strictly in _build_programs instead).
+        # Objects axis first: cluster-axis sharding turns the per-object
+        # reductions (normalize maxima, top-K, planner sorts) into
+        # all-to-all-heavy collectives — measured ~11x slower at
+        # config-5 shapes on the virtual mesh (see parallel/mesh.py
+        # make_mesh).  Only when the objects axis is capped by the
+        # bucket size do the remaining devices go to the cluster axis
+        # (idle devices are worse than cluster collectives).
         usable = 1 << (n.bit_length() - 1)
-        obj, clus = (usable // 2, 2) if usable >= 4 else (usable, 1)
-        obj = min(obj, self.min_bucket)
-        clus = min(clus, self.min_cluster_bucket)
+        obj = min(usable, self.min_bucket)
+        clus = min(usable // obj, self.min_cluster_bucket)
         from kubeadmiral_tpu.parallel.mesh import make_mesh
 
         return make_mesh(devices[: obj * clus], objects_axis=obj)
