@@ -396,13 +396,16 @@ class SchedulerEngine:
         # not as a TypeError deep inside the first scheduling tick.
         self._vocab_caps = dict(vocab_caps or {})
         # Chunk pipelining depth: with depth D the engine keeps up to D
-        # chunks' programs in flight, featurizing/decoding on the host
-        # while the device computes (double buffering at D=2).  Default 1
-        # (strictly sequential): unbounded dispatch-ahead measured SLOWER
-        # over the tunneled single chip (transfers queue behind every
-        # outstanding program); bounded depth is the on-pod optimization,
-        # flip KT_PIPELINE_DEPTH=2 to measure on real hardware.
-        self.pipeline_depth = max(1, int(os.environ.get("KT_PIPELINE_DEPTH", "1")))
+        # chunks' programs in flight, featurizing/dispatching while the
+        # device computes, then drains the whole window in BATCHED
+        # transfers (_drain_fetch_window): one stacked fetch for every
+        # chunk's diff mask, one per plane-group for the delta gathers,
+        # one per output plane for full refetches.  Per-transfer latency
+        # dominates multi-chunk ticks over the tunneled chip (config 5:
+        # 391 chunk masks x ~18ms = 7.0s of a 8.9s tick), so the window
+        # amortizes round trips ~D-fold; in-flight memory is D x the
+        # chunk's output planes (D=16 at [256, 5120] i32 ~ 340MB).
+        self.pipeline_depth = max(1, int(os.environ.get("KT_PIPELINE_DEPTH", "16")))
         # Distinct (fmt, rows, clusters) program shapes dispatched — the
         # observable program count the bucket ladder promises to bound
         # (each unique shape is one XLA compile, amortized by the
@@ -479,6 +482,11 @@ class SchedulerEngine:
         return make_mesh(devices[: obj * clus], objects_axis=obj)
 
     def _build_programs(self) -> None:
+        # Window-drain stacker: one device-side stack of same-shape
+        # buffers -> ONE host transfer for the whole window (jax traces
+        # a variant per (arity, shape); arities are bounded by the
+        # pipeline depth and shapes by the bucket ladder).
+        self._stack = jax.jit(lambda *xs: jnp.stack(xs))
         if self.mesh is None:
             self._tick = jax.jit(_tick_with_diff)
             self._tick_compact = jax.jit(_tick_compact_with_diff)
@@ -984,10 +992,11 @@ class SchedulerEngine:
                 chunk_results.append(None)
                 chunk_changed.append(None)  # filled by the drain
                 if len(pending_fetch) >= self.pipeline_depth:
-                    self._drain_fetch(
-                        pending_fetch.pop(0), chunk_results, chunk_changed,
+                    self._drain_fetch_window(
+                        pending_fetch, chunk_results, chunk_changed,
                         view, want_scores, timings,
                     )
+                    pending_fetch.clear()
                 continue
             jax.block_until_ready(out)
             t2 = time.perf_counter()
@@ -1005,11 +1014,12 @@ class SchedulerEngine:
             chunk_results.append(part)
             chunk_changed.append(changed)
 
-        while pending_fetch:
-            self._drain_fetch(
-                pending_fetch.pop(0), chunk_results, chunk_changed, view,
+        if pending_fetch:
+            self._drain_fetch_window(
+                pending_fetch, chunk_results, chunk_changed, view,
                 want_scores, timings,
             )
+            pending_fetch.clear()
         if pending_sub:
             self._run_sub_batch(
                 pending_sub, chunk_results, view, timings, eff_chunk, ladder,
@@ -1336,6 +1346,241 @@ class SchedulerEngine:
             entry, out, mask_dev, view.names, n, want_scores, timings, view
         )
 
+    def _drain_fetch_window(
+        self, items, chunk_results, chunk_changed, view, want_scores: bool, timings
+    ) -> None:
+        """Drain a whole in-flight window with BATCHED transfers.
+
+        Per-transfer latency, not payload, dominates multi-chunk ticks
+        over the tunneled chip (each blocking device->host read is a
+        round trip): instead of per-chunk mask + gather + plane reads,
+        same-shape buffers across the window are stacked ON DEVICE and
+        fetched in one transfer each — one read for all diff masks, one
+        per plane-group for delta gathers, one per output plane group
+        for full refetches — and every device dispatch is enqueued
+        before the first blocking read.  Per-chunk semantics live in
+        the helpers shared with _fetch_decode (_plan_delta /
+        _note_skip / _apply_delta / _apply_full)."""
+        if not items:
+            return
+        if len(items) == 1:
+            self._drain_fetch(
+                items[0], chunk_results, chunk_changed, view, want_scores, timings
+            )
+            return
+
+        # Phase 1: one stacked transfer per mask shape.
+        t0 = time.perf_counter()
+        mask_np: dict[int, np.ndarray] = {}
+        mgroups: dict[tuple, list] = {}
+        for it in items:
+            if it[3] is not None:
+                mgroups.setdefault(tuple(it[3].shape), []).append(it)
+        for _, group in mgroups.items():
+            if len(group) == 1:
+                mask_np[group[0][0]] = np.asarray(group[0][3])
+            else:
+                stacked = np.asarray(self._stack(*[g[3] for g in group]))
+                for i, g in enumerate(group):
+                    mask_np[g[0]] = stacked[i]
+        timings["fetch"] += time.perf_counter() - t0
+
+        # Phase 2: plan skip/delta/full per chunk from the host masks.
+        delta_items: list[tuple] = []
+        full_items: list[tuple] = []
+        for slot, entry, out, mask_dev, n in items:
+            if mask_dev is None:
+                full_items.append((slot, entry, out, n))
+                continue
+            kind, idx = self._plan_delta(entry, mask_np[slot][:n], n)
+            if kind == "skip":
+                self._note_skip(entry, out, view)
+                chunk_results[slot] = entry.prev_results
+                chunk_changed[slot] = []
+            elif kind == "full":
+                full_items.append((slot, entry, out, n))
+            else:
+                delta_items.append((slot, entry, out, idx))
+
+        # Phase 3: enqueue ALL device work — delta gathers (idx bucketed
+        # to the window max per plane-group so outputs stack) and full-
+        # plane stacks — and only then run the blocking host reads, so
+        # transfers overlap device execution instead of serializing.
+        t0 = time.perf_counter()
+        by_planes: dict[int, list] = {}
+        for slot, entry, out, idx in delta_items:
+            self.fetch_stats["delta"] += 1
+            by_planes.setdefault(
+                4 if entry.prev_has_scores else 3, []
+            ).append((slot, entry, out, idx))
+        stacked_devs: dict[int, object] = {}
+        for planes, group in by_planes.items():
+            k_max = max(
+                _pow2_bucket(idx.size, 16, 1 << 30) for _, _, _, idx in group
+            )
+            devs = []
+            for slot, entry, out, idx in group:
+                padded_idx = np.zeros(k_max, np.int32)
+                padded_idx[: idx.size] = idx
+                if planes == 4:
+                    devs.append(
+                        self._gather(
+                            out.selected, out.replicas, out.counted,
+                            out.scores, padded_idx,
+                        )
+                    )
+                else:
+                    devs.append(
+                        self._gather3(
+                            out.selected, out.replicas, out.counted, padded_idx
+                        )
+                    )
+            stacked_devs[planes] = devs[0] if len(devs) == 1 else self._stack(*devs)
+        fstacks: list[tuple] = []
+        fgroups: dict[tuple, list] = {}
+        for slot, entry, out, n in full_items:
+            fgroups.setdefault(tuple(out.selected.shape), []).append(
+                (slot, entry, out, n)
+            )
+        for _, group in fgroups.items():
+            if len(group) == 1:
+                g = group[0][2]
+                fstacks.append(
+                    (group, g.selected, g.replicas, g.counted,
+                     g.scores if want_scores else None)
+                )
+            else:
+                fstacks.append(
+                    (
+                        group,
+                        self._stack(*[g[2].selected for g in group]),
+                        self._stack(*[g[2].replicas for g in group]),
+                        self._stack(*[g[2].counted for g in group]),
+                        self._stack(*[g[2].scores for g in group])
+                        if want_scores
+                        else None,
+                    )
+                )
+        packed_np = {p: np.asarray(d) for p, d in stacked_devs.items()}
+        full_np = [
+            (
+                group,
+                np.asarray(sel),
+                np.asarray(rep),
+                np.asarray(cnt),
+                np.asarray(sco) if sco is not None else None,
+            )
+            for group, sel, rep, cnt, sco in fstacks
+        ]
+        timings["fetch"] += time.perf_counter() - t0
+
+        # Phase 4: host-side decode + bookkeeping, per chunk.
+        t0 = time.perf_counter()
+        for planes, group in by_planes.items():
+            arr = packed_np[planes]
+            single = len(group) == 1
+            for i, (slot, entry, out, idx) in enumerate(group):
+                merged, idx_rows = self._apply_delta(
+                    entry, out, idx, arr if single else arr[i], planes,
+                    view.names, view,
+                )
+                chunk_results[slot] = merged
+                chunk_changed[slot] = idx_rows
+        for group, sel, rep, cnt, sco in full_np:
+            single = len(group) == 1
+            for i, (slot, entry, out, n) in enumerate(group):
+                results = self._apply_full(
+                    entry, out,
+                    sel if single else sel[i],
+                    rep if single else rep[i],
+                    cnt if single else cnt[i],
+                    (sco if single else sco[i]) if sco is not None else None,
+                    n, view.names, want_scores, view,
+                )
+                chunk_results[slot] = results
+                chunk_changed[slot] = None
+        timings["decode"] += time.perf_counter() - t0
+
+    # -- per-chunk fetch semantics (shared by the sequential path and the
+    # -- batched window drain) --------------------------------------------
+    def _plan_delta(self, entry, mask: np.ndarray, n: int):
+        """('skip'|'delta'|'full', idx) from one chunk's host-side diff
+        mask: bit 0 flags placement changes, bit 1 score-only changes
+        (consulted only when the cached decode carries scores), rows
+        patched by a sub-batch tick are force-fetched, and mass changes
+        fall back to a full refetch."""
+        relevant = mask & _DIFF_PLACEMENT
+        if entry.prev_has_scores:
+            relevant = relevant | (mask & _DIFF_SCORES)
+        if entry.stale_out_rows:
+            # prev_out rows patched by a sub-batch tick: the device diff
+            # compares against pre-patch outputs there, so force-fetch
+            # them regardless of what the mask says.
+            stale = np.asarray(
+                [r for r in entry.stale_out_rows if r < n], np.int64
+            )
+            if stale.size:
+                relevant[stale] |= _DIFF_PLACEMENT
+        idx = np.nonzero(relevant)[0]
+        if idx.size > max(16, n // 4):
+            return "full", None
+        if idx.size == 0:
+            return "skip", None
+        return "delta", idx
+
+    def _note_skip(self, entry, out, view) -> None:
+        self.fetch_stats["skip"] += 1
+        entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+        entry.stale_out_rows = None
+        entry.prev_view = view
+
+    def _apply_delta(
+        self, entry, out, idx, packed: np.ndarray, planes: int, names, view
+    ):
+        """Decode the gathered rows, merge into the cached decode, and
+        record the fresh outputs; returns (merged, changed-rows)."""
+        packed = packed[: idx.size]
+        c_pad = packed.shape[1] // planes
+        changed_results = self._decode_rows(
+            packed[:, :c_pad],
+            packed[:, c_pad : 2 * c_pad],
+            packed[:, 2 * c_pad : 3 * c_pad],
+            names,
+            scores=packed[:, 3 * c_pad :] if planes == 4 else None,
+        )
+        idx_rows = idx.tolist()
+        merged = list(entry.prev_results)
+        for row, res in zip(idx_rows, changed_results):
+            merged[row] = res
+        entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+        entry.stale_out_rows = None
+        entry.prev_results = merged
+        entry.prev_view = view
+        return merged, idx_rows
+
+    def _apply_full(
+        self, entry, out, selected, replicas, counted, scores, n: int,
+        names, want_scores: bool, view,
+    ) -> list[ScheduleResult]:
+        self.fetch_stats["full"] += 1
+        results = self._decode_rows(
+            selected[:n], replicas[:n], counted[:n], names,
+            scores[:n] if scores is not None else None,
+        )
+        if entry is not None:
+            # ALWAYS store the fresh outputs (including on want_scores
+            # ticks): a tick that patched cached rows but skipped this
+            # store would leave prev_results describing pre-patch
+            # inputs, and the next tick's no-op shortcut would replay
+            # stale placements (ADVICE r2).  The caller shares the
+            # stored list's rows — frozen results make that safe.
+            entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+            entry.stale_out_rows = None
+            entry.prev_results = results
+            entry.prev_has_scores = want_scores
+            entry.prev_view = view
+        return results
+
     def _fetch_decode(
         self, entry, out, mask_dev, names, n: int, want_scores: bool, timings, view
     ) -> tuple[list[ScheduleResult], Optional[list[int]]]:
@@ -1351,91 +1596,47 @@ class SchedulerEngine:
         cached decodes carry scores."""
         t2 = time.perf_counter()
         if mask_dev is not None:
-            mask = np.asarray(mask_dev)[:n]
-            relevant = mask & _DIFF_PLACEMENT
-            if entry.prev_has_scores:
-                relevant = relevant | (mask & _DIFF_SCORES)
-            if entry.stale_out_rows:
-                # prev_out rows patched by a sub-batch tick: the device
-                # diff compares against pre-patch outputs there, so
-                # force-fetch them regardless of what the mask says.
-                stale = np.asarray(
-                    [r for r in entry.stale_out_rows if r < n], np.int64
-                )
-                if stale.size:
-                    relevant[stale] |= _DIFF_PLACEMENT
-            idx = np.nonzero(relevant)[0]
-            if idx.size <= max(16, n // 4):
-                new_out = (out.selected, out.replicas, out.counted, out.scores)
-                if idx.size == 0:
-                    self.fetch_stats["skip"] += 1
-                    merged = entry.prev_results
-                else:
-                    self.fetch_stats["delta"] += 1
-                    k = _pow2_bucket(idx.size, 16, 1 << 30)
-                    padded_idx = np.zeros(k, np.int32)
-                    padded_idx[: idx.size] = idx
-                    if entry.prev_has_scores:
-                        packed_dev = self._gather(
-                            out.selected, out.replicas, out.counted,
-                            out.scores, padded_idx,
-                        )
-                        planes = 4
-                    else:
-                        packed_dev = self._gather3(
-                            out.selected, out.replicas, out.counted, padded_idx
-                        )
-                        planes = 3
-                    packed = np.asarray(packed_dev)[: idx.size]
-                    c_pad = packed.shape[1] // planes
-                    t3 = time.perf_counter()
-                    timings["fetch"] += t3 - t2
-                    changed_results = self._decode_rows(
-                        packed[:, :c_pad],
-                        packed[:, c_pad : 2 * c_pad],
-                        packed[:, 2 * c_pad : 3 * c_pad],
-                        names,
-                        scores=packed[:, 3 * c_pad :]
-                        if planes == 4
-                        else None,
-                    )
-                    idx_rows = idx.tolist()
-                    merged = list(entry.prev_results)
-                    for row, res in zip(idx_rows, changed_results):
-                        merged[row] = res
-                    entry.prev_out = new_out
-                    entry.stale_out_rows = None
-                    entry.prev_results = merged
-                    entry.prev_view = view
-                    timings["decode"] += time.perf_counter() - t3
-                    return merged, idx_rows
-                entry.prev_out = new_out
-                entry.stale_out_rows = None
-                entry.prev_view = view
+            kind, idx = self._plan_delta(entry, np.asarray(mask_dev)[:n], n)
+            if kind == "skip":
+                self._note_skip(entry, out, view)
                 timings["fetch"] += time.perf_counter() - t2
-                return merged, []
+                return entry.prev_results, []
+            if kind == "delta":
+                self.fetch_stats["delta"] += 1
+                k = _pow2_bucket(idx.size, 16, 1 << 30)
+                padded_idx = np.zeros(k, np.int32)
+                padded_idx[: idx.size] = idx
+                if entry.prev_has_scores:
+                    packed_dev = self._gather(
+                        out.selected, out.replicas, out.counted,
+                        out.scores, padded_idx,
+                    )
+                    planes = 4
+                else:
+                    packed_dev = self._gather3(
+                        out.selected, out.replicas, out.counted, padded_idx
+                    )
+                    planes = 3
+                packed = np.asarray(packed_dev)
+                t3 = time.perf_counter()
+                timings["fetch"] += t3 - t2
+                merged, idx_rows = self._apply_delta(
+                    entry, out, idx, packed, planes, names, view
+                )
+                timings["decode"] += time.perf_counter() - t3
+                return merged, idx_rows
             # fall through to a full fetch for mass changes
 
-        self.fetch_stats["full"] += 1
-        selected = np.asarray(out.selected)[:n]
-        replicas = np.asarray(out.replicas)[:n]
-        counted = np.asarray(out.counted)[:n]
-        scores = np.asarray(out.scores)[:n] if want_scores else None
+        selected = np.asarray(out.selected)
+        replicas = np.asarray(out.replicas)
+        counted = np.asarray(out.counted)
+        scores = np.asarray(out.scores) if want_scores else None
         t3 = time.perf_counter()
         timings["fetch"] += t3 - t2
-        results = self._decode_rows(selected, replicas, counted, names, scores)
-        if entry is not None:
-            # ALWAYS store the fresh outputs (including on want_scores
-            # ticks): a tick that patched cached rows but skipped this
-            # store would leave prev_results describing pre-patch
-            # inputs, and the next tick's no-op shortcut would replay
-            # stale placements (ADVICE r2).  The caller shares the
-            # stored list's rows — frozen results make that safe.
-            entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
-            entry.stale_out_rows = None
-            entry.prev_results = results
-            entry.prev_has_scores = want_scores
-            entry.prev_view = view
+        results = self._apply_full(
+            entry, out, selected, replicas, counted, scores, n, names,
+            want_scores, view,
+        )
         timings["decode"] += time.perf_counter() - t3
         return results, None
 

@@ -213,6 +213,10 @@ def test_pipelined_chunks_match_sequential():
         for i in range(50)
     ]
     seq = SchedulerEngine(chunk_size=16, min_bucket=8)
+    # Pin the reference engine to the strictly sequential per-chunk
+    # drain: with the pipelined default both sides would take the
+    # batched window path and a bug there would cancel out.
+    seq.pipeline_depth = 1
     piped = SchedulerEngine(chunk_size=16, min_bucket=8)
     piped.pipeline_depth = 3
     assert seq.schedule(units, clusters) == piped.schedule(units, clusters)
