@@ -369,13 +369,27 @@ def parity_check(results, native_fps, names, followers) -> dict:
     mask = np.ones(len(results), bool)
     if followers:
         mask[np.asarray(followers)] = False
+    rows = np.nonzero(mask)[0]
     agree = (got[mask] == native_fps[mask]).all(axis=1)
     mismatches = int((~agree).sum())
-    return {
+    out = {
         "parity": mismatches == 0,
         "parity_rows_checked": int(mask.sum()),
         "parity_mismatches": mismatches,
     }
+    if mismatches:
+        # Diagnosis sample: which rows, and how the (count, idx-sum,
+        # idx-sq-sum, replica-sum, replica-dot) fingerprints differ.
+        bad = rows[~agree][:8]
+        out["parity_sample"] = [
+            {
+                "row": int(r),
+                "got": [int(v) for v in got[r]],
+                "want": [int(v) for v in native_fps[r]],
+            }
+            for r in bad
+        ]
+    return out
 
 
 def time_python_oracle(units, clusters, sample=200):

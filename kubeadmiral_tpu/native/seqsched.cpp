@@ -34,12 +34,19 @@ struct Pref {
   int32_t tiebreak = 0;
 };
 
-// planner.go:62-66 order: weight desc, fnv32 tie-break asc.
+// planner.go:62-66 order: weight desc, fnv32 tie-break asc; cluster
+// index asc as the FINAL canonical key — fnv32 collisions between
+// equal-weight clusters must order identically in the device kernel
+// (ops/planner.py num_keys=3 sort), the Python oracle (stable sort =
+// insertion/index order), and here (total order makes std::sort
+// deterministic).
 void sort_order(std::vector<int>& order, const std::vector<Pref>& prefs) {
   std::sort(order.begin(), order.end(), [&](int a, int b) {
     if (prefs[a].weight != prefs[b].weight)
       return prefs[a].weight > prefs[b].weight;
-    return prefs[a].tiebreak < prefs[b].tiebreak;
+    if (prefs[a].tiebreak != prefs[b].tiebreak)
+      return prefs[a].tiebreak < prefs[b].tiebreak;
+    return a < b;
   });
 }
 

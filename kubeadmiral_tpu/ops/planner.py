@@ -109,9 +109,15 @@ def _distribute(
     (plan, overflow, unplaced_remainder) in original cluster order."""
     c_slots = weight.shape[0]
 
-    # Processing order: members first, weight desc, tiebreak hash asc.
+    # Processing order: members first, weight desc, tiebreak hash asc,
+    # cluster index as the FINAL comparator key — fnv32 tiebreak
+    # collisions between equal-weight clusters would otherwise order
+    # backend-dependently (jnp.lexsort carries the iota as a value
+    # operand and trusts backend sort stability, which the axon TPU
+    # ignores at wide rows; see ops/select.py).
     sort_weight = jnp.where(member, -weight, INT32_INF)
-    perm = jnp.lexsort((tiebreak, sort_weight))
+    iota = jax.lax.iota(jnp.int32, c_slots)
+    perm = jax.lax.sort((sort_weight, tiebreak, iota), num_keys=3)[-1]
     w = weight[perm]
     min_r = min_replicas[perm]
     max_r = max_replicas[perm]

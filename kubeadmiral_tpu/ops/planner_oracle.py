@@ -90,7 +90,11 @@ def plan(inp: PlanInput) -> tuple[dict[str, int], dict[str, int]]:
 def _sorted_names(prefs: dict[str, ClusterPref], key: str) -> list[str]:
     # Ties between equal weights break on a per-object hash so that
     # single-replica workloads don't all pile onto one lexicographically
-    # small cluster (planner.go:62-66).
+    # small cluster (planner.go:62-66).  On fnv32 collisions, Python's
+    # stable sort preserves insertion order — callers build ``prefs``
+    # in cluster-index order, which is the canonical final key shared
+    # with the device kernel (ops/planner.py num_keys=3 sort) and the
+    # C++ baseline (seqsched.cpp sort_order index tie).
     return sorted(
         prefs,
         key=lambda name: (-prefs[name].weight, fnv32(name.encode() + key.encode())),

@@ -9,6 +9,7 @@ sentinel INT32_INF means "no limit".
 
 from __future__ import annotations
 
+from jax import lax
 import jax.numpy as jnp
 
 from kubeadmiral_tpu.ops.planner import INT32_INF
@@ -21,14 +22,25 @@ def select_topk(scores, feasible, max_clusters):
     (normalized in-tree scores) plus webhook scores clamped to
     int32max/2 by the featurizer, so every total fits int32 with room —
     and 64-bit sorts are disproportionately expensive to compile (and,
-    on TPU, to run: int64 is emulated)."""
+    on TPU, to run: int64 is emulated).
+
+    The index tie-break is a comparator KEY (lax.sort num_keys=2), not
+    argsort stability: jnp.argsort(stable=True) carries the iota as a
+    value operand and trusts the backend's is_stable flag, which the
+    axon TPU sort ignores at wide rows — caught by the r5 on-chip
+    parity check as ~3% placement mismatches at 100k x 5120 (ties at
+    the top-K boundary selected backend-dependent clusters) while
+    narrow shapes agreed exactly."""
     c = scores.shape[-1]
     # Rank feasible clusters by score desc, index asc; infeasible last.
     sort_key = jnp.where(
         feasible, -scores.astype(jnp.int32), jnp.iinfo(jnp.int32).max
     )
-    order = jnp.argsort(sort_key, axis=-1, stable=True)
-    rank = jnp.argsort(order, axis=-1, stable=True)  # rank[b,c] = position of c
+    iota = lax.broadcasted_iota(jnp.int32, sort_key.shape, sort_key.ndim - 1)
+    _, order = lax.sort((sort_key, iota), dimension=-1, num_keys=2)
+    # Inverting a permutation: values are unique, so any correct sort
+    # yields the same rank regardless of backend stability.
+    rank = jnp.argsort(order, axis=-1, stable=False)  # rank[b,c] = position of c
     k = jnp.where(
         max_clusters < 0,
         0,
