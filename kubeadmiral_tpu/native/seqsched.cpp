@@ -41,9 +41,12 @@ struct Pref {
 // insertion/index order), and here (total order makes std::sort
 // deterministic).
 void sort_order(std::vector<int>& order, const std::vector<Pref>& prefs) {
+  // Clamped weight in the sort key too (non-positive = no share);
+  // negative-weight clusters tie with zero-weight ones everywhere.
   std::sort(order.begin(), order.end(), [&](int a, int b) {
-    if (prefs[a].weight != prefs[b].weight)
-      return prefs[a].weight > prefs[b].weight;
+    int64_t wa = std::max<int64_t>(prefs[a].weight, 0);
+    int64_t wb = std::max<int64_t>(prefs[b].weight, 0);
+    if (wa != wb) return wa > wb;
     if (prefs[a].tiebreak != prefs[b].tiebreak)
       return prefs[a].tiebreak < prefs[b].tiebreak;
     return a < b;
@@ -68,19 +71,24 @@ void distribute(const std::vector<int>& order, const std::vector<Pref>& prefs,
     out[idx] = take;
   }
 
+  // Non-positive weight = no share (the defined rule shared with the
+  // device kernel and the Python oracle; a negative weight — dynamic-
+  // weight residual at thousands of clusters, or a bad policy value —
+  // would corrupt the ceil quotas).
   std::vector<int> active = order;
   bool moved = true;
   while (moved && remaining > 0) {
     moved = false;
     int64_t weight_sum = 0;
-    for (int idx : active) weight_sum += prefs[idx].weight;
+    for (int idx : active) weight_sum += std::max<int64_t>(prefs[idx].weight, 0);
     if (weight_sum <= 0) break;
     int64_t snapshot = remaining;
     std::vector<int> survivors;
     for (int idx : active) {
       int64_t start = out[idx];
       int64_t extra =
-          (snapshot * prefs[idx].weight + weight_sum - 1) / weight_sum;
+          (snapshot * std::max<int64_t>(prefs[idx].weight, 0) + weight_sum - 1) /
+          weight_sum;
       extra = std::min(extra, remaining);
       int64_t total_n = start + extra;
 
@@ -261,7 +269,10 @@ void dynamic_weights(const World& w, const std::vector<int>& selected,
       max_j = j;
     }
   }
-  if (max_j >= 0) weights_out[max_j] += 1000 - other;
+  if (max_j >= 0)
+    // Clamped at zero — see ops/weights.py (the round-up bias across
+    // thousands of clusters can exceed the max weight).
+    weights_out[max_j] = std::max<int64_t>(weights_out[max_j] + 1000 - other, 0);
 }
 
 // planner.go scaleUp: grow clusters under their desired share.

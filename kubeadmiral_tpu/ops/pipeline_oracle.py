@@ -171,7 +171,9 @@ def _dynamic_weights(p: OracleProblem, selected: list[int]) -> dict[int, int]:
         if weights[c] > max_w:
             max_w, max_c = weights[c], c
     if max_c is not None:
-        weights[max_c] += 1000 - other
+        # Clamped at zero — see ops/weights.py (the round-up bias across
+        # thousands of clusters can exceed the max weight).
+        weights[max_c] = max(weights[max_c] + 1000 - other, 0)
     return weights
 
 

@@ -115,10 +115,19 @@ def _distribute(
     # backend-dependently (jnp.lexsort carries the iota as a value
     # operand and trusts backend sort stability, which the axon TPU
     # ignores at wide rows; see ops/select.py).
-    sort_weight = jnp.where(member, -weight, INT32_INF)
+    # Non-positive weight = no share (defined identically in the Python
+    # oracle and the C++ baseline): a negative weight — the dynamic-
+    # weight residual at thousands of selected clusters, or a bad
+    # policy value — would turn the ceil-quota negative and blow up the
+    # remaining-replica accounting (caught by the r5 full-shape parity
+    # check as INT32_INF-scale replica plans at 100k x 5k).  The SORT
+    # also runs on the clamped weight: negating a raw INT32_MIN would
+    # wrap, ordering that cluster backend-dependently.
+    w_clamped = jnp.maximum(weight, 0)
+    sort_weight = jnp.where(member, -w_clamped, INT32_INF)
     iota = jax.lax.iota(jnp.int32, c_slots)
     perm = jax.lax.sort((sort_weight, tiebreak, iota), num_keys=3)[-1]
-    w = weight[perm]
+    w = w_clamped[perm]
     min_r = min_replicas[perm]
     max_r = max_replicas[perm]
     cap = capacity[perm]
@@ -276,10 +285,13 @@ def plan_batch(inp: PlannerInputs, *, validate: bool = True) -> PlannerOutputs:
 
 
 def validate_ranges(total: np.ndarray, weight: np.ndarray) -> None:
-    """Host-side guard for the int32 value contract."""
-    max_w = int(weight.max(initial=0))
+    """Host-side guard for the int32 value contract.  Sums the CLAMPED
+    weights — the kernel zeroes negatives, so negative entries must not
+    cancel positive ones in the overflow estimate."""
+    clamped = np.maximum(weight, 0)
+    max_w = int(clamped.max(initial=0))
     max_t = int(total.max(initial=0))
-    w_sum = int(weight.sum(axis=-1).max(initial=0))
+    w_sum = int(clamped.sum(axis=-1).max(initial=0))
     if max_t * max_w + w_sum >= 2**31:
         raise OverflowError(
             f"planner int32 contract violated: total={max_t} * weight={max_w} "

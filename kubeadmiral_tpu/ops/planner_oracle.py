@@ -95,9 +95,15 @@ def _sorted_names(prefs: dict[str, ClusterPref], key: str) -> list[str]:
     # in cluster-index order, which is the canonical final key shared
     # with the device kernel (ops/planner.py num_keys=3 sort) and the
     # C++ baseline (seqsched.cpp sort_order index tie).
+    # The sort key clamps at zero like the share math (non-positive
+    # weight = no share): all implementations order negative-weight
+    # clusters together with zero-weight ones, tie-broken by hash/index.
     return sorted(
         prefs,
-        key=lambda name: (-prefs[name].weight, fnv32(name.encode() + key.encode())),
+        key=lambda name: (
+            -max(prefs[name].weight, 0),
+            fnv32(name.encode() + key.encode()),
+        ),
     )
 
 
@@ -123,19 +129,23 @@ def _distribute(
         remaining -= take
         out[name] = take
 
-    # Pass 2: weighted rounds until a fixed point.
+    # Pass 2: weighted rounds until a fixed point.  Non-positive weight
+    # = no share (the defined rule shared with the device kernel and the
+    # C++ baseline; negative weights would corrupt the ceil quotas).
     active = list(order)
     moved = True
     while moved and remaining > 0:
         moved = False
-        weight_sum = sum(prefs[n].weight for n in active)
+        weight_sum = sum(max(prefs[n].weight, 0) for n in active)
         if weight_sum <= 0:
             break
         snapshot = remaining
         survivors = []
         for name in active:
             start = out[name]
-            extra = (snapshot * prefs[name].weight + weight_sum - 1) // weight_sum
+            extra = (
+                snapshot * max(prefs[name].weight, 0) + weight_sum - 1
+            ) // weight_sum
             extra = min(extra, remaining)
             total_n = start + extra
 

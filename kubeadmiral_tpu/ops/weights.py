@@ -76,11 +76,18 @@ def dynamic_weights(selected, cpu_alloc, cpu_avail):
     weight = jnp.where(sel, weight, 0)
 
     # Residual of the second rounding pass goes to the heaviest cluster
-    # (first index on ties; the reference's pick is map-order dependent).
+    # (first index on ties; the reference's pick is map-order dependent),
+    # clamped at zero: at thousands of selected clusters the round-up
+    # bias can exceed the max weight, and a negative weight has no
+    # defined share (the planner treats non-positive weights as zero —
+    # the clamp keeps all three implementations' weight vectors, and
+    # hence the planner's processing ORDER, identical).
     residual = SUM_WEIGHT - jnp.sum(weight, axis=-1, keepdims=True)
     max_w = jnp.max(weight, axis=-1, keepdims=True)
     is_first_max = (
         jnp.cumsum((weight == max_w) & sel, axis=-1) == 1
     ) & (weight == max_w) & sel
-    weight = jnp.where(is_first_max & (max_w > 0), weight + residual, weight)
+    weight = jnp.where(
+        is_first_max & (max_w > 0), jnp.maximum(weight + residual, 0), weight
+    )
     return weight.astype(jnp.int32)

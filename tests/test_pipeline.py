@@ -236,3 +236,80 @@ class TestExactIntegerScoreMath:
                 )[0]
             )
             assert got == want, (num, den, got)
+
+    def test_negative_weight_means_no_share(self):
+        """Non-positive weights get no replicas in any implementation:
+        the r5 full-shape parity check caught the device planner's ceil
+        quotas exploding to INT32_INF-scale plans when the dynamic-
+        weight residual went negative at thousands of selected clusters
+        (100k x 5k: 2,748 rows)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeadmiral_tpu.ops.planner import (
+            INT32_INF, PlannerInputs, plan_batch_jit,
+        )
+        from kubeadmiral_tpu.ops.planner_oracle import (
+            ClusterPref, PlanInput, plan as oracle_plan,
+        )
+
+        c = 4
+        weight = jnp.array([[5, -50, 3, 0]], jnp.int32)
+        member = jnp.ones((1, c), bool)
+        inf = jnp.full((1, c), INT32_INF, jnp.int32)
+        out = plan_batch_jit(
+            PlannerInputs(
+                weight=weight,
+                min_replicas=jnp.zeros((1, c), jnp.int32),
+                max_replicas=inf,
+                scale_max=inf,
+                capacity=inf,
+                tiebreak=jnp.arange(c, dtype=jnp.int32)[None, :],
+                member=member,
+                total=jnp.array([40], jnp.int32),
+                current=jnp.zeros((1, c), jnp.int32),
+                avoid_disruption=jnp.array([False]),
+                keep_unschedulable=jnp.array([False]),
+            )
+        )
+        plan = np.asarray(out.plan)[0]
+        assert plan.sum() == 40, plan
+        assert plan[1] == 0, plan  # negative weight: no share
+        assert (plan >= 0).all(), plan
+
+        want = oracle_plan(
+            PlanInput(
+                prefs={
+                    "m0": ClusterPref(weight=5),
+                    "m1": ClusterPref(weight=-50),
+                    "m2": ClusterPref(weight=3),
+                    "m3": ClusterPref(weight=0),
+                },
+                total=40,
+                key="default/w",
+                clusters=["m0", "m1", "m2", "m3"],
+            )
+        )
+        got = {f"m{i}": int(v) for i, v in enumerate(plan) if v}
+        assert got == {k: v for k, v in want[0].items() if v}, (got, want)
+
+    def test_dynamic_weight_residual_clamped_at_zero(self):
+        """At thousands of selected clusters the rounded weights sum
+        past 1000 and the residual would drive the max cluster negative;
+        all implementations clamp it at zero.  Equal shares across 2000
+        clusters make every weight round half-up to 1 (sum 2000), so the
+        residual is -1000 — far past the max weight of 1."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeadmiral_tpu.ops.weights import dynamic_weights
+
+        c = 2000
+        sel = jnp.ones((1, c), bool)
+        alloc = jnp.full(c, 100, jnp.int64)
+        avail = jnp.full(c, 50, jnp.int64)
+        w = np.asarray(dynamic_weights(sel, alloc, avail))[0]
+        # Every share rounds half-up to 1; the unclamped residual rule
+        # would set the first cluster to 1 + (1000 - 2000) = -999.
+        assert w.sum() == 1999 and w.max() == 1, (w.sum(), w.max())
+        assert (w >= 0).all(), w.min()
