@@ -436,6 +436,10 @@ class SchedulerEngine:
         # last schedule() call ([] = none, None = unknown/all); set by
         # every call including the empty-batch early return.
         self.last_changed: Optional[list[int]] = None
+        # O(1) whole-batch no-op gate (see schedule()): one atomic
+        # entry (units_list, view, want_scores, follower_index,
+        # results, n_chunks), or None.
+        self._noop_gate: Optional[tuple] = None
 
         self.mesh = self._resolve_mesh(mesh)
         self._build_programs()
@@ -877,12 +881,39 @@ class SchedulerEngine:
         ``follower_index`` (an :class:`ops.follower.FollowerIndex`)
         applies follower-scheduling unions over the returned rows
         incrementally, driven by this tick's changed-row set."""
+        units_arg = units
         units = list(units)
         if not units:
             self.last_changed = []
             return []
         if view is None:
             view = self._cached_view(units, clusters)
+        # O(1) whole-batch no-op gate: the SAME units list object against
+        # the SAME cluster view is byte-identical input (units are frozen
+        # by contract, and the list container must be treated as
+        # immutable too — derive changed batches as fresh lists, exactly
+        # like the controllers and the bench churn do), so the previous
+        # results replay without even the per-chunk signature walk — at
+        # 100k x 5k that walk alone costs ~0.6s per no-op tick across
+        # 391 chunks.  Fresh-list callers fall through to the per-chunk
+        # gates; webhook ticks never arm or hit the gate (their plugin
+        # set is outside the key).
+        if webhook_eval is None and self._noop_gate is not None:
+            g_units, g_view, g_ws, g_fidx, g_results, g_chunks = self._noop_gate
+            if (
+                units_arg is g_units
+                and view is g_view
+                and want_scores == g_ws
+                and follower_index is g_fidx
+            ):
+                self.fetch_stats["noop"] += g_chunks
+                self.last_changed = []
+                self.timings = {
+                    "featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0,
+                }
+                # Fresh list: callers may post-process their copy without
+                # corrupting future replays (rows are shared + frozen).
+                return list(g_results)
         # One chunk at a time: dispatching all chunks before pulling
         # measured SLOWER on the tunneled TPU backend (transfers queue
         # behind every outstanding program), so keep dispatch->pull
@@ -1044,6 +1075,16 @@ class SchedulerEngine:
             t_f = time.perf_counter()
             follower_index.apply(results, self.last_changed)
             timings["follower"] = time.perf_counter() - t_f
+        # Arm the O(1) no-op gate (see the top of this method) — never
+        # after a webhook tick: its plugin set is outside the gate key,
+        # and replaying webhook-filtered placements for a plain call
+        # would be wrong.
+        self._noop_gate = (
+            (units_arg, view, want_scores, follower_index, results,
+             len(chunk_results))
+            if webhook_eval is None
+            else None
+        )
         return results
 
     def _pad_for_dispatch(self, inputs, fmt: str, b_pad: int, c_bucket: int):

@@ -60,7 +60,9 @@ class TestEngineCache:
         units, clusters = make_world()
         engine = SchedulerEngine(chunk_size=32)
         first = engine.schedule(units, clusters)
-        second = engine.schedule(units, clusters)
+        # Fresh list: bypass the O(1) same-list gate so the PER-CHUNK
+        # hit path is what's exercised here.
+        second = engine.schedule(list(units), clusters)
         assert engine.cache_stats["hit"] >= 2  # both chunks
         results_equal(first, second)
 
@@ -421,3 +423,33 @@ def test_renamed_fleet_never_reuses_stale_decodes():
     fresh = SchedulerEngine(min_bucket=8)
     assert res_b == fresh.schedule(units, fleet_b)
     assert res_a != res_b  # same pattern, different names
+
+
+def test_whole_batch_noop_gate_is_identity_keyed():
+    """The SAME units list against the same cluster view replays the
+    previous results in O(1); a fresh list with a changed row falls
+    through to the real gates."""
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+    units, clusters = make_world(40, 6)
+    eng = SchedulerEngine(chunk_size=16, min_bucket=8)
+    first = eng.schedule(units, clusters)
+    noops_before = eng.fetch_stats["noop"]
+    again = eng.schedule(units, clusters)
+    assert again == first and again is not first  # replayed into a fresh list
+    assert again[0] is first[0]  # rows shared (frozen)
+    assert eng.fetch_stats["noop"] > noops_before
+    assert eng.last_changed == []
+
+    import dataclasses
+
+    churned = list(units)
+    row = next(
+        i for i, u in enumerate(units) if u.scheduling_mode == "Divide"
+    )
+    churned[row] = dataclasses.replace(
+        churned[row], desired_replicas=(churned[row].desired_replicas or 1) + 5
+    )
+    changed = eng.schedule(churned, clusters)
+    assert changed is not first  # fell through to the real gates
+    assert sum(r != f for r, f in zip(changed, first)) >= 1
