@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench graft-check graft-dryrun native
+.PHONY: test test-fast bench graft-check graft-dryrun native metrics-lint
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -17,10 +17,16 @@ kubeadmiral_tpu/native/libkadmhash.so: kubeadmiral_tpu/native/fnvhash.cpp kubead
 bench-e2e:
 	$(PYTEST_ENV) python bench_e2e.py
 
-test:
+# Fails on metric emissions not in runtime/metric_catalog.py — the
+# exposition, the docs and the source stay one vocabulary (see
+# docs/observability.md).
+metrics-lint:
+	python tools/metrics_lint.py
+
+test: metrics-lint
 	$(PYTEST_ENV) python -m pytest tests/ -q
 
-test-fast:
+test-fast: metrics-lint
 	$(PYTEST_ENV) python -m pytest tests/ -q -x -m "not slow"
 
 bench:

@@ -214,9 +214,16 @@ def churn(rng, units, fraction=0.01):
 
 
 def time_batched(rng, units, clusters, followers):
+    from kubeadmiral_tpu.runtime.metrics import Metrics
     from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
 
-    engine = SchedulerEngine(chunk_size=CHUNK)
+    # A real registry (not the null default): the engine's labeled
+    # series — stage histograms, compile-cache and fetch-path counters —
+    # are embedded in the BENCH artifact below, so the perf trajectory
+    # and a live /metrics scrape share one vocabulary
+    # (runtime/metric_catalog.py).
+    metrics = Metrics()
+    engine = SchedulerEngine(chunk_size=CHUNK, metrics=metrics)
     fidx = follower_index(followers) if followers else None
     # Pre-warm exactly as the production manager does at start
     # (ControllerManager.run): the ladder's tick/gather programs compile
@@ -281,6 +288,18 @@ def time_batched(rng, units, clusters, followers):
     detail["cache"] = dict(engine.cache_stats)
     detail["fetch_paths"] = dict(engine.fetch_stats)
     detail["program_shapes"] = sorted(map(list, engine.program_shapes))
+    # The engine's live telemetry for the whole run, in catalog
+    # vocabulary: counters + gauges verbatim, histograms as sum/count
+    # (the per-stage means are recoverable as sum/count).
+    snap = metrics.snapshot()
+    detail["telemetry"] = {
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": {
+            key: {"sum": round(h["sum"], 4), "count": h["count"]}
+            for key, h in snap["histograms"].items()
+        },
+    }
     # The units/results of the LAST timed tick: the parity check runs
     # the sequential baseline over this exact world.
     return dt, placed, detail, units, results
@@ -440,6 +459,7 @@ def main():
         else {"parity": None}
     )
 
+    telemetry = detail.pop("telemetry", None)
     result = {
         "metric": f"objects_scheduled_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
         "value": round(batched_rate, 1),
@@ -450,6 +470,7 @@ def main():
             **bench_platform_detail(),
             "tick_ms": round(tick_seconds * 1e3, 1),
             "stage_ms": detail,
+            "telemetry": telemetry,
             "baseline": "native-seqsched(g++ -O3)"
             if native_seconds is not None
             else "python-oracle",

@@ -93,6 +93,7 @@ def main(argv=None) -> int:
     from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
     from kubeadmiral_tpu.runtime.leaderelection import LeaderElector
     from kubeadmiral_tpu.runtime.manager import ControllerManager
+    from kubeadmiral_tpu.runtime.metrics import Metrics
     from kubeadmiral_tpu.testing.fakekube import AlreadyExists, ClusterFleet
 
     farm = None
@@ -145,15 +146,22 @@ def main(argv=None) -> int:
             fleet.add_member(f"member-{i + 1}")
 
     health = HealthCheckRegistry()
-    server = HealthServer(health, port=args.port)
+    # ONE registry shared by the manager's controllers, the XLA engine
+    # and the HTTP exposition (docs/observability.md).
+    metrics = Metrics()
+    server = HealthServer(health, port=args.port, metrics=metrics)
     port = server.start()
-    print(f"health endpoints on :{port} (/livez, /readyz, /debug/*)")
+    print(
+        f"health endpoints on :{port} (/livez, /readyz, /metrics, /debug/*)"
+    )
 
     if args.enable_profiling:
         from kubeadmiral_tpu.runtime.profiling import ProfilingServer
 
-        prof_server = ProfilingServer(port=args.profiling_port)
-        print(f"profiling endpoints on :{prof_server.start()} (/debug/*)")
+        prof_server = ProfilingServer(port=args.profiling_port, metrics=metrics)
+        print(
+            f"profiling endpoints on :{prof_server.start()} (/metrics, /debug/*)"
+        )
 
     elector = LeaderElector(fleet.host, identity=f"manager-{os.getpid()}")
     if args.leader_elect:
@@ -164,6 +172,7 @@ def main(argv=None) -> int:
     manager = ControllerManager(
         fleet,
         enabled=[c for c in args.controllers.split(",") if c],
+        metrics=metrics,
         health=health,
         cluster_controller_kwargs={"join_timeout": args.cluster_join_timeout},
         max_pod_listers=args.max_pod_listers,

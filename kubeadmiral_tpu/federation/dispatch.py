@@ -29,6 +29,7 @@ from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation import retain
+from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
 from kubeadmiral_tpu.federation.rollout import (
     LAST_RS_NAME,
@@ -93,12 +94,13 @@ class ImmediateSink:
 
     def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
         def run() -> None:
-            client = self.client_for_cluster(cluster)
-            try:
-                result = client.batch([op])[0]
-            except Exception as e:  # transport-level failure
-                result = {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
-            continuation(result)
+            with trace.span("dispatch.member_write", cluster=cluster):
+                client = self.client_for_cluster(cluster)
+                try:
+                    result = client.batch([op])[0]
+                except Exception as e:  # transport-level failure
+                    result = {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+                continuation(result)
 
         if self._inline:
             try:
@@ -172,25 +174,28 @@ class BatchSink:
             if added:
                 self.thread_registry.add(ident)
             try:
-                try:
-                    client = self.client_for_cluster(cluster)
-                    results = client.batch([op for op, _ in entries])
-                except Exception as e:
-                    results = [
-                        {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
-                    ] * len(entries)
-                if len(results) < len(entries):
-                    # A short results array must not strand the tail at its
-                    # pre-recorded *_TIMED_OUT status with no cause.
-                    results = list(results) + [
-                        {"code": 500, "status": {"reason": "Transport",
-                                                 "message": "batch result missing"}}
-                    ] * (len(entries) - len(results))
-                for (_, continuation), result in zip(entries, results):
+                with trace.span(
+                    "dispatch.member_flush", cluster=cluster, ops=len(entries)
+                ):
                     try:
-                        continuation(result)
-                    except Exception:
-                        pass  # continuations record their own failures
+                        client = self.client_for_cluster(cluster)
+                        results = client.batch([op for op, _ in entries])
+                    except Exception as e:
+                        results = [
+                            {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+                        ] * len(entries)
+                    if len(results) < len(entries):
+                        # A short results array must not strand the tail at its
+                        # pre-recorded *_TIMED_OUT status with no cause.
+                        results = list(results) + [
+                            {"code": 500, "status": {"reason": "Transport",
+                                                     "message": "batch result missing"}}
+                        ] * (len(entries) - len(results))
+                    for (_, continuation), result in zip(entries, results):
+                        try:
+                            continuation(result)
+                        except Exception:
+                            pass  # continuations record their own failures
             finally:
                 if added:
                     self.thread_registry.discard(ident)

@@ -21,10 +21,12 @@ Gauges land in the shared :class:`Metrics` store on a periodic tick
 from __future__ import annotations
 
 import time
+from collections import Counter
 from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime import pending
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
 from kubeadmiral_tpu.testing.fakekube import FakeKube
@@ -84,6 +86,11 @@ class MonitorController:
         now = self.clock()
         total = propagated = 0
         live: set[tuple[str, int]] = set()
+        # Objects per controller in the FIRST pending group: the depth of
+        # each pipeline stage's backlog (runtime/pending.py semantics —
+        # only first-group controllers may act, so this is the real
+        # "waiting on" gauge).
+        first_group: Counter = Counter()
 
         def visit(fed_obj: dict) -> None:
             nonlocal total, propagated
@@ -92,6 +99,12 @@ class MonitorController:
             obj_key = f"{meta.get('namespace', '')}/{meta.get('name', '')}".lstrip("/")
             generation = meta.get("generation", 1)
             pending_key = (obj_key, generation)
+            try:
+                groups = pending.get_pending(fed_obj)
+            except Exception:
+                groups = []
+            if groups:
+                first_group.update(groups[0])
             if _is_propagated(fed_obj):
                 propagated += 1
                 started = self._pending_since.pop(pending_key, None)
@@ -102,6 +115,31 @@ class MonitorController:
                 self._pending_since.setdefault(pending_key, now)
 
         self.host.scan(self._resource, visit)
+        for controller, depth in first_group.items():
+            self.metrics.store(
+                "pending_controllers_depth",
+                depth,
+                ftc=self.ftc.name,
+                controller=controller,
+            )
+        # Real controller error rates for this FTC, aggregated from the
+        # labeled worker series (runtime/worker.py names workers
+        # "<kind>-<ftc>"): what the stub metrics silently discarded.
+        suffix = f"-{self.ftc.name}"
+
+        def family_total(family: str) -> float:
+            return sum(
+                value
+                for labels, value in self.metrics.counter_family(family).items()
+                if dict(labels).get("controller", "").endswith(suffix)
+            )
+
+        self.metrics.store(
+            f"{prefix}.worker_exceptions", family_total("worker_exceptions_total")
+        )
+        self.metrics.store(
+            f"{prefix}.worker_retries", family_total("worker_retries_total")
+        )
         # Drop meters for deleted objects / superseded generations.
         for stale in [k for k in self._pending_since if k not in live]:
             del self._pending_since[stale]

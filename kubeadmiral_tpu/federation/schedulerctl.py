@@ -24,6 +24,7 @@ import numpy as np
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models import policy as P
+from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.models import profile as PR
 from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
@@ -133,7 +134,15 @@ class SchedulerController:
 
     # -- event handlers (fan-in to the dirty queue) ----------------------
     def _on_object_event(self, event: str, obj: dict) -> None:
-        self.worker.enqueue(obj_key(obj))
+        # The reconcile path's root span: the watch event that made the
+        # object dirty (its tick shows up as a later worker.tick span;
+        # the gap between the two is the queue wait, gauged by
+        # worker_queue_wait_seconds).
+        with trace.span(
+            "informer.event", resource=self._resource, event=event,
+            key=obj_key(obj),
+        ):
+            self.worker.enqueue(obj_key(obj))
 
     def _enqueue_objects_for_policies(self, policies: set[tuple[str, str]]) -> None:
         """Re-enqueue every federated object bound to one of the given
@@ -489,7 +498,9 @@ class SchedulerController:
 
         if not to_schedule:
             return results
-        with self.metrics.timer(f"scheduler-{self.ftc.name}.engine_latency"):
+        with trace.span(
+            "scheduler.engine_tick", ftc=self.ftc.name, units=len(units)
+        ), self.metrics.timer(f"scheduler-{self.ftc.name}.engine_latency"):
             # ONE watch-thread-safe snapshot for the whole tick: the
             # score-decode decision and the select pass must agree on
             # the plugin set, or a select plugin registered mid-tick
@@ -506,26 +517,33 @@ class SchedulerController:
                 units, clusters, outcomes, plugins, webhook_eval
             )
         self.metrics.counter(f"scheduler-{self.ftc.name}.scheduled", len(units))
+        self.metrics.counter(
+            "scheduler_scheduled_total", len(units), ftc=self.ftc.name
+        )
 
         hb = HostBatch(self.host)
-        try:
-            for (key, fed_obj, policy, trigger), outcome in zip(
-                to_schedule, outcomes
-            ):
-                # Per-key isolation: one poison object backs off alone;
-                # every already-staged placement still flushes.
-                try:
-                    results[key] = self._persist(
-                        key, fed_obj, policy, trigger, outcome, hb, results
-                    )
-                except Exception:
-                    self.metrics.counter(
-                        f"scheduler-{self.ftc.name}.persist_panic"
-                    )
-                    results[key] = Result.retry()
-        finally:
-            # ONE bulk host round trip persists every placement.
-            hb.flush()
+        with trace.span(
+            "scheduler.persist", ftc=self.ftc.name, units=len(to_schedule)
+        ):
+            try:
+                for (key, fed_obj, policy, trigger), outcome in zip(
+                    to_schedule, outcomes
+                ):
+                    # Per-key isolation: one poison object backs off
+                    # alone; every already-staged placement still
+                    # flushes.
+                    try:
+                        results[key] = self._persist(
+                            key, fed_obj, policy, trigger, outcome, hb, results
+                        )
+                    except Exception:
+                        self.metrics.counter(
+                            f"scheduler-{self.ftc.name}.persist_panic"
+                        )
+                        results[key] = Result.retry()
+            finally:
+                # ONE bulk host round trip persists every placement.
+                hb.flush()
         return results
 
     # -- webhook (out-of-process) plugins --------------------------------

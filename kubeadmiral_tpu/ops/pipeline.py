@@ -19,6 +19,7 @@ is jit-compiled once per (B, C, R) shape bucket.
 
 from __future__ import annotations
 
+import threading
 from typing import NamedTuple
 
 import jax
@@ -32,6 +33,38 @@ from kubeadmiral_tpu.ops.select import select_topk
 from kubeadmiral_tpu.ops.weights import dynamic_weights
 
 NIL_REPLICAS = np.int64(-1)  # "no replica count" (Duplicate-mode placement)
+
+# -- XLA (re)compile telemetry -------------------------------------------
+# A jitted function's Python body runs exactly once per trace, i.e. per
+# XLA compile of a new program shape — so a counter in the body is a
+# TRUE recompile detector, not a heuristic.  The engine drains these
+# events after each tick into ``engine_xla_compiles_total`` counters
+# labeled by program and (B, C) shape bucket.
+_trace_lock = threading.Lock()
+_trace_events: list[tuple[str, int, int]] = []
+_trace_seq = 0
+
+
+def _note_trace(program: str, b: int, c: int) -> None:
+    global _trace_seq
+    with _trace_lock:
+        _trace_seq += 1
+        _trace_events.append((program, int(b), int(c)))
+
+
+def trace_seq() -> int:
+    """Monotonic count of XLA traces of this module's programs — compare
+    around a dispatch to tell a compile from a cache hit."""
+    with _trace_lock:
+        return _trace_seq
+
+
+def drain_trace_events() -> list[tuple[str, int, int]]:
+    """Take (program, B, C) events recorded since the last drain."""
+    global _trace_events
+    with _trace_lock:
+        events, _trace_events = _trace_events, []
+        return events
 
 
 class TickInputs(NamedTuple):
@@ -105,6 +138,7 @@ def expand_compact(ci) -> TickInputs:
     utils/hashing.fnv32_extend + uint32_to_sortable_int32 exactly."""
     b = ci.gvk_id.shape[0]
     c = ci.cluster_valid.shape[0]
+    _note_trace("expand_compact", b, c)
 
     api_ok = ci.api_matrix[ci.gvk_id]
     taint_row = ci.taint_set_id  # i32[C]
@@ -202,6 +236,9 @@ def expand_compact(ci) -> TickInputs:
 
 @jax.jit
 def schedule_tick(inp: TickInputs) -> TickOutputs:
+    _note_trace(
+        "schedule_tick", inp.total.shape[0], inp.cluster_valid.shape[0]
+    )
     # --- Filter ---
     fit_ok = F.resources_fit(inp.request, inp.alloc, inp.used)
     feasible = F.combine_filters(

@@ -60,10 +60,23 @@ class HealthCheckRegistry:
 
 class HealthServer:
     """Serves the registry at /livez + /readyz (controllermanager.go's
-    health HTTP server, default port 11257)."""
+    health HTTP server, default port 11257).  When given a ``metrics``
+    registry / ``tracer`` it additionally serves ``/metrics`` (Prometheus
+    text format) and ``/debug/trace`` (Chrome trace JSON) alongside the
+    pprof-analogue ``/debug/*`` routes — one port for the whole
+    operability surface."""
 
-    def __init__(self, registry: HealthCheckRegistry, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        registry: HealthCheckRegistry,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics=None,
+        tracer=None,
+    ):
         self.registry = registry
+        self.metrics = metrics
+        self.tracer = tracer
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
@@ -76,15 +89,20 @@ class HealthServer:
 
     def start(self) -> int:
         registry = self.registry
+        outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 path, _, raw_query = self.path.partition("?")
-                if path.startswith("/debug/"):
-                    # pprof analogue (profiling.py): profile/stacks/threads.
+                if path.startswith("/debug/") or path == "/metrics":
+                    # Shared operability routes (profiling.py): metrics
+                    # exposition, trace export, profile/stacks/threads.
                     from kubeadmiral_tpu.runtime import profiling
 
-                    if not profiling.respond_debug(self, path, raw_query):
+                    if not profiling.respond_debug(
+                        self, path, raw_query,
+                        metrics=outer.metrics, tracer=outer.tracer,
+                    ):
                         self.send_error(404)
                     return
                 if path == "/livez":

@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable, Optional
 
+from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.testing.fakekube import ADDED, DELETED, FakeKube, obj_key
 
 Handler = Callable[[str, dict], None]
@@ -46,8 +47,13 @@ class Informer:
             else:
                 self._cache[key] = obj
             handlers = list(self._handlers)
-        for h in handlers:
-            h(event, obj)
+        # The root span of the reconcile path: handler work (enqueues,
+        # trigger checks) nests under the event that caused it.
+        with trace.span(
+            "informer.event", resource=self.resource, event=event, key=key
+        ):
+            for h in handlers:
+                h(event, obj)
 
     def add_handler(self, handler: Handler, replay: bool = True) -> None:
         with self._lock:

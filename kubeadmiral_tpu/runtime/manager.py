@@ -103,8 +103,10 @@ class ControllerManager:
         )
         # One shared XLA engine: FTCs share compile caches and the
         # cluster view (ftcmanager starts schedulers per FTC; the batch
-        # engine makes sharing the natural default).
-        self.engine = engine or SchedulerEngine()
+        # engine makes sharing the natural default).  It reports into the
+        # manager's metrics registry so one /metrics scrape covers
+        # controllers and the device hot path alike.
+        self.engine = engine or SchedulerEngine(metrics=self.metrics)
         self._enabled = self._resolve_enabled(enabled)
         self._lock = threading.RLock()
         self._ftcs: dict[str, _FTCRuntime] = {}

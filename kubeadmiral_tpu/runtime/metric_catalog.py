@@ -1,0 +1,156 @@
+"""The metric catalog: every metric name this control plane may emit.
+
+One source of truth shared by three consumers:
+
+* ``tools/metrics_lint.py`` (``make metrics-lint``) walks the source for
+  emission calls and FAILS on names not listed here — new metrics must
+  be cataloged before they ship, so the exposition never drifts from
+  the documentation;
+* ``docs/observability.md`` renders this as the operator-facing metric
+  reference;
+* ``bench.py`` embeds engine series under these names in its BENCH
+  artifact, so the perf trajectory and live ``/metrics`` scrapes share
+  one vocabulary.
+
+``CATALOG`` holds the labeled, Prometheus-shaped families.  The
+``LEGACY_PATTERNS`` grandfather the pre-exposition dotted names (worker
+``<name>.panic`` counters, ``monitor.<ftc>.*`` gauges, per-controller
+``scheduler-<ftc>.*`` counters): they still render (sanitized) in the
+exposition and existing tests read them, but new emissions should use
+the labeled families.
+"""
+
+from __future__ import annotations
+
+from fnmatch import fnmatch
+from typing import NamedTuple
+
+
+class MetricSpec(NamedTuple):
+    type: str  # counter | gauge | histogram
+    unit: str
+    labels: tuple[str, ...]
+    help: str
+
+
+CATALOG: dict[str, MetricSpec] = {
+    # -- reconcile workers (runtime/worker.py) ---------------------------
+    "worker_reconciles_total": MetricSpec(
+        "counter", "reconciles", ("controller",),
+        "Keys reconciled, per controller worker."),
+    "worker_exceptions_total": MetricSpec(
+        "counter", "exceptions", ("controller",),
+        "Reconciles that escaped with an exception (the panic analogue)."),
+    "worker_retries_total": MetricSpec(
+        "counter", "retries", ("controller",),
+        "Keys requeued with exponential backoff after a failed reconcile."),
+    "worker_requeues_total": MetricSpec(
+        "counter", "requeues", ("controller",),
+        "Successful reconciles that scheduled a fixed-delay revisit."),
+    "worker_process_seconds": MetricSpec(
+        "histogram", "seconds", ("controller",),
+        "Per-key reconcile latency (single-key workers)."),
+    "worker_tick_seconds": MetricSpec(
+        "histogram", "seconds", ("controller",),
+        "Whole-batch tick latency (batch workers)."),
+    "worker_queue_wait_seconds": MetricSpec(
+        "histogram", "seconds", ("controller",),
+        "Enqueue-to-drain wait of dequeued keys (sampled per drain)."),
+    "worker_queue_depth": MetricSpec(
+        "gauge", "keys", ("controller",),
+        "Pending keys in the controller's dirty queue."),
+    "worker_queue_oldest_age_seconds": MetricSpec(
+        "gauge", "seconds", ("controller",),
+        "Age of the longest-pending key; the first stuck-controller signal."),
+    # -- XLA scheduling engine (scheduler/engine.py, ops/pipeline.py) ----
+    "engine_ticks_total": MetricSpec(
+        "counter", "ticks", (),
+        "schedule() calls (any fast path included)."),
+    "engine_tick_objects": MetricSpec(
+        "gauge", "objects", (),
+        "Batch size of the last scheduling tick."),
+    "engine_tick_seconds": MetricSpec(
+        "histogram", "seconds", (),
+        "Wall time of one whole scheduling tick."),
+    "engine_tick_stage_seconds": MetricSpec(
+        "histogram", "seconds", ("stage",),
+        "Per-tick wall time of one stage: featurize, device, fetch, "
+        "decode (+ follower when a FollowerIndex is applied)."),
+    "engine_chunk_cache_total": MetricSpec(
+        "counter", "chunks", ("result",),
+        "Incremental-featurization outcomes per chunk: hit, patch, miss."),
+    "engine_fetch_total": MetricSpec(
+        "counter", "chunks", ("path",),
+        "Result-fetch path per chunk: noop, subbatch, skip, delta, full."),
+    "engine_compile_cache_total": MetricSpec(
+        "counter", "dispatches", ("result", "shape"),
+        "Program-shape cache outcome per device dispatch: a shape's "
+        "first dispatch is the miss that traces a new XLA program."),
+    "engine_dispatches_total": MetricSpec(
+        "counter", "dispatches", ("shape",),
+        "Device dispatches per (format, rows, clusters) shape bucket."),
+    "engine_xla_compiles_total": MetricSpec(
+        "counter", "compiles", ("program", "shape"),
+        "True XLA traces observed in ops.pipeline (the jitted body ran), "
+        "per program and shape."),
+    "engine_vocab_overflow_total": MetricSpec(
+        "counter", "overflows", ("scope",),
+        "Compact-vocabulary cap overflows forcing the dense fallback: "
+        "topology (vocabulary build), chunk (full featurize), patch "
+        "(row re-featurize)."),
+    "engine_program_shapes": MetricSpec(
+        "gauge", "programs", (),
+        "Distinct program shapes dispatched since engine construction."),
+    # -- controllers (federation/) ---------------------------------------
+    "scheduler_scheduled_total": MetricSpec(
+        "counter", "objects", ("ftc",),
+        "Objects pushed through the engine by the scheduler controller."),
+    "pending_controllers_depth": MetricSpec(
+        "gauge", "objects", ("ftc", "controller"),
+        "Objects whose FIRST pending-controllers group names the "
+        "controller — each pipeline stage's backlog."),
+}
+
+# Pre-exposition dotted names, matched with fnmatch.  "*" also stands in
+# for f-string interpolations in the linter's extracted names (e.g.
+# f"scheduler-{ftc}.scheduled" lints as "scheduler-*.scheduled").
+LEGACY_PATTERNS: tuple[str, ...] = (
+    # runtime/worker.py per-worker counters/timers (worker name prefix).
+    "*.panic",
+    "*.throughput",
+    "*.latency",
+    "*.tick_latency",
+    # federation controllers' per-FTC counters.
+    "scheduler-*.scheduled",
+    "scheduler-*.unit_errors",
+    "scheduler-*.webhook_errors",
+    "scheduler-*.webhook_config_errors",
+    "scheduler-*.webhook_unsupported_payload",
+    "scheduler-*.persist_panic",
+    "scheduler-*.engine_latency",
+    "sync-*.plan_panic",
+    "sync-*.finish_panic",
+    "sync-*.host_write_panic",
+    "sync-*.plan_rollout_failed",
+    "status.plan_panic",
+    "statusagg.plan_panic",
+    "ftc-manager.parse_errors",
+    # federation/monitor.py gauges (monitor.<ftc>.<field> via a prefix
+    # variable, so the linter sees "*.<field>").
+    "monitor.*",
+    "*.total",
+    "*.propagated",
+    "*.unpropagated",
+    "*.out_of_sync_seconds",
+    "*.sync_latency",
+    "*.worker_exceptions",
+    "*.worker_retries",
+)
+
+
+def is_cataloged(name: str) -> bool:
+    """True when an emitted metric name (possibly containing "*" where
+    an f-string interpolated) is covered by the catalog."""
+    if name in CATALOG:
+        return True
+    return any(fnmatch(name, pattern) for pattern in LEGACY_PATTERNS)

@@ -16,10 +16,15 @@ main.go:21).  The Python control plane's equivalent serves:
   ``goroutine?debug=2`` role) — the first thing to pull from a wedged
   control plane.
 * ``GET /debug/threads`` — thread names/ids/daemon flags.
+* ``GET /metrics`` — the metrics registry in Prometheus text format
+  (runtime/metrics.py), the pkg/stats exposition analogue.
+* ``GET /debug/trace`` — completed reconcile-path spans as Chrome
+  trace-event JSON (runtime/trace.py); load in chrome://tracing.
 
 ``respond_debug`` is the shared route handler: the health server mounts
-it so one port serves livez/readyz/debug, and ``ProfilingServer`` runs
-the same routes standalone on a dedicated port (the :6060 layout).
+it so one port serves livez/readyz/metrics/debug, and
+``ProfilingServer`` runs the same routes standalone on a dedicated port
+(the :6060 layout).
 """
 
 from __future__ import annotations
@@ -117,30 +122,62 @@ def handle_debug_path(path: str, query: dict) -> Optional[dict]:
     return None
 
 
-def respond_debug(http_handler, path: str, raw_query: str) -> bool:
-    """Serve a /debug/* route on any BaseHTTPRequestHandler; returns
-    False when the path isn't a debug route (caller handles it).  The
-    single implementation shared by the health server and the
-    standalone profiling server."""
+def _send(http_handler, body: bytes, content_type: str) -> None:
+    http_handler.send_response(200)
+    http_handler.send_header("Content-Type", content_type)
+    http_handler.send_header("Content-Length", str(len(body)))
+    http_handler.end_headers()
+    http_handler.wfile.write(body)
+
+
+def respond_debug(
+    http_handler, path: str, raw_query: str, metrics=None, tracer=None
+) -> bool:
+    """Serve a /metrics or /debug/* route on any BaseHTTPRequestHandler;
+    returns False when the path isn't one of ours (caller handles it).
+    The single implementation shared by the health server and the
+    standalone profiling server.
+
+    ``metrics`` is the registry to expose (no default: the caller owns
+    its registry); ``tracer`` defaults to the process-wide span tracer
+    the reconcile path records into."""
+    if path == "/metrics":
+        if metrics is None:
+            return False
+        _send(
+            http_handler,
+            metrics.render_prometheus().encode(),
+            "text/plain; version=0.0.4; charset=utf-8",
+        )
+        return True
+    if path == "/debug/trace":
+        from kubeadmiral_tpu.runtime import trace as trace_mod
+
+        active = tracer or trace_mod.get_default()
+        _send(
+            http_handler,
+            active.chrome_trace_json().encode(),
+            "application/json",
+        )
+        return True
     query = {k: v[-1] for k, v in parse_qs(raw_query).items()}
     result = handle_debug_path(path, query)
     if result is None:
         return False
-    body = json.dumps(result).encode()
-    http_handler.send_response(200)
-    http_handler.send_header("Content-Type", "application/json")
-    http_handler.send_header("Content-Length", str(len(body)))
-    http_handler.end_headers()
-    http_handler.wfile.write(body)
+    _send(http_handler, json.dumps(result).encode(), "application/json")
     return True
 
 
 class ProfilingServer:
     """Standalone profiling HTTP server (the reference's :6060)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0, metrics=None, tracer=None
+    ):
         self._host = host
         self._port = port
+        self.metrics = metrics
+        self.tracer = tracer
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -150,10 +187,15 @@ class ProfilingServer:
         return self._server.server_address[1]
 
     def start(self) -> int:
+        outer = self
+
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 split = urlsplit(self.path)
-                if not respond_debug(self, split.path, split.query):
+                if not respond_debug(
+                    self, split.path, split.query,
+                    metrics=outer.metrics, tracer=outer.tracer,
+                ):
                     self.send_error(404)
 
             def log_message(self, *args) -> None:

@@ -50,6 +50,12 @@ class DirtyQueue:
         self._lock = threading.Lock()
         self._heap: list[_Entry] = []
         self._pending: dict[str, _Entry] = {}
+        # key -> first-enqueue time while pending: the true queue wait
+        # (a dedupe re-add does not reset the clock).  Telemetry only —
+        # drain_due publishes the drained keys' waits in
+        # ``last_drain_waits`` and ``oldest_age()`` gauges what's left.
+        self._enqueued_at: dict[str, float] = {}
+        self.last_drain_waits: list[float] = []
         self._seq = 0
         self._wakeup = threading.Condition(self._lock)
 
@@ -61,6 +67,8 @@ class DirtyQueue:
                 if cur.due <= due:
                     return  # an earlier delivery is already scheduled
                 cur.key = _TOMBSTONE  # lazy-delete the later one
+            else:
+                self._enqueued_at[key] = self._clock()
             self._seq += 1
             entry = _Entry(due, self._seq, key)
             self._pending[key] = entry
@@ -71,14 +79,28 @@ class DirtyQueue:
         """Pop every key whose delivery time has arrived."""
         now = self._clock()
         out: list[str] = []
+        waits: list[float] = []
         with self._lock:
             while self._heap and self._heap[0].due <= now:
                 entry = heapq.heappop(self._heap)
                 if entry.key is _TOMBSTONE:
                     continue
                 del self._pending[entry.key]
+                enq = self._enqueued_at.pop(entry.key, None)
+                if enq is not None:
+                    waits.append(max(0.0, now - enq))
                 out.append(entry.key)
+            if out:
+                self.last_drain_waits = waits
         return out
+
+    def oldest_age(self) -> float:
+        """Age of the longest-pending key (0 when empty) — the queue-lag
+        gauge a stuck controller shows first."""
+        with self._lock:
+            if not self._enqueued_at:
+                return 0.0
+            return max(0.0, self._clock() - min(self._enqueued_at.values()))
 
     def wait(self, timeout: float | None = None) -> None:
         """Block until something may be due (new entry or head deadline)."""

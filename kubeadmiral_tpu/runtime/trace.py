@@ -1,0 +1,148 @@
+"""Lightweight span tracer for the reconcile path.
+
+Answers "where did the last tick's 44ms go?" the way a distributed
+tracer would, without the dependency: a context-manager span API with a
+thread-local stack (so child spans record their parent), a bounded ring
+of completed spans, and Chrome trace-event JSON export served at
+``GET /debug/trace`` (load it in chrome://tracing or ui.perfetto.dev).
+
+Spans are threaded through the full reconcile path — informer event
+delivery (runtime/informer.py), worker dequeue/reconcile
+(runtime/worker.py), the engine's featurize/dispatch/fetch stages
+(scheduler/engine.py), and member dispatch (federation/dispatch.py).
+Overhead per span is two ``perf_counter`` calls and a deque append, so
+it stays on in production.
+
+Most callers use the module-level default tracer (``trace.span(...)``);
+tests and embedders may construct their own :class:`Tracer`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional
+
+DEFAULT_RING = 16384
+
+# One epoch per process: span timestamps are microseconds since this
+# moment, comparable across threads and tracers.
+_EPOCH = time.perf_counter()
+
+
+class Span:
+    __slots__ = (
+        "name", "span_id", "parent_id", "start", "end", "args", "tid",
+        "thread_name",
+    )
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int], args: dict):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = time.perf_counter() - _EPOCH
+        self.end: Optional[float] = None
+        self.args = args
+        self.tid = threading.get_ident()
+        self.thread_name = threading.current_thread().name
+
+    def set(self, **args) -> None:
+        """Attach attributes to an open span (e.g. a result count known
+        only at the end of the work)."""
+        self.args.update(args)
+
+
+class Tracer:
+    def __init__(self, ring: int = DEFAULT_RING):
+        # Bounded deque; append/clear/iteration-snapshot are each atomic
+        # under the GIL, so the hot record path takes NO lock — a storm
+        # of writer threads must not serialize on the tracer.
+        self._ring: deque[Span] = deque(maxlen=ring)
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextmanager
+    def span(self, name: str, **args):
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        sp = Span(name, next(self._ids), parent, args)
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.end = time.perf_counter() - _EPOCH
+            stack.pop()
+            self._ring.append(sp)
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def spans(self) -> list[Span]:
+        return list(self._ring)
+
+    def chrome_trace(self) -> dict:
+        """The completed ring as Chrome trace-event JSON: one complete
+        ("X") event per span (ts/dur in microseconds), span/parent ids in
+        args so nesting survives tools that ignore timing, plus
+        thread-name metadata events."""
+        pid = os.getpid()
+        events = []
+        threads: dict[int, str] = {}
+        for sp in self.spans():
+            threads.setdefault(sp.tid, sp.thread_name)
+            args = {"span_id": sp.span_id}
+            if sp.parent_id is not None:
+                args["parent_id"] = sp.parent_id
+            args.update(sp.args)
+            events.append(
+                {
+                    "name": sp.name,
+                    "ph": "X",
+                    "ts": round(sp.start * 1e6, 3),
+                    "dur": round(((sp.end or sp.start) - sp.start) * 1e6, 3),
+                    "pid": pid,
+                    "tid": sp.tid,
+                    "args": args,
+                }
+            )
+        for tid, tname in threads.items():
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": tname},
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def chrome_trace_json(self) -> str:
+        return json.dumps(self.chrome_trace())
+
+
+_default = Tracer()
+
+
+def get_default() -> Tracer:
+    return _default
+
+
+def span(name: str, **args):
+    """Open a span on the process-default tracer."""
+    return _default.span(name, **args)

@@ -20,10 +20,12 @@ revisit.
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
+from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.queue import Backoff, DirtyQueue
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
 
@@ -80,6 +82,31 @@ class _WorkerBase:
     def enqueue(self, key: str, delay: float = 0.0) -> None:
         self.queue.add(key, delay)
 
+    def _drain(self) -> list[str]:
+        """drain_due plus the queue telemetry every controller shares:
+        depth/age gauges and per-key wait histograms, labeled by
+        controller name."""
+        keys = self.queue.drain_due()
+        self.metrics.gauge("worker_queue_depth", len(self.queue), controller=self.name)
+        self.metrics.gauge(
+            "worker_queue_oldest_age_seconds",
+            self.queue.oldest_age(),
+            controller=self.name,
+        )
+        if keys:
+            waits = self.queue.last_drain_waits
+            # Bound per-tick histogram work: a 100k-key batch drain
+            # observes a sample plus the max, not every key.
+            for w in waits[:64]:
+                self.metrics.histogram(
+                    "worker_queue_wait_seconds", w, controller=self.name
+                )
+            if len(waits) > 64:
+                self.metrics.histogram(
+                    "worker_queue_wait_seconds", max(waits), controller=self.name
+                )
+        return keys
+
     def enqueue_all(self, keys: Iterable[str], delay: float = 0.0) -> None:
         for k in keys:
             self.queue.add(k, delay)
@@ -116,7 +143,7 @@ class Worker(_WorkerBase):
         self._reconcile = reconcile
 
     def step(self) -> bool:
-        keys = self.queue.drain_due()
+        keys = self._drain()
         if not keys:
             return False
         for key in keys:
@@ -125,24 +152,37 @@ class Worker(_WorkerBase):
 
     def _dispatch(self, key: str) -> None:
         ident = self._enter()
+        start = time.perf_counter()
         try:
-            with self.metrics.timer(f"{self.name}.latency"):
-                result = self._reconcile(key)
+            with trace.span("worker.reconcile", controller=self.name, key=key):
+                with self.metrics.timer(f"{self.name}.latency"):
+                    result = self._reconcile(key)
         except Exception:
+            # The panic-equivalent: the reconcile escaped instead of
+            # returning Result.retry().
             self.metrics.counter(f"{self.name}.panic")
+            self.metrics.counter("worker_exceptions_total", controller=self.name)
             traceback.print_exc()
             result = Result.retry()
         finally:
             self._exit(ident)
         self.metrics.counter(f"{self.name}.throughput")
+        self.metrics.counter("worker_reconciles_total", controller=self.name)
+        self.metrics.histogram(
+            "worker_process_seconds",
+            time.perf_counter() - start,
+            controller=self.name,
+        )
         self._requeue(key, result)
 
     def _requeue(self, key: str, result: Result) -> None:
         if result.success:
             self.backoff.reset(key)
             if result.requeue_after is not None:
+                self.metrics.counter("worker_requeues_total", controller=self.name)
                 self.queue.add(key, result.requeue_after)
         elif result.backoff:
+            self.metrics.counter("worker_retries_total", controller=self.name)
             self.queue.add(key, self.backoff.next_delay(key))
 
 
@@ -159,26 +199,46 @@ class BatchWorker(_WorkerBase):
         self._reconcile_batch = reconcile_batch
 
     def step(self) -> bool:
-        keys = self.queue.drain_due()
+        keys = self._drain()
         if not keys:
             return False
         ident = self._enter()
+        start = time.perf_counter()
         try:
-            with self.metrics.timer(f"{self.name}.tick_latency"):
-                results = self._reconcile_batch(keys)
+            with trace.span("worker.tick", controller=self.name, keys=len(keys)):
+                with self.metrics.timer(f"{self.name}.tick_latency"):
+                    results = self._reconcile_batch(keys)
         except Exception:
             self.metrics.counter(f"{self.name}.panic")
+            self.metrics.counter("worker_exceptions_total", controller=self.name)
             traceback.print_exc()
             results = {k: Result.retry() for k in keys}
         finally:
             self._exit(ident)
         self.metrics.counter(f"{self.name}.throughput", len(keys))
+        self.metrics.counter(
+            "worker_reconciles_total", len(keys), controller=self.name
+        )
+        self.metrics.histogram(
+            "worker_tick_seconds", time.perf_counter() - start, controller=self.name
+        )
+        retried = requeued = 0
         for key in keys:
             result = results.get(key, Result.ok())
             if result.success:
                 self.backoff.reset(key)
                 if result.requeue_after is not None:
+                    requeued += 1
                     self.queue.add(key, result.requeue_after)
             elif result.backoff:
+                retried += 1
                 self.queue.add(key, self.backoff.next_delay(key))
+        if retried:
+            self.metrics.counter(
+                "worker_retries_total", retried, controller=self.name
+            )
+        if requeued:
+            self.metrics.counter(
+                "worker_requeues_total", requeued, controller=self.name
+            )
         return True

@@ -1,0 +1,177 @@
+"""Label-aware metrics registry + Prometheus text exposition
+(runtime/metrics.py; reference: pkg/stats/stats.go)."""
+
+import re
+
+from kubeadmiral_tpu.runtime.metrics import (
+    Histogram,
+    Metrics,
+    series_key,
+)
+from kubeadmiral_tpu.runtime.metric_catalog import CATALOG, is_cataloged
+
+
+class TestLabeledSeries:
+    def test_tags_make_distinct_series(self):
+        m = Metrics()
+        m.counter("worker_retries", cluster="c1")
+        m.counter("worker_retries", cluster="c1")
+        m.counter("worker_retries", cluster="c2")
+        assert m.get_counter("worker_retries", cluster="c1") == 2
+        assert m.get_counter("worker_retries", cluster="c2") == 1
+        # The legacy dict view keys incorporate the sorted label pairs.
+        assert m.counters["worker_retries{cluster=c1}"] == 2
+
+    def test_untagged_call_sites_keep_plain_keys(self):
+        """The pre-exposition contract: monitor/stress tests read
+        metrics.counters/stores/durations by bare name."""
+        m = Metrics()
+        m.counter("scheduler-x.panic")
+        m.store("monitor.clusters.ready", 3)
+        m.duration("monitor.x.sync_latency", 1.5)
+        assert m.counters["scheduler-x.panic"] == 1
+        assert m.stores["monitor.clusters.ready"] == 3
+        assert m.durations["monitor.x.sync_latency"] == [1.5]
+
+    def test_tag_order_is_irrelevant(self):
+        m = Metrics()
+        m.counter("c", a="1", b="2")
+        m.counter("c", b="2", a="1")
+        assert m.get_counter("c", b="2", a="1") == 2
+        assert series_key("c", {"b": "2", "a": "1"}) == "c{a=1,b=2}"
+
+    def test_timer_feeds_histogram(self):
+        m = Metrics()
+        with m.timer("op.latency", controller="x"):
+            pass
+        key = series_key("op.latency", {"controller": "x"})
+        assert m.histograms[key].count == 1
+        assert len(m.durations[key]) == 1
+
+    def test_counter_family_readback(self):
+        m = Metrics()
+        m.counter("worker_exceptions_total", controller="sync-a")
+        m.counter("worker_exceptions_total", 2, controller="sync-b")
+        fam = m.counter_family("worker_exceptions_total")
+        assert fam == {
+            (("controller", "sync-a"),): 1,
+            (("controller", "sync-b"),): 2,
+        }
+        assert m.sum_counter("worker_exceptions_total") == 3
+
+
+# One exposition line: name{labels} value  (or a # comment).
+_LINE = re.compile(
+    r"^(# (TYPE|HELP) .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(inf|nan)?)$"
+)
+
+
+def render_lines(m):
+    text = m.render_prometheus()
+    lines = text.splitlines()
+    for line in lines:
+        assert _LINE.match(line), f"invalid exposition line: {line!r}"
+    return lines
+
+
+class TestPrometheusExposition:
+    def test_name_sanitization(self):
+        m = Metrics()
+        m.store("monitor.clusters.ready", 2)
+        m.counter("scheduler-web.panic")
+        lines = render_lines(m)
+        assert "monitor_clusters_ready 2" in lines
+        assert "scheduler_web_panic 1" in lines
+
+    def test_label_escaping(self):
+        m = Metrics()
+        m.store("g", 1, path='a"b\\c\nd')
+        text = m.render_prometheus()
+        assert 'path="a\\"b\\\\c\\nd"' in text
+
+    def test_label_ordering_deterministic(self):
+        m1 = Metrics()
+        m1.counter("c", a="1", z="2")
+        m2 = Metrics()
+        m2.counter("c", z="2", a="1")
+        assert m1.render_prometheus() == m2.render_prometheus()
+        # Multiple series render sorted by label set, independent of
+        # emission order.
+        m1.counter("c", a="0", z="9")
+        first = m1.render_prometheus()
+        assert first.index('a="0"') < first.index('a="1"')
+
+    def test_histogram_bucket_cumulativity(self):
+        m = Metrics()
+        for v in (0.0005, 0.003, 0.003, 0.2, 7.0, 100.0):
+            m.histogram("lat", v, stage="device")
+        lines = render_lines(m)
+        buckets = [
+            (line.rsplit(" ", 1)[0], int(line.rsplit(" ", 1)[1]))
+            for line in lines
+            if line.startswith("lat_bucket")
+        ]
+        counts = [n for _, n in buckets]
+        # Cumulative and non-decreasing, ending at the total count.
+        assert counts == sorted(counts)
+        assert buckets[-1][0].endswith('le="+Inf"}')
+        assert counts[-1] == 6
+        assert any(line == "lat_count{stage=\"device\"} 6" for line in lines)
+        total = next(
+            float(line.rsplit(" ", 1)[1])
+            for line in lines
+            if line.startswith("lat_sum")
+        )
+        assert abs(total - 107.2065) < 1e-9
+
+    def test_type_lines(self):
+        m = Metrics()
+        m.counter("a_total")
+        m.store("b", 1)
+        m.histogram("c_seconds", 0.1)
+        lines = render_lines(m)
+        assert "# TYPE a_total counter" in lines
+        assert "# TYPE b gauge" in lines
+        assert "# TYPE c_seconds histogram" in lines
+
+    def test_mixed_tagged_and_untagged(self):
+        m = Metrics()
+        m.counter("hits")
+        m.counter("hits", shape="64x256")
+        lines = render_lines(m)
+        assert "hits 1" in lines
+        assert 'hits{shape="64x256"} 1' in lines
+
+
+class TestHistogram:
+    def test_bucket_assignment(self):
+        h = Histogram(buckets=(1.0, 2.0))
+        for v in (0.5, 1.5, 99.0):
+            h.observe(v)
+        assert h.cumulative() == [(1.0, 1), (2.0, 2), (float("inf"), 3)]
+        assert h.count == 3
+
+
+class TestCatalog:
+    def test_new_vocabulary_is_cataloged(self):
+        for name in (
+            "worker_reconciles_total",
+            "engine_tick_stage_seconds",
+            "engine_compile_cache_total",
+        ):
+            assert name in CATALOG
+
+    def test_legacy_patterns_cover_dotted_names(self):
+        assert is_cataloged("scheduler-deployments.apps.scheduled")
+        assert is_cataloged("monitor.deployments.apps.sync_latency")
+        assert is_cataloged("sync-x.plan_panic")
+        assert not is_cataloged("made_up_metric_total")
+
+    def test_snapshot_shares_vocabulary(self):
+        m = Metrics()
+        m.counter("engine_ticks_total")
+        m.histogram("engine_tick_seconds", 0.5)
+        snap = m.snapshot()
+        assert snap["counters"]["engine_ticks_total"] == 1
+        assert snap["histograms"]["engine_tick_seconds"]["count"] == 1

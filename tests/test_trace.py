@@ -1,0 +1,96 @@
+"""Span tracer: nesting, ring bounds, Chrome trace export
+(runtime/trace.py)."""
+
+import json
+import threading
+
+import pytest
+
+from kubeadmiral_tpu.runtime.trace import Tracer
+
+
+class TestNesting:
+    def test_parent_child_ids(self):
+        t = Tracer()
+        with t.span("parent") as p:
+            with t.span("child") as c:
+                pass
+            with t.span("sibling") as s:
+                pass
+        assert c.parent_id == p.span_id
+        assert s.parent_id == p.span_id
+        assert p.parent_id is None
+        # Completion order: children land in the ring before the parent.
+        assert [sp.name for sp in t.spans()] == ["child", "sibling", "parent"]
+
+    def test_span_attrs_and_set(self):
+        t = Tracer()
+        with t.span("work", controller="sync") as sp:
+            sp.set(keys=7)
+        done = t.spans()[0]
+        assert done.args == {"controller": "sync", "keys": 7}
+        assert done.end >= done.start
+
+    def test_exception_still_records_and_propagates(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("x")
+        assert [sp.name for sp in t.spans()] == ["boom"]
+        assert t.current() is None  # stack unwound
+
+    def test_threads_get_independent_stacks(self):
+        t = Tracer()
+        done = threading.Event()
+
+        def other():
+            with t.span("other-root"):
+                pass
+            done.set()
+
+        with t.span("main-root"):
+            th = threading.Thread(target=other)
+            th.start()
+            th.join()
+        assert done.wait(1)
+        roots = {sp.name: sp.parent_id for sp in t.spans()}
+        # The other thread's span is NOT a child of main's open span.
+        assert roots == {"other-root": None, "main-root": None}
+
+    def test_ring_is_bounded(self):
+        t = Tracer(ring=4)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        assert [sp.name for sp in t.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+class TestChromeExport:
+    def test_event_shape(self):
+        t = Tracer()
+        with t.span("outer", ftc="deployments.apps"):
+            with t.span("inner"):
+                pass
+        doc = json.loads(t.chrome_trace_json())
+        events = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert set(events) == {"outer", "inner"}
+        outer, inner = events["outer"], events["inner"]
+        for e in (outer, inner):
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+            assert e["pid"] > 0 and e["tid"] > 0
+        assert inner["args"]["parent_id"] == outer["args"]["span_id"]
+        assert outer["args"]["ftc"] == "deployments.apps"
+        # Nesting must also hold by timestamps (what chrome://tracing
+        # actually renders): inner within [outer.ts, outer.ts+dur].
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+    def test_thread_metadata_events(self):
+        t = Tracer()
+        with t.span("x"):
+            pass
+        doc = t.chrome_trace()
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert meta and meta[0]["name"] == "thread_name"
+        assert doc["displayTimeUnit"] == "ms"

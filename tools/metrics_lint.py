@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""metrics-lint: fail on metric emissions outside the catalog.
+
+Walks the source tree's ASTs for calls of the Metrics emission surface
+(``counter``/``rate``/``store``/``gauge``/``duration``/``histogram``/
+``timer``) on a metrics-shaped receiver, extracts the metric-name
+argument (f-string interpolations become "*"), and checks every name
+against ``kubeadmiral_tpu.runtime.metric_catalog``.  Run as
+``make metrics-lint``; part of the default verify path, so a new metric
+name must be cataloged (and thereby documented in
+docs/observability.md) before it can merge.
+
+Exit status: 0 clean, 1 violations (listed one per line), 2 on a file
+that fails to parse.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from kubeadmiral_tpu.runtime.metric_catalog import is_cataloged  # noqa: E402
+
+EMITTERS = {"counter", "rate", "store", "gauge", "duration", "histogram", "timer"}
+
+SCAN_ROOTS = ("kubeadmiral_tpu", "bench.py", "bench_e2e.py")
+
+# The emission receiver must look like a metrics registry: `metrics.x`,
+# `self.metrics.x`, `<anything>.metrics.x`, or a local alias `m.x`.
+_RECEIVER_NAMES = {"metrics", "m"}
+
+
+def _is_metrics_receiver(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _RECEIVER_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr == "metrics"
+    return False
+
+
+def _name_pattern(node: ast.AST) -> str | None:
+    """The metric-name argument as a lintable string; f-string
+    interpolations become "*"; non-literal names return None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for value in node.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def lint_file(path: Path) -> list[str]:
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        print(f"{path}: parse error: {e}", file=sys.stderr)
+        raise
+    errors = []
+    rel = path.relative_to(REPO)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr in EMITTERS):
+            continue
+        if not _is_metrics_receiver(func.value):
+            continue
+        if not node.args:
+            continue
+        name = _name_pattern(node.args[0])
+        if name is None:
+            errors.append(
+                f"{rel}:{node.lineno}: non-literal metric name in "
+                f".{func.attr}() — the linter (and the catalog) cannot "
+                f"see it; use a literal or f-string"
+            )
+            continue
+        if not is_cataloged(name):
+            errors.append(
+                f"{rel}:{node.lineno}: metric {name!r} (via .{func.attr}()) "
+                f"is not in runtime/metric_catalog.py — catalog it (and "
+                f"document it in docs/observability.md) first"
+            )
+    return errors
+
+
+def main() -> int:
+    errors: list[str] = []
+    for root in SCAN_ROOTS:
+        path = REPO / root
+        files = sorted(path.rglob("*.py")) if path.is_dir() else [path]
+        for f in files:
+            if not f.exists():
+                continue
+            try:
+                errors.extend(lint_file(f))
+            except SyntaxError:
+                return 2
+    if errors:
+        print("\n".join(errors))
+        print(f"metrics-lint: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    print("metrics-lint: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
