@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench graft-check graft-dryrun native metrics-lint
+.PHONY: test test-fast bench bench-gate graft-check graft-dryrun native metrics-lint
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -22,6 +22,12 @@ bench-e2e:
 # docs/observability.md).
 metrics-lint:
 	python tools/metrics_lint.py
+
+# Fails when the latest BENCH_r*.json regresses throughput/latency vs
+# the best prior round of the same metric+platform (tolerance 10%; see
+# tools/bench_gate.py for the intentional-regression knob).
+bench-gate:
+	python tools/bench_gate.py
 
 test: metrics-lint
 	$(PYTEST_ENV) python -m pytest tests/ -q

@@ -16,6 +16,21 @@ monitor_subcontroller.go, report.go): per federated type it meters
 
 Gauges land in the shared :class:`Metrics` store on a periodic tick
 (report.go DoReport's interval loop).
+
+Placement drift detection (``fleet`` given): per object it diffs the
+scheduler's desired placement — the persisted placement on the
+federated object, cross-checked against the engine's flight-recorder
+decision — against the dispatched/observed member state, and exposes
+
+* ``placement_drift_objects{ftc,kind}`` gauges per drift kind
+  (``missing`` / ``orphan`` / ``replicas`` / ``decision``), and
+* a bounded listing served at ``GET /debug/drift`` (the detector
+  registers itself as a flightrec drift provider).
+
+Drift includes in-flight propagation: an object scheduled but not yet
+synced shows as ``missing`` until the dispatch lands, so the gauge's
+steady-state baseline is the sync-latency window, and a persistent
+non-zero value is the page.
 """
 
 from __future__ import annotations
@@ -26,14 +41,26 @@ from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
+from kubeadmiral_tpu.runtime import flightrec as FR
 from kubeadmiral_tpu.runtime import pending
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
 from kubeadmiral_tpu.testing.fakekube import FakeKube
+from kubeadmiral_tpu.utils.unstructured import get_path
 
 _TICK = "tick"
 
 DEFAULT_INTERVAL_SECONDS = 30.0
+
+# Drift kinds (the placement_drift_objects label vocabulary).
+DRIFT_MISSING = "missing"      # desired cluster lacks the member object
+DRIFT_ORPHAN = "orphan"        # member object exists off the desired set
+DRIFT_REPLICAS = "replicas"    # member replicas != scheduler's override
+DRIFT_DECISION = "decision"    # persisted placement != flight-recorder decision
+DRIFT_KINDS = (DRIFT_MISSING, DRIFT_ORPHAN, DRIFT_REPLICAS, DRIFT_DECISION)
+
+# Bound on the /debug/drift listing (gauges stay exact).
+_DRIFT_LIST_CAP = 1000
 
 
 def _is_propagated(fed_obj: dict) -> bool:
@@ -59,6 +86,8 @@ class MonitorController:
         metrics: Optional[Metrics] = None,
         interval: float = DEFAULT_INTERVAL_SECONDS,
         clock=time.monotonic,
+        fleet=None,
+        flight_recorder="default",
     ):
         self.host = host
         self.ftc = ftc
@@ -66,6 +95,19 @@ class MonitorController:
         self.interval = interval
         self.clock = clock
         self._resource = ftc.federated.resource
+        # Placement drift detection needs the member stores; a host-only
+        # monitor (the reference's shape) skips it.
+        self.fleet = fleet
+        self.flightrec = (
+            FR.get_default() if flight_recorder == "default" else flight_recorder
+        )
+        self._drift: list[dict] = []
+        self._drift_checked = 0
+        self._drift_at: Optional[float] = None
+        if fleet is not None:
+            FR.register_drift_provider(
+                f"monitor-{ftc.name}", self.drift_snapshot
+            )
         # (key, generation) -> first-seen timestamp, dropped once synced.
         self._pending_since: dict[tuple[str, int], float] = {}
         # The same clock drives latency math AND the requeue timer, so a
@@ -164,3 +206,98 @@ class MonitorController:
                 ready += 1
         self.metrics.store("monitor.clusters.total", total_clusters)
         self.metrics.store("monitor.clusters.ready", ready)
+        self._detect_drift()
+
+    # -- placement drift --------------------------------------------------
+    def _detect_drift(self) -> None:
+        """Diff the scheduler's desired placements against observed
+        member state; gauges per drift kind + a bounded listing for
+        GET /debug/drift."""
+        if self.fleet is None:
+            return
+        source = self.ftc.source.resource
+        replicas_path = self.ftc.path.replicas_spec
+        override_path = (
+            "/" + replicas_path.replace(".", "/") if replicas_path else None
+        )
+        members = dict(self.fleet.members)
+        counts: Counter = Counter()
+        drifted: list[dict] = []
+        checked = 0
+
+        def note(kind: str, key: str, cluster: str, detail: str) -> None:
+            counts[kind] += 1
+            if len(drifted) < _DRIFT_LIST_CAP:
+                drifted.append(
+                    {"key": key, "cluster": cluster, "kind": kind,
+                     "detail": detail}
+                )
+
+        def visit(fed: dict) -> None:
+            nonlocal checked
+            meta = fed.get("metadata", {})
+            ns = meta.get("namespace", "")
+            key = f"{ns}/{meta.get('name', '')}".lstrip("/")
+            desired = C.get_placement(fed, C.SCHEDULER)
+            if desired is None:
+                return  # never scheduled: nothing to drift against
+            checked += 1
+            want_reps: dict[str, int] = {}
+            if override_path:
+                for cl, patches in C.get_overrides(fed, C.SCHEDULER).items():
+                    for p in patches:
+                        if (
+                            p.get("path") == override_path
+                            and p.get("op", "replace") == "replace"
+                        ):
+                            want_reps[cl] = int(p["value"])
+            for cl, member in members.items():
+                obs = member.try_get_view(source, key)
+                if cl in desired and obs is None:
+                    note(DRIFT_MISSING, key, cl,
+                         "desired placement not present in member")
+                elif cl not in desired and obs is not None:
+                    note(DRIFT_ORPHAN, key, cl,
+                         "member object outside the desired placement")
+                elif obs is not None and cl in want_reps:
+                    got = get_path(obs, replicas_path)
+                    if got != want_reps[cl]:
+                        note(
+                            DRIFT_REPLICAS, key, cl,
+                            f"member replicas {got} != desired {want_reps[cl]}",
+                        )
+            # Cross-check against the engine's recorded decision: the
+            # persisted placement should be the flight recorder's chosen
+            # set (a mismatch means a decision was recorded but never
+            # persisted, or overwritten outside the scheduler).
+            rec = (
+                self.flightrec.lookup(key)
+                if self.flightrec is not None and self.flightrec.enabled
+                else None
+            )
+            if rec is not None and set(rec.placements) != desired:
+                note(
+                    DRIFT_DECISION, key, "",
+                    f"flight recorder chose {sorted(rec.placements)} vs "
+                    f"persisted {sorted(desired)}",
+                )
+
+        self.host.scan(self._resource, visit)
+        for kind in DRIFT_KINDS:
+            self.metrics.store(
+                "placement_drift_objects", counts.get(kind, 0),
+                ftc=self.ftc.name, kind=kind,
+            )
+        self._drift = drifted
+        self._drift_checked = checked
+        self._drift_at = time.time()
+
+    def drift_snapshot(self) -> dict:
+        """The /debug/drift payload (registered as a flightrec drift
+        provider when the monitor has member access)."""
+        return {
+            "ftc": self.ftc.name,
+            "checked": self._drift_checked,
+            "generated_at": self._drift_at,
+            "drifted": list(self._drift),
+        }

@@ -30,6 +30,7 @@ from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.models.types import parse_resources
 from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime.eventsink import DefederatingRecorderMux
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.hostbatch import HostBatch
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
@@ -109,6 +110,11 @@ class SchedulerController:
         self.ftc = ftc
         self.engine = engine or SchedulerEngine()
         self.metrics = metrics or Metrics()
+        # Scheduling events land on the federated object AND its
+        # de-federated source, so `kubectl describe deployment` shows the
+        # federation decision (scheduler.go eventRecorder; the message
+        # strings share the flight recorder's reason vocabulary).
+        self.recorder = DefederatingRecorderMux(host, C.SCHEDULER)
         self.worker = BatchWorker(f"scheduler-{ftc.name}", self.reconcile_batch, metrics=self.metrics)
         self._resource = ftc.federated.resource
         self._webhook_client = webhook_client
@@ -783,6 +789,41 @@ class SchedulerController:
             outcomes[slot] = new_outcome
         return outcomes
 
+    def _record_schedule_event(
+        self, key: str, fed_obj: dict, outcome: ScheduleResult, modified: bool
+    ) -> None:
+        """Scheduled / ScheduleFailed events with the flight recorder's
+        explanation strings (scheduler.go's schedulingUnit events).
+        Emitted when the decision changed (or failed), so steady-state
+        re-persists don't churn event objects; identical repeats bump
+        the event count instead of piling up."""
+        try:
+            if outcome.clusters:
+                if not modified:
+                    return
+                placements = ", ".join(
+                    name if reps is None else f"{name}({int(reps)})"
+                    for name, reps in sorted(outcome.clusters.items())
+                )
+                self.recorder.event(
+                    fed_obj, "Normal", "Scheduled",
+                    f"scheduled to {len(outcome.clusters)} cluster(s): "
+                    f"{placements}",
+                )
+                return
+            detail = "no cluster selected"
+            rec = getattr(self.engine, "flightrec", None)
+            record = rec.lookup(key) if rec is not None else None
+            if record is not None:
+                from kubeadmiral_tpu.runtime import flightrec as FR
+
+                summary = FR.summarize_reasons(record)
+                if summary:
+                    detail = f"no cluster selected: {summary}"
+            self.recorder.event(fed_obj, "Warning", "ScheduleFailed", detail)
+        except Exception:
+            pass  # event loss must never fail a persist
+
     # -- persistence -----------------------------------------------------
     def _advance_pipeline(self, fed_obj: dict, modified: bool) -> Result:
         """Remove self from pending-controllers (re-arming downstream when
@@ -864,6 +905,7 @@ class SchedulerController:
 
         ann[C.SCHEDULING_TRIGGER_HASH] = trigger
         pending.update_pending(fed_obj, self.name, modified, self.ftc.controller_groups)
+        self._record_schedule_event(key, fed_obj, outcome, modified)
 
         def on_persist(result: dict) -> None:
             code = result.get("code")

@@ -58,16 +58,39 @@ def combine_filters(
     selector_ok,     # bool[B, C] labels selector AND required affinity
 ):
     """Conjunction of enabled filter plugins -> feasible[B, C]."""
+    feasible, _ = combine_filters_explain(
+        filter_enabled, api_ok, taint_ok_new, taint_ok_cur, current_mask,
+        fit_ok, placement_has, placement_ok, selector_ok,
+    )
+    return feasible
 
-    def gate(idx, ok):
-        return ~filter_enabled[:, idx, None] | ok
 
+def combine_filters_explain(
+    filter_enabled,  # bool[B, 5]
+    api_ok,          # bool[B, C]
+    taint_ok_new,    # bool[B, C]
+    taint_ok_cur,    # bool[B, C]
+    current_mask,    # bool[B, C]
+    fit_ok,          # bool[B, C]
+    placement_has,   # bool[B]
+    placement_ok,    # bool[B, C]
+    selector_ok,     # bool[B, C]
+):
+    """Conjunction of enabled filter plugins, plus a per-(object,
+    cluster) reason bitmask: bit i is set iff enabled plugin i rejected
+    the pair (ops.reasons vocabulary).  ``feasible == (reasons == 0)``
+    by construction — the conjunction and its explanation cannot drift.
+    Returns (feasible bool[B, C], reasons i32[B, C])."""
     taint_ok = jnp.where(current_mask, taint_ok_cur, taint_ok_new)
     placement = ~placement_has[:, None] | placement_ok
-    return (
-        gate(F_API_RESOURCES, api_ok)
-        & gate(F_TAINT_TOLERATION, taint_ok)
-        & gate(F_RESOURCES_FIT, fit_ok)
-        & gate(F_PLACEMENT, placement)
-        & gate(F_CLUSTER_AFFINITY, selector_ok)
-    )
+    reasons = jnp.zeros(api_ok.shape, jnp.int32)
+    for idx, ok in (
+        (F_API_RESOURCES, api_ok),
+        (F_TAINT_TOLERATION, taint_ok),
+        (F_RESOURCES_FIT, fit_ok),
+        (F_PLACEMENT, placement),
+        (F_CLUSTER_AFFINITY, selector_ok),
+    ):
+        rejected = filter_enabled[:, idx, None] & ~ok
+        reasons = reasons | jnp.where(rejected, jnp.int32(1 << idx), 0)
+    return reasons == 0, reasons

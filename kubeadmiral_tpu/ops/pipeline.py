@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeadmiral_tpu.ops import filters as F
+from kubeadmiral_tpu.ops import reasons as RSN
 from kubeadmiral_tpu.ops import scores as S
 from kubeadmiral_tpu.ops.planner import INT32_INF, PlannerInputs, plan_batch_jit
 from kubeadmiral_tpu.ops.select import select_topk
@@ -124,6 +125,9 @@ class TickOutputs(NamedTuple):
                           # count (Duplicate mode / nil sticky entries)
     feasible: jax.Array   # i8[B,C] post-filter (introspection)
     scores: jax.Array     # i32[B,C] post-normalize totals (introspection)
+    reasons: jax.Array    # i32[B,C] rejection bitmask (ops.reasons); 0
+                          # exactly where selected — the decision audit
+                          # plane the flight recorder serves
 
 
 def expand_compact(ci) -> TickInputs:
@@ -241,7 +245,7 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
     )
     # --- Filter ---
     fit_ok = F.resources_fit(inp.request, inp.alloc, inp.used)
-    feasible = F.combine_filters(
+    feasible, reasons = F.combine_filters_explain(
         inp.filter_enabled,
         inp.api_ok,
         inp.taint_ok_new,
@@ -251,6 +255,13 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
         inp.placement_has,
         inp.placement_ok,
         inp.selector_ok,
+    )
+    reasons = (
+        reasons
+        | jnp.where(~inp.webhook_ok, jnp.int32(RSN.REASON_WEBHOOK_FILTER), 0)
+        | jnp.where(
+            ~inp.cluster_valid[None, :], jnp.int32(RSN.REASON_CLUSTER_INVALID), 0
+        )
     )
     feasible = feasible & inp.cluster_valid[None, :] & inp.webhook_ok
 
@@ -271,6 +282,11 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
 
     # --- Select ---
     selected = select_topk(totals, feasible, inp.max_clusters)
+    # Feasible pairs the top-K cut: score rank >= K (including K == 0
+    # for a negative maxClusters).
+    reasons = reasons | jnp.where(
+        feasible & ~selected, jnp.int32(RSN.REASON_MAX_CLUSTERS), 0
+    )
 
     # --- Replicas (Divide mode) ---
     dyn_w = dynamic_weights(selected, inp.cpu_alloc, inp.cpu_avail)
@@ -308,6 +324,13 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
     # policies) are preserved, as the reference's merge does.
     divide_selected = selected & (divide_replicas != 0)
 
+    # Selected by top-K but dropped by the Divide-mode zero-entry merge.
+    reasons = reasons | jnp.where(
+        inp.mode_divide[:, None] & selected & ~divide_selected,
+        jnp.int32(RSN.REASON_ZERO_REPLICAS),
+        0,
+    )
+
     mode_divide = inp.mode_divide[:, None]
     out_selected = jnp.where(mode_divide, divide_selected, selected)
     out_replicas = jnp.where(
@@ -330,10 +353,24 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
     )
     out_replicas = jnp.where(out_selected, out_replicas, 0)
 
+    # Sticky short-circuit reasons: the current clusters win regardless
+    # of plugin verdicts; everything else is cut by stickiness (the
+    # filter bits are kept for context — they explain what WOULD reject
+    # the pair if the object were rescheduled from scratch).
+    reasons = jnp.where(
+        sticky_active & ~inp.current_mask,
+        reasons | jnp.int32(RSN.REASON_STICKY),
+        reasons,
+    )
+    # Invariant the flight recorder (and test_explain) rely on:
+    # reasons == 0 exactly where selected.
+    reasons = jnp.where(out_selected, 0, reasons)
+
     return TickOutputs(
         selected=out_selected.astype(jnp.int8),
         replicas=out_replicas.astype(jnp.int32),
         counted=(out_counted & out_selected).astype(jnp.int8),
         feasible=feasible.astype(jnp.int8),
         scores=totals.astype(jnp.int32),
+        reasons=reasons.astype(jnp.int32),
     )

@@ -10,6 +10,7 @@ scheduler" baseline.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 
 from kubeadmiral_tpu.ops.planner_oracle import ClusterPref, PlanInput, plan as planner
@@ -177,31 +178,32 @@ def _dynamic_weights(p: OracleProblem, selected: list[int]) -> dict[int, int]:
     return weights
 
 
-def schedule_one(p: OracleProblem) -> dict[int, int | None]:
-    """Returns {cluster_idx: replicas-or-None} like ScheduleResult."""
-    if p.sticky and p.current:
-        return dict(p.current)
+def _filter_reasons(p: OracleProblem) -> list[int]:
+    """Per-cluster filter-rejection bitmask (ops.reasons vocabulary):
+    bit i set iff enabled plugin i rejects the pair.  ``bits == 0`` is
+    exactly the feasibility predicate schedule_one applies."""
+    from kubeadmiral_tpu.ops import reasons as RSN
 
-    # Filter.
-    feasible = []
+    out = []
     for c in range(p.n_clusters):
-        ok = True
-        if p.filter_enabled[0]:
-            ok &= p.api_ok[c]
-        if p.filter_enabled[1]:
-            ok &= p.taint_ok_cur[c] if c in p.current else p.taint_ok_new[c]
-        if p.filter_enabled[2]:
-            ok &= _fits(p, c)
-        if p.filter_enabled[3] and p.placement_has:
-            ok &= p.placement_ok[c]
-        if p.filter_enabled[4]:
-            ok &= p.selector_ok[c]
-        if ok:
-            feasible.append(c)
-    if not feasible:
-        return {}
+        bits = 0
+        if p.filter_enabled[0] and not p.api_ok[c]:
+            bits |= RSN.REASON_API_RESOURCES
+        taint_ok = p.taint_ok_cur[c] if c in p.current else p.taint_ok_new[c]
+        if p.filter_enabled[1] and not taint_ok:
+            bits |= RSN.REASON_TAINT_TOLERATION
+        if p.filter_enabled[2] and not _fits(p, c):
+            bits |= RSN.REASON_RESOURCES_FIT
+        if p.filter_enabled[3] and p.placement_has and not p.placement_ok[c]:
+            bits |= RSN.REASON_PLACEMENT
+        if p.filter_enabled[4] and not p.selector_ok[c]:
+            bits |= RSN.REASON_CLUSTER_AFFINITY
+        out.append(bits)
+    return out
 
-    # Score + normalize + sum.
+
+def _totals(p: OracleProblem, feasible: list[int]) -> dict[int, int]:
+    """Score + normalize + sum over the feasible set."""
     totals = {c: 0 for c in feasible}
     if p.score_enabled[0]:
         for c, s in _normalize({c: p.taint_counts[c] for c in feasible}, True).items():
@@ -220,13 +222,35 @@ def schedule_one(p: OracleProblem) -> dict[int, int | None]:
     if p.score_enabled[4]:
         for c in feasible:
             totals[c] += _ratio(p, c, False)
+    return totals
 
-    # Select: top-K by (score desc, index asc).
+
+def _select(p: OracleProblem, totals: dict[int, int], feasible: list[int]) -> list[int]:
+    """Top-K by (score desc, index asc); a negative maxClusters selects
+    nothing (the reference returns Unschedulable)."""
     if p.max_clusters is not None and p.max_clusters < 0:
-        return {}
+        return []
     ranked = sorted(feasible, key=lambda c: (-totals[c], c))
     k = len(ranked) if p.max_clusters is None else min(p.max_clusters, len(ranked))
-    selected = ranked[:k]
+    return ranked[:k]
+
+
+def schedule_one(p: OracleProblem) -> dict[int, int | None]:
+    """Returns {cluster_idx: replicas-or-None} like ScheduleResult."""
+    if p.sticky and p.current:
+        return dict(p.current)
+
+    # Filter.
+    bits = _filter_reasons(p)
+    feasible = [c for c in range(p.n_clusters) if bits[c] == 0]
+    if not feasible:
+        return {}
+
+    # Score + normalize + sum, then select.
+    totals = _totals(p, feasible)
+    selected = _select(p, totals, feasible)
+    if not selected:
+        return {}
 
     if not p.mode_divide:
         return {c: None for c in selected}
@@ -264,3 +288,42 @@ def schedule_one(p: OracleProblem) -> dict[int, int | None]:
         for name, reps in merged.items()
         if reps != 0 and name in by_name
     }
+
+
+def explain_one(p: OracleProblem) -> list[int]:
+    """Per-cluster rejection bitmask (ops.reasons vocabulary) — the
+    sequential oracle for ``TickOutputs.reasons``, asserted bit-exact
+    against the XLA tick by tests/test_explain.py.
+
+    Mirrors the device's dataflow, which computes every stage
+    unconditionally and folds the per-object special cases in as masks:
+    filter bits and select-stage cuts are derived from the NON-sticky
+    pipeline, then the sticky short-circuit overlays them (current
+    clusters win with mask 0, everything else gains the sticky bit on
+    top of the would-be verdicts).  ``bits[c] == 0`` holds exactly for
+    the clusters ``schedule_one`` selects."""
+    from kubeadmiral_tpu.ops import reasons as RSN
+
+    bits = _filter_reasons(p)
+    feasible = [c for c in range(p.n_clusters) if bits[c] == 0]
+    selected: list[int] = []
+    if feasible:
+        totals = _totals(p, feasible)
+        selected = _select(p, totals, feasible)
+        chosen = set(selected)
+        for c in feasible:
+            if c not in chosen:
+                bits[c] |= RSN.REASON_MAX_CLUSTERS
+    if p.mode_divide and selected:
+        q = dataclasses.replace(p, sticky=False)
+        final = schedule_one(q)
+        for c in selected:
+            if c not in final:
+                bits[c] |= RSN.REASON_ZERO_REPLICAS
+    if p.sticky and p.current:
+        for c in range(p.n_clusters):
+            if c in p.current:
+                bits[c] = 0
+            else:
+                bits[c] |= RSN.REASON_STICKY
+    return bits

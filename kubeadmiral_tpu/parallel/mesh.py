@@ -159,6 +159,7 @@ def output_shardings(mesh: Mesh) -> TickOutputs:
         counted=sharding,
         feasible=sharding,
         scores=sharding,
+        reasons=sharding,
     )
 
 

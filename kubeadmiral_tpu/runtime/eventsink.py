@@ -18,9 +18,21 @@ import time
 from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
-from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound
+from kubeadmiral_tpu.testing.fakekube import (
+    AlreadyExists,
+    Conflict,
+    FakeKube,
+    NotFound,
+)
 
 EVENTS = "v1/events"
+
+# Bounded optimistic-concurrency retries for the count-bump path: two
+# recorders bumping the same event race on resourceVersion; each retry
+# re-reads and re-applies the increment, so no bump is silently lost
+# (the real recorder serializes through a broadcaster and never races
+# itself; this mux is called from many controller threads directly).
+_BUMP_RETRIES = 8
 
 # Set by the federate controller on every federated object it creates.
 FEDERATED_OBJECT_ANNOTATION = C.FEDERATED_OBJECT
@@ -65,40 +77,49 @@ class EventRecorder:
         ns = ref.get("namespace", "")
         name = f"{ref['kind']}.{ref['name']}.{reason}".lower()
         key = f"{ns}/{name}" if ns else name
-        existing = self.host.try_get(EVENTS, key)
-        if existing is not None and existing.get("message") == message:
-            existing["count"] = existing.get("count", 1) + 1
-            existing["lastTimestamp"] = self.clock()
+        # Bounded retry loop: a Conflict means another recorder updated
+        # the same event between our read and write — re-read and
+        # re-apply instead of dropping the bump (concurrent recorders
+        # used to under-count; the regression test hammers this path
+        # from many threads).
+        for _ in range(_BUMP_RETRIES):
+            existing = self.host.try_get(EVENTS, key)
+            if existing is not None and existing.get("message") == message:
+                existing["count"] = existing.get("count", 1) + 1
+                existing["lastTimestamp"] = self.clock()
+                try:
+                    self.host.update(EVENTS, existing)
+                    return
+                except Conflict:
+                    continue
+                except NotFound:
+                    continue  # deleted under us: recreate on re-read
+            event = {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name},
+                "involvedObject": ref,
+                "type": event_type,
+                "reason": reason,
+                "message": message,
+                "source": {"component": self.component},
+                "count": 1,
+                "firstTimestamp": self.clock(),
+                "lastTimestamp": self.clock(),
+            }
+            if ns:
+                event["metadata"]["namespace"] = ns
             try:
-                self.host.update(EVENTS, existing)
-            except (Conflict, NotFound):
-                pass
-            return
-        event = {
-            "apiVersion": "v1",
-            "kind": "Event",
-            "metadata": {"name": name},
-            "involvedObject": ref,
-            "type": event_type,
-            "reason": reason,
-            "message": message,
-            "source": {"component": self.component},
-            "count": 1,
-            "firstTimestamp": self.clock(),
-            "lastTimestamp": self.clock(),
-        }
-        if ns:
-            event["metadata"]["namespace"] = ns
-        try:
-            if existing is None:
-                self.host.create(EVENTS, event)
-            else:
-                event["metadata"] = existing["metadata"]
-                self.host.update(EVENTS, event)
-        except (Conflict, NotFound):
-            pass
-        except Exception:
-            pass  # event loss is tolerated, as with the real broadcaster
+                if existing is None:
+                    self.host.create(EVENTS, event)
+                else:
+                    event["metadata"] = existing["metadata"]
+                    self.host.update(EVENTS, event)
+                return
+            except (Conflict, NotFound, AlreadyExists):
+                continue  # raced: re-read and retry
+            except Exception:
+                return  # event loss is tolerated, as with the real broadcaster
 
     def event(self, obj: dict, event_type: str, reason: str, message: str) -> None:
         self._record(self._reference(obj), event_type, reason, message)

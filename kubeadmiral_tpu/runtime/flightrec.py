@@ -1,0 +1,383 @@
+"""Scheduling decision flight recorder.
+
+A bounded ring of the last N ticks' decision records: for every object
+row the engine actually fetched off the device, the recorder keeps the
+reason bitmask per cluster (ops.reasons vocabulary), the top-k
+normalized scores, the chosen clusters + replica split, and the
+tick/program fingerprint — enough to answer "why is object X on
+clusters {A, B} and not C?" without re-running the solver.
+
+Populated OFF the hot path: the engine records from the host-side
+arrays its fetch stage already pulled (scheduler/engine.py packs the
+reason plane into the same delta gathers / full-plane fetches it runs
+anyway), so device dispatch latency is unaffected.  Ticks that ride the
+noop/skip fast paths record nothing — the previous records remain
+current, because the tick provably reproduced the previous outputs.
+Consequently a record describes the decision AS OF the tick that last
+fetched that row (each record carries its tick id and age).
+
+Served by the health/profiling HTTP servers:
+
+* ``GET /debug/decisions``  — ring summary (recent ticks, volumes).
+* ``GET /debug/explain?key=<ns/name>`` — per-cluster verdicts for one
+  object ("filtered: resources_fit", "feasible, cut by max_clusters",
+  "selected, replicas=3", ...).
+* ``GET /debug/drift`` — placement drift listing, fed by providers
+  registered here (federation/monitor.py's drift detector).
+
+Sizing: records cost ~2 bytes per (object, cluster) pair (an int16
+reason row) plus ~200 bytes per object.  The ring keeps at most
+``max_ticks`` tick entries and evicts oldest-first past ``max_bytes``,
+but always retains the most recent tick so a cold full-batch schedule
+stays explainable.  Knobs: ``KT_FLIGHTREC`` (0 disables),
+``KT_FLIGHTREC_TICKS``, ``KT_FLIGHTREC_BYTES``, ``KT_FLIGHTREC_TOPK``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from kubeadmiral_tpu.ops import reasons as RSN
+
+
+class DecisionRecord:
+    """One object's scheduling decision, as of ``tick``."""
+
+    __slots__ = (
+        "key", "tick", "when", "program", "placements", "reasons",
+        "topk_idx", "topk_scores", "names",
+    )
+
+    def __init__(self, key, tick, when, program, placements, reasons,
+                 topk_idx, topk_scores, names):
+        self.key = key
+        self.tick = tick
+        self.when = when
+        self.program = program
+        self.placements = placements    # Mapping[str, Optional[int]]
+        self.reasons = reasons          # np.int16[C]
+        self.topk_idx = topk_idx        # np.int32[k] cluster indices
+        self.topk_scores = topk_scores  # np.int32[k] matching scores
+        self.names = names              # tuple[str, ...] (shared per tick)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.reasons.nbytes + self.topk_idx.nbytes
+                   + self.topk_scores.nbytes) + 200
+
+
+class _TickEntry:
+    __slots__ = ("tick", "when", "objects", "clusters", "records",
+                 "nbytes", "programs")
+
+    def __init__(self, tick, when, objects, clusters):
+        self.tick = tick
+        self.when = when
+        self.objects = objects
+        self.clusters = clusters
+        self.records: dict[str, DecisionRecord] = {}
+        self.nbytes = 0
+        self.programs: set[str] = set()
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        max_ticks: Optional[int] = None,
+        max_bytes: Optional[int] = None,
+        topk: Optional[int] = None,
+        enabled: Optional[bool] = None,
+        clock=time.time,
+    ):
+        env = os.environ
+        self.max_ticks = int(env.get("KT_FLIGHTREC_TICKS", "8")) if max_ticks is None else max_ticks
+        self.max_bytes = int(env.get("KT_FLIGHTREC_BYTES", str(256 << 20))) if max_bytes is None else max_bytes
+        self.topk = int(env.get("KT_FLIGHTREC_TOPK", "8")) if topk is None else topk
+        self.enabled = (env.get("KT_FLIGHTREC", "1") != "0") if enabled is None else enabled
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._ring: deque[_TickEntry] = deque()
+        self._index: dict[str, DecisionRecord] = {}
+        self._tick_seq = 0
+        self._bytes = 0
+        self._current: Optional[_TickEntry] = None
+        # Cluster-name tuple interning: one tuple shared by every record
+        # of a topology, not one list per record.
+        self._names_cache: Optional[tuple[str, ...]] = None
+
+    # -- recording (engine-facing) ---------------------------------------
+    def begin_tick(self, objects: int, clusters: int) -> int:
+        with self._lock:
+            self._tick_seq += 1
+            self._current = _TickEntry(
+                self._tick_seq, self.clock(), objects, clusters
+            )
+            return self._tick_seq
+
+    def end_tick(self) -> None:
+        with self._lock:
+            self._current = None
+            self._evict_locked()
+
+    def record_rows(
+        self,
+        keys: Sequence[str],
+        placements: Sequence[Mapping[str, Optional[int]]],
+        reasons: np.ndarray,          # int[n, >=C]
+        scores: Optional[np.ndarray],  # int[n, >=C] or None
+        names: Sequence[str],
+        program: str = "",
+    ) -> None:
+        """Record a batch of fetched rows for the current tick.  Padded
+        cluster columns are masked out (sliced to ``len(names)``);
+        callers pass only real (non-padded) object rows."""
+        if not self.enabled or not keys:
+            return
+        c = len(names)
+        reasons = np.asarray(reasons)[:, :c].astype(np.int16)
+        k = min(self.topk, c)
+        if scores is not None:
+            scores = np.asarray(scores)[:, :c]
+            # Top-k among FEASIBLE clusters (score planes are zero/garbage
+            # on infeasible ones): rank by score desc, index asc — the
+            # select stage's exact tie order.
+            feasible = (reasons & RSN.FILTER_REASON_MASK) == 0
+            masked = np.where(feasible, scores.astype(np.int64), np.iinfo(np.int64).min)
+            order = np.argsort(-masked, axis=1, kind="stable")[:, :k]
+            top_scores = np.take_along_axis(masked, order, axis=1)
+        else:
+            order = np.zeros((len(keys), 0), np.int32)
+            top_scores = order
+        with self._lock:
+            entry = self._current
+            if entry is None:  # recording outside a tick: tolerate
+                self._tick_seq += 1
+                entry = self._current = _TickEntry(
+                    self._tick_seq, self.clock(), len(keys), c
+                )
+            if self._names_cache is None or tuple(self._names_cache) != tuple(names):
+                self._names_cache = tuple(names)
+            names_t = self._names_cache
+            if not entry.records and entry not in self._ring:
+                self._ring.append(entry)
+            if program:
+                entry.programs.add(program)
+            when = entry.when
+            for i, key in enumerate(keys):
+                rec = DecisionRecord(
+                    key=key,
+                    tick=entry.tick,
+                    when=when,
+                    program=program,
+                    placements=placements[i],
+                    reasons=reasons[i],
+                    topk_idx=order[i].astype(np.int32),
+                    topk_scores=top_scores[i].astype(np.int64),
+                    names=names_t,
+                )
+                old = entry.records.get(key)
+                if old is not None:
+                    entry.nbytes -= old.nbytes
+                    self._bytes -= old.nbytes
+                entry.records[key] = rec
+                entry.nbytes += rec.nbytes
+                self._bytes += rec.nbytes
+                self._index[key] = rec
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        while len(self._ring) > 1 and (
+            len(self._ring) > self.max_ticks or self._bytes > self.max_bytes
+        ):
+            evicted = self._ring.popleft()
+            self._bytes -= evicted.nbytes
+            for key, rec in evicted.records.items():
+                if self._index.get(key) is rec:
+                    del self._index[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._index.clear()
+            self._bytes = 0
+            self._current = None
+            self._names_cache = None
+
+    # -- introspection (HTTP-facing) -------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "ticks_seen": self._tick_seq,
+                "ring_ticks": len(self._ring),
+                "records": len(self._index),
+                "bytes": self._bytes,
+                "max_ticks": self.max_ticks,
+                "max_bytes": self.max_bytes,
+                "topk": self.topk,
+            }
+
+    def decisions(self) -> dict:
+        """Ring summary for GET /debug/decisions."""
+        now = self.clock()
+        with self._lock:
+            ticks = [
+                {
+                    "tick": e.tick,
+                    "age_seconds": round(now - e.when, 3),
+                    "objects": e.objects,
+                    "clusters": e.clusters,
+                    "recorded_rows": len(e.records),
+                    "bytes": e.nbytes,
+                    "programs": sorted(e.programs),
+                }
+                for e in self._ring
+            ]
+        out = self.stats()
+        out["ticks"] = ticks
+        return out
+
+    def lookup(self, key: str) -> Optional[DecisionRecord]:
+        with self._lock:
+            return self._index.get(key)
+
+    def explain(self, key: str) -> Optional[dict]:
+        """Human-readable per-cluster verdicts for GET /debug/explain."""
+        rec = self.lookup(key)
+        if rec is None:
+            return None
+        top_by_idx = {
+            int(j): (rank, int(s))
+            for rank, (j, s) in enumerate(zip(rec.topk_idx, rec.topk_scores), 1)
+            if s > np.iinfo(np.int64).min
+        }
+        feasible_n = int(((rec.reasons & RSN.FILTER_REASON_MASK) == 0).sum())
+        clusters = {}
+        for j, name in enumerate(rec.names):
+            mask = int(rec.reasons[j])
+            verdict = _verdict(
+                mask, rec.placements.get(name, _MISSING),
+                top_by_idx.get(j), feasible_n,
+            )
+            clusters[name] = verdict
+        return {
+            "key": key,
+            "tick": rec.tick,
+            "age_seconds": round(self.clock() - rec.when, 3),
+            "program": rec.program,
+            "placements": {
+                cl: (None if reps is None else int(reps))
+                for cl, reps in rec.placements.items()
+            },
+            "feasible_clusters": feasible_n,
+            "clusters": clusters,
+        }
+
+
+_MISSING = object()
+
+
+def _verdict(mask, replicas, top_rank, feasible_n) -> dict:
+    """One (object, cluster) verdict: the reason slugs plus a sentence."""
+    slugs = RSN.describe(mask)
+    if mask == 0 and replicas is not _MISSING:
+        text = (
+            "selected (no replica count)"
+            if replicas is None
+            else f"selected, replicas={int(replicas)}"
+        )
+    elif mask == 0:
+        # Selected by the recorded tick but absent from the decoded
+        # placement map — only possible for padded rows, which callers
+        # never record; keep a faithful fallback.
+        text = "selected"
+    elif mask & RSN.REASON_STICKY:
+        text = "cut by sticky_cluster (object is stickily placed)"
+    elif mask & RSN.FILTER_REASON_MASK:
+        text = "filtered: " + ", ".join(
+            RSN.describe(mask & RSN.FILTER_REASON_MASK)
+        )
+    elif mask & RSN.REASON_MAX_CLUSTERS:
+        if top_rank is not None:
+            rank, score = top_rank
+            text = (
+                f"feasible, scored {score}, rank {rank}/{feasible_n}, "
+                f"cut by maxClusters"
+            )
+        else:
+            text = (
+                f"feasible but below the recorded top-k of {feasible_n} "
+                f"feasible clusters, cut by maxClusters"
+            )
+    elif mask & RSN.REASON_ZERO_REPLICAS:
+        text = "selected by top-K but the replica planner assigned 0"
+    else:
+        text = "rejected: " + ", ".join(slugs)
+    out = {"reasons": slugs, "verdict": text}
+    if top_rank is not None:
+        rank, score = top_rank
+        out["score"] = score
+        out["rank"] = rank
+    return out
+
+
+def summarize_reasons(rec: DecisionRecord, limit: int = 4) -> str:
+    """Aggregate one record's per-cluster rejection masks into a short
+    operator string ("resources_fit x3, taint_toleration x1") — the
+    ScheduleFailed event message vocabulary."""
+    counts: dict[str, int] = {}
+    for mask in rec.reasons.tolist():
+        for slug in RSN.describe(int(mask)):
+            counts[slug] = counts.get(slug, 0) + 1
+    parts = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:limit]
+    return ", ".join(f"{slug} x{n}" for slug, n in parts)
+
+
+# -- process-wide default (the engine and HTTP servers meet here) --------
+_default = FlightRecorder()
+
+
+def get_default() -> FlightRecorder:
+    return _default
+
+
+# -- drift providers ------------------------------------------------------
+# federation/monitor.py's drift detector registers a snapshot callable;
+# GET /debug/drift renders every registered provider.  Kept here (not in
+# profiling.py) so runtime/ has no federation/ import and any controller
+# can contribute a drift view.
+_drift_lock = threading.Lock()
+_drift_providers: dict[str, Callable[[], dict]] = {}
+
+
+def register_drift_provider(name: str, fn: Callable[[], dict]) -> None:
+    with _drift_lock:
+        _drift_providers[name] = fn
+
+
+def unregister_drift_provider(name: str) -> None:
+    with _drift_lock:
+        _drift_providers.pop(name, None)
+
+
+def drift_report() -> dict:
+    with _drift_lock:
+        providers = dict(_drift_providers)
+    out: dict = {"providers": sorted(providers)}
+    drifted: list[dict] = []
+    for name, fn in sorted(providers.items()):
+        try:
+            snap = fn()
+        except Exception as e:  # a broken provider must not 500 the route
+            snap = {"error": repr(e)}
+        out[name] = snap
+        drifted.extend(snap.get("drifted", ()) if isinstance(snap, dict) else ())
+    out["drifted"] = drifted
+    out["drifted_total"] = len(drifted)
+    return out

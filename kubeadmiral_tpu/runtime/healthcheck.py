@@ -63,8 +63,10 @@ class HealthServer:
     health HTTP server, default port 11257).  When given a ``metrics``
     registry / ``tracer`` it additionally serves ``/metrics`` (Prometheus
     text format) and ``/debug/trace`` (Chrome trace JSON) alongside the
-    pprof-analogue ``/debug/*`` routes — one port for the whole
-    operability surface."""
+    pprof-analogue ``/debug/*`` routes and the decision-audit routes
+    ``/debug/decisions`` / ``/debug/explain`` / ``/debug/drift``
+    (runtime/flightrec.py) — one port for the whole operability
+    surface."""
 
     def __init__(
         self,
@@ -73,10 +75,14 @@ class HealthServer:
         port: int = 0,
         metrics=None,
         tracer=None,
+        flightrec=None,
+        drift=None,
     ):
         self.registry = registry
         self.metrics = metrics
         self.tracer = tracer
+        self.flightrec = flightrec
+        self.drift = drift
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
@@ -102,6 +108,7 @@ class HealthServer:
                     if not profiling.respond_debug(
                         self, path, raw_query,
                         metrics=outer.metrics, tracer=outer.tracer,
+                        flightrec=outer.flightrec, drift=outer.drift,
                     ):
                         self.send_error(404)
                     return

@@ -101,6 +101,18 @@ CATALOG: dict[str, MetricSpec] = {
     "engine_program_shapes": MetricSpec(
         "gauge", "programs", (),
         "Distinct program shapes dispatched since engine construction."),
+    # -- decision flight recorder (runtime/flightrec.py) -----------------
+    "flightrec_records": MetricSpec(
+        "gauge", "objects", (),
+        "Per-object decision records currently held by the flight "
+        "recorder's ring (the /debug/explain working set)."),
+    "flightrec_bytes": MetricSpec(
+        "gauge", "bytes", (),
+        "Memory held by flight-recorder decision records (bounded by "
+        "KT_FLIGHTREC_BYTES, oldest ticks evicted first)."),
+    "flightrec_ring_ticks": MetricSpec(
+        "gauge", "ticks", (),
+        "Tick entries in the flight recorder's bounded ring."),
     # -- controllers (federation/) ---------------------------------------
     "scheduler_scheduled_total": MetricSpec(
         "counter", "objects", ("ftc",),
@@ -109,7 +121,51 @@ CATALOG: dict[str, MetricSpec] = {
         "gauge", "objects", ("ftc", "controller"),
         "Objects whose FIRST pending-controllers group names the "
         "controller — each pipeline stage's backlog."),
+    "placement_drift_objects": MetricSpec(
+        "gauge", "objects", ("ftc", "kind"),
+        "Desired-vs-observed placement drift found by the monitor "
+        "controller's detector, per kind: missing (desired placement "
+        "absent from the member), orphan (member object outside the "
+        "desired set), replicas (member replicas != scheduler override), "
+        "decision (persisted placement != flight-recorder decision)."),
 }
+
+# -- decision audit vocabulary -------------------------------------------
+# Kubernetes Event reasons this control plane may record
+# (runtime/eventsink.py recorders).  tools/metrics_lint.py walks
+# ``.event(obj, type, reason, message)`` calls and fails on literal
+# reasons not listed here — like metric names, the event vocabulary is
+# documented (docs/observability.md) before it ships.
+EVENT_REASONS: frozenset[str] = frozenset({
+    "Scheduled",        # scheduler: placement decided (message: clusters + replicas)
+    "ScheduleFailed",   # scheduler: no cluster selected (message: reason summary)
+    "PropagationFailed",  # sync: member writes failed (message: clusters)
+})
+
+# Rejection-reason slugs served by /debug/explain and embedded in
+# ScheduleFailed messages — must stay in lockstep with
+# ops.reasons.REASON_NAMES (metrics-lint cross-checks both directions).
+DECISION_REASONS: frozenset[str] = frozenset({
+    "api_resources",
+    "taint_toleration",
+    "resources_fit",
+    "placement",
+    "cluster_affinity",
+    "webhook_filter",
+    "cluster_invalid",
+    "max_clusters",
+    "zero_replicas",
+    "sticky_cluster",
+})
+
+# The flight-recorder record schema (runtime/flightrec.py
+# DecisionRecord.__slots__): metrics-lint fails when the record grows or
+# renames a field without this catalog (and docs/observability.md)
+# following along.
+FLIGHT_RECORDER_FIELDS: tuple[str, ...] = (
+    "key", "tick", "when", "program", "placements", "reasons",
+    "topk_idx", "topk_scores", "names",
+)
 
 # Pre-exposition dotted names, matched with fnmatch.  "*" also stands in
 # for f-string interpolations in the linter's extracted names (e.g.

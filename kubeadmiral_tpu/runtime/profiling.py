@@ -20,6 +20,15 @@ main.go:21).  The Python control plane's equivalent serves:
   (runtime/metrics.py), the pkg/stats exposition analogue.
 * ``GET /debug/trace`` — completed reconcile-path spans as Chrome
   trace-event JSON (runtime/trace.py); load in chrome://tracing.
+* ``GET /debug/decisions`` — the scheduling flight recorder's ring
+  summary (runtime/flightrec.py): recent ticks, record volumes.
+* ``GET /debug/explain?key=<ns/name>`` — per-cluster verdicts for one
+  object's latest recorded scheduling decision (which filter rejected
+  each infeasible cluster, score/rank for select-stage cuts, the chosen
+  clusters + replica split).
+* ``GET /debug/drift`` — desired-vs-observed placement drift, from the
+  providers registered with the flight recorder module (the monitor
+  controller's drift detector).
 
 ``respond_debug`` is the shared route handler: the health server mounts
 it so one port serves livez/readyz/metrics/debug, and
@@ -131,7 +140,8 @@ def _send(http_handler, body: bytes, content_type: str) -> None:
 
 
 def respond_debug(
-    http_handler, path: str, raw_query: str, metrics=None, tracer=None
+    http_handler, path: str, raw_query: str, metrics=None, tracer=None,
+    flightrec=None, drift=None,
 ) -> bool:
     """Serve a /metrics or /debug/* route on any BaseHTTPRequestHandler;
     returns False when the path isn't one of ours (caller handles it).
@@ -140,7 +150,10 @@ def respond_debug(
 
     ``metrics`` is the registry to expose (no default: the caller owns
     its registry); ``tracer`` defaults to the process-wide span tracer
-    the reconcile path records into."""
+    the reconcile path records into; ``flightrec`` defaults to the
+    process-wide decision flight recorder the engine feeds; ``drift``
+    (a callable returning the drift listing) defaults to the registered
+    drift providers (flightrec.drift_report)."""
     if path == "/metrics":
         if metrics is None:
             return False
@@ -160,6 +173,32 @@ def respond_debug(
             "application/json",
         )
         return True
+    if path in ("/debug/decisions", "/debug/explain", "/debug/drift"):
+        from kubeadmiral_tpu.runtime import flightrec as flightrec_mod
+
+        recorder = flightrec or flightrec_mod.get_default()
+        if path == "/debug/decisions":
+            body = json.dumps(recorder.decisions()).encode()
+        elif path == "/debug/explain":
+            query = {k: v[-1] for k, v in parse_qs(raw_query).items()}
+            key = query.get("key", "")
+            if not key:
+                http_handler.send_error(
+                    400, explain="missing ?key=<namespace/name>"
+                )
+                return True
+            result = recorder.explain(key)
+            if result is None:
+                http_handler.send_error(
+                    404, explain=f"no recorded decision for {key!r}"
+                )
+                return True
+            body = json.dumps(result).encode()
+        else:
+            report = drift() if drift is not None else flightrec_mod.drift_report()
+            body = json.dumps(report).encode()
+        _send(http_handler, body, "application/json")
+        return True
     query = {k: v[-1] for k, v in parse_qs(raw_query).items()}
     result = handle_debug_path(path, query)
     if result is None:
@@ -172,12 +211,15 @@ class ProfilingServer:
     """Standalone profiling HTTP server (the reference's :6060)."""
 
     def __init__(
-        self, host: str = "127.0.0.1", port: int = 0, metrics=None, tracer=None
+        self, host: str = "127.0.0.1", port: int = 0, metrics=None,
+        tracer=None, flightrec=None, drift=None,
     ):
         self._host = host
         self._port = port
         self.metrics = metrics
         self.tracer = tracer
+        self.flightrec = flightrec
+        self.drift = drift
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -195,6 +237,7 @@ class ProfilingServer:
                 if not respond_debug(
                     self, split.path, split.query,
                     metrics=outer.metrics, tracer=outer.tracer,
+                    flightrec=outer.flightrec, drift=outer.drift,
                 ):
                     self.send_error(404)
 

@@ -43,6 +43,7 @@ from kubeadmiral_tpu.ops.pipeline import (
     expand_compact,
     schedule_tick,
 )
+from kubeadmiral_tpu.runtime import flightrec as flightrec_mod
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
 from kubeadmiral_tpu.scheduler import compact as Cmp
@@ -343,6 +344,23 @@ def _gather_packed3(sel, rep, cnt, idx):
     )
 
 
+def _gather_packed5(sel, rep, cnt, sco, rsn, idx):
+    """Flight-recorder variant: score + reason planes ride the SAME
+    packed transfer as the selection planes — the decision audit costs
+    extra bytes on rows already being fetched, never an extra
+    device->host round trip."""
+    return jnp.concatenate(
+        [
+            sel[idx].astype(jnp.int32),
+            rep[idx],
+            cnt[idx].astype(jnp.int32),
+            sco[idx],
+            rsn[idx],
+        ],
+        axis=1,
+    )
+
+
 def _patch_rows(dev: dict, rows: dict, idx):
     """Scatter freshly featurized rows into the cached device tensors
     (idx is padded with out-of-range values; mode='drop' ignores them) —
@@ -380,8 +398,20 @@ class SchedulerEngine:
         canonical_c: int = 256,
         vocab_caps: Optional[dict] = None,
         metrics: Optional[Metrics] = None,
+        flight_recorder="default",
     ):
         self.chunk_size = chunk_size
+        # Decision flight recorder (runtime/flightrec.py): fed from the
+        # host-side arrays the fetch stage pulls anyway, so /debug/explain
+        # can name the rejecting filter for any (object, cluster) pair
+        # without re-running the solver.  "default" = the process-wide
+        # recorder (disabled via KT_FLIGHTREC=0); pass None to opt out.
+        self.flightrec = (
+            flightrec_mod.get_default()
+            if flight_recorder == "default"
+            else flight_recorder
+        )
+        self._tick_rec = None
         # Telemetry registry (runtime/metrics.py): stage histograms,
         # compile-cache and fetch-path counters land here alongside the
         # raw dict stats below.  The manager passes its shared registry;
@@ -505,6 +535,7 @@ class SchedulerEngine:
             self._tick_compact = jax.jit(_tick_compact_with_diff)
             self._gather = jax.jit(_gather_packed)
             self._gather3 = jax.jit(_gather_packed3)
+            self._gather5 = jax.jit(_gather_packed5)
             self._patch = jax.jit(_patch_rows)
             self._patch_compact = jax.jit(_patch_rows)
             self._per_object_shardings = None
@@ -560,6 +591,11 @@ class SchedulerEngine:
         self._gather3 = jax.jit(
             _gather_packed3,
             in_shardings=(grid, grid, grid, rep),
+            out_shardings=rep,
+        )
+        self._gather5 = jax.jit(
+            _gather_packed5,
+            in_shardings=(grid, grid, grid, grid, grid, rep),
             out_shardings=rep,
         )
         self._patch = jax.jit(
@@ -897,14 +933,26 @@ class SchedulerEngine:
             return []
         cache0 = dict(self.cache_stats)
         fetch0 = dict(self.fetch_stats)
+        # Arm the flight recorder for this tick: record sites (the fetch/
+        # decode helpers) consume _tick_rec; ticks riding the noop/skip
+        # fast paths record nothing and the previous records stay
+        # current (the tick provably reproduced the previous outputs).
+        rec = self.flightrec if (self.flightrec is not None and self.flightrec.enabled) else None
+        self._tick_rec = rec
+        if rec is not None:
+            rec.begin_tick(len(units), len(clusters))
         t_start = time.perf_counter()
-        with trace.span(
-            "engine.schedule", objects=len(units), clusters=len(clusters)
-        ):
-            results = self._schedule_impl(
-                units, clusters, view=view, webhook_eval=webhook_eval,
-                want_scores=want_scores, follower_index=follower_index,
-            )
+        try:
+            with trace.span(
+                "engine.schedule", objects=len(units), clusters=len(clusters)
+            ):
+                results = self._schedule_impl(
+                    units, clusters, view=view, webhook_eval=webhook_eval,
+                    want_scores=want_scores, follower_index=follower_index,
+                )
+        finally:
+            if rec is not None:
+                rec.end_tick()
         self._emit_tick_metrics(
             len(units), time.perf_counter() - t_start, cache0, fetch0
         )
@@ -934,6 +982,11 @@ class SchedulerEngine:
         for program, b, c in pipeline_mod.drain_trace_events():
             m.counter("engine_xla_compiles_total", program=program, shape=f"{b}x{c}")
         m.store("engine_program_shapes", len(self.program_shapes))
+        if self._tick_rec is not None:
+            st = self._tick_rec.stats()
+            m.store("flightrec_records", st["records"])
+            m.store("flightrec_bytes", st["bytes"])
+            m.store("flightrec_ring_ticks", st["ring_ticks"])
 
     def _count_dispatch(self, fmt: str, b_pad: int, c_bucket: int) -> None:
         """Program-shape cache accounting for one device dispatch: a
@@ -1273,6 +1326,12 @@ class SchedulerEngine:
             inputs = TickInputs(**combined, **shared)
         total = inputs.total.shape[0]
         want_scores = any(e.prev_has_scores for _, e, _, _ in pending)
+        record = self._tick_rec is not None
+        planes = 5 if record else (4 if want_scores else 3)
+        # Reason/score rows for the flight recorder, aligned with the
+        # concatenated decode order (same packed fetch, no extra reads).
+        rec_reasons: list[np.ndarray] = []
+        rec_scores: list[np.ndarray] = []
         decoded: list[ScheduleResult] = []
         cls = CompactInputs if fmt == "compact" else TickInputs
         for start in range(0, total, eff_chunk):
@@ -1301,21 +1360,28 @@ class SchedulerEngine:
             k = _pow2_bucket(n, 16, 1 << 30)
             idx = np.zeros(k, np.int32)
             idx[:n] = np.arange(n)
-            if want_scores:
+            if planes == 5:
+                packed_dev = self._gather5(
+                    out.selected, out.replicas, out.counted, out.scores,
+                    out.reasons, idx,
+                )
+            elif planes == 4:
                 packed_dev = self._gather(
                     out.selected, out.replicas, out.counted, out.scores, idx
                 )
-                planes = 4
             else:
                 packed_dev = self._gather3(
                     out.selected, out.replicas, out.counted, idx
                 )
-                planes = 3
             jax.block_until_ready(packed_dev)
             t2 = time.perf_counter()
             timings["device"] += t2 - t1
             packed = np.asarray(packed_dev)[:n]
             c_pad = packed.shape[1] // planes
+            sco = packed[:, 3 * c_pad : 4 * c_pad] if planes >= 4 else None
+            if planes == 5:
+                rec_reasons.append(packed[:, 4 * c_pad : 5 * c_pad])
+                rec_scores.append(sco)
             t3 = time.perf_counter()
             timings["fetch"] += t3 - t2
             decoded.extend(
@@ -1324,7 +1390,7 @@ class SchedulerEngine:
                     packed[:, c_pad : 2 * c_pad],
                     packed[:, 2 * c_pad : 3 * c_pad],
                     view.names,
-                    scores=packed[:, 3 * c_pad :] if planes == 4 else None,
+                    scores=sco if want_scores else None,
                 )
             )
             timings["decode"] += time.perf_counter() - t3
@@ -1332,13 +1398,24 @@ class SchedulerEngine:
 
         offset = 0
         t3 = time.perf_counter()
+        all_reasons = np.concatenate(rec_reasons) if rec_reasons else None
+        all_scores = np.concatenate(rec_scores) if rec_scores else None
         for slot, entry, changed_rows, _sub in pending:
             merged = list(entry.prev_results)
+            res_rows = []
             for j, row in enumerate(changed_rows):
                 res = decoded[offset + j]
                 if not entry.prev_has_scores:
                     res = ScheduleResult(res.clusters, {})
                 merged[row] = res
+                res_rows.append(res)
+            if all_reasons is not None:
+                span = slice(offset, offset + len(changed_rows))
+                self._record_decisions(
+                    entry, changed_rows, res_rows, all_reasons[span],
+                    all_scores[span] if all_scores is not None else None,
+                    view, program=f"{fmt}:sub",
+                )
             offset += len(changed_rows)
             entry.prev_results = merged
             entry.prev_view = view
@@ -1540,12 +1617,12 @@ class SchedulerEngine:
         # plane stacks — and only then run the blocking host reads, so
         # transfers overlap device execution instead of serializing.
         t0 = time.perf_counter()
+        record = self._tick_rec is not None
         by_planes: dict[int, list] = {}
         for slot, entry, out, idx in delta_items:
             self.fetch_stats["delta"] += 1
-            by_planes.setdefault(
-                4 if entry.prev_has_scores else 3, []
-            ).append((slot, entry, out, idx))
+            planes = 5 if record else (4 if entry.prev_has_scores else 3)
+            by_planes.setdefault(planes, []).append((slot, entry, out, idx))
         stacked_devs: dict[int, object] = {}
         for planes, group in by_planes.items():
             k_max = max(
@@ -1555,7 +1632,14 @@ class SchedulerEngine:
             for slot, entry, out, idx in group:
                 padded_idx = np.zeros(k_max, np.int32)
                 padded_idx[: idx.size] = idx
-                if planes == 4:
+                if planes == 5:
+                    devs.append(
+                        self._gather5(
+                            out.selected, out.replicas, out.counted,
+                            out.scores, out.reasons, padded_idx,
+                        )
+                    )
+                elif planes == 4:
                     devs.append(
                         self._gather(
                             out.selected, out.replicas, out.counted,
@@ -1569,6 +1653,7 @@ class SchedulerEngine:
                         )
                     )
             stacked_devs[planes] = devs[0] if len(devs) == 1 else self._stack(*devs)
+        want_score_plane = want_scores or record
         fstacks: list[tuple] = []
         fgroups: dict[tuple, list] = {}
         for slot, entry, out, n in full_items:
@@ -1580,7 +1665,8 @@ class SchedulerEngine:
                 g = group[0][2]
                 fstacks.append(
                     (group, g.selected, g.replicas, g.counted,
-                     g.scores if want_scores else None)
+                     g.scores if want_score_plane else None,
+                     g.reasons if record else None)
                 )
             else:
                 fstacks.append(
@@ -1590,7 +1676,10 @@ class SchedulerEngine:
                         self._stack(*[g[2].replicas for g in group]),
                         self._stack(*[g[2].counted for g in group]),
                         self._stack(*[g[2].scores for g in group])
-                        if want_scores
+                        if want_score_plane
+                        else None,
+                        self._stack(*[g[2].reasons for g in group])
+                        if record
                         else None,
                     )
                 )
@@ -1602,8 +1691,9 @@ class SchedulerEngine:
                 np.asarray(rep),
                 np.asarray(cnt),
                 np.asarray(sco) if sco is not None else None,
+                np.asarray(rsn) if rsn is not None else None,
             )
-            for group, sel, rep, cnt, sco in fstacks
+            for group, sel, rep, cnt, sco, rsn in fstacks
         ]
         timings["fetch"] += time.perf_counter() - t0
 
@@ -1615,11 +1705,11 @@ class SchedulerEngine:
             for i, (slot, entry, out, idx) in enumerate(group):
                 merged, idx_rows = self._apply_delta(
                     entry, out, idx, arr if single else arr[i], planes,
-                    view.names, view,
+                    view.names, view, has_scores=entry.prev_has_scores,
                 )
                 chunk_results[slot] = merged
                 chunk_changed[slot] = idx_rows
-        for group, sel, rep, cnt, sco in full_np:
+        for group, sel, rep, cnt, sco, rsn in full_np:
             single = len(group) == 1
             for i, (slot, entry, out, n) in enumerate(group):
                 results = self._apply_full(
@@ -1629,6 +1719,7 @@ class SchedulerEngine:
                     cnt if single else cnt[i],
                     (sco if single else sco[i]) if sco is not None else None,
                     n, view.names, want_scores, view,
+                    reasons=(rsn if single else rsn[i]) if rsn is not None else None,
                 )
                 chunk_results[slot] = results
                 chunk_changed[slot] = None
@@ -1667,24 +1758,57 @@ class SchedulerEngine:
         entry.stale_out_rows = None
         entry.prev_view = view
 
+    def _record_decisions(
+        self, entry, rows, results_rows, reasons_rows, scores_rows, view,
+        program: str,
+    ) -> None:
+        """Feed the flight recorder from already-fetched host arrays —
+        zero extra device->host traffic.  ``rows`` are LOCAL chunk row
+        indices; entry.units maps them to object keys.  No-op without a
+        recorder or a cache entry (webhook/nocache ticks carry no unit
+        list)."""
+        rec = self._tick_rec
+        if rec is None or entry is None or reasons_rows is None:
+            return
+        units = entry.units
+        rec.record_rows(
+            [units[r].key for r in rows],
+            [res.clusters for res in results_rows],
+            reasons_rows,
+            scores_rows,
+            view.names,
+            program=program,
+        )
+
     def _apply_delta(
-        self, entry, out, idx, packed: np.ndarray, planes: int, names, view
+        self, entry, out, idx, packed: np.ndarray, planes: int, names, view,
+        has_scores: bool,
     ):
         """Decode the gathered rows, merge into the cached decode, and
-        record the fresh outputs; returns (merged, changed-rows)."""
+        record the fresh outputs; returns (merged, changed-rows).
+        ``planes`` is the packed layout width (3 = sel/rep/cnt, 4 =
+        +scores, 5 = +scores+reasons for the flight recorder);
+        ``has_scores`` says whether the cached decode carries score
+        dicts (scores may be fetched for the recorder alone)."""
         packed = packed[: idx.size]
         c_pad = packed.shape[1] // planes
+        sco = packed[:, 3 * c_pad : 4 * c_pad] if planes >= 4 else None
+        rsn = packed[:, 4 * c_pad : 5 * c_pad] if planes >= 5 else None
         changed_results = self._decode_rows(
             packed[:, :c_pad],
             packed[:, c_pad : 2 * c_pad],
             packed[:, 2 * c_pad : 3 * c_pad],
             names,
-            scores=packed[:, 3 * c_pad :] if planes == 4 else None,
+            scores=sco if has_scores else None,
         )
         idx_rows = idx.tolist()
         merged = list(entry.prev_results)
         for row, res in zip(idx_rows, changed_results):
             merged[row] = res
+        self._record_decisions(
+            entry, idx_rows, changed_results, rsn, sco, view,
+            program=f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}",
+        )
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
         entry.stale_out_rows = None
         entry.prev_results = merged
@@ -1693,12 +1817,23 @@ class SchedulerEngine:
 
     def _apply_full(
         self, entry, out, selected, replicas, counted, scores, n: int,
-        names, want_scores: bool, view,
+        names, want_scores: bool, view, reasons=None,
     ) -> list[ScheduleResult]:
         self.fetch_stats["full"] += 1
         results = self._decode_rows(
             selected[:n], replicas[:n], counted[:n], names,
+            scores[:n] if (scores is not None and want_scores) else None,
+        )
+        self._record_decisions(
+            entry, range(n), results,
+            reasons[:n] if reasons is not None else None,
             scores[:n] if scores is not None else None,
+            view,
+            program=(
+                f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}"
+                if entry is not None
+                else ""
+            ),
         )
         if entry is not None:
             # ALWAYS store the fresh outputs (including on want_scores
@@ -1739,7 +1874,13 @@ class SchedulerEngine:
                 k = _pow2_bucket(idx.size, 16, 1 << 30)
                 padded_idx = np.zeros(k, np.int32)
                 padded_idx[: idx.size] = idx
-                if entry.prev_has_scores:
+                if self._tick_rec is not None and entry is not None:
+                    packed_dev = self._gather5(
+                        out.selected, out.replicas, out.counted,
+                        out.scores, out.reasons, padded_idx,
+                    )
+                    planes = 5
+                elif entry.prev_has_scores:
                     packed_dev = self._gather(
                         out.selected, out.replicas, out.counted,
                         out.scores, padded_idx,
@@ -1754,21 +1895,24 @@ class SchedulerEngine:
                 t3 = time.perf_counter()
                 timings["fetch"] += t3 - t2
                 merged, idx_rows = self._apply_delta(
-                    entry, out, idx, packed, planes, names, view
+                    entry, out, idx, packed, planes, names, view,
+                    has_scores=entry.prev_has_scores,
                 )
                 timings["decode"] += time.perf_counter() - t3
                 return merged, idx_rows
             # fall through to a full fetch for mass changes
 
+        record = self._tick_rec is not None and entry is not None
         selected = np.asarray(out.selected)
         replicas = np.asarray(out.replicas)
         counted = np.asarray(out.counted)
-        scores = np.asarray(out.scores) if want_scores else None
+        scores = np.asarray(out.scores) if (want_scores or record) else None
+        reasons = np.asarray(out.reasons) if record else None
         t3 = time.perf_counter()
         timings["fetch"] += t3 - t2
         results = self._apply_full(
             entry, out, selected, replicas, counted, scores, n, names,
-            want_scores, view,
+            want_scores, view, reasons=reasons,
         )
         timings["decode"] += time.perf_counter() - t3
         return results, None
@@ -1887,6 +2031,12 @@ class SchedulerEngine:
                     )
                     jax.block_until_ready(
                         self._gather3(out.selected, out.replicas, out.counted, idx)
+                    )
+                    jax.block_until_ready(
+                        self._gather5(
+                            out.selected, out.replicas, out.counted,
+                            out.scores, out.reasons, idx,
+                        )
                     )
                     log.info("prewarmed tick program %s", shape)
             except Exception:

@@ -1,0 +1,229 @@
+"""End-to-end decision audit (ISSUE 2 acceptance): after a
+membersim-driven reconcile round, /debug/explain returns a populated
+record whose chosen clusters match the dispatched placement and
+/debug/drift is empty; mutating one member object then reports drift.
+Plus: scheduling events on the source object, /debug/decisions, and the
+eventsink concurrent count-bump regression."""
+
+import dataclasses
+import json
+import threading
+import urllib.request
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    NODES,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.monitor import MonitorController
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.runtime import trace
+from kubeadmiral_tpu.runtime.eventsink import EVENTS, EventRecorder
+from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet, FakeKube
+from kubeadmiral_tpu.testing.membersim import DEPLOYMENTS, MemberDeploymentSimulator
+
+from test_e2e_slice import make_deployment, make_node
+
+
+def fetch(port, path):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class TestDecisionAuditEndToEnd:
+    def setup_method(self):
+        trace.get_default().clear()
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        self.ftc = dataclasses.replace(
+            ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
+        )
+        self.fleet = ClusterFleet()
+        self.metrics = Metrics()
+        self.flightrec = FlightRecorder(max_ticks=8, max_bytes=64 << 20)
+        gvk = "apps/v1/Deployment"
+        self.clusterctl = FederatedClusterController(
+            self.fleet, api_resource_probe=[gvk], metrics=self.metrics
+        )
+        self.federate = FederateController(
+            self.fleet.host, self.ftc, metrics=self.metrics
+        )
+        engine = SchedulerEngine(flight_recorder=self.flightrec)
+        self.scheduler = SchedulerController(
+            self.fleet.host, self.ftc, engine=engine, metrics=self.metrics
+        )
+        self.scheduler.engine.metrics = self.metrics
+        self.sync = SyncController(self.fleet, self.ftc, metrics=self.metrics)
+        self.monitor = MonitorController(
+            self.fleet.host, self.ftc, metrics=self.metrics, interval=0.0,
+            fleet=self.fleet, flight_recorder=self.flightrec,
+        )
+        self.sim = MemberDeploymentSimulator(self.fleet)
+        for name in ("c1", "c2", "c3"):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", "64", "128Gi"))
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                },
+            )
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {"schedulingMode": "Divide"},
+            },
+        )
+
+    def reconcile_round(self, max_rounds=60):
+        controllers = (
+            self.clusterctl, self.federate, self.scheduler, self.sync,
+            self.monitor,
+        )
+        for _ in range(max_rounds):
+            progressed = False
+            for c in controllers:
+                progressed |= c.worker.step()
+            progressed |= self.sim.step()
+            if not progressed:
+                return
+
+    def test_explain_matches_dispatch_then_drift_on_mutation(self):
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        self.reconcile_round()
+        fed = self.fleet.host.get(self.ftc.federated.resource, "default/web")
+        placed = C.get_placement(fed, C.SCHEDULER)
+        assert placed == {"c1", "c2", "c3"}
+
+        # The flight recorder holds the decision for the scheduled key;
+        # its chosen clusters match the persisted/dispatched placement.
+        registry = HealthCheckRegistry()
+        server = HealthServer(
+            registry, metrics=self.metrics, flightrec=self.flightrec,
+            drift=None,
+        )
+        port = server.start()
+        try:
+            status, body = fetch(port, "/debug/explain?key=default/web")
+            assert status == 200, body
+            doc = json.loads(body)
+            assert set(doc["placements"]) == placed
+            # Divide mode: the replica split is recorded per cluster.
+            assert sum(doc["placements"].values()) == 9
+            for name, verdict in doc["clusters"].items():
+                assert (verdict["reasons"] == []) == (name in placed)
+            # And each member actually holds its dispatched object.
+            for name in placed:
+                member_obj = self.fleet.members[name].try_get(
+                    DEPLOYMENTS, "default/web"
+                )
+                assert member_obj is not None
+                assert member_obj["spec"]["replicas"] == doc["placements"][name]
+
+            # /debug/decisions shows the recording tick.
+            status, body = fetch(port, "/debug/decisions")
+            assert status == 200
+            decisions = json.loads(body)
+            assert decisions["records"] >= 1
+            assert any(t["recorded_rows"] >= 1 for t in decisions["ticks"])
+
+            # Unknown keys 404.
+            status, _ = fetch(port, "/debug/explain?key=default/nope")
+            assert status == 404
+            status, _ = fetch(port, "/debug/explain")
+            assert status == 400
+
+            # Converged state: no drift (the monitor registered itself
+            # as the drift provider at construction).
+            self.monitor._report()
+            status, body = fetch(port, "/debug/drift")
+            assert status == 200
+            drift = json.loads(body)
+            assert f"monitor-{self.ftc.name}" in drift["providers"]
+            assert drift["drifted_total"] == 0, drift
+            series = self.metrics.stores.get(
+                "placement_drift_objects{ftc=deployments.apps,kind=missing}"
+            )
+            assert series == 0
+
+            # Mutate ONE member object: drift must be reported.
+            self.fleet.members["c1"].delete(DEPLOYMENTS, "default/web")
+            self.monitor._report()
+            status, body = fetch(port, "/debug/drift")
+            drift = json.loads(body)
+            assert drift["drifted_total"] == 1, drift
+            entry = drift["drifted"][0]
+            assert entry == {
+                "key": "default/web", "cluster": "c1", "kind": "missing",
+                "detail": "desired placement not present in member",
+            }
+            assert self.metrics.stores[
+                "placement_drift_objects{ftc=deployments.apps,kind=missing}"
+            ] == 1
+        finally:
+            server.stop()
+
+    def test_scheduled_event_reaches_source_object(self):
+        self.fleet.host.create(self.ftc.source.resource, make_deployment())
+        self.reconcile_round()
+        events = list(self.fleet.host.list(EVENTS))
+        scheduled = [e for e in events if e.get("reason") == "Scheduled"]
+        assert scheduled, [e.get("reason") for e in events]
+        # The defederating mux records on the federated object AND the
+        # de-federated source, so `kubectl describe deployment` shows it.
+        kinds = {e["involvedObject"]["kind"] for e in scheduled}
+        assert "Deployment" in kinds, kinds
+        msg = scheduled[0]["message"]
+        assert "scheduled to 3 cluster(s)" in msg
+        for cl in ("c1", "c2", "c3"):
+            assert cl in msg
+
+
+class TestEventSinkConcurrency:
+    def test_concurrent_count_bumps_are_not_dropped(self):
+        """Regression: the Conflict path used to drop the bump; with the
+        bounded retry loop N concurrent recorders produce an exact
+        count."""
+        host = FakeKube("host")
+        obj = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+        }
+        recorders = [EventRecorder(host, f"worker-{i}") for i in range(8)]
+        per_thread = 25
+        barrier = threading.Barrier(len(recorders))
+
+        def hammer(rec):
+            barrier.wait()
+            for _ in range(per_thread):
+                rec.event(obj, "Normal", "Scheduled", "same message")
+
+        threads = [
+            threading.Thread(target=hammer, args=(rec,)) for rec in recorders
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = list(host.list(EVENTS))
+        assert len(events) == 1
+        assert events[0]["count"] == len(recorders) * per_thread

@@ -1,0 +1,221 @@
+"""Decision-audit differential tests: the reason bitmask carried by
+TickOutputs.reasons must agree BIT-EXACTLY with the sequential oracle's
+per-filter rejection reasons (ops/pipeline_oracle.explain_one) on
+seeded rounds, and the flight recorder must explain every infeasible
+(object, cluster) pair."""
+
+import numpy as np
+import pytest
+
+from test_pipeline import R, random_problem, to_tick_inputs
+
+from kubeadmiral_tpu.ops import pipeline as dev
+from kubeadmiral_tpu.ops import reasons as RSN
+from kubeadmiral_tpu.ops.pipeline_oracle import explain_one, schedule_one
+
+
+def seeded_problems(seed, c, n=80):
+    rng = np.random.default_rng(seed)
+    names = [f"member-{j}" for j in range(c)]
+    shared_alloc = [[int(x) for x in rng.integers(5, 50, R)] for _ in range(c)]
+    shared_used = [[int(x) for x in rng.integers(0, 40, R)] for _ in range(c)]
+    shared_cpu_a = [int(x) for x in rng.integers(0, 30, c)]
+    shared_cpu_v = [int(x) for x in rng.integers(-3, 25, c)]
+    problems = []
+    for i in range(n):
+        p = random_problem(rng, c, f"ns-{i}/workload-{i}", names)
+        p.alloc, p.used = shared_alloc, shared_used
+        p.cpu_alloc, p.cpu_avail = shared_cpu_a, shared_cpu_v
+        problems.append(p)
+    return problems
+
+
+@pytest.mark.parametrize("c", [3, 8, 19])
+def test_reasons_match_oracle_bit_exactly(c):
+    problems = seeded_problems(1000 + c, c)
+    out = dev.schedule_tick(to_tick_inputs(problems, c))
+    reasons = np.asarray(out.reasons)
+    selected = np.asarray(out.selected)
+
+    for i, p in enumerate(problems):
+        want = explain_one(p)
+        got = reasons[i].tolist()
+        assert got == want, (
+            f"case {i}: reasons {got} != oracle {want}\n{p}\n"
+            f"selected={selected[i].tolist()}"
+        )
+        # The invariant the flight recorder serves: mask 0 exactly on
+        # the selected clusters.
+        for j in range(c):
+            assert (got[j] == 0) == bool(selected[i, j]), (i, j, got[j])
+
+
+@pytest.mark.parametrize("c", [3, 8, 19])
+def test_every_infeasible_pair_names_its_rejector(c):
+    """Acceptance: for every infeasible (object, cluster) pair the mask
+    names the rejecting filter (or the select-stage cut), and the slugs
+    stay inside the cataloged vocabulary."""
+    problems = seeded_problems(2000 + c, c)
+    out = dev.schedule_tick(to_tick_inputs(problems, c))
+    reasons = np.asarray(out.reasons)
+    feasible = np.asarray(out.feasible)
+
+    for i, p in enumerate(problems):
+        placed = set(schedule_one(p))
+        for j in range(c):
+            mask = int(reasons[i, j])
+            slugs = RSN.describe(mask)
+            if j in placed:
+                assert mask == 0, (i, j, slugs)
+                continue
+            assert slugs, f"case {i} cluster {j}: unexplained rejection"
+            assert set(slugs) <= set(RSN.REASON_NAMES.values())
+            if not feasible[i, j] and not (mask & RSN.REASON_STICKY):
+                # Infeasible pairs must carry a FILTER-stage reason.
+                assert mask & RSN.FILTER_REASON_MASK, (i, j, slugs)
+
+
+def test_reasons_cover_select_and_replica_cuts():
+    """Deterministic corner pins: maxClusters cut, zero-replica drop,
+    sticky short-circuit, per-plugin filter bits."""
+    c = 4
+    names = [f"m-{j}" for j in range(c)]
+
+    def base(**kw):
+        p = random_problem(np.random.default_rng(0), c, "ns/base", names)
+        p.filter_enabled = [True] * 5
+        p.score_enabled = [False] * 5
+        p.api_ok = [True] * c
+        p.taint_ok_new = [True] * c
+        p.taint_ok_cur = [True] * c
+        p.selector_ok = [True] * c
+        p.placement_ok = [True] * c
+        p.placement_has = False
+        p.request = [0] * R
+        p.taint_counts = [0] * c
+        p.affinity_scores = [0] * c
+        p.max_clusters = None
+        p.mode_divide = False
+        p.sticky = False
+        p.current = {}
+        p.total = 8
+        p.weights = {j: 1 for j in range(c)}
+        p.min_replicas = {}
+        p.max_replicas = {}
+        p.capacity = {}
+        for k, v in kw.items():
+            setattr(p, k, v)
+        return p
+
+    cases = [
+        # maxClusters=2: two feasible clusters cut by rank.
+        base(max_clusters=2),
+        # api filter rejects cluster 1.
+        base(api_ok=[True, False, True, True]),
+        # sticky with current on cluster 0 only.
+        base(sticky=True, current={0: 3}, mode_divide=True),
+        # Divide with zero total: every selection planner-zeroed.
+        base(mode_divide=True, total=0, weights={j: 1 for j in range(c)}),
+    ]
+    out = dev.schedule_tick(to_tick_inputs(cases, c))
+    reasons = np.asarray(out.reasons)
+
+    # maxClusters cut: 2 selected, 2 cut with the max_clusters bit.
+    cut = [j for j in range(c) if reasons[0, j] & RSN.REASON_MAX_CLUSTERS]
+    assert len(cut) == 2
+    # api rejection names the plugin.
+    assert reasons[1, 1] & RSN.REASON_API_RESOURCES
+    assert reasons[1, 0] == 0
+    # sticky: current cluster clean, everything else cut by stickiness.
+    assert reasons[2, 0] == 0
+    for j in range(1, c):
+        assert reasons[2, j] & RSN.REASON_STICKY
+    # zero-replica drop.
+    assert all(
+        reasons[3, j] & RSN.REASON_ZERO_REPLICAS for j in range(c)
+    ), reasons[3]
+    # All four agree with the oracle bit-exactly.
+    for i, p in enumerate(cases):
+        assert reasons[i].tolist() == explain_one(p), i
+
+
+class TestEngineFlightRecorder:
+    """Engine-level: records are populated from the existing fetch paths
+    and explain() answers for every scheduled object."""
+
+    def _schedule(self, n_units=40, n_clusters=12, seed=7):
+        from test_engine_vs_sequential import random_cluster, random_unit
+
+        from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+        rng = np.random.default_rng(seed)
+        clusters = [random_cluster(rng, j) for j in range(n_clusters)]
+        names = [cl.name for cl in clusters]
+        units = [random_unit(rng, i, names) for i in range(n_units)]
+        rec = FlightRecorder(max_ticks=4, max_bytes=64 << 20, topk=4)
+        engine = SchedulerEngine(
+            chunk_size=16, min_bucket=8, min_cluster_bucket=8, mesh=None,
+            flight_recorder=rec,
+        )
+        results = engine.schedule(units, clusters)
+        return engine, rec, units, clusters, results
+
+    def test_cold_tick_records_every_object(self):
+        engine, rec, units, clusters, results = self._schedule()
+        for su, res in zip(units, results):
+            record = rec.lookup(su.key)
+            assert record is not None, su.key
+            explained = rec.explain(su.key)
+            assert explained["placements"] == {
+                cl: (None if reps is None else int(reps))
+                for cl, reps in res.clusters.items()
+            }
+            # Every non-selected cluster names its rejection.
+            for name, verdict in explained["clusters"].items():
+                if name in res.clusters:
+                    assert verdict["reasons"] == []
+                else:
+                    assert verdict["reasons"], (su.key, name, verdict)
+
+    def test_churn_rows_get_fresh_records(self):
+        from test_engine_vs_sequential import random_unit
+
+        engine, rec, units, clusters, _ = self._schedule()
+        names = [cl.name for cl in clusters]
+        rng = np.random.default_rng(99)
+        units2 = list(units)
+        units2[5] = random_unit(rng, 500, names)
+        results2 = engine.schedule(units2, clusters)
+        record = rec.lookup(units2[5].key)
+        assert record is not None
+        assert rec.explain(units2[5].key)["placements"] == {
+            cl: (None if reps is None else int(reps))
+            for cl, reps in results2[5].clusters.items()
+        }
+
+    def test_ring_eviction_is_bounded(self):
+        from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+
+        rec = FlightRecorder(max_ticks=2, max_bytes=1 << 30, topk=2)
+        names = ("a", "b")
+        for tick in range(5):
+            rec.begin_tick(1, 2)
+            rec.record_rows(
+                [f"ns/obj-{tick}"], [{"a": None}],
+                np.zeros((1, 2), np.int32), None, names,
+            )
+            rec.end_tick()
+        stats = rec.stats()
+        assert stats["ring_ticks"] == 2
+        assert rec.lookup("ns/obj-4") is not None
+        assert rec.lookup("ns/obj-0") is None  # evicted with its tick
+
+    def test_disabled_recorder_records_nothing(self):
+        from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+
+        rec = FlightRecorder(enabled=False)
+        rec.begin_tick(1, 1)
+        rec.record_rows(["k"], [{}], np.zeros((1, 1), np.int32), None, ("a",))
+        rec.end_tick()
+        assert rec.stats()["records"] == 0

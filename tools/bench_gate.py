@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""bench-gate: fail when the latest BENCH artifact regresses.
+
+Compares the newest ``BENCH_r*.json`` round against the best prior
+round of the SAME metric and platform (a TPU number is never judged
+against a cpu-fallback number):
+
+* throughput (``parsed.value``, objects/s) must be at least
+  ``(1 - tolerance) * best prior``;
+* steady-state tick latency (``parsed.detail.tick_ms``) must be at most
+  ``(1 + tolerance) * best prior`` (checked only when both rounds
+  report it).
+
+Rounds that failed to run (``rc != 0`` or no parsed value) are skipped;
+with no comparable prior round the gate passes trivially.
+
+Run as ``make bench-gate``.  Tolerance defaults to 10%; override with
+``--tolerance`` or ``KT_BENCH_GATE_TOL`` (fraction, e.g. ``0.25``).
+For an INTENTIONAL regression (e.g. trading throughput for a required
+feature), run with ``KT_BENCH_GATE_TOL`` raised for that invocation and
+record the rationale in the BENCH artifact/PR — the next round then
+gates against the new best, not the pre-regression one.
+
+Exit status: 0 pass, 1 regression, 2 malformed artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+_ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def load_rounds(root: Path) -> list[dict]:
+    """[{round, path, metric, platform, value, tick_ms}], skipping
+    failed/unparseable rounds (with a note)."""
+    rounds = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        m = _ROUND_RE.match(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench-gate: {path.name}: unreadable ({e})", file=sys.stderr)
+            raise SystemExit(2)
+        parsed = doc.get("parsed") or {}
+        value = parsed.get("value")
+        if doc.get("rc", 0) != 0 or value is None:
+            print(f"bench-gate: skipping {path.name} (failed or no value)")
+            continue
+        detail = parsed.get("detail") or {}
+        rounds.append(
+            {
+                "round": int(m.group(1)),
+                "path": path.name,
+                "metric": parsed.get("metric", ""),
+                "platform": detail.get("platform") or "unknown",
+                "value": float(value),
+                "tick_ms": detail.get("tick_ms"),
+            }
+        )
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def gate(rounds: list[dict], tolerance: float) -> int:
+    if not rounds:
+        print("bench-gate: no BENCH_r*.json artifacts; trivially ok")
+        return 0
+    latest = rounds[-1]
+    priors = [
+        r
+        for r in rounds[:-1]
+        if r["metric"] == latest["metric"]
+        and r["platform"] == latest["platform"]
+    ]
+    if not priors:
+        print(
+            f"bench-gate: {latest['path']} "
+            f"({latest['metric']}, platform={latest['platform']}) has no "
+            f"comparable prior round; trivially ok"
+        )
+        return 0
+    best_value = max(r["value"] for r in priors)
+    floor = best_value * (1.0 - tolerance)
+    ok = True
+    print(
+        f"bench-gate: {latest['path']} value={latest['value']:.1f} vs best "
+        f"prior {best_value:.1f} (floor {floor:.1f}, tol {tolerance:.0%})"
+    )
+    if latest["value"] < floor:
+        print(
+            f"bench-gate: THROUGHPUT REGRESSION: {latest['value']:.1f} < "
+            f"{floor:.1f} — raise KT_BENCH_GATE_TOL only for an "
+            f"intentional, documented regression",
+            file=sys.stderr,
+        )
+        ok = False
+    prior_ticks = [r["tick_ms"] for r in priors if r["tick_ms"] is not None]
+    if latest["tick_ms"] is not None and prior_ticks:
+        best_tick = min(prior_ticks)
+        ceil = best_tick * (1.0 + tolerance)
+        print(
+            f"bench-gate: tick_ms={latest['tick_ms']:.1f} vs best prior "
+            f"{best_tick:.1f} (ceiling {ceil:.1f})"
+        )
+        if latest["tick_ms"] > ceil:
+            print(
+                f"bench-gate: LATENCY REGRESSION: {latest['tick_ms']:.1f}ms "
+                f"> {ceil:.1f}ms",
+                file=sys.stderr,
+            )
+            ok = False
+    print("bench-gate: ok" if ok else "bench-gate: FAILED")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--tolerance",
+        type=float,
+        default=float(os.environ.get("KT_BENCH_GATE_TOL", "0.10")),
+        help="allowed fractional regression (default 0.10 or "
+        "$KT_BENCH_GATE_TOL)",
+    )
+    parser.add_argument(
+        "--root", type=Path, default=REPO, help="artifact directory"
+    )
+    args = parser.parse_args()
+    return gate(load_rounds(args.root), args.tolerance)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,6 +10,17 @@ against ``kubeadmiral_tpu.runtime.metric_catalog``.  Run as
 name must be cataloged (and thereby documented in
 docs/observability.md) before it can merge.
 
+The same walk keeps the decision vocabulary cataloged:
+
+* ``.event(obj, type, reason, message)`` calls — literal event reasons
+  must be in ``metric_catalog.EVENT_REASONS``;
+* the flight recorder's record schema
+  (``runtime.flightrec.DecisionRecord``) must equal
+  ``metric_catalog.FLIGHT_RECORDER_FIELDS``;
+* the reason-slug set (``ops.reasons.REASON_NAMES``) must equal
+  ``metric_catalog.DECISION_REASONS`` — so the strings /debug/explain
+  serves (and events embed) never drift from docs/observability.md.
+
 Exit status: 0 clean, 1 violations (listed one per line), 2 on a file
 that fails to parse.
 """
@@ -23,7 +34,12 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from kubeadmiral_tpu.runtime.metric_catalog import is_cataloged  # noqa: E402
+from kubeadmiral_tpu.runtime.metric_catalog import (  # noqa: E402
+    DECISION_REASONS,
+    EVENT_REASONS,
+    FLIGHT_RECORDER_FIELDS,
+    is_cataloged,
+)
 
 EMITTERS = {"counter", "rate", "store", "gauge", "duration", "histogram", "timer"}
 
@@ -70,7 +86,27 @@ def lint_file(path: Path) -> list[str]:
         if not isinstance(node, ast.Call):
             continue
         func = node.func
-        if not (isinstance(func, ast.Attribute) and func.attr in EMITTERS):
+        if not isinstance(func, ast.Attribute):
+            continue
+        # Event-reason vocabulary: .event(obj, type, reason, message) on
+        # any recorder-shaped receiver.  Only literal reasons are
+        # checkable (the eventsink's own forwarding call passes a
+        # variable and is skipped).
+        if func.attr == "event" and len(node.args) >= 4:
+            reason_node = node.args[2]
+            if (
+                isinstance(reason_node, ast.Constant)
+                and isinstance(reason_node.value, str)
+                and reason_node.value not in EVENT_REASONS
+            ):
+                errors.append(
+                    f"{rel}:{node.lineno}: event reason "
+                    f"{reason_node.value!r} is not in "
+                    f"runtime/metric_catalog.py EVENT_REASONS — catalog it "
+                    f"(and document it in docs/observability.md) first"
+                )
+            continue
+        if func.attr not in EMITTERS:
             continue
         if not _is_metrics_receiver(func.value):
             continue
@@ -93,8 +129,39 @@ def lint_file(path: Path) -> list[str]:
     return errors
 
 
-def main() -> int:
+def lint_decision_vocabulary() -> list[str]:
+    """Cross-check the flight recorder's schema and reason slugs against
+    the catalog (both directions), without importing jax-heavy modules'
+    behavior — plain attribute reads."""
     errors: list[str] = []
+    from kubeadmiral_tpu.ops import reasons as RSN
+    from kubeadmiral_tpu.runtime.flightrec import DecisionRecord
+
+    slugs = set(RSN.REASON_NAMES.values())
+    for missing in sorted(slugs - DECISION_REASONS):
+        errors.append(
+            f"ops/reasons.py: reason slug {missing!r} is not in "
+            f"runtime/metric_catalog.py DECISION_REASONS — catalog it (and "
+            f"document it in docs/observability.md) first"
+        )
+    for stale in sorted(DECISION_REASONS - slugs):
+        errors.append(
+            f"runtime/metric_catalog.py: DECISION_REASONS entry {stale!r} "
+            f"has no ops/reasons.py bit — remove it or add the bit"
+        )
+    fields = tuple(DecisionRecord.__slots__)
+    if fields != FLIGHT_RECORDER_FIELDS:
+        errors.append(
+            f"runtime/flightrec.py: DecisionRecord fields {fields} != "
+            f"catalog FLIGHT_RECORDER_FIELDS {FLIGHT_RECORDER_FIELDS} — "
+            f"update the catalog (and docs/observability.md) with the "
+            f"record schema"
+        )
+    return errors
+
+
+def main() -> int:
+    errors: list[str] = list(lint_decision_vocabulary())
     for root in SCAN_ROOTS:
         path = REPO / root
         files = sorted(path.rglob("*.py")) if path.is_dir() else [path]
