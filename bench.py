@@ -257,6 +257,7 @@ def time_batched(rng, units, clusters, followers):
     # against current cluster state), exercised through the incremental
     # patch + on-device delta-fetch machinery.
     detail = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
+    fetch_bytes0 = engine.fetch_bytes_total
     t0 = time.perf_counter()
     for _ in range(TICKS):
         units = churn(rng, units)
@@ -264,6 +265,7 @@ def time_batched(rng, units, clusters, followers):
         for stage, secs in engine.timings.items():
             detail[stage] = detail.get(stage, 0.0) + secs
     dt = (time.perf_counter() - t0) / TICKS
+    tick_fetch_bytes = (engine.fetch_bytes_total - fetch_bytes0) / TICKS
     placed = sum(1 for r in results if r.clusters)
 
     # Drift tick: one cluster's resources changed — every row must be
@@ -285,6 +287,13 @@ def time_batched(rng, units, clusters, followers):
     detail["prewarm_s"] = round(prewarm_s, 1)
     detail["featurize_cold_ms"] = cold_featurize_ms
     detail["noop_tick_ms"] = round(noop_ms, 1)
+    # Fetch wire telemetry (ISSUE 3): the per-timed-tick transfer volume
+    # the packed export exists to shrink, plus the format and the
+    # K-overflow fallback count for the whole run.
+    detail["fetch_format"] = engine.fetch_format
+    detail["fetch_bytes"] = round(tick_fetch_bytes)
+    detail["fetch_bytes_run_total"] = engine.fetch_bytes_total
+    detail["fetch_overflow_rows"] = engine.overflow_rows_total
     detail["cache"] = dict(engine.cache_stats)
     detail["fetch_paths"] = dict(engine.fetch_stats)
     detail["program_shapes"] = sorted(map(list, engine.program_shapes))
@@ -460,6 +469,10 @@ def main():
     )
 
     telemetry = detail.pop("telemetry", None)
+    fetch_format = detail.pop("fetch_format", None)
+    fetch_bytes = detail.pop("fetch_bytes", None)
+    fetch_bytes_run = detail.pop("fetch_bytes_run_total", None)
+    fetch_overflow = detail.pop("fetch_overflow_rows", None)
     result = {
         "metric": f"objects_scheduled_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
         "value": round(batched_rate, 1),
@@ -469,6 +482,10 @@ def main():
             "config": CONFIG,
             **bench_platform_detail(),
             "tick_ms": round(tick_seconds * 1e3, 1),
+            "fetch_format": fetch_format,
+            "fetch_bytes": fetch_bytes,
+            "fetch_bytes_run_total": fetch_bytes_run,
+            "fetch_overflow_rows": fetch_overflow,
             "stage_ms": detail,
             "telemetry": telemetry,
             "baseline": "native-seqsched(g++ -O3)"

@@ -40,12 +40,19 @@ _jax.config.update("jax_enable_x64", True)
 # time grows steeply with the cluster axis (26s at C=512, ~2min at
 # C=1024 on the tunneled backend) while the compiled program is
 # millisecond-fast; the on-disk cache makes that a one-time cost per
-# shape per machine.  JAX reads JAX_COMPILATION_CACHE_DIR natively and
-# an explicit app/env setting wins — only the unset default is filled.
+# shape per machine.  Precedence: KT_COMPILE_CACHE_DIR (this control
+# plane's knob; empty/"0" disables), then JAX's native
+# JAX_COMPILATION_CACHE_DIR / app setting, then the profile-dir default.
+# The engine reports per-trace hit/miss as
+# engine_persistent_cache_total{result} (docs/observability.md).
 try:
-    if _jax.config.jax_compilation_cache_dir is None:
-        import os as _os
+    import os as _os
 
+    _kt_dir = _os.environ.get("KT_COMPILE_CACHE_DIR")
+    if _kt_dir is not None:
+        if _kt_dir not in ("", "0"):
+            _jax.config.update("jax_compilation_cache_dir", _kt_dir)
+    elif _jax.config.jax_compilation_cache_dir is None:
         _jax.config.update(
             "jax_compilation_cache_dir",
             _os.path.expanduser("~/.cache/kubeadmiral_tpu/xla-cache"),

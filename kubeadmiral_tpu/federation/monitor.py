@@ -39,6 +39,8 @@ import time
 from collections import Counter
 from typing import Optional
 
+import numpy as np
+
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.runtime import flightrec as FR
@@ -212,7 +214,15 @@ class MonitorController:
     def _detect_drift(self) -> None:
         """Diff the scheduler's desired placements against observed
         member state; gauges per drift kind + a bounded listing for
-        GET /debug/drift."""
+        GET /debug/drift.
+
+        Vectorized over (object, member) incidence matrices: one host
+        scan collects desired placements, one bulk key listing per
+        member builds the observed matrix (np.isin), and missing/orphan
+        drift falls out of boolean plane arithmetic — no per-(object,
+        member) Python loop.  Only replicas checks (bounded by the
+        override count, not N x M) and the flight-recorder cross-check
+        (a dict lookup per object) stay per-object."""
         if self.fleet is None:
             return
         source = self.ftc.source.resource
@@ -221,9 +231,10 @@ class MonitorController:
             "/" + replicas_path.replace(".", "/") if replicas_path else None
         )
         members = dict(self.fleet.members)
+        member_names = list(members)
+        col = {name: j for j, name in enumerate(member_names)}
         counts: Counter = Counter()
         drifted: list[dict] = []
-        checked = 0
 
         def note(kind: str, key: str, cluster: str, detail: str) -> None:
             counts[kind] += 1
@@ -233,16 +244,20 @@ class MonitorController:
                      "detail": detail}
                 )
 
+        keys: list[str] = []
+        desired_sets: list[set] = []
+        overrides: list[tuple[int, str, int]] = []  # (row, cluster, want)
+
         def visit(fed: dict) -> None:
-            nonlocal checked
             meta = fed.get("metadata", {})
             ns = meta.get("namespace", "")
             key = f"{ns}/{meta.get('name', '')}".lstrip("/")
             desired = C.get_placement(fed, C.SCHEDULER)
             if desired is None:
                 return  # never scheduled: nothing to drift against
-            checked += 1
-            want_reps: dict[str, int] = {}
+            row = len(keys)
+            keys.append(key)
+            desired_sets.append(desired)
             if override_path:
                 for cl, patches in C.get_overrides(fed, C.SCHEDULER).items():
                     for p in patches:
@@ -250,22 +265,7 @@ class MonitorController:
                             p.get("path") == override_path
                             and p.get("op", "replace") == "replace"
                         ):
-                            want_reps[cl] = int(p["value"])
-            for cl, member in members.items():
-                obs = member.try_get_view(source, key)
-                if cl in desired and obs is None:
-                    note(DRIFT_MISSING, key, cl,
-                         "desired placement not present in member")
-                elif cl not in desired and obs is not None:
-                    note(DRIFT_ORPHAN, key, cl,
-                         "member object outside the desired placement")
-                elif obs is not None and cl in want_reps:
-                    got = get_path(obs, replicas_path)
-                    if got != want_reps[cl]:
-                        note(
-                            DRIFT_REPLICAS, key, cl,
-                            f"member replicas {got} != desired {want_reps[cl]}",
-                        )
+                            overrides.append((row, cl, int(p["value"])))
             # Cross-check against the engine's recorded decision: the
             # persisted placement should be the flight recorder's chosen
             # set (a mismatch means a decision was recorded but never
@@ -283,6 +283,43 @@ class MonitorController:
                 )
 
         self.host.scan(self._resource, visit)
+        checked = len(keys)
+
+        n, m = len(keys), len(member_names)
+        if n and m:
+            keys_arr = np.asarray(keys, dtype=object)
+            desired_m = np.zeros((n, m), bool)
+            for i, ds in enumerate(desired_sets):
+                for cl in ds:
+                    j = col.get(cl)
+                    if j is not None:
+                        desired_m[i, j] = True
+            observed_m = np.zeros((n, m), bool)
+            for j, name in enumerate(member_names):
+                present = members[name].keys(source)
+                if present:
+                    observed_m[:, j] = np.isin(
+                        keys_arr, np.asarray(present, dtype=object)
+                    )
+            missing = desired_m & ~observed_m
+            orphan = observed_m & ~desired_m
+            for i, j in np.argwhere(missing):
+                note(DRIFT_MISSING, keys[i], member_names[j],
+                     "desired placement not present in member")
+            for i, j in np.argwhere(orphan):
+                note(DRIFT_ORPHAN, keys[i], member_names[j],
+                     "member object outside the desired placement")
+            for row, cl, want in overrides:
+                j = col.get(cl)
+                if j is None or not observed_m[row, j]:
+                    continue
+                obs = members[cl].try_get_view(source, keys[row])
+                got = get_path(obs, replicas_path) if obs is not None else None
+                if got != want:
+                    note(
+                        DRIFT_REPLICAS, keys[row], cl,
+                        f"member replicas {got} != desired {want}",
+                    )
         for kind in DRIFT_KINDS:
             self.metrics.store(
                 "placement_drift_objects", counts.get(kind, 0),

@@ -25,6 +25,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from kubeadmiral_tpu.ops import filters as F
 from kubeadmiral_tpu.ops import reasons as RSN
@@ -373,4 +374,108 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
         feasible=feasible.astype(jnp.int8),
         scores=totals.astype(jnp.int32),
         reasons=reasons.astype(jnp.int32),
+    )
+
+
+# -- packed placement export ---------------------------------------------
+# Each object lands on at most max_clusters clusters, yet the dense
+# output planes ship B x C cells off the device.  The packed export
+# top-k-compacts every row into K-wide tensors before the transfer, so
+# fetch bytes scale as B x K instead of B x C (~C/K less traffic); the
+# rare row selecting more than K clusters raises its overflow flag
+# (nsel > K) and the engine re-fetches it through the dense-plane path.
+
+PACK_FILL = -1  # idx value of unused packed slots
+
+
+class PackedRows(NamedTuple):
+    """The packed placement layout: one row per object, K slots."""
+
+    idx: jax.Array   # i32[N,K] selected cluster indices, ascending; PACK_FILL pads
+    rep: jax.Array   # i32[N,K] replicas of that cluster (NIL in Duplicate mode)
+    cnt: jax.Array   # i32[N,K] 1 when the placement carries a replica count
+    sco: jax.Array   # i32[N,K] post-normalize score total of that cluster
+    nsel: jax.Array  # i32[N]   true selected count; nsel > K flags overflow
+    nfeas: jax.Array # i32[N]   valid clusters with no filter-stage reason
+    rsum: jax.Array  # i32[N,NUM_REASON_BITS] clusters rejected per reason
+    #                  bit (ops.reasons.REASON_BITS order), valid slots only
+
+
+def pack_rows(selected, replicas, counted, scores, reasons, k: int) -> PackedRows:
+    """Top-k-compact dense output planes (any leading row count) into the
+    packed layout.  Slot order is (score desc, cluster index asc) over
+    the SELECTED clusters — the select stage's own ranking — so the
+    first slots ARE the row's top scorers: the flight recorder's top-k
+    reads straight off the wire even for K-overflow rows.  The index is
+    a comparator key (lax.sort num_keys=2, unique per row), not argsort
+    stability, so the layout is bit-identical on every backend and
+    matches the sequential oracle's pack_one exactly (see
+    ops/select.py for why stability must not be relied on)."""
+    c = selected.shape[-1]
+    k = min(k, c)
+    selb = selected != 0
+    iota = lax.broadcasted_iota(jnp.int32, selb.shape, selb.ndim - 1)
+    # Selected clusters sort to the front by (-score, index); unselected
+    # sink past them (scores are bounded far below int32 max).
+    key1 = jnp.where(selb, -scores.astype(jnp.int32), jnp.iinfo(jnp.int32).max)
+    _, order = lax.sort((key1, iota), dimension=-1, num_keys=2)
+    order = order[..., :k]
+    valid = jnp.take_along_axis(selb, order, axis=-1)
+    gidx = jnp.where(valid, order, 0)
+
+    def take(plane):
+        return jnp.take_along_axis(plane.astype(jnp.int32), gidx, axis=-1)
+
+    zero = jnp.int32(0)
+    rsn = reasons.astype(jnp.int32)
+    valid_slot = (rsn & jnp.int32(RSN.REASON_CLUSTER_INVALID)) == 0
+    rsum = jnp.stack(
+        [
+            jnp.sum(((rsn & jnp.int32(bit)) != 0) & valid_slot, axis=-1)
+            for bit in RSN.REASON_BITS
+        ],
+        axis=-1,
+    ).astype(jnp.int32)
+    nfeas = jnp.sum(
+        ((rsn & jnp.int32(RSN.FILTER_REASON_MASK)) == 0) & valid_slot, axis=-1
+    ).astype(jnp.int32)
+    return PackedRows(
+        idx=jnp.where(valid, order, jnp.int32(PACK_FILL)),
+        rep=jnp.where(valid, take(replicas), zero),
+        cnt=jnp.where(valid, take(counted), zero),
+        sco=jnp.where(valid, take(scores), zero),
+        nsel=jnp.sum(selb, axis=-1).astype(jnp.int32),
+        nfeas=nfeas,
+        rsum=rsum,
+    )
+
+
+def wire_width(k: int) -> int:
+    """Column count of the packed wire row: 4 K-wide planes + nsel +
+    nfeas + the reason-summary counts."""
+    return 4 * k + 2 + RSN.NUM_REASON_BITS
+
+
+def pack_wire(selected, replicas, counted, scores, reasons, k: int) -> jax.Array:
+    """The packed layout flattened to ONE i32[N, wire_width(k)] array —
+    a single device->host transfer per fetch, like the dense path's
+    _gather_packed* concats."""
+    p = pack_rows(selected, replicas, counted, scores, reasons, k)
+    return jnp.concatenate(
+        [p.idx, p.rep, p.cnt, p.sco, p.nsel[..., None], p.nfeas[..., None], p.rsum],
+        axis=-1,
+    )
+
+
+def unpack_wire(arr, k: int) -> PackedRows:
+    """Host-side inverse of pack_wire (numpy views, no copies)."""
+    arr = np.asarray(arr)
+    return PackedRows(
+        idx=arr[:, :k],
+        rep=arr[:, k : 2 * k],
+        cnt=arr[:, 2 * k : 3 * k],
+        sco=arr[:, 3 * k : 4 * k],
+        nsel=arr[:, 4 * k],
+        nfeas=arr[:, 4 * k + 1],
+        rsum=arr[:, 4 * k + 2 : 4 * k + 2 + RSN.NUM_REASON_BITS],
     )

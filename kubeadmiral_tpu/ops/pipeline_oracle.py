@@ -327,3 +327,52 @@ def explain_one(p: OracleProblem) -> list[int]:
             else:
                 bits[c] |= RSN.REASON_STICKY
     return bits
+
+
+def pack_one(p: OracleProblem, k: int) -> dict:
+    """Packed-export reference for one object — the sequential oracle
+    for ``ops.pipeline.pack_rows``, asserted bit-exact against the XLA
+    pack by tests/test_packed_export.py.
+
+    Canonical slot order: (score desc, cluster index asc) over the
+    selected clusters — the select stage's ranking, so ties at the K
+    boundary resolve identically to the device sort — truncated to the
+    first K; ``nsel`` is the TRUE selected count, so ``nsel > k`` is
+    the overflow flag.  Scores reproduce the device's score plane (the
+    non-sticky pipeline's post-normalize totals, 0 on infeasible
+    clusters), replicas use the device's NIL sentinel for countless
+    placements."""
+    from kubeadmiral_tpu.ops import reasons as RSN
+
+    res = schedule_one(p)
+    bits = _filter_reasons(p)
+    feasible = [c for c in range(p.n_clusters) if bits[c] == 0]
+    totals = _totals(p, feasible) if feasible else {}
+    explain = explain_one(p)
+
+    sel_sorted = sorted(res, key=lambda c: (-totals.get(c, 0), c))
+    idx = [NIL] * k
+    rep = [0] * k
+    cnt = [0] * k
+    sco = [0] * k
+    for slot, c in enumerate(sel_sorted[:k]):
+        idx[slot] = c
+        reps = res[c]
+        rep[slot] = NIL if reps is None else int(reps)
+        cnt[slot] = 0 if reps is None else 1
+        sco[slot] = int(totals.get(c, 0))
+    rsum = [
+        sum(1 for mask in explain if mask & bit) for bit in RSN.REASON_BITS
+    ]
+    nfeas = sum(
+        1 for mask in explain if not (mask & RSN.FILTER_REASON_MASK)
+    )
+    return {
+        "idx": idx,
+        "rep": rep,
+        "cnt": cnt,
+        "sco": sco,
+        "nsel": len(res),
+        "nfeas": nfeas,
+        "rsum": rsum,
+    }

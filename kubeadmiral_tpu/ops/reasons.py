@@ -60,6 +60,23 @@ FILTER_REASON_MASK = (
 SELECT_REASON_MASK = REASON_MAX_CLUSTERS | REASON_ZERO_REPLICAS | REASON_STICKY
 ALL_REASON_MASK = FILTER_REASON_MASK | SELECT_REASON_MASK
 
+# Canonical bit order (ascending bit value) — the column order of the
+# packed export's per-row reason-summary counts (ops/pipeline.pack_rows)
+# and of DecisionRecord.reason_counts in the flight recorder.
+REASON_BITS: tuple[int, ...] = (
+    REASON_API_RESOURCES,
+    REASON_TAINT_TOLERATION,
+    REASON_RESOURCES_FIT,
+    REASON_PLACEMENT,
+    REASON_CLUSTER_AFFINITY,
+    REASON_WEBHOOK_FILTER,
+    REASON_CLUSTER_INVALID,
+    REASON_MAX_CLUSTERS,
+    REASON_ZERO_REPLICAS,
+    REASON_STICKY,
+)
+NUM_REASON_BITS = len(REASON_BITS)
+
 # bit value -> operator-facing slug (the decision vocabulary).
 REASON_NAMES: dict[int, str] = {
     REASON_API_RESOURCES: "api_resources",
@@ -73,6 +90,9 @@ REASON_NAMES: dict[int, str] = {
     REASON_ZERO_REPLICAS: "zero_replicas",
     REASON_STICKY: "sticky_cluster",
 }
+
+# The packed column order must cover exactly the named bits, ascending.
+assert REASON_BITS == tuple(sorted(REASON_NAMES))
 
 
 def describe(mask: int) -> list[str]:

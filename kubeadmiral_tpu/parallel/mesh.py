@@ -211,5 +211,14 @@ def rows_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(OBJECTS))
 
 
+def rows_only_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, C] sharded over objects ONLY — for row-wise device programs
+    (the packed export's per-row sort, the overflow bit-pack reshape)
+    whose cluster axis must be whole on every shard: GSPMD mis-combines
+    sorts/reshapes along a sharded dimension (observed as shard-summed
+    outputs in the multichip dryrun)."""
+    return NamedSharding(mesh, P(OBJECTS, None))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

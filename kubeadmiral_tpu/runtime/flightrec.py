@@ -2,10 +2,12 @@
 
 A bounded ring of the last N ticks' decision records: for every object
 row the engine actually fetched off the device, the recorder keeps the
-reason bitmask per cluster (ops.reasons vocabulary), the top-k
-normalized scores, the chosen clusters + replica split, and the
-tick/program fingerprint — enough to answer "why is object X on
-clusters {A, B} and not C?" without re-running the solver.
+chosen clusters + replica split, the top-k scores among the selected
+clusters, the per-reason rejection counts + feasible count (and, when
+the dense fetch format shipped it, the full per-cluster reason bitmask
+row, ops.reasons vocabulary) and the tick/program fingerprint — enough
+to answer "why is object X on clusters {A, B} and not C?" without
+re-running the solver.
 
 Populated OFF the hot path: the engine records from the host-side
 arrays its fetch stage already pulled (scheduler/engine.py packs the
@@ -25,12 +27,13 @@ Served by the health/profiling HTTP servers:
 * ``GET /debug/drift`` — placement drift listing, fed by providers
   registered here (federation/monitor.py's drift detector).
 
-Sizing: records cost ~2 bytes per (object, cluster) pair (an int16
-reason row) plus ~200 bytes per object.  The ring keeps at most
-``max_ticks`` tick entries and evicts oldest-first past ``max_bytes``,
-but always retains the most recent tick so a cold full-batch schedule
-stays explainable.  Knobs: ``KT_FLIGHTREC`` (0 disables),
-``KT_FLIGHTREC_TICKS``, ``KT_FLIGHTREC_BYTES``, ``KT_FLIGHTREC_TOPK``.
+Sizing: packed-format records cost ~300 bytes flat; dense-format
+records add ~2 bytes per (object, cluster) pair (the int16 reason
+row).  The ring keeps at most ``max_ticks`` tick entries and evicts
+oldest-first past ``max_bytes``, but always retains the most recent
+tick so a cold full-batch schedule stays explainable.  Knobs:
+``KT_FLIGHTREC`` (0 disables), ``KT_FLIGHTREC_TICKS``,
+``KT_FLIGHTREC_BYTES``, ``KT_FLIGHTREC_TOPK``.
 """
 
 from __future__ import annotations
@@ -47,28 +50,40 @@ from kubeadmiral_tpu.ops import reasons as RSN
 
 
 class DecisionRecord:
-    """One object's scheduling decision, as of ``tick``."""
+    """One object's scheduling decision, as of ``tick``.
+
+    The format-independent core (identical whichever fetch format the
+    engine ran): ``placements``, ``reason_counts`` (clusters rejected
+    per reason bit, ops.reasons.REASON_BITS order), ``feasible_n``, and
+    the top-k scores among the SELECTED clusters.  ``reasons`` — the
+    full per-cluster mask row — is carried only when the fetch shipped
+    it (KT_FETCH_FORMAT=dense, or a packed-overflow row's dense
+    refetch never includes it); packed-mode records hold None there and
+    /debug/explain falls back to the summary counts."""
 
     __slots__ = (
         "key", "tick", "when", "program", "placements", "reasons",
-        "topk_idx", "topk_scores", "names",
+        "reason_counts", "feasible_n", "topk_idx", "topk_scores", "names",
     )
 
     def __init__(self, key, tick, when, program, placements, reasons,
-                 topk_idx, topk_scores, names):
+                 reason_counts, feasible_n, topk_idx, topk_scores, names):
         self.key = key
         self.tick = tick
         self.when = when
         self.program = program
-        self.placements = placements    # Mapping[str, Optional[int]]
-        self.reasons = reasons          # np.int16[C]
-        self.topk_idx = topk_idx        # np.int32[k] cluster indices
-        self.topk_scores = topk_scores  # np.int32[k] matching scores
-        self.names = names              # tuple[str, ...] (shared per tick)
+        self.placements = placements      # Mapping[str, Optional[int]]
+        self.reasons = reasons            # np.int16[C] or None (packed)
+        self.reason_counts = reason_counts  # np.int64[NUM_REASON_BITS]
+        self.feasible_n = feasible_n      # int
+        self.topk_idx = topk_idx          # np.int32[k] selected cluster idx
+        self.topk_scores = topk_scores    # np.int64[k] matching scores
+        self.names = names                # tuple[str, ...] (shared per tick)
 
     @property
     def nbytes(self) -> int:
-        return int(self.reasons.nbytes + self.topk_idx.nbytes
+        dense = self.reasons.nbytes if self.reasons is not None else 0
+        return int(dense + self.reason_counts.nbytes + self.topk_idx.nbytes
                    + self.topk_scores.nbytes) + 200
 
 
@@ -129,37 +144,72 @@ class FlightRecorder:
         self,
         keys: Sequence[str],
         placements: Sequence[Mapping[str, Optional[int]]],
-        reasons: np.ndarray,          # int[n, >=C]
-        scores: Optional[np.ndarray],  # int[n, >=C] or None
+        reasons: Optional[np.ndarray],  # int[n, >=C] or None (packed fetch)
+        scores: Optional[np.ndarray],   # int[n, >=C] or None
         names: Sequence[str],
         program: str = "",
+        reason_counts: Optional[np.ndarray] = None,  # int[n, NUM_REASON_BITS]
+        feasible_n: Optional[np.ndarray] = None,     # int[n]
+        topk_idx: Optional[np.ndarray] = None,       # int[n, <=topk]
+        topk_scores: Optional[np.ndarray] = None,
     ) -> None:
         """Record a batch of fetched rows for the current tick.  Padded
         cluster columns are masked out (sliced to ``len(names)``);
-        callers pass only real (non-padded) object rows."""
+        callers pass only real (non-padded) object rows.
+
+        The dense fetch format passes ``reasons`` (and optionally
+        ``scores``) and the compact fields are derived here; the packed
+        format passes ``reason_counts``/``feasible_n``/``topk_*``
+        straight off the wire — both produce the SAME record core, so
+        packed-vs-dense A/B records are identical apart from the dense
+        path's extra per-cluster mask row."""
         if not self.enabled or not keys:
             return
         c = len(names)
-        reasons = np.asarray(reasons)[:, :c].astype(np.int16)
+        n = len(keys)
         k = min(self.topk, c)
-        if scores is not None:
+        name_idx = {nm: j for j, nm in enumerate(names)}
+        if reasons is not None:
+            reasons = np.asarray(reasons)[:, :c].astype(np.int16)
+            if reason_counts is None:
+                r32 = reasons.astype(np.int64)
+                reason_counts = np.stack(
+                    [((r32 & bit) != 0).sum(axis=1) for bit in RSN.REASON_BITS],
+                    axis=1,
+                )
+            if feasible_n is None:
+                feasible_n = ((reasons & RSN.FILTER_REASON_MASK) == 0).sum(axis=1)
+        if reason_counts is None:
+            reason_counts = np.zeros((n, RSN.NUM_REASON_BITS), np.int64)
+        reason_counts = np.asarray(reason_counts, dtype=np.int64)
+        if feasible_n is None:
+            feasible_n = np.zeros(n, np.int64)
+        feasible_n = np.asarray(feasible_n)
+        if topk_idx is None and scores is not None:
+            # Top-k among the SELECTED clusters ("why these won"): rank
+            # by score desc, index asc — the select stage's tie order.
             scores = np.asarray(scores)[:, :c]
-            # Top-k among FEASIBLE clusters (score planes are zero/garbage
-            # on infeasible ones): rank by score desc, index asc — the
-            # select stage's exact tie order.
-            feasible = (reasons & RSN.FILTER_REASON_MASK) == 0
-            masked = np.where(feasible, scores.astype(np.int64), np.iinfo(np.int64).min)
-            order = np.argsort(-masked, axis=1, kind="stable")[:, :k]
-            top_scores = np.take_along_axis(masked, order, axis=1)
-        else:
-            order = np.zeros((len(keys), 0), np.int32)
-            top_scores = order
+            topk_idx, topk_scores = [], []
+            for i in range(n):
+                sel = sorted(
+                    (j for nm in placements[i] if (j := name_idx.get(nm)) is not None)
+                )
+                ranked = sorted(sel, key=lambda j: (-int(scores[i, j]), j))[:k]
+                topk_idx.append(np.asarray(ranked, np.int32))
+                topk_scores.append(
+                    np.asarray([int(scores[i, j]) for j in ranked], np.int64)
+                )
+        if topk_idx is None:
+            empty_i = np.zeros(0, np.int32)
+            empty_s = np.zeros(0, np.int64)
+            topk_idx = [empty_i] * n
+            topk_scores = [empty_s] * n
         with self._lock:
             entry = self._current
             if entry is None:  # recording outside a tick: tolerate
                 self._tick_seq += 1
                 entry = self._current = _TickEntry(
-                    self._tick_seq, self.clock(), len(keys), c
+                    self._tick_seq, self.clock(), n, c
                 )
             if self._names_cache is None or tuple(self._names_cache) != tuple(names):
                 self._names_cache = tuple(names)
@@ -176,9 +226,11 @@ class FlightRecorder:
                     when=when,
                     program=program,
                     placements=placements[i],
-                    reasons=reasons[i],
-                    topk_idx=order[i].astype(np.int32),
-                    topk_scores=top_scores[i].astype(np.int64),
+                    reasons=reasons[i] if reasons is not None else None,
+                    reason_counts=reason_counts[i],
+                    feasible_n=int(feasible_n[i]),
+                    topk_idx=np.asarray(topk_idx[i], np.int32),
+                    topk_scores=np.asarray(topk_scores[i], np.int64),
                     names=names_t,
                 )
                 old = entry.records.get(key)
@@ -248,7 +300,14 @@ class FlightRecorder:
             return self._index.get(key)
 
     def explain(self, key: str) -> Optional[dict]:
-        """Human-readable per-cluster verdicts for GET /debug/explain."""
+        """Human-readable per-cluster verdicts for GET /debug/explain.
+
+        With a dense record (full per-cluster masks) every cluster gets
+        a verdict, as before.  A packed record covers the selected
+        clusters individually and aggregates the rejections under
+        ``rejected`` (reason slug -> cluster count) — the designed
+        fidelity trade of KT_FETCH_FORMAT=packed; run dense for
+        per-pair verdicts."""
         rec = self.lookup(key)
         if rec is None:
             return None
@@ -257,15 +316,29 @@ class FlightRecorder:
             for rank, (j, s) in enumerate(zip(rec.topk_idx, rec.topk_scores), 1)
             if s > np.iinfo(np.int64).min
         }
-        feasible_n = int(((rec.reasons & RSN.FILTER_REASON_MASK) == 0).sum())
+        feasible_n = int(rec.feasible_n)
         clusters = {}
-        for j, name in enumerate(rec.names):
-            mask = int(rec.reasons[j])
-            verdict = _verdict(
-                mask, rec.placements.get(name, _MISSING),
-                top_by_idx.get(j), feasible_n,
-            )
-            clusters[name] = verdict
+        if rec.reasons is not None:
+            for j, name in enumerate(rec.names):
+                mask = int(rec.reasons[j])
+                verdict = _verdict(
+                    mask, rec.placements.get(name, _MISSING),
+                    top_by_idx.get(j), feasible_n,
+                )
+                clusters[name] = verdict
+        else:
+            nidx = {nm: j for j, nm in enumerate(rec.names)}
+            for name, reps in rec.placements.items():
+                j = nidx.get(name)
+                clusters[name] = _verdict(
+                    0, reps, top_by_idx.get(j) if j is not None else None,
+                    feasible_n,
+                )
+        rejected = {
+            RSN.REASON_NAMES[bit]: int(count)
+            for bit, count in zip(RSN.REASON_BITS, rec.reason_counts)
+            if count
+        }
         return {
             "key": key,
             "tick": rec.tick,
@@ -277,6 +350,7 @@ class FlightRecorder:
             },
             "feasible_clusters": feasible_n,
             "clusters": clusters,
+            "rejected": rejected,
         }
 
 
@@ -328,13 +402,15 @@ def _verdict(mask, replicas, top_rank, feasible_n) -> dict:
 
 
 def summarize_reasons(rec: DecisionRecord, limit: int = 4) -> str:
-    """Aggregate one record's per-cluster rejection masks into a short
+    """Aggregate one record's rejection-reason counts into a short
     operator string ("resources_fit x3, taint_toleration x1") — the
-    ScheduleFailed event message vocabulary."""
-    counts: dict[str, int] = {}
-    for mask in rec.reasons.tolist():
-        for slug in RSN.describe(int(mask)):
-            counts[slug] = counts.get(slug, 0) + 1
+    ScheduleFailed event message vocabulary.  Fed by reason_counts, so
+    packed- and dense-format records summarize identically."""
+    counts = {
+        RSN.REASON_NAMES[bit]: int(n)
+        for bit, n in zip(RSN.REASON_BITS, rec.reason_counts)
+        if n
+    }
     parts = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:limit]
     return ", ".join(f"{slug} x{n}" for slug, n in parts)
 

@@ -82,6 +82,20 @@ CATALOG: dict[str, MetricSpec] = {
     "engine_fetch_total": MetricSpec(
         "counter", "chunks", ("path",),
         "Result-fetch path per chunk: noop, subbatch, skip, delta, full."),
+    "engine_fetch_bytes_total": MetricSpec(
+        "counter", "bytes", ("format",),
+        "Device->host result-transfer volume, labeled by the engine's "
+        "fetch wire format (packed = [B,K] top-k-compacted rows, dense "
+        "= full [B,C] planes; KT_FETCH_FORMAT)."),
+    "engine_fetch_overflow_rows_total": MetricSpec(
+        "counter", "rows", (),
+        "Packed-export K-overflow rows (selected set exceeded the K "
+        "bucket) re-fetched through the dense row-gather fallback."),
+    "engine_persistent_cache_total": MetricSpec(
+        "counter", "traces", ("result",),
+        "Persistent XLA compilation-cache outcome per observed trace: "
+        "miss wrote a new on-disk entry (a real compile), hit loaded "
+        "the program from KT_COMPILE_CACHE_DIR."),
     "engine_compile_cache_total": MetricSpec(
         "counter", "dispatches", ("result", "shape"),
         "Program-shape cache outcome per device dispatch: a shape's "
@@ -164,7 +178,7 @@ DECISION_REASONS: frozenset[str] = frozenset({
 # following along.
 FLIGHT_RECORDER_FIELDS: tuple[str, ...] = (
     "key", "tick", "when", "program", "placements", "reasons",
-    "topk_idx", "topk_scores", "names",
+    "reason_counts", "feasible_n", "topk_idx", "topk_scores", "names",
 )
 
 # Pre-exposition dotted names, matched with fnmatch.  "*" also stands in

@@ -39,10 +39,14 @@ from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.ops import pipeline as pipeline_mod
 from kubeadmiral_tpu.ops.pipeline import (
     NIL_REPLICAS,
+    PackedRows,
     TickInputs,
     expand_compact,
+    pack_wire,
     schedule_tick,
+    unpack_wire,
 )
+from kubeadmiral_tpu.ops.planner import INT32_INF
 from kubeadmiral_tpu.runtime import flightrec as flightrec_mod
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
@@ -370,6 +374,70 @@ def _patch_rows(dev: dict, rows: dict, idx):
     }
 
 
+def _pack_full_wire(sel, rep, cnt, sco, rsn, k: int):
+    """Packed placement export of a whole chunk: top-k-compact every row
+    on device and ship ONE i32[B, 4K+2+NR] array instead of five dense
+    [B, C] planes (ops/pipeline.pack_wire documents the layout)."""
+    return pack_wire(sel, rep, cnt, sco, rsn, k)
+
+
+def _gather_packed_wire(sel, rep, cnt, sco, rsn, idx, k: int):
+    """Delta-fetch variant: row gather + top-k compaction in one device
+    program — the packed wire rows for just the changed rows."""
+    return pack_wire(sel[idx], rep[idx], cnt[idx], sco[idx], rsn[idx], k)
+
+
+def _bitpack_bool(x):
+    """bool[N, C] -> i32[N, ceil(C/32)] little-endian bit words — the
+    selection/counted planes cost 1 bit per cluster on the wire instead
+    of 32."""
+    n, c = x.shape
+    pad = (-c) % 32
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+    x = x.reshape(n, -1, 32).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    words = (x * weights).sum(axis=-1, dtype=jnp.uint32)
+    return jax.lax.bitcast_convert_type(words, jnp.int32)
+
+
+def _gather_overflow3(sel, cnt, rep, idx):
+    """K-overflow row fetch: bit-packed selected/counted masks + the
+    replica plane for the given rows in ONE transfer — ~1/4 of the
+    dense 3-plane row (C/32 + C/32 + C words vs 3C)."""
+    return jnp.concatenate(
+        [
+            _bitpack_bool(sel[idx] != 0),
+            _bitpack_bool(cnt[idx] != 0),
+            rep[idx],
+        ],
+        axis=1,
+    )
+
+
+def _gather_overflow4(sel, cnt, rep, sco, idx):
+    """Score-carrying variant, for want_scores consumers whose overflow
+    rows must decode per-cluster score dicts."""
+    return jnp.concatenate(
+        [
+            _bitpack_bool(sel[idx] != 0),
+            _bitpack_bool(cnt[idx] != 0),
+            rep[idx],
+            sco[idx],
+        ],
+        axis=1,
+    )
+
+
+def _unpack_bits(words: np.ndarray, c: int) -> np.ndarray:
+    """Host inverse of _bitpack_bool: i32[N, ceil(C/32)] -> uint8[N, C]."""
+    u8 = np.ascontiguousarray(words.astype("<i4")).view(np.uint8)
+    bits = np.unpackbits(
+        u8.reshape(words.shape[0], -1), axis=1, bitorder="little"
+    )
+    return bits[:, :c]
+
+
 class SchedulerEngine:
     """Chunked, shape-bucketed driver around ops.pipeline.schedule_tick.
 
@@ -399,8 +467,34 @@ class SchedulerEngine:
         vocab_caps: Optional[dict] = None,
         metrics: Optional[Metrics] = None,
         flight_recorder="default",
+        fetch_format: Optional[str] = None,
+        pack_k_min: Optional[int] = None,
     ):
         self.chunk_size = chunk_size
+        # Result-fetch wire format: "packed" (default) ships [B, K]
+        # top-k-compacted placement rows (ops/pipeline.pack_wire) and
+        # falls back to dense row gathers only for K-overflow rows;
+        # "dense" ships the full [B, C] planes (the pre-packed behavior,
+        # kept for A/B comparison and full-fidelity /debug/explain).
+        # Knobs: KT_FETCH_FORMAT, KT_PACK_K (minimum K bucket).
+        if fetch_format is None:
+            fetch_format = os.environ.get("KT_FETCH_FORMAT", "packed")
+        if fetch_format not in ("packed", "dense"):
+            raise ValueError(
+                f"fetch_format must be 'packed' or 'dense', got {fetch_format!r}"
+            )
+        self.fetch_format = fetch_format
+        self.pack_k_min = (
+            int(os.environ.get("KT_PACK_K", "16"))
+            if pack_k_min is None
+            else pack_k_min
+        )
+        # Cumulative device->host result-transfer volume and packed-
+        # overflow rows (rows whose selected set exceeded K and were
+        # re-fetched through the dense path); per-tick deltas land in
+        # engine_fetch_bytes_total / engine_fetch_overflow_rows_total.
+        self.fetch_bytes_total = 0
+        self.overflow_rows_total = 0
         # Decision flight recorder (runtime/flightrec.py): fed from the
         # host-side arrays the fetch stage pulls anyway, so /debug/explain
         # can name the rejecting filter for any (object, cluster) pair
@@ -479,6 +573,22 @@ class SchedulerEngine:
         # entry (units_list, view, want_scores, follower_index,
         # results, n_chunks), or None.
         self._noop_gate: Optional[tuple] = None
+        # schedule() is serialized: the chunk cache, the per-tick
+        # recorder arm (_tick_rec), timings and last_changed are all
+        # engine-level state keyed by chunk INDEX — two overlapping
+        # ticks would validate/patch each other's cache entries and can
+        # persist wrong (even empty) placements.  Multi-threaded batch
+        # workers (worker.run(workers=N)) gain nothing from overlap
+        # anyway: the device serializes, and each tick schedules the
+        # whole pending set.
+        self._schedule_lock = threading.Lock()
+
+        # Persistent XLA compilation-cache telemetry (the cache itself
+        # is enabled in kubeadmiral_tpu.__init__; KT_COMPILE_CACHE_DIR
+        # overrides the location): entry-count deltas around observed
+        # traces attribute each trace to a disk hit or a real compile.
+        self._pcache_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+        self._pcache_count = self._pcache_entries()
 
         self.mesh = self._resolve_mesh(mesh)
         self._build_programs()
@@ -536,12 +646,17 @@ class SchedulerEngine:
             self._gather = jax.jit(_gather_packed)
             self._gather3 = jax.jit(_gather_packed3)
             self._gather5 = jax.jit(_gather_packed5)
+            self._gather_over3 = jax.jit(_gather_overflow3)
+            self._gather_over4 = jax.jit(_gather_overflow4)
             self._patch = jax.jit(_patch_rows)
             self._patch_compact = jax.jit(_patch_rows)
             self._per_object_shardings = None
             self._per_object_shardings_compact = None
             self._table_shardings = None
             self._grid_sharding = None
+            self._replicated = None
+            self._rows_only_sharding = None
+            self._pack_programs: dict[tuple, object] = {}
             return
         from kubeadmiral_tpu.parallel import mesh as M
 
@@ -583,6 +698,9 @@ class SchedulerEngine:
             out_shardings=out_shardings,
         )
         rep = M.replicated(self.mesh)
+        self._replicated = rep
+        self._rows_only_sharding = M.rows_only_sharding(self.mesh)
+        self._pack_programs = {}
         self._gather = jax.jit(
             _gather_packed,
             in_shardings=(grid, grid, grid, grid, rep),
@@ -596,6 +714,48 @@ class SchedulerEngine:
         self._gather5 = jax.jit(
             _gather_packed5,
             in_shardings=(grid, grid, grid, grid, grid, rep),
+            out_shardings=rep,
+        )
+        # Overflow gathers bit-pack via a reshape+sum along the cluster
+        # axis: like the pack sort, the gathered rows must be replicated
+        # before that (GSPMD mis-combines reshapes of sharded axes).
+        def _over3_meshed(sel, cnt, rep_p, idx):
+            rows = tuple(
+                jax.lax.with_sharding_constraint(x[idx], rep)
+                for x in (sel, cnt, rep_p)
+            )
+            return jnp.concatenate(
+                [
+                    _bitpack_bool(rows[0] != 0),
+                    _bitpack_bool(rows[1] != 0),
+                    rows[2],
+                ],
+                axis=1,
+            )
+
+        def _over4_meshed(sel, cnt, rep_p, sco, idx):
+            rows = tuple(
+                jax.lax.with_sharding_constraint(x[idx], rep)
+                for x in (sel, cnt, rep_p, sco)
+            )
+            return jnp.concatenate(
+                [
+                    _bitpack_bool(rows[0] != 0),
+                    _bitpack_bool(rows[1] != 0),
+                    rows[2],
+                    rows[3],
+                ],
+                axis=1,
+            )
+
+        self._gather_over3 = jax.jit(
+            _over3_meshed,
+            in_shardings=(grid, grid, grid, rep),
+            out_shardings=rep,
+        )
+        self._gather_over4 = jax.jit(
+            _over4_meshed,
+            in_shardings=(grid, grid, grid, grid, rep),
             out_shardings=rep,
         )
         self._patch = jax.jit(
@@ -629,6 +789,93 @@ class SchedulerEngine:
             zp = fn()
             self._zero_prev[shape] = zp
         return zp
+
+    # -- packed export programs ------------------------------------------
+    def _pack_program(self, kind: str, k: int):
+        """Jitted packed-export program per (kind, K): "full" compacts a
+        whole chunk's planes, "gather" compacts just the given rows.
+        K is a closure constant (one cheap XLA program per K bucket)."""
+        key = (kind, k)
+        fn = self._pack_programs.get(key)
+        if fn is not None:
+            return fn
+        rows_only = self._rows_only_sharding
+        if kind == "full":
+            def impl(sel, rep, cnt, sco, rsn, _k=k):
+                if rows_only is not None:
+                    # The per-row sort needs the WHOLE cluster axis on
+                    # every shard (see parallel/mesh.rows_only_sharding)
+                    # — keep rows sharded, replicate clusters.
+                    sel, rep, cnt, sco, rsn = (
+                        jax.lax.with_sharding_constraint(x, rows_only)
+                        for x in (sel, rep, cnt, sco, rsn)
+                    )
+                return _pack_full_wire(sel, rep, cnt, sco, rsn, _k)
+
+            if self._grid_sharding is not None:
+                fn = jax.jit(
+                    impl,
+                    in_shardings=(self._grid_sharding,) * 5,
+                    out_shardings=self._replicated,
+                )
+            else:
+                fn = jax.jit(impl)
+        else:
+            replicated = self._replicated
+
+            def impl(sel, rep, cnt, sco, rsn, idx, _k=k):
+                rows = (sel[idx], rep[idx], cnt[idx], sco[idx], rsn[idx])
+                if replicated is not None:
+                    # Gathered rows are few: replicate them before the
+                    # sort rather than sorting a sharded axis.
+                    rows = tuple(
+                        jax.lax.with_sharding_constraint(x, replicated)
+                        for x in rows
+                    )
+                return pack_wire(*rows, _k)
+
+            if self._grid_sharding is not None:
+                fn = jax.jit(
+                    impl,
+                    in_shardings=(self._grid_sharding,) * 5 + (self._replicated,),
+                    out_shardings=self._replicated,
+                )
+            else:
+                fn = jax.jit(impl)
+        self._pack_programs[key] = fn
+        return fn
+
+    def _pack_k(self, inputs, c_bucket: int) -> int:
+        """The chunk's packed-slot count K: the pow2 bucket of the
+        largest finite maxClusters (floored at pack_k_min so Divide-mode
+        rows with unlimited maxClusters but small replica spreads still
+        pack), capped at the cluster bucket (K = C is lossless).  Rows
+        whose selected set exceeds K raise the overflow flag and ride
+        the dense fallback."""
+        mc = np.asarray(inputs.max_clusters)
+        finite = mc[(mc >= 0) & (mc < INT32_INF)]
+        bound = int(finite.max()) if finite.size else 0
+        k = _pow2_bucket(max(bound, self.pack_k_min), 8, 1 << 30)
+        return min(k, c_bucket)
+
+    def _pcache_entries(self) -> int:
+        """Entry count of the persistent XLA compilation cache directory
+        (0 when disabled/absent) — the miss detector's substrate."""
+        d = self._pcache_dir
+        if not d or not os.path.isdir(d):
+            return 0
+        try:
+            return len(os.listdir(d))
+        except OSError:
+            return 0
+
+    def _read_np(self, dev) -> np.ndarray:
+        """Blocking device->host read with fetch-byte accounting — every
+        result transfer funnels through here so engine_fetch_bytes_total
+        (and bench.py's fetch_bytes) reflect real wire volume."""
+        arr = np.asarray(dev)
+        self.fetch_bytes_total += arr.nbytes
+        return arr
 
     # -- shape policy ----------------------------------------------------
     def _tick_geometry(self, n_clusters: int) -> tuple[int, int, Optional[list]]:
@@ -931,35 +1178,43 @@ class SchedulerEngine:
         if not units:
             self.last_changed = []
             return []
-        cache0 = dict(self.cache_stats)
-        fetch0 = dict(self.fetch_stats)
-        # Arm the flight recorder for this tick: record sites (the fetch/
-        # decode helpers) consume _tick_rec; ticks riding the noop/skip
-        # fast paths record nothing and the previous records stay
-        # current (the tick provably reproduced the previous outputs).
-        rec = self.flightrec if (self.flightrec is not None and self.flightrec.enabled) else None
-        self._tick_rec = rec
-        if rec is not None:
-            rec.begin_tick(len(units), len(clusters))
-        t_start = time.perf_counter()
-        try:
-            with trace.span(
-                "engine.schedule", objects=len(units), clusters=len(clusters)
-            ):
-                results = self._schedule_impl(
-                    units, clusters, view=view, webhook_eval=webhook_eval,
-                    want_scores=want_scores, follower_index=follower_index,
-                )
-        finally:
+        # One tick at a time (see _schedule_lock): overlapping ticks
+        # from multi-threaded batch workers would race the chunk cache.
+        with self._schedule_lock:
+            cache0 = dict(self.cache_stats)
+            fetch0 = dict(self.fetch_stats)
+            bytes0 = self.fetch_bytes_total
+            overflow0 = self.overflow_rows_total
+            # Arm the flight recorder for this tick: record sites (the
+            # fetch/decode helpers) consume _tick_rec; ticks riding the
+            # noop/skip fast paths record nothing and the previous
+            # records stay current (the tick provably reproduced the
+            # previous outputs).
+            rec = self.flightrec if (self.flightrec is not None and self.flightrec.enabled) else None
+            self._tick_rec = rec
             if rec is not None:
-                rec.end_tick()
-        self._emit_tick_metrics(
-            len(units), time.perf_counter() - t_start, cache0, fetch0
-        )
-        return results
+                rec.begin_tick(len(units), len(clusters))
+            t_start = time.perf_counter()
+            try:
+                with trace.span(
+                    "engine.schedule", objects=len(units), clusters=len(clusters)
+                ):
+                    results = self._schedule_impl(
+                        units, clusters, view=view, webhook_eval=webhook_eval,
+                        want_scores=want_scores, follower_index=follower_index,
+                    )
+            finally:
+                if rec is not None:
+                    rec.end_tick()
+            self._emit_tick_metrics(
+                len(units), time.perf_counter() - t_start, cache0, fetch0,
+                bytes0, overflow0,
+            )
+            return results
 
     def _emit_tick_metrics(
-        self, n_units: int, wall: float, cache0: dict, fetch0: dict
+        self, n_units: int, wall: float, cache0: dict, fetch0: dict,
+        bytes0: int = 0, overflow0: int = 0,
     ) -> None:
         """Per-tick telemetry: stage-latency histograms, cache/fetch path
         counters (as deltas of the raw dict stats over this call), true
@@ -979,8 +1234,34 @@ class SchedulerEngine:
             delta = value - fetch0.get(key, 0)
             if delta:
                 m.counter("engine_fetch_total", delta, path=key)
-        for program, b, c in pipeline_mod.drain_trace_events():
+        bytes_delta = self.fetch_bytes_total - bytes0
+        if bytes_delta:
+            m.counter(
+                "engine_fetch_bytes_total", bytes_delta, format=self.fetch_format
+            )
+        overflow_delta = self.overflow_rows_total - overflow0
+        if overflow_delta:
+            m.counter("engine_fetch_overflow_rows_total", overflow_delta)
+        events = pipeline_mod.drain_trace_events()
+        for program, b, c in events:
             m.counter("engine_xla_compiles_total", program=program, shape=f"{b}x{c}")
+        if events:
+            # Persistent-cache attribution: a trace that WROTE a new
+            # on-disk cache entry was a real compile (miss); one that
+            # didn't was served from the persistent cache (hit).  Entry
+            # counting is approximate under the concurrent prewarm
+            # thread, but per-tick deltas are exact in steady state.
+            new_count = self._pcache_entries()
+            misses = max(0, min(len(events), new_count - self._pcache_count))
+            self._pcache_count = new_count
+            if misses:
+                m.counter("engine_persistent_cache_total", misses, result="miss")
+            if len(events) - misses:
+                m.counter(
+                    "engine_persistent_cache_total",
+                    len(events) - misses,
+                    result="hit",
+                )
         m.store("engine_program_shapes", len(self.program_shapes))
         if self._tick_rec is not None:
             st = self._tick_rec.stats()
@@ -1124,6 +1405,7 @@ class SchedulerEngine:
                 continue
 
             b_pad = self._bucket_rows(len(chunk), ladder, eff_chunk, multi_chunk)
+            pack_k = self._pack_k(inputs, c_bucket)
             padded = self._pad_for_dispatch(inputs, fmt, b_pad, c_bucket)
             t1 = time.perf_counter()
             timings["featurize"] += t1 - t0
@@ -1157,6 +1439,7 @@ class SchedulerEngine:
                         out,
                         mask_dev if delta_ok else None,
                         len(chunk),
+                        pack_k,
                     )
                 )
                 chunk_results.append(None)
@@ -1183,6 +1466,7 @@ class SchedulerEngine:
                 want_scores,
                 timings,
                 view,
+                pack_k,
             )
             chunk_results.append(part)
             chunk_changed.append(changed)
@@ -1327,13 +1611,16 @@ class SchedulerEngine:
         total = inputs.total.shape[0]
         want_scores = any(e.prev_has_scores for _, e, _, _ in pending)
         record = self._tick_rec is not None
+        packed_mode = self.fetch_format == "packed"
+        pack_k = self._pack_k(inputs, c_bucket) if packed_mode else 0
         planes = 5 if record else (4 if want_scores else 3)
-        # Reason/score rows for the flight recorder, aligned with the
-        # concatenated decode order (same packed fetch, no extra reads).
-        rec_reasons: list[np.ndarray] = []
-        rec_scores: list[np.ndarray] = []
-        decoded: list[ScheduleResult] = []
         cls = CompactInputs if fmt == "compact" else TickInputs
+        # Cross-slab pipelining: EVERY slab's tick + fetch program is
+        # enqueued before the first blocking read, so slab t+1's device
+        # work overlaps slab t's transfer (the window pattern the
+        # full-dispatch path uses), instead of dispatch->block->read per
+        # slab.
+        slabs: list[tuple] = []  # (n, out, fetch_dev)
         for start in range(0, total, eff_chunk):
             piece = cls(
                 **{
@@ -1357,49 +1644,107 @@ class SchedulerEngine:
                 out, _mask = self._tick_compact(device_in, self._zeros_for(shape))
             else:
                 out, _mask = self._tick(padded, self._zeros_for(shape))
-            k = _pow2_bucket(n, 16, 1 << 30)
-            idx = np.zeros(k, np.int32)
-            idx[:n] = np.arange(n)
-            if planes == 5:
-                packed_dev = self._gather5(
+            if packed_mode:
+                # Row-bucketed gather-pack, not the whole padded slab:
+                # n changed rows bucket to pow2(n) wire rows instead of
+                # b_pad.
+                kp = _pow2_bucket(n, 16, 1 << 30)
+                idx = np.zeros(kp, np.int32)
+                idx[:n] = np.arange(n)
+                fetch_dev = self._pack_program("gather", pack_k)(
                     out.selected, out.replicas, out.counted, out.scores,
                     out.reasons, idx,
                 )
-            elif planes == 4:
-                packed_dev = self._gather(
-                    out.selected, out.replicas, out.counted, out.scores, idx
-                )
             else:
-                packed_dev = self._gather3(
-                    out.selected, out.replicas, out.counted, idx
-                )
-            jax.block_until_ready(packed_dev)
-            t2 = time.perf_counter()
-            timings["device"] += t2 - t1
-            packed = np.asarray(packed_dev)[:n]
-            c_pad = packed.shape[1] // planes
-            sco = packed[:, 3 * c_pad : 4 * c_pad] if planes >= 4 else None
-            if planes == 5:
-                rec_reasons.append(packed[:, 4 * c_pad : 5 * c_pad])
-                rec_scores.append(sco)
-            t3 = time.perf_counter()
-            timings["fetch"] += t3 - t2
-            decoded.extend(
-                self._decode_rows(
-                    packed[:, :c_pad],
-                    packed[:, c_pad : 2 * c_pad],
-                    packed[:, 2 * c_pad : 3 * c_pad],
-                    view.names,
-                    scores=sco if want_scores else None,
-                )
-            )
-            timings["decode"] += time.perf_counter() - t3
+                kp = _pow2_bucket(n, 16, 1 << 30)
+                idx = np.zeros(kp, np.int32)
+                idx[:n] = np.arange(n)
+                if planes == 5:
+                    fetch_dev = self._gather5(
+                        out.selected, out.replicas, out.counted, out.scores,
+                        out.reasons, idx,
+                    )
+                elif planes == 4:
+                    fetch_dev = self._gather(
+                        out.selected, out.replicas, out.counted, out.scores, idx
+                    )
+                else:
+                    fetch_dev = self._gather3(
+                        out.selected, out.replicas, out.counted, idx
+                    )
+            slabs.append((n, out, fetch_dev))
+            timings["device"] += time.perf_counter() - t1
             t0 = time.perf_counter()
+
+        # All slabs are in flight; wait for device completion ONCE (the
+        # last program's completion implies the whole queue), so the
+        # reads below measure pure transfer — same stage attribution as
+        # the pre-pipelined per-slab block.
+        if slabs:
+            t1 = time.perf_counter()
+            jax.block_until_ready(slabs[-1][2])
+            timings["device"] += time.perf_counter() - t1
+
+        # Drain: blocking reads (+ packed-overflow re-fetches), decode.
+        decoded: list[ScheduleResult] = []
+        rec_reasons: list[np.ndarray] = []   # dense-mode recorder rows
+        rec_scores: list[np.ndarray] = []
+        rec_counts: list[np.ndarray] = []    # packed-mode recorder fields
+        rec_feas: list[np.ndarray] = []
+        rec_ti: list[np.ndarray] = []
+        rec_ts: list[np.ndarray] = []
+        for n, out, fetch_dev in slabs:
+            t2 = time.perf_counter()
+            arr = self._read_np(fetch_dev)[:n]
+            if packed_mode:
+                packed = unpack_wire(arr, pack_k)
+                over_pos = np.nonzero(np.asarray(packed.nsel) > pack_k)[0]
+                over_dense = None
+                if over_pos.size:
+                    over_dense = self._fetch_overflow(
+                        out, over_pos.astype(np.int64), want_scores
+                    )
+                t3 = time.perf_counter()
+                timings["fetch"] += t3 - t2
+                decoded.extend(
+                    self._decode_packed_mixed(
+                        packed, over_pos, over_dense, view.names, want_scores
+                    )
+                )
+                if record:
+                    ti, ts = self._packed_record_fields(
+                        packed, self._tick_rec.topk
+                    )
+                    rec_counts.append(np.asarray(packed.rsum))
+                    rec_feas.append(np.asarray(packed.nfeas))
+                    rec_ti.extend(ti)
+                    rec_ts.extend(ts)
+                timings["decode"] += time.perf_counter() - t3
+            else:
+                c_pad = arr.shape[1] // planes
+                sco = arr[:, 3 * c_pad : 4 * c_pad] if planes >= 4 else None
+                if planes == 5:
+                    rec_reasons.append(arr[:, 4 * c_pad : 5 * c_pad])
+                    rec_scores.append(sco)
+                t3 = time.perf_counter()
+                timings["fetch"] += t3 - t2
+                decoded.extend(
+                    self._decode_rows(
+                        arr[:, :c_pad],
+                        arr[:, c_pad : 2 * c_pad],
+                        arr[:, 2 * c_pad : 3 * c_pad],
+                        view.names,
+                        scores=sco if want_scores else None,
+                    )
+                )
+                timings["decode"] += time.perf_counter() - t3
 
         offset = 0
         t3 = time.perf_counter()
         all_reasons = np.concatenate(rec_reasons) if rec_reasons else None
         all_scores = np.concatenate(rec_scores) if rec_scores else None
+        all_counts = np.concatenate(rec_counts) if rec_counts else None
+        all_feas = np.concatenate(rec_feas) if rec_feas else None
         for slot, entry, changed_rows, _sub in pending:
             merged = list(entry.prev_results)
             res_rows = []
@@ -1409,12 +1754,21 @@ class SchedulerEngine:
                     res = ScheduleResult(res.clusters, {})
                 merged[row] = res
                 res_rows.append(res)
+            span = slice(offset, offset + len(changed_rows))
             if all_reasons is not None:
-                span = slice(offset, offset + len(changed_rows))
                 self._record_decisions(
                     entry, changed_rows, res_rows, all_reasons[span],
                     all_scores[span] if all_scores is not None else None,
                     view, program=f"{fmt}:sub",
+                )
+            elif all_counts is not None and self._tick_rec is not None:
+                self._tick_rec.record_rows(
+                    [entry.units[r].key for r in changed_rows],
+                    [res.clusters for res in res_rows],
+                    None, None, view.names, program=f"{fmt}:sub",
+                    reason_counts=all_counts[span],
+                    feasible_n=all_feas[span],
+                    topk_idx=rec_ti[span], topk_scores=rec_ts[span],
                 )
             offset += len(changed_rows)
             entry.prev_results = merged
@@ -1520,22 +1874,23 @@ class SchedulerEngine:
             **{name: fields[name] for name in _CLUSTER_ONLY_FIELDS},
         )
 
-    def _decode_rows(
-        self, selected, replicas, counted, names, scores=None
+    @staticmethod
+    def _build_results(
+        n_rows, rows, cols, replicas_at, counted_at, names, scores_at
     ) -> list[ScheduleResult]:
-        """Vectorized decode: one nonzero over the rows, then per-row
-        dict(zip(...)) at C speed — no per-placement Python."""
-        rows, cols = np.nonzero(selected)
-        bounds = np.searchsorted(rows, np.arange(selected.shape[0] + 1))
-        reps_obj = replicas[rows, cols].astype(object)
-        reps_obj[counted[rows, cols] == 0] = DUPLICATE
+        """Shared decode tail: (row, col) placement pairs -> frozen
+        ScheduleResults, one dict(zip(...)) per row — no per-placement
+        Python.  ``*_at`` are the values already gathered at the pairs."""
+        bounds = np.searchsorted(rows, np.arange(n_rows + 1))
+        reps_obj = replicas_at.astype(object)
+        reps_obj[counted_at == 0] = DUPLICATE
         names_arr = np.asarray(names, dtype=object)
         sel_names = names_arr[cols].tolist()
         reps_list = reps_obj.tolist()
-        score_list = scores[rows, cols].tolist() if scores is not None else None
+        score_list = scores_at.tolist() if scores_at is not None else None
         out = []
         empty = _FrozenDict()
-        for i in range(selected.shape[0]):
+        for i in range(n_rows):
             s, e = bounds[i], bounds[i + 1]
             out.append(
                 ScheduleResult(
@@ -1547,13 +1902,44 @@ class SchedulerEngine:
             )
         return out
 
+    def _decode_rows(
+        self, selected, replicas, counted, names, scores=None
+    ) -> list[ScheduleResult]:
+        """Vectorized decode of dense [n, C] planes."""
+        rows, cols = np.nonzero(selected)
+        return self._build_results(
+            selected.shape[0], rows, cols,
+            replicas[rows, cols], counted[rows, cols], names,
+            scores[rows, cols] if scores is not None else None,
+        )
+
+    def _decode_packed_rows(
+        self, packed: PackedRows, names, scores: bool = False
+    ) -> list[ScheduleResult]:
+        """Decode packed [n, K] rows (slots score-ordered, PACK_FILL
+        padded).  Dict content is identical to the dense decode —
+        insertion order differs (score vs index order), which no
+        consumer observes: persistence sorts placements and all
+        comparisons are dict equality.  Callers must exclude overflow
+        rows (nsel > K)."""
+        idx = np.asarray(packed.idx)
+        valid = idx >= 0
+        rows, slots = np.nonzero(valid)
+        return self._build_results(
+            idx.shape[0], rows, idx[rows, slots],
+            np.asarray(packed.rep)[rows, slots],
+            np.asarray(packed.cnt)[rows, slots], names,
+            np.asarray(packed.sco)[rows, slots] if scores else None,
+        )
+
     def _drain_fetch(
         self, item, chunk_results, chunk_changed, view, want_scores: bool, timings
     ) -> None:
         """Complete one in-flight pipelined chunk (see pipeline_depth)."""
-        slot, entry, out, mask_dev, n = item
+        slot, entry, out, mask_dev, n, pack_k = item
         chunk_results[slot], chunk_changed[slot] = self._fetch_decode(
-            entry, out, mask_dev, view.names, n, want_scores, timings, view
+            entry, out, mask_dev, view.names, n, want_scores, timings, view,
+            pack_k,
         )
 
     def _drain_fetch_window(
@@ -1588,9 +1974,9 @@ class SchedulerEngine:
                 mgroups.setdefault(tuple(it[3].shape), []).append(it)
         for _, group in mgroups.items():
             if len(group) == 1:
-                mask_np[group[0][0]] = np.asarray(group[0][3])
+                mask_np[group[0][0]] = self._read_np(group[0][3])
             else:
-                stacked = np.asarray(self._stack(*[g[3] for g in group]))
+                stacked = self._read_np(self._stack(*[g[3] for g in group]))
                 for i, g in enumerate(group):
                     mask_np[g[0]] = stacked[i]
         timings["fetch"] += time.perf_counter() - t0
@@ -1598,9 +1984,9 @@ class SchedulerEngine:
         # Phase 2: plan skip/delta/full per chunk from the host masks.
         delta_items: list[tuple] = []
         full_items: list[tuple] = []
-        for slot, entry, out, mask_dev, n in items:
+        for slot, entry, out, mask_dev, n, pack_k in items:
             if mask_dev is None:
-                full_items.append((slot, entry, out, n))
+                full_items.append((slot, entry, out, n, pack_k))
                 continue
             kind, idx = self._plan_delta(entry, mask_np[slot][:n], n)
             if kind == "skip":
@@ -1608,9 +1994,16 @@ class SchedulerEngine:
                 chunk_results[slot] = entry.prev_results
                 chunk_changed[slot] = []
             elif kind == "full":
-                full_items.append((slot, entry, out, n))
+                full_items.append((slot, entry, out, n, pack_k))
             else:
-                delta_items.append((slot, entry, out, idx))
+                delta_items.append((slot, entry, out, idx, pack_k))
+
+        if self.fetch_format == "packed":
+            self._drain_window_packed(
+                delta_items, full_items, chunk_results, chunk_changed,
+                view, want_scores, timings,
+            )
+            return
 
         # Phase 3: enqueue ALL device work — delta gathers (idx bucketed
         # to the window max per plane-group so outputs stack) and full-
@@ -1619,7 +2012,7 @@ class SchedulerEngine:
         t0 = time.perf_counter()
         record = self._tick_rec is not None
         by_planes: dict[int, list] = {}
-        for slot, entry, out, idx in delta_items:
+        for slot, entry, out, idx, _k in delta_items:
             self.fetch_stats["delta"] += 1
             planes = 5 if record else (4 if entry.prev_has_scores else 3)
             by_planes.setdefault(planes, []).append((slot, entry, out, idx))
@@ -1656,7 +2049,7 @@ class SchedulerEngine:
         want_score_plane = want_scores or record
         fstacks: list[tuple] = []
         fgroups: dict[tuple, list] = {}
-        for slot, entry, out, n in full_items:
+        for slot, entry, out, n, _k in full_items:
             fgroups.setdefault(tuple(out.selected.shape), []).append(
                 (slot, entry, out, n)
             )
@@ -1683,15 +2076,15 @@ class SchedulerEngine:
                         else None,
                     )
                 )
-        packed_np = {p: np.asarray(d) for p, d in stacked_devs.items()}
+        packed_np = {p: self._read_np(d) for p, d in stacked_devs.items()}
         full_np = [
             (
                 group,
-                np.asarray(sel),
-                np.asarray(rep),
-                np.asarray(cnt),
-                np.asarray(sco) if sco is not None else None,
-                np.asarray(rsn) if rsn is not None else None,
+                self._read_np(sel),
+                self._read_np(rep),
+                self._read_np(cnt),
+                self._read_np(sco) if sco is not None else None,
+                self._read_np(rsn) if rsn is not None else None,
             )
             for group, sel, rep, cnt, sco, rsn in fstacks
         ]
@@ -1720,6 +2113,118 @@ class SchedulerEngine:
                     (sco if single else sco[i]) if sco is not None else None,
                     n, view.names, want_scores, view,
                     reasons=(rsn if single else rsn[i]) if rsn is not None else None,
+                )
+                chunk_results[slot] = results
+                chunk_changed[slot] = None
+        timings["decode"] += time.perf_counter() - t0
+
+    def _drain_window_packed(
+        self, delta_items, full_items, chunk_results, chunk_changed, view,
+        want_scores: bool, timings,
+    ) -> None:
+        """Packed-format window drain: every chunk's changed rows (or
+        whole output set) ship as top-k-compacted wire rows — one
+        stacked transfer per wire shape — followed by ONE batched dense
+        re-fetch per plane-group for the rare K-overflow rows.  All
+        device programs are enqueued before the first blocking read, so
+        transfers overlap device execution across the window."""
+        t0 = time.perf_counter()
+        wire_devs: list[tuple] = []  # (kind, item, fetched-row count, dev)
+        for slot, entry, out, idx, k in delta_items:
+            self.fetch_stats["delta"] += 1
+            kp = _pow2_bucket(idx.size, 16, 1 << 30)
+            padded_idx = np.zeros(kp, np.int32)
+            padded_idx[: idx.size] = idx
+            dev = self._pack_program("gather", k)(
+                out.selected, out.replicas, out.counted, out.scores,
+                out.reasons, padded_idx,
+            )
+            wire_devs.append(("delta", (slot, entry, out, idx, k), idx.size, dev))
+        for slot, entry, out, n, k in full_items:
+            dev = self._pack_program("full", k)(
+                out.selected, out.replicas, out.counted, out.scores, out.reasons
+            )
+            wire_devs.append(("full", (slot, entry, out, n, k), n, dev))
+        wire_np: list[Optional[np.ndarray]] = [None] * len(wire_devs)
+        wgroups: dict[tuple, list[int]] = {}
+        for i, (_, _, _, dev) in enumerate(wire_devs):
+            wgroups.setdefault(tuple(dev.shape), []).append(i)
+        for _, members in wgroups.items():
+            if len(members) == 1:
+                wire_np[members[0]] = self._read_np(wire_devs[members[0]][3])
+            else:
+                stacked = self._read_np(
+                    self._stack(*[wire_devs[i][3] for i in members])
+                )
+                for j, i in enumerate(members):
+                    wire_np[i] = stacked[j]
+        timings["fetch"] += time.perf_counter() - t0
+
+        # K-overflow rows: plan per chunk, then gather + read batched
+        # per (scores, shape) group across the whole window.
+        t0 = time.perf_counter()
+        parsed: list[tuple] = []  # (kind, item, packed, over_pos)
+        over_jobs: list[tuple] = []  # (parsed idx, global row idx, with_scores)
+        for i, (kind, item, rows, _dev) in enumerate(wire_devs):
+            entry = item[1]
+            k = item[4]
+            packed = unpack_wire(wire_np[i][:rows], k)
+            over_pos = np.nonzero(np.asarray(packed.nsel) > k)[0]
+            parsed.append((kind, item, packed, over_pos))
+            if over_pos.size:
+                if kind == "delta":
+                    gidx = item[3][over_pos]
+                    need_scores = bool(entry.prev_has_scores)
+                else:
+                    gidx = over_pos
+                    need_scores = want_scores
+                over_jobs.append((i, np.asarray(gidx, np.int64), need_scores))
+        over_res: dict[int, tuple] = {}  # parsed idx -> (rows, c_pad, scores)
+        ogroups: dict[tuple, list] = {}
+        for pi, gidx, need_scores in over_jobs:
+            c_pad = parsed[pi][1][2].selected.shape[1]
+            ogroups.setdefault((need_scores, c_pad), []).append((pi, gidx))
+        for (need_scores, c_pad), group in ogroups.items():
+            kmax = max(_pow2_bucket(g[1].size, 16, 1 << 30) for g in group)
+            devs = []
+            for pi, gidx in group:
+                pad = np.zeros(kmax, np.int32)
+                pad[: gidx.size] = gidx
+                out = parsed[pi][1][2]
+                if need_scores:
+                    devs.append(
+                        self._gather_over4(
+                            out.selected, out.counted, out.replicas,
+                            out.scores, pad,
+                        )
+                    )
+                else:
+                    devs.append(
+                        self._gather_over3(
+                            out.selected, out.counted, out.replicas, pad
+                        )
+                    )
+            arr = self._read_np(devs[0] if len(devs) == 1 else self._stack(*devs))
+            for gi, (pi, gidx) in enumerate(group):
+                over_res[pi] = (
+                    arr if len(devs) == 1 else arr[gi], c_pad, need_scores,
+                )
+        timings["fetch"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for i, (kind, item, packed, over_pos) in enumerate(parsed):
+            if kind == "delta":
+                slot, entry, out, idx, k = item
+                merged, idx_rows = self._apply_packed_delta(
+                    entry, out, idx, packed, over_pos, over_res.get(i), view
+                )
+                chunk_results[slot] = merged
+                chunk_changed[slot] = idx_rows
+            else:
+                slot, entry, out, n, k = item
+                results = self._apply_packed_full(
+                    entry, out, packed, over_pos, over_res.get(i), n, view,
+                    want_scores,
                 )
                 chunk_results[slot] = results
                 chunk_changed[slot] = None
@@ -1849,8 +2354,205 @@ class SchedulerEngine:
             entry.prev_view = view
         return results
 
+    # -- packed-format fetch helpers --------------------------------------
+    @staticmethod
+    def _split_overflow(arr: np.ndarray, c_pad: int, with_scores: bool):
+        """Split one overflow-gather read back into plane views:
+        (selected, replicas, counted, scores-or-None).  Layout:
+        [sel bits | cnt bits | rep | sco?] with ceil(C/32)-word masks."""
+        nw = -(-c_pad // 32)
+        sel = _unpack_bits(arr[:, :nw], c_pad)
+        cnt = _unpack_bits(arr[:, nw : 2 * nw], c_pad)
+        rep = arr[:, 2 * nw : 2 * nw + c_pad]
+        sco = (
+            arr[:, 2 * nw + c_pad : 2 * nw + 2 * c_pad] if with_scores else None
+        )
+        return sel, rep, cnt, sco
+
+    def _fetch_overflow(self, out, gidx: np.ndarray, with_scores: bool) -> tuple:
+        """Re-fetch of K-overflow rows (the packed export's escape
+        hatch): bit-packed selection/counted masks + the replica plane
+        (+ scores only for want_scores consumers) in one transfer."""
+        kp = _pow2_bucket(gidx.size, 16, 1 << 30)
+        pad = np.zeros(kp, np.int32)
+        pad[: gidx.size] = gidx
+        if with_scores:
+            dev = self._gather_over4(
+                out.selected, out.counted, out.replicas, out.scores, pad
+            )
+        else:
+            dev = self._gather_over3(
+                out.selected, out.counted, out.replicas, pad
+            )
+        c_pad = out.selected.shape[1]
+        return (self._read_np(dev), c_pad, with_scores)
+
+    @staticmethod
+    def _packed_record_fields(packed: PackedRows, topk: int):
+        """Per-row recorder top-k: the wire slots are already ordered
+        (score desc, index asc) over the selected clusters, so the
+        first slots ARE the top-k — for overflow rows too (their first
+        K slots are the global top-K by score)."""
+        idx = np.asarray(packed.idx)[:, :topk]
+        sco = np.asarray(packed.sco)[:, :topk]
+        topk_i, topk_s = [], []
+        for p in range(idx.shape[0]):
+            valid = idx[p] >= 0
+            topk_i.append(idx[p][valid].astype(np.int32))
+            topk_s.append(sco[p][valid].astype(np.int64))
+        return topk_i, topk_s
+
+    def _record_packed(
+        self, entry, rows, results_rows, packed: PackedRows, over_pos,
+        over_dense, view, program: str,
+    ) -> None:
+        """Packed-mode flight-recorder feed: reason summaries and
+        feasible counts come off the wire (no reason plane crosses the
+        link), so records match the dense path's core fields exactly."""
+        rec = self._tick_rec
+        if rec is None or entry is None:
+            return
+        topk_i, topk_s = self._packed_record_fields(packed, rec.topk)
+        units = entry.units
+        rec.record_rows(
+            [units[r].key for r in rows],
+            [res.clusters for res in results_rows],
+            None, None, view.names, program=program,
+            reason_counts=np.asarray(packed.rsum),
+            feasible_n=np.asarray(packed.nfeas),
+            topk_idx=topk_i, topk_scores=topk_s,
+        )
+
+    def _decode_packed_mixed(
+        self, packed: PackedRows, over_pos, over_dense, names, with_scores: bool
+    ) -> list[ScheduleResult]:
+        """Decode a packed fetch: packable rows from the wire slots,
+        K-overflow rows from their dense re-fetch planes."""
+        results = self._decode_packed_rows(packed, names, scores=with_scores)
+        if over_pos is not None and over_pos.size:
+            self.overflow_rows_total += int(over_pos.size)
+            arr, c_pad, has_sco = over_dense
+            sel, rep, cnt, sco = self._split_overflow(
+                arr[: over_pos.size], c_pad, has_sco
+            )
+            over_results = self._decode_rows(
+                sel, rep, cnt, names, scores=sco if with_scores else None
+            )
+            for p, r in zip(over_pos.tolist(), over_results):
+                results[p] = r
+        return results
+
+    def _apply_packed_delta(
+        self, entry, out, idx, packed: PackedRows, over_pos, over_dense, view
+    ):
+        """Packed analogue of _apply_delta: decode the wire rows, merge
+        into the cached decode, feed the recorder, store fresh outputs."""
+        results = self._decode_packed_mixed(
+            packed, over_pos, over_dense, view.names, entry.prev_has_scores
+        )
+        idx_rows = idx.tolist()
+        merged = list(entry.prev_results)
+        for row, res in zip(idx_rows, results):
+            merged[row] = res
+        self._record_packed(
+            entry, idx_rows, results, packed, over_pos, over_dense, view,
+            program=f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}",
+        )
+        entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+        entry.stale_out_rows = None
+        entry.prev_results = merged
+        entry.prev_view = view
+        return merged, idx_rows
+
+    def _apply_packed_full(
+        self, entry, out, packed: PackedRows, over_pos, over_dense, n: int,
+        view, want_scores: bool,
+    ) -> list[ScheduleResult]:
+        """Packed analogue of _apply_full (whole-chunk refetch)."""
+        self.fetch_stats["full"] += 1
+        results = self._decode_packed_mixed(
+            packed, over_pos, over_dense, view.names, want_scores
+        )
+        self._record_packed(
+            entry, range(n), results, packed, over_pos, over_dense, view,
+            program=(
+                f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}"
+                if entry is not None
+                else ""
+            ),
+        )
+        if entry is not None:
+            entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+            entry.stale_out_rows = None
+            entry.prev_results = results
+            entry.prev_has_scores = want_scores
+            entry.prev_view = view
+        return results
+
+    def _fetch_decode_packed(
+        self, entry, out, mask_dev, names, n: int, want_scores: bool, timings,
+        view, k: int,
+    ) -> tuple[list[ScheduleResult], Optional[list[int]]]:
+        """Packed-format result fetch for one chunk (the sequential
+        drain path): same skip/delta/full semantics as _fetch_decode,
+        but what crosses the link is the [*, 4K+2+NR] wire layout plus
+        a bit-packed re-fetch of K-overflow rows only."""
+        t2 = time.perf_counter()
+        if mask_dev is not None:
+            kind, idx = self._plan_delta(entry, self._read_np(mask_dev)[:n], n)
+            if kind == "skip":
+                self._note_skip(entry, out, view)
+                timings["fetch"] += time.perf_counter() - t2
+                return entry.prev_results, []
+            if kind == "delta":
+                self.fetch_stats["delta"] += 1
+                kp = _pow2_bucket(idx.size, 16, 1 << 30)
+                padded_idx = np.zeros(kp, np.int32)
+                padded_idx[: idx.size] = idx
+                wire = self._read_np(
+                    self._pack_program("gather", k)(
+                        out.selected, out.replicas, out.counted, out.scores,
+                        out.reasons, padded_idx,
+                    )
+                )
+                packed = unpack_wire(wire[: idx.size], k)
+                over_pos = np.nonzero(np.asarray(packed.nsel) > k)[0]
+                over_dense = None
+                if over_pos.size:
+                    over_dense = self._fetch_overflow(
+                        out, idx[over_pos], entry.prev_has_scores
+                    )
+                t3 = time.perf_counter()
+                timings["fetch"] += t3 - t2
+                merged, idx_rows = self._apply_packed_delta(
+                    entry, out, idx, packed, over_pos, over_dense, view
+                )
+                timings["decode"] += time.perf_counter() - t3
+                return merged, idx_rows
+            # fall through to a full packed fetch for mass changes
+        wire = self._read_np(
+            self._pack_program("full", k)(
+                out.selected, out.replicas, out.counted, out.scores, out.reasons
+            )
+        )
+        packed = unpack_wire(wire[:n], k)
+        over_pos = np.nonzero(np.asarray(packed.nsel) > k)[0]
+        over_dense = None
+        if over_pos.size:
+            over_dense = self._fetch_overflow(
+                out, over_pos.astype(np.int64), want_scores
+            )
+        t3 = time.perf_counter()
+        timings["fetch"] += t3 - t2
+        results = self._apply_packed_full(
+            entry, out, packed, over_pos, over_dense, n, view, want_scores
+        )
+        timings["decode"] += time.perf_counter() - t3
+        return results, None
+
     def _fetch_decode(
-        self, entry, out, mask_dev, names, n: int, want_scores: bool, timings, view
+        self, entry, out, mask_dev, names, n: int, want_scores: bool, timings,
+        view, pack_k: Optional[int] = None,
     ) -> tuple[list[ScheduleResult], Optional[list[int]]]:
         """Returns (results, changed-local-rows or None for all).
 
@@ -1862,9 +2564,14 @@ class SchedulerEngine:
         trigger-hash skip).  Score planes ride the same delta: bit 1 of
         the mask flags score-only changes, consulted only when the
         cached decodes carry scores."""
+        if self.fetch_format == "packed" and pack_k is not None:
+            return self._fetch_decode_packed(
+                entry, out, mask_dev, names, n, want_scores, timings, view,
+                pack_k,
+            )
         t2 = time.perf_counter()
         if mask_dev is not None:
-            kind, idx = self._plan_delta(entry, np.asarray(mask_dev)[:n], n)
+            kind, idx = self._plan_delta(entry, self._read_np(mask_dev)[:n], n)
             if kind == "skip":
                 self._note_skip(entry, out, view)
                 timings["fetch"] += time.perf_counter() - t2
@@ -1891,7 +2598,7 @@ class SchedulerEngine:
                         out.selected, out.replicas, out.counted, padded_idx
                     )
                     planes = 3
-                packed = np.asarray(packed_dev)
+                packed = self._read_np(packed_dev)
                 t3 = time.perf_counter()
                 timings["fetch"] += t3 - t2
                 merged, idx_rows = self._apply_delta(
@@ -1903,11 +2610,11 @@ class SchedulerEngine:
             # fall through to a full fetch for mass changes
 
         record = self._tick_rec is not None and entry is not None
-        selected = np.asarray(out.selected)
-        replicas = np.asarray(out.replicas)
-        counted = np.asarray(out.counted)
-        scores = np.asarray(out.scores) if (want_scores or record) else None
-        reasons = np.asarray(out.reasons) if record else None
+        selected = self._read_np(out.selected)
+        replicas = self._read_np(out.replicas)
+        counted = self._read_np(out.counted)
+        scores = self._read_np(out.scores) if (want_scores or record) else None
+        reasons = self._read_np(out.reasons) if record else None
         t3 = time.perf_counter()
         timings["fetch"] += t3 - t2
         results = self._apply_full(
@@ -2038,6 +2745,25 @@ class SchedulerEngine:
                             out.scores, out.reasons, idx,
                         )
                     )
+                    if self.fetch_format == "packed":
+                        pk = self._pack_k(ci, c_bucket)
+                        jax.block_until_ready(
+                            self._pack_program("full", pk)(
+                                out.selected, out.replicas, out.counted,
+                                out.scores, out.reasons,
+                            )
+                        )
+                        jax.block_until_ready(
+                            self._pack_program("gather", pk)(
+                                out.selected, out.replicas, out.counted,
+                                out.scores, out.reasons, idx,
+                            )
+                        )
+                        jax.block_until_ready(
+                            self._gather_over3(
+                                out.selected, out.counted, out.replicas, idx
+                            )
+                        )
                     log.info("prewarmed tick program %s", shape)
             except Exception:
                 log.warning("engine prewarm failed", exc_info=True)

@@ -143,7 +143,7 @@ class TestEngineFlightRecorder:
     """Engine-level: records are populated from the existing fetch paths
     and explain() answers for every scheduled object."""
 
-    def _schedule(self, n_units=40, n_clusters=12, seed=7):
+    def _schedule(self, n_units=40, n_clusters=12, seed=7, fetch_format="packed"):
         from test_engine_vs_sequential import random_cluster, random_unit
 
         from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
@@ -156,13 +156,16 @@ class TestEngineFlightRecorder:
         rec = FlightRecorder(max_ticks=4, max_bytes=64 << 20, topk=4)
         engine = SchedulerEngine(
             chunk_size=16, min_bucket=8, min_cluster_bucket=8, mesh=None,
-            flight_recorder=rec,
+            flight_recorder=rec, fetch_format=fetch_format,
         )
         results = engine.schedule(units, clusters)
         return engine, rec, units, clusters, results
 
-    def test_cold_tick_records_every_object(self):
-        engine, rec, units, clusters, results = self._schedule()
+    @pytest.mark.parametrize("fetch_format", ["packed", "dense"])
+    def test_cold_tick_records_every_object(self, fetch_format):
+        engine, rec, units, clusters, results = self._schedule(
+            fetch_format=fetch_format
+        )
         for su, res in zip(units, results):
             record = rec.lookup(su.key)
             assert record is not None, su.key
@@ -171,12 +174,24 @@ class TestEngineFlightRecorder:
                 cl: (None if reps is None else int(reps))
                 for cl, reps in res.clusters.items()
             }
-            # Every non-selected cluster names its rejection.
-            for name, verdict in explained["clusters"].items():
-                if name in res.clusters:
-                    assert verdict["reasons"] == []
-                else:
-                    assert verdict["reasons"], (su.key, name, verdict)
+            if fetch_format == "dense":
+                # Full fidelity: every non-selected cluster names its
+                # rejection individually.
+                assert set(explained["clusters"]) == {
+                    cl.name for cl in clusters
+                }
+                for name, verdict in explained["clusters"].items():
+                    if name in res.clusters:
+                        assert verdict["reasons"] == []
+                    else:
+                        assert verdict["reasons"], (su.key, name, verdict)
+            else:
+                # Packed: selected clusters individually, everything
+                # else summarized under "rejected" by reason slug.
+                assert set(explained["clusters"]) == set(res.clusters)
+                rejected_total = sum(explained["rejected"].values())
+                if len(res.clusters) < len(clusters):
+                    assert rejected_total > 0, (su.key, explained)
 
     def test_churn_rows_get_fresh_records(self):
         from test_engine_vs_sequential import random_unit

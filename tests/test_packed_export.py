@@ -1,0 +1,340 @@
+"""Packed placement export (ISSUE 3): the device-side top-k compaction
+must be bit-exact against the sequential oracle's pack_one, the engine's
+packed fetch format must produce placements identical to the dense
+format on every path (including K-overflow fallbacks, score ties at the
+select boundary and zero-replica rows), and flight-recorder records must
+carry identical core fields in both formats."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from test_pipeline import R, random_problem, to_tick_inputs
+
+from kubeadmiral_tpu.ops import pipeline as dev
+from kubeadmiral_tpu.ops import reasons as RSN
+from kubeadmiral_tpu.ops.pipeline_oracle import pack_one
+from kubeadmiral_tpu.ops.planner import INT32_INF
+
+
+def device_pack(problems, c, k):
+    out = dev.schedule_tick(to_tick_inputs(problems, c))
+    return dev.pack_rows(
+        np.asarray(out.selected), np.asarray(out.replicas),
+        np.asarray(out.counted), np.asarray(out.scores),
+        np.asarray(out.reasons), k,
+    )
+
+
+class TestPackRowsVsOracle:
+    @pytest.mark.parametrize("c,k", [(3, 8), (8, 4), (19, 8), (19, 32)])
+    def test_pack_matches_oracle_bit_exactly(self, c, k):
+        rng = np.random.default_rng(4000 + c * 100 + k)
+        names = [f"member-{j}" for j in range(c)]
+        # Cluster-axis tensors are shared across the batch in
+        # TickInputs, so every problem must carry the same planes.
+        shared_alloc = [[int(x) for x in rng.integers(5, 50, R)] for _ in range(c)]
+        shared_used = [[int(x) for x in rng.integers(0, 40, R)] for _ in range(c)]
+        shared_cpu_a = [int(x) for x in rng.integers(0, 30, c)]
+        shared_cpu_v = [int(x) for x in rng.integers(-3, 25, c)]
+        problems = []
+        for i in range(60):
+            p = random_problem(rng, c, f"ns-{i}/w-{i}", names)
+            p.alloc, p.used = shared_alloc, shared_used
+            p.cpu_alloc, p.cpu_avail = shared_cpu_a, shared_cpu_v
+            problems.append(p)
+        p = device_pack(problems, c, k)
+        keff = min(k, c)
+        for i, prob in enumerate(problems):
+            want = pack_one(prob, keff)
+            got = {
+                "idx": np.asarray(p.idx)[i].tolist(),
+                "rep": np.asarray(p.rep)[i].tolist(),
+                "cnt": np.asarray(p.cnt)[i].tolist(),
+                "sco": np.asarray(p.sco)[i].tolist(),
+                "nsel": int(np.asarray(p.nsel)[i]),
+                "nfeas": int(np.asarray(p.nfeas)[i]),
+                "rsum": np.asarray(p.rsum)[i].tolist(),
+            }
+            assert got == want, (i, got, want, prob)
+
+    def test_wire_roundtrip(self):
+        c, k = 8, 4
+        rng = np.random.default_rng(99)
+        names = [f"member-{j}" for j in range(c)]
+        problems = [
+            random_problem(rng, c, f"ns/w-{i}", names) for i in range(20)
+        ]
+        out = dev.schedule_tick(to_tick_inputs(problems, c))
+        planes = (
+            np.asarray(out.selected), np.asarray(out.replicas),
+            np.asarray(out.counted), np.asarray(out.scores),
+            np.asarray(out.reasons),
+        )
+        wire = np.asarray(dev.pack_wire(*planes, k))
+        assert wire.shape == (len(problems), dev.wire_width(k))
+        p = dev.unpack_wire(wire, k)
+        direct = dev.pack_rows(*planes, k)
+        for field in p._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(p, field)), np.asarray(getattr(direct, field))
+            )
+
+    def test_overflow_flag_and_boundary_ties(self):
+        """Score ties at the top-K select boundary resolve by cluster
+        index in select_topk; the packed export must reproduce exactly
+        that selected set, and rows selecting more than K clusters must
+        flag overflow (nsel > K) without corrupting packable rows."""
+        c = 12
+        names = [f"m-{j}" for j in range(c)]
+        rng = np.random.default_rng(0)
+
+        def flat(maxc):
+            # All clusters feasible with IDENTICAL scores: the top-K cut
+            # is decided purely by the index tie-break.
+            p = random_problem(rng, c, "ns/tie", names)
+            p.filter_enabled = [True] * 5
+            p.score_enabled = [False] * 5
+            p.api_ok = [True] * c
+            p.taint_ok_new = [True] * c
+            p.taint_ok_cur = [True] * c
+            p.selector_ok = [True] * c
+            p.placement_ok = [True] * c
+            p.placement_has = False
+            p.request = [0] * R
+            p.max_clusters = maxc
+            p.mode_divide = False
+            p.sticky = False
+            p.current = {}
+            return p
+
+        k = 4
+        problems = [flat(4), flat(7), flat(None), flat(0)]
+        p = device_pack(problems, c, k)
+        nsel = np.asarray(p.nsel).tolist()
+        assert nsel == [4, 7, c, 0]
+        # Row 0 fits exactly; ties broke by index: clusters 0..3.
+        assert np.asarray(p.idx)[0].tolist() == [0, 1, 2, 3]
+        # Rows 1 and 2 overflow (nsel > K); their first-K slots still
+        # hold the lowest selected indices.
+        assert np.asarray(p.idx)[1].tolist() == [0, 1, 2, 3]
+        # Row 3 selects nothing: all slots padded.
+        assert np.asarray(p.idx)[3].tolist() == [dev.PACK_FILL] * k
+        assert np.asarray(p.rsum)[3][
+            RSN.REASON_BITS.index(RSN.REASON_MAX_CLUSTERS)
+        ] == c
+
+    def test_zero_replica_rows_pack_empty(self):
+        """Divide-mode rows whose planner assigns 0 everywhere are
+        dropped from the selected set: packed rows must be empty with
+        the zero_replicas summary accounting for every cut cluster."""
+        c = 6
+        names = [f"m-{j}" for j in range(c)]
+        rng = np.random.default_rng(1)
+        p = random_problem(rng, c, "ns/zero", names)
+        p.filter_enabled = [True] * 5
+        p.score_enabled = [False] * 5
+        p.api_ok = [True] * c
+        p.taint_ok_new = [True] * c
+        p.taint_ok_cur = [True] * c
+        p.selector_ok = [True] * c
+        p.placement_ok = [True] * c
+        p.placement_has = False
+        p.request = [0] * R
+        p.max_clusters = None
+        p.mode_divide = True
+        p.sticky = False
+        p.current = {}
+        p.total = 0
+        p.weights = {j: 1 for j in range(c)}
+        p.min_replicas = {}
+        p.max_replicas = {}
+        p.capacity = {}
+        packed = device_pack([p], c, 4)
+        assert int(np.asarray(packed.nsel)[0]) == 0
+        assert np.asarray(packed.idx)[0].tolist() == [dev.PACK_FILL] * 4
+        zr = RSN.REASON_BITS.index(RSN.REASON_ZERO_REPLICAS)
+        assert int(np.asarray(packed.rsum)[0][zr]) == c
+
+
+def make_engines(pack_k_min=16, **kw):
+    from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+    recs = {}
+    engines = {}
+    for fmt in ("packed", "dense"):
+        recs[fmt] = FlightRecorder(max_ticks=8, max_bytes=64 << 20, topk=4)
+        engines[fmt] = SchedulerEngine(
+            chunk_size=16, min_bucket=8, min_cluster_bucket=8, mesh=None,
+            fetch_format=fmt, flight_recorder=recs[fmt],
+            pack_k_min=pack_k_min, **kw,
+        )
+    return engines, recs
+
+
+def make_world(n_units=48, n_clusters=12, seed=11):
+    from test_engine_vs_sequential import random_cluster, random_unit
+
+    rng = np.random.default_rng(seed)
+    clusters = [random_cluster(rng, j) for j in range(n_clusters)]
+    names = [cl.name for cl in clusters]
+    units = [random_unit(rng, i, names) for i in range(n_units)]
+    return rng, units, clusters, names
+
+
+def assert_results_equal(got, want):
+    assert len(got) == len(want)
+    for i, (a, b) in enumerate(zip(got, want)):
+        assert dict(a.clusters) == dict(b.clusters), (
+            i, dict(a.clusters), dict(b.clusters)
+        )
+
+
+class TestEnginePackedVsDense:
+    """Packed-vs-dense A/B: identical placements on every fetch path,
+    including engines whose tiny K forces routine overflow fallbacks."""
+
+    @pytest.mark.parametrize("pack_k_min", [16, 2])
+    def test_all_paths_identical(self, pack_k_min):
+        from test_engine_vs_sequential import random_unit
+
+        engines, recs = make_engines(pack_k_min=pack_k_min)
+        rng, units, clusters, names = make_world()
+
+        # Cold tick (full fetch path).
+        cold = {f: e.schedule(units, clusters) for f, e in engines.items()}
+        assert_results_equal(cold["packed"], cold["dense"])
+        if pack_k_min == 2:
+            # K=2 with unlimited-maxClusters rows: overflow MUST engage.
+            assert engines["packed"].overflow_rows_total > 0
+
+        # Churn tick (sub-batch or delta path).
+        units2 = list(units)
+        units2[3] = random_unit(rng, 300, names)
+        units2[20] = random_unit(rng, 301, names)
+        churn = {f: e.schedule(units2, clusters) for f, e in engines.items()}
+        assert_results_equal(churn["packed"], churn["dense"])
+
+        # Resource-drift tick (full dispatch + delta fetch path).
+        drifted = list(clusters)
+        drifted[0] = dataclasses.replace(
+            drifted[0],
+            available={k: max(0, v // 3) for k, v in drifted[0].available.items()},
+        )
+        drift = {f: e.schedule(units2, drifted) for f, e in engines.items()}
+        assert_results_equal(drift["packed"], drift["dense"])
+
+        # Both formats agree with a cache-less fresh engine too.
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+        fresh = SchedulerEngine(
+            chunk_size=16, min_bucket=8, min_cluster_bucket=8, mesh=None,
+            fetch_format="dense", flight_recorder=None,
+        ).schedule(units2, drifted)
+        assert_results_equal(drift["packed"], fresh)
+
+    def test_want_scores_identical(self):
+        engines, _ = make_engines()
+        _, units, clusters, _ = make_world(seed=23)
+        got = {
+            f: e.schedule(units, clusters, want_scores=True)
+            for f, e in engines.items()
+        }
+        assert_results_equal(got["packed"], got["dense"])
+        for a, b in zip(got["packed"], got["dense"]):
+            assert dict(a.scores) == dict(b.scores)
+
+    def test_recorder_records_identical_core(self):
+        """The flight recorder's format-independent core — placements,
+        reason counts, feasible count, selected top-k — must be
+        identical between packed and dense; only the dense format keeps
+        the full per-cluster mask row."""
+        engines, recs = make_engines()
+        _, units, clusters, _ = make_world(seed=31)
+        for e in engines.values():
+            e.schedule(units, clusters)
+        for su in units:
+            a = recs["packed"].lookup(su.key)
+            b = recs["dense"].lookup(su.key)
+            assert a is not None and b is not None, su.key
+            assert dict(a.placements) == dict(b.placements)
+            assert a.reason_counts.tolist() == b.reason_counts.tolist()
+            assert a.feasible_n == b.feasible_n
+            assert a.topk_idx.tolist() == b.topk_idx.tolist()
+            assert a.topk_scores.tolist() == b.topk_scores.tolist()
+            assert a.reasons is None
+            assert b.reasons is not None
+            # The dense row's summary equals the packed wire summary.
+            r = b.reasons.astype(np.int64)
+            want_counts = [
+                int(((r & bit) != 0).sum()) for bit in RSN.REASON_BITS
+            ]
+            assert a.reason_counts.tolist() == want_counts
+            # summarize identically (the ScheduleFailed vocabulary).
+            from kubeadmiral_tpu.runtime import flightrec as FR
+
+            assert FR.summarize_reasons(a) == FR.summarize_reasons(b)
+
+    def test_recorder_overflow_rows_record_identical_core(self):
+        """K-overflow rows (dense re-fetch fallback) must still produce
+        the same recorder core as the dense format."""
+        engines, recs = make_engines(pack_k_min=2)
+        _, units, clusters, _ = make_world(seed=37)
+        for e in engines.values():
+            e.schedule(units, clusters)
+        assert engines["packed"].overflow_rows_total > 0
+        for su in units:
+            a = recs["packed"].lookup(su.key)
+            b = recs["dense"].lookup(su.key)
+            assert dict(a.placements) == dict(b.placements)
+            assert a.reason_counts.tolist() == b.reason_counts.tolist()
+            assert a.feasible_n == b.feasible_n
+            assert a.topk_idx.tolist() == b.topk_idx.tolist()
+            assert a.topk_scores.tolist() == b.topk_scores.tolist()
+
+    def test_explain_covers_placements_and_rejected_summary(self):
+        engines, recs = make_engines()
+        _, units, clusters, names = make_world(seed=41)
+        results = {f: e.schedule(units, clusters) for f, e in engines.items()}
+        for i, su in enumerate(units):
+            ex_p = recs["packed"].explain(su.key)
+            ex_d = recs["dense"].explain(su.key)
+            assert ex_p["placements"] == ex_d["placements"]
+            assert ex_p["rejected"] == ex_d["rejected"]
+            assert ex_p["feasible_clusters"] == ex_d["feasible_clusters"]
+            # Packed explain covers exactly the selected clusters.
+            assert set(ex_p["clusters"]) == set(results["packed"][i].clusters)
+            for name, verdict in ex_p["clusters"].items():
+                assert verdict["reasons"] == []
+            # Dense explain still names every cluster's verdict.
+            assert set(ex_d["clusters"]) == set(names)
+
+    def test_fetch_bytes_accounting(self):
+        engines, _ = make_engines()
+        _, units, clusters, _ = make_world(seed=43)
+        for e in engines.values():
+            assert e.fetch_bytes_total == 0
+            e.schedule(units, clusters)
+            assert e.fetch_bytes_total > 0
+
+
+class TestPackKPolicy:
+    def test_k_tracks_finite_max_clusters(self):
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+        eng = SchedulerEngine(mesh=None, flight_recorder=None)
+
+        class Inputs:
+            max_clusters = np.asarray([3, 40, int(INT32_INF), -1], np.int32)
+
+        # Largest finite bound is 40 -> pow2 64, capped by the cluster
+        # bucket.
+        assert eng._pack_k(Inputs(), 512) == 64
+        assert eng._pack_k(Inputs(), 32) == 32
+
+        class Unlimited:
+            max_clusters = np.asarray([int(INT32_INF)], np.int32)
+
+        assert eng._pack_k(Unlimited(), 512) == 16  # the KT_PACK_K floor

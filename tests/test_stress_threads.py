@@ -69,9 +69,26 @@ class TestThreadStress:
 
         # Every controller on its own threads (2 workers each) — the
         # reference's --worker-count concurrency, actually concurrent.
+        # Everything below runs under try/finally: a failing assertion
+        # (or a raced divergence probe) must still stop every worker,
+        # or 8 live reconcile threads keep storming the process through
+        # the REST of the suite (observed as a tail-wide crawl).
         for ctl in controllers:
             ctl.worker.run(workers=2)
+        try:
+            self._storm_and_converge(fleet, ftc, controllers)
+        finally:
+            for ctl in controllers:
+                ctl.worker.stop()
 
+        # No exceptions escaped any reconcile worker.
+        for ctl in controllers:
+            panic_count = ctl.metrics.counters.get(f"{ctl.worker.name}.panic", 0)
+            assert not panic_count, (
+                f"{ctl.worker.name}: {panic_count} reconcile panics"
+            )
+
+    def _storm_and_converge(self, fleet, ftc, controllers):
         fuzz_errors: list[BaseException] = []
 
         def fuzz(seed: int):
@@ -123,10 +140,11 @@ class TestThreadStress:
 
         def divergence():
             """None when every invariant holds, else a description."""
-            sources = {
-                key: fleet.host.get(ftc.source.resource, key)
-                for key in fleet.host.keys(ftc.source.resource)
-            }
+            sources = {}
+            for key in fleet.host.keys(ftc.source.resource):
+                obj = fleet.host.try_get(ftc.source.resource, key)
+                if obj is not None:  # tolerate in-flight deletions
+                    sources[key] = obj
             for key, src in sources.items():
                 fed = fleet.host.try_get(ftc.federated.resource, key)
                 if fed is None:
@@ -159,16 +177,7 @@ class TestThreadStress:
             last = divergence()
             if last is None:
                 break
-        for ctl in controllers:
-            ctl.worker.stop()
         assert last is None, last
-
-        # No exceptions escaped any reconcile worker.
-        for ctl in controllers:
-            panic_count = ctl.metrics.counters.get(f"{ctl.worker.name}.panic", 0)
-            assert not panic_count, (
-                f"{ctl.worker.name}: {panic_count} reconcile panics"
-            )
 
 
 class TestThreadStressHTTP:

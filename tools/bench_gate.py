@@ -64,6 +64,11 @@ def load_rounds(root: Path) -> list[dict]:
                 "platform": detail.get("platform") or "unknown",
                 "value": float(value),
                 "tick_ms": detail.get("tick_ms"),
+                # Informational fields carried through (never gated, and
+                # absent in pre-packed rounds): the fetch wire format and
+                # per-tick transfer volume of the packed-export work.
+                "fetch_format": detail.get("fetch_format"),
+                "fetch_bytes": detail.get("fetch_bytes"),
             }
         )
     rounds.sort(key=lambda r: r["round"])
@@ -95,6 +100,18 @@ def gate(rounds: list[dict], tolerance: float) -> int:
         f"bench-gate: {latest['path']} value={latest['value']:.1f} vs best "
         f"prior {best_value:.1f} (floor {floor:.1f}, tol {tolerance:.0%})"
     )
+    if latest.get("fetch_format") is not None:
+        prior_bytes = [
+            r["fetch_bytes"] for r in priors if r.get("fetch_bytes") is not None
+        ]
+        note = (
+            f" (best prior {min(prior_bytes)})" if prior_bytes else ""
+        )
+        print(
+            f"bench-gate: fetch_format={latest['fetch_format']} "
+            f"fetch_bytes={latest['fetch_bytes']}{note} — informational, "
+            f"not gated"
+        )
     if latest["value"] < floor:
         print(
             f"bench-gate: THROUGHPUT REGRESSION: {latest['value']:.1f} < "
