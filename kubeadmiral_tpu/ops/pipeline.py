@@ -377,6 +377,356 @@ def schedule_tick(inp: TickInputs) -> TickOutputs:
     )
 
 
+# -- drift gate -----------------------------------------------------------
+# A cluster-capacity drift tick must revalidate every row, but the rows
+# whose DECISION can actually move are a function of which cluster
+# columns changed.  These kernels classify rows exactly, from the cached
+# per-object planes plus the previous tick's feasibility plane, without
+# running the expensive select/planner stages:
+#
+#   recompute — the row's placement may change and must be re-scheduled;
+#   wcheck    — the selection provably cannot change, but the row uses
+#               DYNAMIC weights over a cluster whose CPU figures moved:
+#               compare old-vs-new weights (drift_wcheck) and recompute
+#               only on a real difference;
+#   (neither) — the row's outputs are provably bit-identical.
+#
+# Exactness argument (each step is checked by tests/test_drift_tick.py's
+# randomized differential):
+#
+# 1. Feasibility depends on the cluster planes ONLY through the
+#    resource-fit mask (filters.resources_fit); every other filter input
+#    is per-object/topology.  So feasibility can flip only on changed
+#    columns — recompute any row with such a flip ("fitflip").
+# 2. The normalized score plugins (taint, affinity) read per-object
+#    planes and normalize by the per-row max over FEASIBLE columns; the
+#    resource plugins are per-cell functions of (request, alloc, used).
+#    Hence, absent a fit flip, the score totals change only on changed
+#    columns — and a column that is infeasible contributes neither a
+#    total nor a normalization max.
+# 3. Selection: with max_clusters >= nfeas (or unlimited, or negative =
+#    select nothing), the top-K cut never engages — selection IS the
+#    feasible set, so score changes cannot move it.  Otherwise the cut
+#    is rank-based.  Unchanged columns keep their relative order (their
+#    totals are untouched, step 2), so the selected SET changes iff
+#    some changed column's top-K membership flips: a non-delta column
+#    can enter (leave) the set only when a delta column leaves (enters)
+#    it.  For small deltas the gate tests that exactly — it derives the
+#    changed columns' new totals from the stored score plane (the
+#    resource plugins are per-cell, the normalized plugins untouched)
+#    and counts, per the select stage's own (-total, index) comparator,
+#    how many feasible columns outrank each delta column before and
+#    after.  Wider deltas fall back to the conservative "any delta
+#    column feasible" rule.  Either way the gate scatters the updated
+#    totals back into the stored score plane, so skipped rows' stored
+#    state stays exact for future drift ticks.
+# 4. Replicas: the planner consumes per-object inputs plus the weights.
+#    Static weights are per-object; dynamic weights read cpu_alloc/
+#    cpu_avail of the SELECTED clusters.  A Divide-mode row without
+#    given weights whose selection touches a cpu-changed column goes to
+#    wcheck: selection is provably unchanged there (step 3), so
+#    comparing dynamic_weights old-vs-new on that selection decides
+#    replica equality exactly.
+# 5. Sticky rows with current placements short-circuit to their current
+#    clusters — independent of cluster planes entirely — and are never
+#    candidates.
+#
+# Skipped (and weight-equal wcheck) rows keep their previous outputs;
+# their score/reason introspection planes may go stale on changed
+# columns, exactly like the engine's existing mask-only "skip" path —
+# placement planes stay exact, which is what parity and the delta
+# machinery consume.
+
+DRIFT_RECOMPUTE = 1  # gate-mask bit: row must be re-scheduled
+DRIFT_WCHECK = 2     # gate-mask bit: row needs the dynamic-weight check
+
+# Widest delta the exact top-K membership refinement runs at: the rank
+# counts cost O(B x C x D) compares, so wider drifts use the
+# conservative any-delta-column-feasible rule instead.
+DRIFT_REFINE_MAX_COLS = 8
+
+
+def _resource_scores_cols(request, score_enabled, alloc_d, used_d):
+    """The cluster-plane-dependent part of a row's score total at the
+    given columns: the resource plugins, enabled-masked (taint/affinity
+    are per-object and normalization is untouched without a fit flip —
+    see the exactness argument above)."""
+    parts = (
+        (S.S_BALANCED, S.balanced_allocation_score(request, alloc_d, used_d)),
+        (S.S_LEAST, S.least_allocated_score(request, alloc_d, used_d)),
+        (S.S_MOST, S.most_allocated_score(request, alloc_d, used_d)),
+    )
+    total = jnp.zeros((request.shape[0], alloc_d.shape[0]), jnp.int64)
+    for idx, s in parts:
+        total = total + jnp.where(score_enabled[:, idx, None], s, 0)
+    return total
+
+
+def _drift_classify(
+    fea_new_d,      # bool[B, D] feasibility of the changed columns, new planes
+    prev_feas_d,    # i8[B, D] previous feasibility at the changed columns
+    prev_feas,      # i8[B, C] previous feasibility plane
+    prev_scores,    # i32[B, C] previous post-normalize totals
+    res_old_d,      # i64[B, D] resource-score part at the columns, old planes
+    res_new_d,      # i64[B, D] resource-score part at the columns, new planes
+    delta_idx,      # i32[D] changed column indices (pad: out of range)
+    delta_valid,    # bool[D] slot is a real changed column (not padding)
+    delta_cpu,      # bool[D] the column's cpu_alloc/cpu_avail changed
+    max_clusters,   # i32[B]
+    mode_divide,    # bool[B]
+    weights_given,  # bool[B]
+    sticky_active,  # bool[B]
+):
+    """Shared tail of the dense/compact drift gates.
+
+    Returns (i8[B] bit mask, i32[B, C] updated score plane): the mask
+    classifies rows, and the score plane is the stored totals with the
+    changed columns' values refreshed — so skipped rows' cached state
+    stays exact across consecutive drift ticks."""
+    b, c = prev_feas.shape
+    pf = prev_feas != 0
+    pf_d = prev_feas_d != 0
+    valid = delta_valid[None, :]
+    fitflip = ((fea_new_d != pf_d) & valid).any(axis=1)
+    dcpu_any = (pf_d & (delta_cpu & delta_valid)[None, :]).any(axis=1)
+    nfeas = pf.sum(axis=1, dtype=jnp.int32)
+    # Selection equals the feasible set when the top-K cut cannot engage
+    # (unlimited, K >= nfeas, or negative K = empty selection).
+    kinf = (
+        (max_clusters == INT32_INF)
+        | (max_clusters < 0)
+        | (max_clusters >= nfeas)
+    )
+
+    # Updated totals at the changed columns (masked exactly like the
+    # tick: zero where infeasible), and the scatter back into the
+    # stored plane (padded delta slots are out of range -> dropped).
+    tot_old_d = prev_scores[:, jnp.clip(delta_idx, 0, c - 1)].astype(jnp.int64)
+    tot_new_d = jnp.where(pf_d, tot_old_d - res_old_d + res_new_d, 0)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    new_scores = prev_scores.at[
+        rows, jnp.broadcast_to(delta_idx[None, :], (b, delta_idx.shape[0]))
+    ].set(tot_new_d.astype(jnp.int32), mode="drop")
+
+    d = delta_idx.shape[0]
+    if d <= DRIFT_REFINE_MAX_COLS:
+        # Exact top-K refinement: the selected set changes iff some
+        # delta column's top-K membership flips (unchanged columns keep
+        # their relative order, so one can only enter/leave when a
+        # delta column leaves/enters).  Membership is counted with the
+        # select stage's own comparator: (-total, index) ascending.
+        is_delta = jnp.zeros(c, bool).at[delta_idx].set(
+            delta_valid, mode="drop"
+        )
+        s_plane = prev_scores.astype(jnp.int64)[:, :, None]   # [B, C, 1]
+        j_idx = jnp.arange(c, dtype=jnp.int32)[None, :, None]
+
+        def above_counts(tot_d):
+            t = tot_d[:, None, :]                              # [B, 1, D]
+            beats = (s_plane > t) | (
+                (s_plane == t) & (j_idx < delta_idx[None, None, :])
+            )
+            unchanged = (pf & ~is_delta[None, :])[:, :, None]
+            cnt = jnp.sum(beats & unchanged, axis=1, dtype=jnp.int32)
+            # Delta-vs-delta comparisons use the same snapshot's totals.
+            te = tot_d[:, :, None]                             # [B, D(e), 1]
+            td = tot_d[:, None, :]                             # [B, 1, D(d)]
+            e_beats = (te > td) | (
+                (te == td)
+                & (delta_idx[:, None] < delta_idx[None, :])[None, :, :]
+            )
+            e_mask = (pf_d & valid)[:, :, None]
+            return cnt + jnp.sum(e_beats & e_mask, axis=1, dtype=jnp.int32)
+
+        k = jnp.clip(max_clusters, 0, c)[:, None]
+        member_old = pf_d & (above_counts(tot_old_d) < k)
+        member_new = pf_d & (above_counts(tot_new_d) < k)
+        sel_exposed = ((member_old != member_new) & valid).any(axis=1)
+        # Finite-K rows with DYNAMIC weights whose top-K selection
+        # touches a cpu-changed column: their weight set is the top-K
+        # selection (not the feasible set), so the wcheck comparison
+        # below cannot decide them — recompute.  (member_old|member_new
+        # is exact top-K membership from the rank counts.)
+        dyn_fin = (
+            (member_old | member_new) & (delta_cpu & delta_valid)[None, :]
+        ).any(axis=1)
+        sel_exposed = sel_exposed | (
+            mode_divide & ~weights_given & dyn_fin
+        )
+    else:
+        # Conservative: any feasible delta column may cross the K cut
+        # (this also covers the finite-K dynamic-weight exposure, since
+        # a cpu-changed column in the selection is feasible).
+        sel_exposed = ((fea_new_d | pf_d) & valid).any(axis=1)
+
+    recompute = ~sticky_active & (fitflip | (~kinf & sel_exposed))
+    # The weight check is sound ONLY where selection provably equals
+    # the feasible set (kinf): dynamic weights are computed over the
+    # selection, and that is what drift_wcheck reconstructs from
+    # prev_feas.
+    wcheck = (
+        ~sticky_active
+        & ~recompute
+        & kinf
+        & mode_divide
+        & ~weights_given
+        & dcpu_any
+    )
+    mask = (
+        recompute.astype(jnp.int8) * DRIFT_RECOMPUTE
+        + wcheck.astype(jnp.int8) * DRIFT_WCHECK
+    )
+    return mask, new_scores
+
+
+def drift_gate_dense(
+    per_object: dict,
+    prev_feas,
+    prev_scores,
+    alloc_old_d,
+    used_old_d,
+    alloc_new_d,
+    used_new_d,
+    delta_idx,
+    delta_valid,
+    delta_cpu,
+):
+    """Drift gate over dense cached per-object planes.
+
+    ``per_object`` is the engine's cached device dict (every TickInputs
+    field that is not cluster-axis-only); ``*_old_d``/``*_new_d`` are
+    the OLD/NEW cluster tensors pre-sliced at the changed columns
+    (i64[D, R]); ``delta_idx`` i32[D] names the changed columns (padded
+    entries carry an out-of-range index and ``delta_valid`` False).
+    Returns (i8[B] mask, i32[B, C] refreshed score plane)."""
+    b = per_object["total"].shape[0]
+    _note_trace("drift_gate", b, prev_feas.shape[1])
+    c = prev_feas.shape[1]
+    d_safe = jnp.clip(delta_idx, 0, c - 1)
+    fit_new = F.resources_fit(per_object["request"], alloc_new_d, used_new_d)
+    fea_new_d = F.combine_filters(
+        per_object["filter_enabled"],
+        per_object["api_ok"][:, d_safe],
+        per_object["taint_ok_new"][:, d_safe],
+        per_object["taint_ok_cur"][:, d_safe],
+        per_object["current_mask"][:, d_safe],
+        fit_new,
+        per_object["placement_has"],
+        per_object["placement_ok"][:, d_safe],
+        per_object["selector_ok"][:, d_safe],
+    ) & per_object["webhook_ok"][:, d_safe]
+    sticky_active = per_object["sticky"] & per_object["current_mask"].any(axis=1)
+    enabled = per_object["score_enabled"]
+    return _drift_classify(
+        fea_new_d,
+        prev_feas[:, d_safe],
+        prev_feas,
+        prev_scores,
+        _resource_scores_cols(
+            per_object["request"], enabled, alloc_old_d, used_old_d
+        ),
+        _resource_scores_cols(
+            per_object["request"], enabled, alloc_new_d, used_new_d
+        ),
+        delta_idx,
+        delta_valid,
+        delta_cpu,
+        per_object["max_clusters"],
+        per_object["mode_divide"],
+        per_object["weights_given"],
+        sticky_active,
+    )
+
+
+def drift_gate_compact(
+    per_object: dict,
+    tables: dict,
+    prev_feas,
+    prev_scores,
+    alloc_old_d,
+    used_old_d,
+    alloc_new_d,
+    used_new_d,
+    delta_idx,
+    delta_valid,
+    delta_cpu,
+    cur_absent,
+):
+    """Compact-format drift gate: the changed columns' filter masks are
+    gathered straight from the vocabulary tables (a D-column slice of
+    ops.pipeline.expand_compact), so the gate never materializes [B, C]
+    planes."""
+    b = per_object["total"].shape[0]
+    _note_trace("drift_gate", b, prev_feas.shape[1])
+    c = prev_feas.shape[1]
+    d_safe = jnp.clip(delta_idx, 0, c - 1)
+    api = tables["api_matrix"][:, d_safe][per_object["gvk_id"]]
+    trow = tables["taint_set_id"][d_safe]
+    taint_new = tables["taint_new"][per_object["tol_id"]][:, trow]
+    taint_cur = tables["taint_cur"][per_object["tol_id"]][:, trow]
+    selector = tables["sel_matrix"][:, d_safe][per_object["sel_id"]]
+    placement = tables["place_matrix"][:, d_safe][per_object["place_id"]]
+    cur_present = per_object["sparse_cur"] != cur_absent  # [B, P]
+    current_d = (
+        (per_object["sparse_idx"][:, :, None] == delta_idx[None, None, :])
+        & cur_present[:, :, None]
+    ).any(axis=1)
+    fit_new = F.resources_fit(per_object["request"], alloc_new_d, used_new_d)
+    fea_new_d = F.combine_filters(
+        per_object["filter_enabled"],
+        api,
+        taint_new,
+        taint_cur,
+        current_d,
+        fit_new,
+        per_object["placement_has"],
+        placement,
+        selector,
+    )
+    sticky_active = per_object["sticky"] & cur_present.any(axis=1)
+    enabled = per_object["score_enabled"]
+    return _drift_classify(
+        fea_new_d,
+        prev_feas[:, d_safe],
+        prev_feas,
+        prev_scores,
+        _resource_scores_cols(
+            per_object["request"], enabled, alloc_old_d, used_old_d
+        ),
+        _resource_scores_cols(
+            per_object["request"], enabled, alloc_new_d, used_new_d
+        ),
+        delta_idx,
+        delta_valid,
+        delta_cpu,
+        per_object["max_clusters"],
+        per_object["mode_divide"],
+        per_object["weights_given"],
+        sticky_active,
+    )
+
+
+def drift_wcheck(
+    prev_feas,
+    rows_idx,
+    cpu_alloc_old,
+    cpu_avail_old,
+    cpu_alloc_new,
+    cpu_avail_new,
+):
+    """Dynamic-weight equality check for gate-classified wcheck rows.
+
+    Those rows' selection provably equals their feasible set (see the
+    gate's exactness argument, step 3/4), so comparing dynamic weights
+    over prev_feas decides replica equality exactly.  Returns i8[K]:
+    1 where the weights differ (row must recompute)."""
+    _note_trace("drift_wcheck", rows_idx.shape[0], prev_feas.shape[1])
+    sel = prev_feas[rows_idx] != 0
+    w_old = dynamic_weights(sel, cpu_alloc_old, cpu_avail_old)
+    w_new = dynamic_weights(sel, cpu_alloc_new, cpu_avail_new)
+    return (w_old != w_new).any(axis=-1).astype(jnp.int8)
+
+
 # -- packed placement export ---------------------------------------------
 # Each object lands on at most max_clusters clusters, yet the dense
 # output planes ship B x C cells off the device.  The packed export

@@ -91,6 +91,19 @@ CATALOG: dict[str, MetricSpec] = {
         "counter", "rows", (),
         "Packed-export K-overflow rows (selected set exceeded the K "
         "bucket) re-fetched through the dense row-gather fallback."),
+    "engine_upload_bytes_total": MetricSpec(
+        "counter", "bytes", ("plane",),
+        "Host->device input-transfer volume: object = cached per-object "
+        "tensors (full uploads, row scatter-repairs, sub-batch slabs), "
+        "cluster = the shared once-per-tick cluster-axis planes and "
+        "vocabulary tables.  A drift tick must move cluster bytes only."),
+    "engine_drift_rows_total": MetricSpec(
+        "counter", "rows", ("kind",),
+        "Drift-gate row classification on cluster-capacity drift ticks: "
+        "skip = provably identical outputs, wcheck = dynamic-weight "
+        "comparison rows, wcheck_changed = weight comparisons that "
+        "found a difference, recompute = rows re-scheduled through the "
+        "sub-batch slabs."),
     "engine_persistent_cache_total": MetricSpec(
         "counter", "traces", ("result",),
         "Persistent XLA compilation-cache outcome per observed trace: "

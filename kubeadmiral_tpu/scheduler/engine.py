@@ -38,9 +38,14 @@ import numpy as np
 from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.ops import pipeline as pipeline_mod
 from kubeadmiral_tpu.ops.pipeline import (
+    DRIFT_RECOMPUTE,
+    DRIFT_WCHECK,
     NIL_REPLICAS,
     PackedRows,
     TickInputs,
+    drift_gate_compact,
+    drift_gate_dense,
+    drift_wcheck,
     expand_compact,
     pack_wire,
     schedule_tick,
@@ -205,8 +210,10 @@ _CLUSTER_AXIS_FILL = {
 }
 
 
-def _pad_clusters(inputs: TickInputs, c_pad: int) -> TickInputs:
-    """Pad the cluster axis with invalid slots (cluster_valid=False)."""
+def _pad_clusters(inputs: TickInputs, c_pad: int, skip: tuple = ()) -> TickInputs:
+    """Pad the cluster axis with invalid slots (cluster_valid=False).
+    Fields named in ``skip`` pass through untouched (the engine swaps
+    them for shared pre-padded device copies at dispatch)."""
     c = inputs.cluster_valid.shape[0]
     if c == c_pad:
         return inputs
@@ -214,7 +221,7 @@ def _pad_clusters(inputs: TickInputs, c_pad: int) -> TickInputs:
     fields = {}
     for name, arr in inputs._asdict().items():
         fill = _CLUSTER_AXIS_FILL.get(name)
-        if fill is None:
+        if fill is None or name in skip:
             fields[name] = arr
             continue
         arr = np.asarray(arr)
@@ -268,6 +275,10 @@ class _CachedChunk:
     # + decoded results (host) for the delta fetch: unchanged rows are
     # never pulled off the device again.
     prev_out: Optional[tuple] = None
+    # Previous tick's feasibility plane (device i8[B, C]): the drift
+    # gate's substrate — which rows a cluster-capacity drift can
+    # actually move is a function of feasibility at the changed columns.
+    prev_feas: Optional[object] = None
     prev_results: Optional[list] = None
     # Whether prev_results carry decoded score dicts — a want_scores
     # consumer can only ride the noop/delta/sub-batch fast paths when
@@ -461,7 +472,9 @@ class SchedulerEngine:
         min_bucket: int = 64,
         min_cluster_bucket: int = 8,
         cache_bytes: int = 16 << 30,
-        cell_budget: int = 4096 * 512,
+        cell_budget: Optional[int] = None,
+        megachunk_rows: Optional[int] = None,
+        donate: Optional[bool] = None,
         mesh="auto",
         canonical_c: int = 256,
         vocab_caps: Optional[dict] = None,
@@ -495,6 +508,25 @@ class SchedulerEngine:
         # engine_fetch_bytes_total / engine_fetch_overflow_rows_total.
         self.fetch_bytes_total = 0
         self.overflow_rows_total = 0
+        # Host->device transfer volume, split by plane family: "object"
+        # counts the cached per-object tensors (full uploads + row
+        # scatter-repairs + sub-batch slab inputs), "cluster" counts the
+        # shared cluster-axis planes and vocabulary tables.  On a drift
+        # tick only the cluster planes changed, so the object counter
+        # must stay flat (tests/test_drift_tick.py pins this).
+        self.upload_bytes = {"object": 0, "cluster": 0}
+        # Drift-gate row classification totals (see _schedule_drift):
+        # skip = provably identical, wcheck = dynamic-weight check rows
+        # (wcheck_changed of them actually recomputed), recompute = rows
+        # re-scheduled through the sub-batch slabs.
+        self.drift_stats = {
+            "gated": 0, "skip": 0, "wcheck": 0, "wcheck_changed": 0,
+            "recompute": 0, "fallback": 0,
+        }
+        # Raw device-dispatch count (the number bench.py reports for the
+        # cold/drift dispatch-count acceptance): every tick/gather/pack/
+        # gate program launch increments it.
+        self.dispatches_total = 0
         # Decision flight recorder (runtime/flightrec.py): fed from the
         # host-side arrays the fetch stage pulls anyway, so /debug/explain
         # can name the rejecting filter for any (object, cluster) pair
@@ -516,7 +548,33 @@ class SchedulerEngine:
         # execution stays ~0.1s; bounding cells per chunk keeps compiles
         # tractable at 2k-5k clusters and the steady-state sub-batch path
         # shares the same (small) program.
+        #
+        # MEGACHUNK sizing (KT_CELL_BUDGET / KT_MEGACHUNK_ROWS): the
+        # budget defaults to 4096 x 5120 cells, so even the widest bench
+        # cluster axis keeps full 4096-row chunks — a 100k x 5k full
+        # revalidation is ~25 dispatches instead of the 391 that a
+        # 2M-cell budget produced (each tiny dispatch paid Python
+        # featurize-check + cluster re-upload + a ~0.4s round trip on
+        # the tunneled TPU link; BENCH_DETAIL_c5_tpu_r05).  The one-time
+        # trace cost of the bigger programs is absorbed by the prewarm
+        # ladder + persistent compile cache.  KT_MEGACHUNK_ROWS caps the
+        # row axis independently for HBM-tight deployments.
+        if cell_budget is None:
+            cell_budget = int(
+                os.environ.get("KT_CELL_BUDGET", str(4096 * 5120))
+            )
         self.cell_budget = cell_budget
+        if megachunk_rows is None:
+            megachunk_rows = int(os.environ.get("KT_MEGACHUNK_ROWS", "4096"))
+        self.megachunk_rows = max(1, megachunk_rows)
+        # Buffer donation (KT_DONATE=0 opts out): the tick programs
+        # donate their `prev` planes, so a full dispatch stops double-
+        # buffering [B, C] output state — XLA aliases the donated
+        # buffers into the new outputs instead of allocating a second
+        # copy per chunk.
+        if donate is None:
+            donate = os.environ.get("KT_DONATE", "1") not in ("0", "false", "no")
+        self.donate = bool(donate)
         self.min_bucket = min_bucket
         self.min_cluster_bucket = min_cluster_bucket
         # Cluster-axis width from which row counts are bucketed to the
@@ -569,9 +627,11 @@ class SchedulerEngine:
         # last schedule() call ([] = none, None = unknown/all); set by
         # every call including the empty-batch early return.
         self.last_changed: Optional[list[int]] = None
-        # O(1) whole-batch no-op gate (see schedule()): one atomic
-        # entry (units_list, view, want_scores, follower_index,
-        # results, n_chunks), or None.
+        # Whole-batch no-op gate (see _schedule_impl): one atomic entry
+        # (units_list, row id array, view, want_scores, follower_index,
+        # results, n_chunks), or None.  Same-list replays are O(1);
+        # fresh lists of the same row objects replay via the vectorized
+        # id comparison.
         self._noop_gate: Optional[tuple] = None
         # schedule() is serialized: the chunk cache, the per-tick
         # recorder arm (_tick_rec), timings and last_changed are all
@@ -595,9 +655,23 @@ class SchedulerEngine:
         # (B, C) -> device-resident zero "previous outputs" (created by a
         # trivial on-device program, NOT a host upload): the unified tick
         # always takes a prev argument; cold chunks diff against zeros
-        # and the mask is simply ignored.
+        # and the mask is simply ignored.  Under donation only the
+        # builder fns are cached (the tick consumes the buffers).
         self._zero_prev: dict[tuple, tuple] = {}
+        self._zero_fns: dict[tuple, object] = {}
         self._prewarm_thread: Optional[threading.Thread] = None
+        # Once-per-tick shared cluster-plane upload: the padded cluster-
+        # axis tensors (alloc/used/cpu/cluster_valid) are device_put ONCE
+        # per (view, c_bucket) and reused by every chunk dispatch — on a
+        # drift tick these are the only bytes that changed, so the whole
+        # tick's host->device traffic is a few [C, R] arrays instead of
+        # per-chunk re-pads and re-uploads.  (One entry: views change
+        # wholesale per tick; the tuple holds the view to keep its id
+        # stable.)
+        self._cluster_device: Optional[tuple] = None
+        # Same idea for the PREVIOUS view's cpu planes (the drift
+        # wcheck's old side).
+        self._old_cpu_device: Optional[tuple] = None
         # Compact-format state: one vocabulary per cluster topology
         # (None = topology overflowed a cap; dense fallback), kept for a
         # few recent topologies so an A->B->A flap reuses A's vocabulary
@@ -640,9 +714,24 @@ class SchedulerEngine:
         # a variant per (arity, shape); arities are bounded by the
         # pipeline depth and shapes by the bucket ladder).
         self._stack = jax.jit(lambda *xs: jnp.stack(xs))
+        # Device-side concat (the sub-batch write-back repair stacks
+        # hetero-height slabs); jax traces one variant per shape tuple.
+        self._concat = jax.jit(lambda *xs: jnp.concatenate(xs))
+        # Per-shape program caches for the drift gate, its dynamic-
+        # weight check, and the prev-plane scatter repair.
+        self._gate_programs: dict[tuple, object] = {}
+        self._wcheck_program_cache: dict[tuple, object] = {}
+        self._repair_program_cache: dict[tuple, object] = {}
+        # Donating `prev` (argnums 1) lets XLA alias the previous tick's
+        # output planes into the new ones: full dispatches stop holding
+        # two [B, C] output generations live at once.
+        donate = (1,) if self.donate else ()
         if self.mesh is None:
-            self._tick = jax.jit(_tick_with_diff)
-            self._tick_compact = jax.jit(_tick_compact_with_diff)
+            self._tick = jax.jit(_tick_with_diff, donate_argnums=donate)
+            self._tick_compact = jax.jit(
+                _tick_compact_with_diff, donate_argnums=donate
+            )
+            self._cluster_shardings = None
             self._gather = jax.jit(_gather_packed)
             self._gather3 = jax.jit(_gather_packed3)
             self._gather5 = jax.jit(_gather_packed5)
@@ -681,7 +770,13 @@ class SchedulerEngine:
             M.rows_sharding(self.mesh),
         )
         self._tick = jax.jit(
-            _tick_with_diff, in_shardings=in_shardings, out_shardings=out_shardings
+            _tick_with_diff,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=donate,
+        )
+        self._cluster_shardings = M.field_shardings(
+            self.mesh, _CLUSTER_ONLY_FIELDS
         )
         self._per_object_shardings_compact = M.compact_field_shardings(
             self.mesh, Cmp.PER_OBJECT_FIELDS
@@ -696,6 +791,7 @@ class SchedulerEngine:
                 (grid, grid, grid, grid),
             ),
             out_shardings=out_shardings,
+            donate_argnums=donate,
         )
         rep = M.replicated(self.mesh)
         self._replicated = rep
@@ -770,8 +866,17 @@ class SchedulerEngine:
         )
 
     def _zeros_for(self, shape: tuple) -> tuple:
-        zp = self._zero_prev.get(shape)
-        if zp is None:
+        """Device-resident zero prev planes.  Under donation the tick
+        CONSUMES its prev argument, so every call returns fresh buffers
+        (the jitted builder is cached per shape; materializing zeros is
+        a trivial on-device program, not a host upload); without
+        donation the arrays themselves are cached."""
+        if not self.donate:
+            cached = self._zero_prev.get(shape)
+            if cached is not None:
+                return cached
+        fn = self._zero_fns.get(shape)
+        if fn is None:
             def make():
                 return (
                     jnp.zeros(shape, jnp.int8),
@@ -786,7 +891,9 @@ class SchedulerEngine:
                 if sharding is not None
                 else jax.jit(make)
             )
-            zp = fn()
+            self._zero_fns[shape] = fn
+        zp = fn()
+        if not self.donate:
             self._zero_prev[shape] = zp
         return zp
 
@@ -881,13 +988,21 @@ class SchedulerEngine:
     def _tick_geometry(self, n_clusters: int) -> tuple[int, int, Optional[list]]:
         """(c_bucket, eff_chunk, row ladder or None).
 
-        Cell-budget chunking: compile time grows with b x C, so wide
-        cluster axes get proportionally shorter chunks.  At wide C the
-        row buckets are a fixed 3-rung ladder so the number of distinct
-        (expensive) programs is bounded; at narrow C free pow2 buckets
-        are fine (those compiles are cheap)."""
+        Cell-budget chunking: runtime memory (not compile time — the
+        persistent cache + prewarm ladder absorb traces) bounds cells
+        per chunk, so wide cluster axes get proportionally shorter
+        chunks only past KT_CELL_BUDGET; KT_MEGACHUNK_ROWS caps the row
+        axis independently.  The default budget keeps full 4096-row
+        megachunks through C=5120 (~25 dispatches for a 100k-object
+        full revalidation).  At wide C the row buckets are a fixed
+        3-rung ladder so the number of distinct (expensive) programs is
+        bounded; at narrow C free pow2 buckets are fine (those compiles
+        are cheap)."""
         c_bucket = _cluster_bucket(n_clusters, self.min_cluster_bucket)
-        max_rows = max(self.min_bucket, self.cell_budget // max(1, c_bucket))
+        max_rows = max(
+            self.min_bucket,
+            min(self.megachunk_rows, self.cell_budget // max(1, c_bucket)),
+        )
         eff_chunk = min(self.chunk_size, 1 << (max_rows.bit_length() - 1))
         ladder = None
         if c_bucket >= self.canonical_c:
@@ -1107,14 +1222,15 @@ class SchedulerEngine:
         # Budget charge covers everything the entry pins, not just the
         # host arrays: a device-resident copy of the (padded, so up to
         # 2x along each axis) per-object tensors, plus the previous
-        # tick's device outputs (i8+i32+i8+i32 = 10 bytes/cell).
+        # tick's device outputs (i8+i32+i8+i32 = 10 bytes/cell) and the
+        # drift gate's feasibility plane (+1 byte/cell).
         # Decoded result dicts are small relative to the tensor planes.
         b = len(chunk)
         c = np.asarray(inputs.cluster_valid).shape[0]
         # prev_out device planes live at PADDED shape — charge for it.
         b_pad = _pow2_bucket(b, self.min_bucket, 1 << 30)
         c_pad = _cluster_bucket(c, self.min_cluster_bucket)
-        nbytes = host_bytes * 3 + b_pad * c_pad * 10
+        nbytes = host_bytes * 3 + b_pad * c_pad * 11
         entry = None
         if self._cache_used + nbytes <= self.cache_bytes:
             entry = _CachedChunk(
@@ -1150,6 +1266,7 @@ class SchedulerEngine:
                 # output pattern would otherwise reuse decodes that map
                 # indices to the WRONG cluster names.
                 entry.prev_out = cached.prev_out
+                entry.prev_feas = cached.prev_feas
                 entry.prev_results = cached.prev_results
                 entry.prev_has_scores = cached.prev_has_scores
                 entry.stale_out_rows = cached.stale_out_rows
@@ -1185,6 +1302,8 @@ class SchedulerEngine:
             fetch0 = dict(self.fetch_stats)
             bytes0 = self.fetch_bytes_total
             overflow0 = self.overflow_rows_total
+            upload0 = dict(self.upload_bytes)
+            drift0 = dict(self.drift_stats)
             # Arm the flight recorder for this tick: record sites (the
             # fetch/decode helpers) consume _tick_rec; ticks riding the
             # noop/skip fast paths record nothing and the previous
@@ -1208,13 +1327,14 @@ class SchedulerEngine:
                     rec.end_tick()
             self._emit_tick_metrics(
                 len(units), time.perf_counter() - t_start, cache0, fetch0,
-                bytes0, overflow0,
+                bytes0, overflow0, upload0, drift0,
             )
             return results
 
     def _emit_tick_metrics(
         self, n_units: int, wall: float, cache0: dict, fetch0: dict,
         bytes0: int = 0, overflow0: int = 0,
+        upload0: Optional[dict] = None, drift0: Optional[dict] = None,
     ) -> None:
         """Per-tick telemetry: stage-latency histograms, cache/fetch path
         counters (as deltas of the raw dict stats over this call), true
@@ -1242,6 +1362,14 @@ class SchedulerEngine:
         overflow_delta = self.overflow_rows_total - overflow0
         if overflow_delta:
             m.counter("engine_fetch_overflow_rows_total", overflow_delta)
+        for plane, value in self.upload_bytes.items():
+            delta = value - (upload0 or {}).get(plane, 0)
+            if delta:
+                m.counter("engine_upload_bytes_total", delta, plane=plane)
+        for kind in ("skip", "wcheck", "wcheck_changed", "recompute"):
+            delta = self.drift_stats[kind] - (drift0 or {}).get(kind, 0)
+            if delta:
+                m.counter("engine_drift_rows_total", delta, kind=kind)
         events = pipeline_mod.drain_trace_events()
         for program, b, c in events:
             m.counter("engine_xla_compiles_total", program=program, shape=f"{b}x{c}")
@@ -1281,6 +1409,7 @@ class SchedulerEngine:
             shape=shape,
         )
         self.metrics.counter("engine_dispatches_total", shape=shape)
+        self.dispatches_total += 1
         self.program_shapes.add(shape_key)
 
     def _schedule_impl(
@@ -1299,24 +1428,47 @@ class SchedulerEngine:
             return []
         if view is None:
             view = self._cached_view(units, clusters)
-        # O(1) whole-batch no-op gate: the SAME units list object against
-        # the SAME cluster view is byte-identical input (units are frozen
-        # by contract, and the list container must be treated as
-        # immutable too — derive changed batches as fresh lists, exactly
-        # like the controllers and the bench churn do), so the previous
-        # results replay without even the per-chunk signature walk — at
-        # 100k x 5k that walk alone costs ~0.6s per no-op tick across
-        # 391 chunks.  Fresh-list callers fall through to the per-chunk
-        # gates; webhook ticks never arm or hit the gate (their plugin
-        # set is outside the key).
+        # O(1)/O(B) whole-batch no-op gate: the SAME units list object
+        # against the SAME cluster view is byte-identical input (units
+        # are frozen by contract, and the list container must be treated
+        # as immutable too — derive changed batches as fresh lists,
+        # exactly like the controllers and the bench churn do), so the
+        # previous results replay without even the per-chunk signature
+        # walk — at 100k x 5k that walk alone costs ~0.6s per no-op tick
+        # across the chunks.  A FRESH list holding the same row objects
+        # replays too, via the content-identity arm: the stored id array
+        # is compared against the new list's ids in one vectorized pass
+        # (~5ms at 100k rows; sound because the gate keeps the original
+        # objects alive, so a live id() match IS object identity).
+        # Webhook ticks never arm or hit the gate (their plugin set is
+        # outside the key).
         if webhook_eval is None and self._noop_gate is not None:
-            g_units, g_view, g_ws, g_fidx, g_results, g_chunks = self._noop_gate
-            if (
+            g_units, g_ids, g_view, g_ws, g_fidx, g_results, g_chunks = (
+                self._noop_gate
+            )
+            replay = (
                 units_arg is g_units
                 and view is g_view
                 and want_scores == g_ws
                 and follower_index is g_fidx
+            )
+            if (
+                not replay
+                and view is g_view
+                and want_scores == g_ws
+                and follower_index is g_fidx
+                and len(units) == len(g_units)
             ):
+                ids = np.fromiter(map(id, units), np.int64, count=len(units))
+                if np.array_equal(ids, g_ids):
+                    replay = True
+                    # Re-arm on the new container so the O(1) identity
+                    # check works for its re-submissions too.
+                    self._noop_gate = (
+                        units_arg, g_ids, g_view, g_ws, g_fidx, g_results,
+                        g_chunks,
+                    )
+            if replay:
                 self.fetch_stats["noop"] += g_chunks
                 self.last_changed = []
                 self.timings = {
@@ -1325,17 +1477,30 @@ class SchedulerEngine:
                 # Fresh list: callers may post-process their copy without
                 # corrupting future replays (rows are shared + frozen).
                 return list(g_results)
-        # One chunk at a time: dispatching all chunks before pulling
-        # measured SLOWER on the tunneled TPU backend (transfers queue
-        # behind every outstanding program), so keep dispatch->pull
-        # strictly sequential per chunk.
+        # Chunk pipelining: with KT_PIPELINE_DEPTH > 1 (default 16) up
+        # to that many chunks' programs stay in flight — featurize/
+        # dispatch continues while the device computes — and the window
+        # is then drained with BATCHED per-wire-shape transfers
+        # (_drain_fetch_window / _drain_window_packed).  Depth 1 keeps
+        # the old strictly-sequential dispatch->pull per chunk, which
+        # only wins when per-transfer latency is negligible AND memory
+        # for in-flight output planes is tight (docs/operations.md
+        # documents the knob and the sizing math).
         chunk_results: list[Optional[list[ScheduleResult]]] = []
         # Per chunk: LOCAL row indices whose placement may have changed
         # this tick ([] = none, None = unknown/all) — consumed by
         # follower union and exposed as ``last_changed``.
         chunk_changed: list[Optional[list[int]]] = []
-        pending_sub: list[tuple[int, _CachedChunk, list[int], TickInputs]] = []
+        # (slot, entry, changed rows, featurized rows, inputs_stale):
+        # consumed by the shared sub-batch slab pass.  inputs_stale says
+        # whether the rows' HOST inputs changed (churn patches) — drift
+        # recomputes reuse unchanged inputs, so their device copies are
+        # not marked stale.
+        pending_sub: list[tuple] = []
         pending_fetch: list[tuple] = []
+        # Drift-gated chunks awaiting their row classification masks.
+        pending_gate: list[tuple] = []
+        drift_cache: dict[int, object] = {}
         timings = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
         self.timings = timings
         c_bucket, eff_chunk, ladder = self._tick_geometry(len(view.clusters))
@@ -1396,7 +1561,7 @@ class SchedulerEngine:
             ):
                 changed_rows, sub_inputs = patch_info
                 pending_sub.append(
-                    (len(chunk_results), entry, changed_rows, sub_inputs)
+                    (len(chunk_results), entry, changed_rows, sub_inputs, True)
                 )
                 chunk_results.append(None)  # filled by the sub-batch pass
                 chunk_changed.append(list(changed_rows))
@@ -1406,7 +1571,64 @@ class SchedulerEngine:
 
             b_pad = self._bucket_rows(len(chunk), ladder, eff_chunk, multi_chunk)
             pack_k = self._pack_k(inputs, c_bucket)
-            padded = self._pad_for_dispatch(inputs, fmt, b_pad, c_bucket)
+
+            drift_info = None
+            if (
+                status == "hit"
+                and entry is not None
+                and entry.prev_view is not None
+                and entry.prev_view is not view
+            ):
+                drift_info = self._drift_delta(
+                    entry.prev_view, view, drift_cache
+                )
+            drift_ok = drift_info is not None
+            if drift_ok and drift_info["empty"] and prev_valid:
+                # The views differ only in ways that round to identical
+                # cluster tensors: every row provably reproduces its
+                # previous outputs — no device work at all.
+                self.fetch_stats["skip"] += 1
+                self.drift_stats["gated"] += 1
+                self.drift_stats["skip"] += len(chunk)
+                entry.prev_view = view
+                chunk_results.append(entry.prev_results)
+                chunk_changed.append([])
+                timings["featurize"] += time.perf_counter() - t0
+                continue
+
+            # Drift fast path: a clean cache hit whose ONLY change is
+            # cluster resource quantities classifies rows exactly (cheap
+            # gate program over the cached device planes) instead of
+            # re-running select+planner math over the whole chunk.
+            if (
+                status == "hit"
+                and drift_ok
+                and prev_valid
+                and not want_scores
+                and not entry.prev_has_scores
+                and entry.prev_out is not None
+                and entry.prev_feas is not None
+                and entry.device_per_object is not None
+                and entry.prev_out[0].shape == (b_pad, c_bucket)
+                and entry.prev_feas.shape == (b_pad, c_bucket)
+                and entry.padded_shape is not None
+                and entry.padded_shape[0] == b_pad
+            ):
+                gate_dev = self._dispatch_drift_gate(
+                    entry, fmt, c_bucket, drift_info, vocab, view
+                )
+                pending_gate.append(
+                    (len(chunk_results), entry, len(chunk), gate_dev, fmt,
+                     b_pad, pack_k)
+                )
+                chunk_results.append(None)
+                chunk_changed.append(None)
+                timings["featurize"] += time.perf_counter() - t0
+                continue
+
+            padded = self._pad_for_dispatch(
+                inputs, fmt, b_pad, c_bucket, skip_cluster_fields=True
+            )
             t1 = time.perf_counter()
             timings["featurize"] += t1 - t0
             with trace.span(
@@ -1414,7 +1636,10 @@ class SchedulerEngine:
                 chunk=chunk_idx,
                 shape=f"{fmt}:{b_pad}x{c_bucket}",
             ):
-                device_in = self._device_inputs(entry, padded, status, fmt, vocab)
+                device_in = self._device_inputs(
+                    entry, padded, status, fmt, vocab, c_bucket,
+                    self._cluster_planes_device(view, c_bucket),
+                )
                 out_shape = (b_pad, c_bucket)
                 delta_ok = (
                     prev_valid
@@ -1427,6 +1652,11 @@ class SchedulerEngine:
                 tick = self._tick_compact if fmt == "compact" else self._tick
                 self._count_dispatch(fmt, b_pad, c_bucket)
                 out, mask_dev = tick(device_in, prev)
+                if delta_ok and self.donate:
+                    # The donated prev buffers are dead; every drain
+                    # path stores the fresh outputs before they're
+                    # consulted again.
+                    entry.prev_out = None
             if self.pipeline_depth > 1:
                 # Async dispatch: leave the program in flight and go
                 # featurize the next chunk; the wait lands in the fetch
@@ -1478,6 +1708,14 @@ class SchedulerEngine:
                     want_scores, timings,
                 )
             pending_fetch.clear()
+        if pending_gate:
+            with trace.span("engine.drift_gate", chunks=len(pending_gate)):
+                self._drain_drift_gates(
+                    pending_gate, chunk_results, chunk_changed, view,
+                    want_scores, timings, pending_sub, c_bucket, eff_chunk,
+                    ladder, vocab,
+                )
+            pending_gate.clear()
         if pending_sub:
             with trace.span("engine.sub_batch", chunks=len(pending_sub)):
                 self._run_sub_batch(
@@ -1508,20 +1746,37 @@ class SchedulerEngine:
         # and replaying webhook-filtered placements for a plain call
         # would be wrong.
         self._noop_gate = (
-            (units_arg, view, want_scores, follower_index, results,
+            (units_arg,
+             np.fromiter(map(id, units), np.int64, count=len(units)),
+             view, want_scores, follower_index, results,
              len(chunk_results))
             if webhook_eval is None
             else None
         )
         return results
 
-    def _pad_for_dispatch(self, inputs, fmt: str, b_pad: int, c_bucket: int):
+    def _pad_for_dispatch(
+        self,
+        inputs,
+        fmt: str,
+        b_pad: int,
+        c_bucket: int,
+        skip_cluster_fields: bool = False,
+    ):
         """Format-aware shape bucketing: the dense format pads its [B, C]
         planes; the compact one additionally buckets the sparse-entry
         and key-byte widths (pow2) so those axes don't leak unbounded
-        program shapes either."""
+        program shapes either.
+
+        ``skip_cluster_fields=True`` (every engine dispatch path) leaves
+        the cluster-axis-only tensors untouched: they are replaced by
+        the shared once-per-tick device copies (_cluster_planes_device)
+        at dispatch, so per-chunk re-padding + re-upload of cluster
+        state is never paid.  Prewarm keeps the self-contained padding.
+        """
         if fmt == "dense":
-            return _pad_clusters(_pad_batch(inputs, b_pad), c_bucket)
+            skip = _CLUSTER_ONLY_FIELDS if skip_cluster_fields else ()
+            return _pad_clusters(_pad_batch(inputs, b_pad), c_bucket, skip=skip)
         padded = Cmp.pad_rows(inputs, b_pad)
         p = np.asarray(padded.sparse_idx).shape[1]
         padded = Cmp.pad_axis1(
@@ -1534,7 +1789,10 @@ class SchedulerEngine:
         # Vocabulary tables (multi-MB at wide C) are NOT padded here:
         # _tables_device pads them once per actual upload, not per
         # dispatch — steady state reuses the device copy.
-        return Cmp.pad_clusters(padded, c_bucket, skip=Cmp.TABLE_FIELDS)
+        skip = Cmp.TABLE_FIELDS + (
+            Cmp.CLUSTER_FIELDS if skip_cluster_fields else ()
+        )
+        return Cmp.pad_clusters(padded, c_bucket, skip=skip)
 
     def _tables_device(self, vocab: CompactVocab, c_bucket: int):
         """Device-resident vocabulary tables, re-uploaded (and re-padded)
@@ -1547,7 +1805,68 @@ class SchedulerEngine:
             dev = jax.device_put(tables, self._table_shardings)
         else:
             dev = jax.device_put(tables)
+        self.upload_bytes["cluster"] += sum(
+            np.asarray(t).nbytes for t in tables.values()
+        )
         self._device_tables = (key, dev)
+        return dev
+
+    @staticmethod
+    def _pad_cluster_axis(arr, c_pad: int, fill):
+        arr = np.asarray(arr)
+        extra = c_pad - arr.shape[0]
+        if extra <= 0:
+            return arr
+        pad_shape = (extra,) + arr.shape[1:]
+        return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)])
+
+    def _cluster_planes_device(self, view: ClusterView, c_bucket: int) -> dict:
+        """The padded cluster-axis tensors, uploaded ONCE per (view,
+        c_bucket) and shared by every chunk dispatch of the tick — the
+        same five fields in both formats (_CLUSTER_ONLY_FIELDS ==
+        compact.CLUSTER_FIELDS, identical mesh layouts).  On a drift
+        tick these few [C, R] arrays are the only host->device bytes."""
+        key = (id(view), c_bucket)
+        if self._cluster_device is not None and self._cluster_device[0] == key:
+            return self._cluster_device[2]
+        c = len(view.names)
+        host = {
+            "alloc": self._pad_cluster_axis(view.alloc, c_bucket, 0),
+            "used": self._pad_cluster_axis(view.used, c_bucket, 0),
+            "cpu_alloc": self._pad_cluster_axis(view.cpu_alloc, c_bucket, 0),
+            "cpu_avail": self._pad_cluster_axis(view.cpu_avail, c_bucket, 0),
+            "cluster_valid": self._pad_cluster_axis(
+                np.ones(c, bool), c_bucket, False
+            ),
+        }
+        if self._cluster_shardings is not None:
+            dev = jax.device_put(host, self._cluster_shardings)
+        else:
+            dev = jax.device_put(host)
+        self.upload_bytes["cluster"] += sum(a.nbytes for a in host.values())
+        # The view reference keeps id(view) stable for the cache key.
+        self._cluster_device = (key, view, dev)
+        return dev
+
+    def _wcheck_cpu_device(self, old_view: ClusterView, c_bucket: int) -> dict:
+        """The PREVIOUS view's cpu planes (padded, device) — the old
+        side of the drift wcheck's dynamic-weight comparison."""
+        key = (id(old_view), c_bucket)
+        if self._old_cpu_device is not None and self._old_cpu_device[0] == key:
+            return self._old_cpu_device[2]
+        host = {
+            "cpu_alloc": self._pad_cluster_axis(old_view.cpu_alloc, c_bucket, 0),
+            "cpu_avail": self._pad_cluster_axis(old_view.cpu_avail, c_bucket, 0),
+        }
+        if self._cluster_shardings is not None:
+            dev = jax.device_put(
+                host,
+                {name: self._cluster_shardings[name] for name in host},
+            )
+        else:
+            dev = jax.device_put(host)
+        self.upload_bytes["cluster"] += sum(a.nbytes for a in host.values())
+        self._old_cpu_device = (key, old_view, dev)
         return dev
 
     def _run_sub_batch(
@@ -1569,13 +1888,28 @@ class SchedulerEngine:
                     ladder, c_bucket, vocab,
                 )
 
+    def _slice_rows(self, entry: _CachedChunk, rows: list[int]):
+        """The given rows of a cached chunk's (refreshed) host inputs,
+        as a sub-batch piece in the entry's own format — the drift
+        recompute's input source (rows are unchanged since the cache
+        was built, so no re-featurization happens)."""
+        idx = np.asarray(rows)
+        per_object = set(self._per_object_fields(entry.fmt))
+        cls = CompactInputs if entry.fmt == "compact" else TickInputs
+        return cls(
+            **{
+                name: np.asarray(arr)[idx] if name in per_object else arr
+                for name, arr in entry.inputs._asdict().items()
+            }
+        )
+
     def _run_sub_batch_group(
         self, pending, fmt, chunk_results, view, timings, eff_chunk, ladder,
         c_bucket, vocab,
     ) -> None:
         t0 = time.perf_counter()
         per_object = self._per_object_fields(fmt)
-        subs = [sub for _, _, _, sub in pending]
+        subs = [sub for _, _, _, sub, _ in pending]
         if fmt == "compact":
             # Align sparse/key widths across chunks before concatenating.
             p_max = max(np.asarray(s.sparse_idx).shape[1] for s in subs)
@@ -1593,6 +1927,10 @@ class SchedulerEngine:
             for name in per_object
         }
         c = len(view.names)
+        # The cluster-axis tensors come from the shared once-per-tick
+        # device copy; host placeholders only complete the NamedTuple
+        # for the row/width padding below.
+        cluster_dev = self._cluster_planes_device(view, c_bucket)
         shared = dict(
             alloc=view.alloc,
             used=view.used,
@@ -1609,7 +1947,7 @@ class SchedulerEngine:
         else:
             inputs = TickInputs(**combined, **shared)
         total = inputs.total.shape[0]
-        want_scores = any(e.prev_has_scores for _, e, _, _ in pending)
+        want_scores = any(e.prev_has_scores for _, e, _, _, _ in pending)
         record = self._tick_rec is not None
         packed_mode = self.fetch_format == "packed"
         pack_k = self._pack_k(inputs, c_bucket) if packed_mode else 0
@@ -1620,12 +1958,26 @@ class SchedulerEngine:
         # work overlaps slab t's transfer (the window pattern the
         # full-dispatch path uses), instead of dispatch->block->read per
         # slab.
+        # Slab cut: a sub-eff_chunk batch is cut at the ladder rung that
+        # minimizes padded cells (ties -> fewer dispatches).  Without
+        # this, e.g. 1988 changed rows at a 256/1024/4096 ladder would
+        # pad a single slab to 4096 — 2x the device math of two
+        # 1024-row slabs.
+        slab_cut = eff_chunk
+        if ladder is not None and total < eff_chunk:
+            best_cells = -(-total // eff_chunk) * eff_chunk
+            for rung in ladder:
+                cells = -(-total // rung) * rung
+                if cells < best_cells or (
+                    cells == best_cells and rung > slab_cut
+                ):
+                    slab_cut, best_cells = rung, cells
         slabs: list[tuple] = []  # (n, out, fetch_dev)
-        for start in range(0, total, eff_chunk):
+        for start in range(0, total, slab_cut):
             piece = cls(
                 **{
                     name: (
-                        np.asarray(arr)[start : start + eff_chunk]
+                        np.asarray(arr)[start : start + slab_cut]
                         if name in combined
                         else arr
                     )
@@ -1634,16 +1986,24 @@ class SchedulerEngine:
             )
             n = piece.total.shape[0]
             b_pad = self._bucket_rows(n, ladder, eff_chunk, False)
-            padded = self._pad_for_dispatch(piece, fmt, b_pad, c_bucket)
+            padded = self._pad_for_dispatch(
+                piece, fmt, b_pad, c_bucket, skip_cluster_fields=True
+            )
             t1 = time.perf_counter()
             timings["featurize"] += t1 - t0
             shape = (b_pad, c_bucket)
             self._count_dispatch(fmt, b_pad, c_bucket)
+            self.upload_bytes["object"] += sum(
+                np.asarray(getattr(padded, name)).nbytes for name in per_object
+            )
             if fmt == "compact":
-                device_in = padded._replace(**self._tables_device(vocab, c_bucket))
+                device_in = padded._replace(
+                    **self._tables_device(vocab, c_bucket), **cluster_dev
+                )
                 out, _mask = self._tick_compact(device_in, self._zeros_for(shape))
             else:
-                out, _mask = self._tick(padded, self._zeros_for(shape))
+                device_in = padded._replace(**cluster_dev)
+                out, _mask = self._tick(device_in, self._zeros_for(shape))
             if packed_mode:
                 # Row-bucketed gather-pack, not the whole padded slab:
                 # n changed rows bucket to pow2(n) wire rows instead of
@@ -1745,7 +2105,7 @@ class SchedulerEngine:
         all_scores = np.concatenate(rec_scores) if rec_scores else None
         all_counts = np.concatenate(rec_counts) if rec_counts else None
         all_feas = np.concatenate(rec_feas) if rec_feas else None
-        for slot, entry, changed_rows, _sub in pending:
+        for slot, entry, changed_rows, _sub, inputs_stale in pending:
             merged = list(entry.prev_results)
             res_rows = []
             for j, row in enumerate(changed_rows):
@@ -1770,43 +2130,442 @@ class SchedulerEngine:
                     feasible_n=all_feas[span],
                     topk_idx=rec_ti[span], topk_scores=rec_ts[span],
                 )
-            offset += len(changed_rows)
             entry.prev_results = merged
             entry.prev_view = view
-            # The device input copy is stale for the patched rows —
-            # record them for lazy scatter-repair (a drift tick after a
-            # churn tick must not pay a full chunk re-upload).  prev_out
-            # rows for the patched objects no longer match prev_results;
-            # KEEP the planes and record the rows instead of dropping
-            # them (VERDICT r3 #3): the next full dispatch (a drift
-            # tick) then delta-fetches — device diff for the untouched
-            # rows, forced gather for these.
-            entry.stale_rows = sorted(
-                set(entry.stale_rows or ()) | set(changed_rows)
-            )
-            entry.stale_out_rows = sorted(
-                set(entry.stale_out_rows or ()) | set(changed_rows)
-            )
+            if inputs_stale:
+                # The device INPUT copy is stale for the patched rows —
+                # record them for lazy scatter-repair (a later dispatch
+                # must not pay a full chunk re-upload).  Drift
+                # recomputes reuse unchanged inputs and skip this.
+                entry.stale_rows = sorted(
+                    set(entry.stale_rows or ()) | set(changed_rows)
+                )
+            # Device write-back: scatter the slab's fresh output planes
+            # into the chunk's cached prev planes, so the prev state
+            # stays exact row-for-row — later drift gates and delta
+            # diffs then need no forced fetches.  Falls back to the
+            # stale_out_rows marking (VERDICT r3 #3: forced gather on
+            # the next full dispatch) when shapes don't line up.
+            if not self._repair_prev_planes(
+                entry, changed_rows, offset, slabs, slab_cut
+            ):
+                entry.stale_out_rows = sorted(
+                    set(entry.stale_out_rows or ()) | set(changed_rows)
+                )
+            offset += len(changed_rows)
             # Shared by reference (frozen results): the cached list is
             # fresh this tick and rows are immutable.
             chunk_results[slot] = merged
         timings["decode"] += time.perf_counter() - t3
+
+    def _repair_program(self):
+        """Jitted 5-plane scatter: prev planes .at[dst].set(slab[src])
+        (dst padded out-of-range -> mode='drop').  The planes are
+        DONATED: XLA updates them in place instead of copying ~20MB of
+        [B, C] state per repaired chunk (the engine re-references the
+        returned planes; nothing else holds the old ones)."""
+        fn = self._repair_program_cache.get("repair")
+        if fn is None:
+            def impl(planes, slab, src, dst):
+                return tuple(
+                    p.at[dst].set(s[src], mode="drop")
+                    for p, s in zip(planes, slab)
+                )
+
+            donate = (0,) if self.donate else ()
+            if self._grid_sharding is not None:
+                grid, rep = self._grid_sharding, self._replicated
+                fn = jax.jit(
+                    impl,
+                    in_shardings=((grid,) * 5, (grid,) * 5, rep, rep),
+                    out_shardings=(grid,) * 5,
+                    donate_argnums=donate,
+                )
+            else:
+                fn = jax.jit(impl, donate_argnums=donate)
+            self._repair_program_cache["repair"] = fn
+        return fn
+
+    def _repair_prev_planes(
+        self, entry, changed_rows, offset: int, slabs, slab_cut: int
+    ) -> bool:
+        """Write the sub-batch slab outputs for this chunk's rows back
+        into entry.prev_out/prev_feas on device.  Returns False (caller
+        keeps the stale-marking fallback) when the cached planes are
+        absent or any touched slab's cluster axis disagrees."""
+        if entry.prev_out is None or entry.prev_feas is None or not changed_rows:
+            return entry.prev_out is not None and entry.prev_feas is not None
+        c_pad = entry.prev_out[0].shape[1]
+        b_pad = entry.prev_out[0].shape[0]
+        if entry.prev_feas.shape != (b_pad, c_pad):
+            return False
+        # Split this chunk's combined-array span into per-slab segments.
+        segments: dict[int, tuple[list, list]] = {}
+        for j, dst in enumerate(changed_rows):
+            if dst >= b_pad:
+                return False
+            pos = offset + j
+            srcs, dsts = segments.setdefault(pos // slab_cut, ([], []))
+            srcs.append(pos % slab_cut)
+            dsts.append(dst)
+        for s in segments:
+            if s >= len(slabs) or slabs[s][1].selected.shape[1] != c_pad:
+                return False
+        planes = entry.prev_out + (entry.prev_feas,)
+        fn = self._repair_program()
+        for s, (srcs, dsts) in segments.items():
+            out = slabs[s][1]
+            slab_planes = (
+                out.selected, out.replicas, out.counted, out.scores,
+                out.feasible,
+            )
+            # Floor the index bucket at 128: repair shapes then come
+            # from a tiny set (prewarmed below), so steady-state churn
+            # ticks never stall on a scatter-program trace.
+            k = _pow2_bucket(len(srcs), 128, 1 << 30)
+            src = np.zeros(k, np.int32)
+            src[: len(srcs)] = srcs
+            dst = np.full(k, b_pad, np.int32)  # pad scatters drop
+            dst[: len(dsts)] = dsts
+            self.dispatches_total += 1
+            planes = fn(planes, slab_planes, src, dst)
+        entry.prev_out = planes[:4]
+        entry.prev_feas = planes[4]
+        entry.stale_out_rows = (
+            sorted(set(entry.stale_out_rows) - set(changed_rows))
+            if entry.stale_out_rows
+            else entry.stale_out_rows
+        )
+        return True
+
+    # -- drift fast path ---------------------------------------------------
+    def _drift_delta(self, old_view, view: ClusterView, cache: dict):
+        """Which cluster columns changed between the view a chunk's
+        outputs were computed against and the current one.  None = the
+        tick is not drift-shaped (different topology/shapes, or so many
+        columns moved that gating would cost more than recomputing);
+        {"empty": True} = the tensors are bit-identical (the views
+        differ only in ways that round away)."""
+        key = id(old_view)
+        if key in cache:
+            return cache[key]
+        info = None
+        if (
+            getattr(old_view, "names", None) == view.names
+            and np.asarray(old_view.alloc).shape == np.asarray(view.alloc).shape
+        ):
+            dcpu_col = (old_view.cpu_alloc != view.cpu_alloc) | (
+                old_view.cpu_avail != view.cpu_avail
+            )
+            diff = (
+                (old_view.alloc != view.alloc).any(axis=1)
+                | (old_view.used != view.used).any(axis=1)
+                | dcpu_col
+            )
+            cols = np.nonzero(diff)[0]
+            c = len(view.names)
+            if cols.size == 0:
+                info = {"empty": True}
+            elif cols.size <= max(8, c // 4):
+                nb = _pow2_bucket(cols.size, 8, 1 << 30)
+                # Padded slots carry an out-of-range index: gathers are
+                # clamped-and-masked, the score write-back drops them.
+                didx = np.full(nb, 1 << 30, np.int32)
+                didx[: cols.size] = cols
+                dvalid = np.zeros(nb, bool)
+                dvalid[: cols.size] = True
+                dcpu = np.zeros(nb, bool)
+                dcpu[: cols.size] = dcpu_col[cols]
+
+                def slice_cols(arr):
+                    arr = np.asarray(arr)
+                    out = np.zeros((nb,) + arr.shape[1:], arr.dtype)
+                    out[: cols.size] = arr[cols]
+                    return out
+
+                info = {
+                    "empty": False, "didx": didx, "dvalid": dvalid,
+                    "dcpu": dcpu,
+                    "alloc_old_d": slice_cols(old_view.alloc),
+                    "used_old_d": slice_cols(old_view.used),
+                    "alloc_new_d": slice_cols(view.alloc),
+                    "used_new_d": slice_cols(view.used),
+                }
+        cache[key] = info
+        return info
+
+    def _gate_program(self, fmt: str):
+        """Jitted drift gate per format (jax re-traces per shape; the
+        gate is a cheap filter-slice program, so the trace cost is
+        negligible next to the tick programs it replaces)."""
+        fn = self._gate_programs.get(fmt)
+        if fn is not None:
+            return fn
+        if fmt == "compact":
+            cur_absent = Cmp.CUR_ABSENT
+
+            def impl(per_object, tables, prev_feas, prev_scores, ao, uo,
+                     an, un, didx, dvalid, dcpu):
+                return drift_gate_compact(
+                    per_object, tables, prev_feas, prev_scores, ao, uo,
+                    an, un, didx, dvalid, dcpu, cur_absent,
+                )
+
+            if self._grid_sharding is not None:
+                rep = self._replicated
+                grid = self._grid_sharding
+                fn = jax.jit(
+                    impl,
+                    in_shardings=(
+                        self._per_object_shardings_compact,
+                        self._table_shardings,
+                        grid, grid,
+                        rep, rep, rep, rep, rep, rep, rep,
+                    ),
+                    out_shardings=(rep, grid),
+                )
+            else:
+                fn = jax.jit(impl)
+        else:
+            impl = drift_gate_dense
+            if self._grid_sharding is not None:
+                rep = self._replicated
+                grid = self._grid_sharding
+                fn = jax.jit(
+                    impl,
+                    in_shardings=(
+                        self._per_object_shardings,
+                        grid, grid,
+                        rep, rep, rep, rep, rep, rep, rep,
+                    ),
+                    out_shardings=(rep, grid),
+                )
+            else:
+                fn = jax.jit(impl)
+        self._gate_programs[fmt] = fn
+        return fn
+
+    def _wcheck_program(self):
+        fn = self._wcheck_program_cache.get("wcheck")
+        if fn is None:
+            if self._grid_sharding is not None:
+                rep = self._replicated
+                cl = self._cluster_shardings
+                fn = jax.jit(
+                    drift_wcheck,
+                    in_shardings=(
+                        self._grid_sharding, rep,
+                        cl["cpu_alloc"], cl["cpu_avail"],
+                        cl["cpu_alloc"], cl["cpu_avail"],
+                    ),
+                    out_shardings=rep,
+                )
+            else:
+                fn = jax.jit(drift_wcheck)
+            self._wcheck_program_cache["wcheck"] = fn
+        return fn
+
+    def _dispatch_drift_gate(
+        self, entry, fmt: str, c_bucket: int, info: dict, vocab, view,
+    ):
+        """Launch the drift gate for one chunk (async; the mask is
+        drained batched in _drain_drift_gates).  Returns the (mask,
+        refreshed score plane) device pair."""
+        gate = self._gate_program(fmt)
+        self.dispatches_total += 1
+        slices = (
+            info["alloc_old_d"], info["used_old_d"],
+            info["alloc_new_d"], info["used_new_d"],
+        )
+        self.upload_bytes["cluster"] += sum(a.nbytes for a in slices)
+        if fmt == "compact":
+            return gate(
+                entry.device_per_object,
+                self._tables_device(vocab, c_bucket),
+                entry.prev_feas,
+                entry.prev_out[3],
+                *slices,
+                info["didx"], info["dvalid"], info["dcpu"],
+            )
+        return gate(
+            entry.device_per_object,
+            entry.prev_feas,
+            entry.prev_out[3],
+            *slices,
+            info["didx"], info["dvalid"], info["dcpu"],
+        )
+
+    def _drain_drift_gates(
+        self, items, chunk_results, chunk_changed, view, want_scores: bool,
+        timings, pending_sub, c_bucket, eff_chunk, ladder, vocab,
+    ) -> None:
+        """Resolve every gated chunk: batched mask reads, the batched
+        dynamic-weight check, then either a provable skip, a sub-batch
+        recompute of the candidate rows, or (mass change) a fallback
+        full dispatch with the regular delta fetch."""
+        if not items:
+            return
+        t0 = time.perf_counter()
+        mask_np: dict[int, np.ndarray] = {}
+        groups: dict[tuple, list[int]] = {}
+        for i, it in enumerate(items):
+            groups.setdefault(tuple(it[3][0].shape), []).append(i)
+        for _, members in groups.items():
+            if len(members) == 1:
+                mask_np[members[0]] = self._read_np(items[members[0]][3][0])
+            else:
+                stacked = self._read_np(
+                    self._stack(*[items[i][3][0] for i in members])
+                )
+                for j, i in enumerate(members):
+                    mask_np[i] = stacked[j]
+        timings["fetch"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        plans: list[list] = []  # [slot, entry, n, recompute set, fmt, b_pad, k]
+        wcheck_jobs: list[tuple] = []  # (plan index, wcheck rows)
+        for i, (slot, entry, n, devs, fmt, b_pad, pack_k) in enumerate(items):
+            self.drift_stats["gated"] += 1
+            # The gate refreshed the changed columns of the stored score
+            # plane (skipped rows stay exact for future drift gates;
+            # recomputed rows are overwritten by the slab repair).
+            entry.prev_out = entry.prev_out[:3] + (devs[1],)
+            mask = mask_np[i][:n]
+            rec = set(np.nonzero(mask & DRIFT_RECOMPUTE)[0].tolist())
+            # Rows whose cached prev planes are unreliable (patched
+            # without a successful device write-back) are gate-blind:
+            # force them into the recompute set.
+            forced = set()
+            if entry.stale_out_rows:
+                forced.update(r for r in entry.stale_out_rows if r < n)
+            if entry.stale_rows:
+                forced.update(r for r in entry.stale_rows if r < n)
+            rec |= forced
+            wrows = np.nonzero(mask & DRIFT_WCHECK)[0]
+            if forced and wrows.size:
+                wrows = wrows[~np.isin(wrows, sorted(forced))]
+            plans.append([slot, entry, n, rec, fmt, b_pad, pack_k])
+            if wrows.size:
+                wcheck_jobs.append((len(plans) - 1, wrows))
+        timings["decode"] += time.perf_counter() - t0
+
+        if wcheck_jobs:
+            t0 = time.perf_counter()
+            newc = self._cluster_planes_device(view, c_bucket)
+            fn = self._wcheck_program()
+            wdevs: list[tuple] = []
+            for pi, wrows in wcheck_jobs:
+                entry = plans[pi][1]
+                self.drift_stats["wcheck"] += int(wrows.size)
+                kb = _pow2_bucket(wrows.size, 16, 1 << 30)
+                ridx = np.zeros(kb, np.int32)
+                ridx[: wrows.size] = wrows
+                oldc = self._wcheck_cpu_device(entry.prev_view, c_bucket)
+                self.dispatches_total += 1
+                wdevs.append(
+                    (pi, wrows, fn(
+                        entry.prev_feas, ridx,
+                        oldc["cpu_alloc"], oldc["cpu_avail"],
+                        newc["cpu_alloc"], newc["cpu_avail"],
+                    ))
+                )
+            wgroups: dict[tuple, list[int]] = {}
+            for i, (_, _, dev) in enumerate(wdevs):
+                wgroups.setdefault(tuple(dev.shape), []).append(i)
+            warr: dict[int, np.ndarray] = {}
+            for _, members in wgroups.items():
+                if len(members) == 1:
+                    warr[members[0]] = self._read_np(wdevs[members[0]][2])
+                else:
+                    stacked = self._read_np(
+                        self._stack(*[wdevs[i][2] for i in members])
+                    )
+                    for j, i in enumerate(members):
+                        warr[i] = stacked[j]
+            for i, (pi, wrows, _dev) in enumerate(wdevs):
+                changed = wrows[warr[i][: wrows.size] != 0]
+                self.drift_stats["wcheck_changed"] += int(changed.size)
+                plans[pi][3] |= set(changed.tolist())
+            timings["fetch"] += time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        fallback: list[tuple] = []
+        for slot, entry, n, rec, fmt, b_pad, pack_k in plans:
+            rec = {r for r in rec if r < n}
+            if not rec:
+                self.fetch_stats["skip"] += 1
+                self.drift_stats["skip"] += n
+                entry.prev_view = view
+                chunk_results[slot] = entry.prev_results
+                chunk_changed[slot] = []
+            elif len(rec) > n // 2:
+                # Mass change: the whole-chunk dispatch with the regular
+                # delta fetch beats slabbing most of the chunk.
+                self.drift_stats["fallback"] += 1
+                fallback.append((slot, entry, n, fmt, b_pad, pack_k))
+            else:
+                rows = sorted(rec)
+                self.fetch_stats["delta"] += 1
+                self.drift_stats["recompute"] += len(rows)
+                self.drift_stats["skip"] += n - len(rows)
+                pending_sub.append(
+                    (slot, entry, rows, self._slice_rows(entry, rows), False)
+                )
+                chunk_changed[slot] = list(rows)
+        timings["featurize"] += time.perf_counter() - t0
+
+        if fallback:
+            t0 = time.perf_counter()
+            fitems: list[tuple] = []
+            cluster_dev = self._cluster_planes_device(view, c_bucket)
+            for slot, entry, n, fmt, b_pad, pack_k in fallback:
+                padded = self._pad_for_dispatch(
+                    entry.inputs, fmt, b_pad, c_bucket,
+                    skip_cluster_fields=True,
+                )
+                device_in = self._device_inputs(
+                    entry, padded, "hit", fmt, vocab, c_bucket, cluster_dev
+                )
+                shape = (b_pad, c_bucket)
+                delta_ok = (
+                    entry.prev_out is not None
+                    and entry.prev_out[0].shape == shape
+                )
+                prev = entry.prev_out if delta_ok else self._zeros_for(shape)
+                tick = self._tick_compact if fmt == "compact" else self._tick
+                self._count_dispatch(fmt, b_pad, c_bucket)
+                out, mask_dev = tick(device_in, prev)
+                if delta_ok and self.donate:
+                    entry.prev_out = None
+                fitems.append(
+                    (slot, entry, out, mask_dev if delta_ok else None, n,
+                     pack_k)
+                )
+            timings["device"] += time.perf_counter() - t0
+            self._drain_fetch_window(
+                fitems, chunk_results, chunk_changed, view, want_scores,
+                timings,
+            )
 
     def _device_inputs(
         self,
         entry: Optional[_CachedChunk],
         padded,
         status: str,
-        fmt: str = "dense",
-        vocab: Optional[CompactVocab] = None,
+        fmt: str,
+        vocab: Optional[CompactVocab],
+        c_bucket: int,
+        cluster_dev: dict,
     ):
         """Per-object tensors live on device across ticks: a clean re-tick
         ("hit") reuses last tick's device buffers and transfers nothing
-        but the (tiny) cluster-axis tensors.  Patched or fresh chunks are
-        re-uploaded and re-cached.  Under a mesh the upload lands
-        pre-sharded in the tick's input layout.  The compact format
-        additionally sources its vocabulary tables from the shared
-        device copy (uploaded once per vocab version)."""
+        at all — the cluster-axis tensors come from the shared
+        once-per-tick device copy (``cluster_dev``,
+        _cluster_planes_device) instead of riding every dispatch.
+        Patched or fresh chunks are re-uploaded and re-cached.  Under a
+        mesh the upload lands pre-sharded in the tick's input layout.
+        The compact format additionally sources its vocabulary tables
+        from the shared device copy (uploaded once per vocab version)."""
         fields = padded._asdict()
         per_object_names = self._per_object_fields(fmt)
         per_object = {name: fields[name] for name in per_object_names}
@@ -1814,7 +2573,7 @@ class SchedulerEngine:
         # participates in the program shape: (B, C) for dense, plus the
         # sparse-entry and key-byte widths for compact.
         b_pad = np.asarray(padded.total).shape[0]
-        c_pad = np.asarray(padded.cluster_valid).shape[0]
+        c_pad = c_bucket
         if fmt == "compact":
             shape = (
                 b_pad,
@@ -1849,12 +2608,18 @@ class SchedulerEngine:
                     name: np.ascontiguousarray(np.asarray(fields[name])[src])
                     for name in per_object_names
                 }
+                self.upload_bytes["object"] += sum(
+                    a.nbytes for a in rows.values()
+                )
                 per_object = patch(entry.device_per_object, rows, dst)
                 entry.device_per_object = per_object
                 entry.stale_rows = None
             else:
                 per_object = entry.device_per_object
         else:
+            self.upload_bytes["object"] += sum(
+                np.asarray(a).nbytes for a in per_object.values()
+            )
             if shardings is not None:
                 per_object = jax.device_put(per_object, shardings)
             else:
@@ -1867,12 +2632,9 @@ class SchedulerEngine:
             return CompactInputs(
                 **per_object,
                 **self._tables_device(vocab, c_pad),
-                **{name: fields[name] for name in Cmp.CLUSTER_FIELDS},
+                **cluster_dev,
             )
-        return TickInputs(
-            **per_object,
-            **{name: fields[name] for name in _CLUSTER_ONLY_FIELDS},
-        )
+        return TickInputs(**per_object, **cluster_dev)
 
     @staticmethod
     def _build_results(
@@ -2260,6 +3022,7 @@ class SchedulerEngine:
     def _note_skip(self, entry, out, view) -> None:
         self.fetch_stats["skip"] += 1
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+        entry.prev_feas = out.feasible
         entry.stale_out_rows = None
         entry.prev_view = view
 
@@ -2315,6 +3078,7 @@ class SchedulerEngine:
             program=f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}",
         )
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+        entry.prev_feas = out.feasible
         entry.stale_out_rows = None
         entry.prev_results = merged
         entry.prev_view = view
@@ -2348,6 +3112,7 @@ class SchedulerEngine:
             # stale placements (ADVICE r2).  The caller shares the
             # stored list's rows — frozen results make that safe.
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+            entry.prev_feas = out.feasible
             entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
@@ -2459,6 +3224,7 @@ class SchedulerEngine:
             program=f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}",
         )
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+        entry.prev_feas = out.feasible
         entry.stale_out_rows = None
         entry.prev_results = merged
         entry.prev_view = view
@@ -2483,6 +3249,7 @@ class SchedulerEngine:
         )
         if entry is not None:
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
+            entry.prev_feas = out.feasible
             entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
@@ -2710,6 +3477,7 @@ class SchedulerEngine:
                     # All rungs: full chunks use the top, sub-batches the
                     # lower ones.
                     shapes = ladder
+                outs: dict[int, object] = {}
                 for b_pad in shapes:
                     # The compact program is the production path; the
                     # dense variant serves webhook ticks (warmed only
@@ -2764,7 +3532,70 @@ class SchedulerEngine:
                                 out.selected, out.counted, out.replicas, idx
                             )
                         )
+                    # Drift-gate + weight-check programs: tiny traces,
+                    # but warming them keeps the FIRST capacity-drift
+                    # tick off the compile path too.
+                    per_object = {
+                        name: np.asarray(getattr(padded, name))
+                        for name in Cmp.PER_OBJECT_FIELDS
+                    }
+                    didx8 = np.full(8, 1 << 30, np.int32)
+                    dflag8 = np.zeros(8, bool)
+                    slice8 = np.zeros(
+                        (8,) + np.asarray(padded.alloc).shape[1:],
+                        np.asarray(padded.alloc).dtype,
+                    )
+                    jax.block_until_ready(
+                        self._gate_program("compact")(
+                            per_object,
+                            Cmp.pad_tables(vocab.tables(), c_bucket),
+                            np.zeros(shape, np.int8),
+                            np.zeros(shape, np.int32),
+                            slice8, slice8, slice8, slice8,
+                            didx8, dflag8, dflag8,
+                        )
+                    )
+                    jax.block_until_ready(
+                        self._wcheck_program()(
+                            np.zeros(shape, np.int8),
+                            np.zeros(16, np.int32),
+                            np.asarray(padded.cpu_alloc),
+                            np.asarray(padded.cpu_avail),
+                            np.asarray(padded.cpu_alloc),
+                            np.asarray(padded.cpu_avail),
+                        )
+                    )
+                    outs[b_pad] = out
                     log.info("prewarmed tick program %s", shape)
+                # Sub-batch write-back repair: full-chunk planes get
+                # slab rows scattered in — warm each (chunk, slab-rung)
+                # shape pair so steady-state churn ticks never stall on
+                # the scatter trace.  Planes are DONATED by the repair,
+                # so the chain starts from freshly built zeros (never
+                # from the slab outputs, which must stay alive as the
+                # non-donated inputs) and threads each call's results.
+                big = max(shapes)
+                pshape = (big, c_bucket)
+                planes = jax.jit(
+                    lambda: (
+                        jnp.zeros(pshape, jnp.int8),
+                        jnp.zeros(pshape, jnp.int32),
+                        jnp.zeros(pshape, jnp.int8),
+                        jnp.zeros(pshape, jnp.int32),
+                        jnp.zeros(pshape, jnp.int8),
+                    )
+                )()
+                src128 = np.zeros(128, np.int32)
+                dst128 = np.full(128, big, np.int32)  # out of range: no-op
+                for b_pad in shapes:
+                    slab = outs[b_pad]
+                    planes = self._repair_program()(
+                        planes,
+                        (slab.selected, slab.replicas, slab.counted,
+                         slab.scores, slab.feasible),
+                        src128, dst128,
+                    )
+                    jax.block_until_ready(planes[0])
             except Exception:
                 log.warning("engine prewarm failed", exc_info=True)
 

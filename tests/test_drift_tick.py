@@ -1,0 +1,504 @@
+"""Drift-tick regression suite (ISSUE 4).
+
+A cluster-capacity drift revalidates every row, but only rows whose
+decision can actually move may be recomputed — and nothing but the
+cluster planes may cross the host->device link again.  The drift gate's
+exactness claims (ops/pipeline.py, "drift gate") are checked here both
+by targeted rule cases and by a randomized differential against a
+cache-less engine and the sequential oracle.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from kubeadmiral_tpu.bench_support import sequential_schedule
+from kubeadmiral_tpu.models.types import parse_resources
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+from test_engine_cache import make_world, results_equal
+from test_engine_vs_sequential import random_cluster, random_unit
+
+
+def halve_available(cluster):
+    return dataclasses.replace(
+        cluster,
+        available={k: max(0, v // 2) for k, v in cluster.available.items()},
+    )
+
+
+class TestDriftUploadBytes:
+    def test_drift_does_not_reupload_object_planes(self):
+        """On a drift tick the cluster planes are the ONLY bytes that
+        changed: the cached per-object device tensors must be reused
+        as-is (satellite (a): pinned via the upload-byte counters)."""
+        units, clusters = make_world(b=64, c=12)
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)
+        engine.schedule(list(units), clusters)  # device copies armed
+        object0 = engine.upload_bytes["object"]
+        cluster0 = engine.upload_bytes["cluster"]
+
+        drifted = [halve_available(c) if j == 0 else c
+                   for j, c in enumerate(clusters)]
+        engine.schedule(units, drifted)
+        assert engine.drift_stats["gated"] >= 2, engine.drift_stats
+        # The chunk planes must NOT ride the link again; only the
+        # recomputed rows' slab inputs may (a small fraction of the
+        # cold upload).
+        recomputed = engine.drift_stats["recompute"]
+        delta = engine.upload_bytes["object"] - object0
+        assert recomputed < len(units) // 2
+        # The slab pads its rows to a pow2 bucket, so bound against the
+        # padded slab size: strictly less than re-uploading the chunks
+        # (the provably-zero case is pinned by the inert-column test).
+        assert delta < object0 // 2 + 1024, (
+            "drift tick re-uploaded per-object planes",
+            delta, object0, engine.drift_stats,
+        )
+        assert engine.upload_bytes["cluster"] > cluster0
+
+    def test_inert_drift_uploads_no_object_bytes_at_all(self):
+        """When the drifted column is infeasible for every row, the
+        drift tick moves ZERO object-plane bytes."""
+        units, clusters = make_world(b=48, c=12)
+        # No tolerations anywhere: cluster 0 (tainted in make_world) is
+        # infeasible for every row.
+        units = [dataclasses.replace(u, tolerations=()) for u in units]
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)
+        engine.schedule(list(units), clusters)
+        object0 = engine.upload_bytes["object"]
+        drifted = [halve_available(c) if j == 0 else c
+                   for j, c in enumerate(clusters)]
+        engine.schedule(units, drifted)
+        assert engine.drift_stats["gated"] >= 1
+        assert engine.upload_bytes["object"] == object0, engine.upload_bytes
+
+    def test_cluster_planes_uploaded_once_per_tick(self):
+        """Every chunk dispatch shares ONE padded cluster-plane upload:
+        a multi-chunk drift tick charges the cluster counter for a
+        single plane set, not per chunk (the cold tick's vocabulary
+        tables are already device-resident)."""
+        units, clusters = make_world(b=96, c=12)
+        engine = SchedulerEngine(chunk_size=32)
+        engine.schedule(units, clusters)  # cold: tables + planes
+        assert engine.cache_stats["miss"] == 3
+        cluster0 = engine.upload_bytes["cluster"]
+        drifted = [halve_available(c) for c in clusters[:2]] + clusters[2:]
+        engine.schedule(units, drifted)
+        per_tick = engine.upload_bytes["cluster"] - cluster0
+        # One padded plane set (alloc/used [C,R] i64, cpu planes [C]
+        # i64, valid [C] bool) + the wcheck's old cpu planes + the
+        # per-chunk gate delta slices (4 x [8, R] i64 each — a few
+        # hundred bytes) — NOT one plane-set copy per chunk, and no
+        # table re-upload.
+        c_bucket = 16
+        r = np.asarray(engine._chunk_cache[0].inputs.alloc).shape[1]
+        plane_set = c_bucket * (2 * r * 8 + 2 * 8 + 1)
+        gate_slices = 3 * 4 * 8 * r * 8
+        assert 0 < per_tick <= plane_set + 2 * c_bucket * 8 + gate_slices, (
+            per_tick, plane_set, gate_slices,
+        )
+
+
+class TestDriftExactness:
+    def test_drift_matches_sequential_oracle(self):
+        """Satellite (b): bit-exact against the per-object sequential
+        oracle after capacity drift."""
+        rng = np.random.default_rng(20260804)
+        clusters = [random_cluster(rng, j) for j in range(16)]
+        names = [c.name for c in clusters]
+        units = [random_unit(rng, i, names) for i in range(96)]
+        engine = SchedulerEngine(chunk_size=32, min_bucket=16,
+                                 min_cluster_bucket=8)
+        engine.schedule(units, clusters)
+
+        drifted = [halve_available(c) if j in (0, 5) else c
+                   for j, c in enumerate(clusters)]
+        got = engine.schedule(units, drifted)
+        assert engine.drift_stats["gated"] >= 1, engine.drift_stats
+        want = sequential_schedule(units, drifted)
+        for i, (g, w) in enumerate(zip(got, want)):
+            w_named = {names[j]: reps for j, reps in w.items()}
+            assert g.clusters == w_named, (
+                f"object {i} ({units[i].name}): engine={dict(g.clusters)} "
+                f"sequential={w_named}"
+            )
+
+    def test_randomized_drift_sequence_differential(self):
+        """Many drift patterns in sequence — single column, cpu-only,
+        alloc growth, churn interleaved, mass drift (gate bail) — each
+        tick compared against a cache-less engine on the same world."""
+        rng = np.random.default_rng(7)
+        clusters = [random_cluster(rng, j) for j in range(14)]
+        names = [c.name for c in clusters]
+        units = [random_unit(rng, i, names) for i in range(72)]
+        engine = SchedulerEngine(chunk_size=32, min_bucket=16,
+                                 min_cluster_bucket=8)
+        engine.schedule(units, clusters)
+
+        for step in range(8):
+            kind = step % 4
+            if kind == 0:  # one column's available halves
+                j = int(rng.integers(0, len(clusters)))
+                clusters = [halve_available(c) if i == j else c
+                            for i, c in enumerate(clusters)]
+            elif kind == 1:  # cpu-only change on two columns
+                picks = set(rng.integers(0, len(clusters), 2).tolist())
+                clusters = [
+                    dataclasses.replace(
+                        c,
+                        available={**c.available,
+                                   "cpu": max(0, c.available.get("cpu", 0) - 1500)},
+                    )
+                    if i in picks else c
+                    for i, c in enumerate(clusters)
+                ]
+            elif kind == 2:  # churn + drift in the same tick
+                units = list(units)
+                for r in rng.integers(0, len(units), 3):
+                    units[int(r)] = dataclasses.replace(
+                        units[int(r)],
+                        desired_replicas=int(rng.integers(1, 50)),
+                    )
+                j = int(rng.integers(0, len(clusters)))
+                clusters = [halve_available(c) if i == j else c
+                            for i, c in enumerate(clusters)]
+            else:  # mass drift: every column moves (gate bails out)
+                clusters = [
+                    dataclasses.replace(
+                        c,
+                        available={k: max(0, v - v // 10)
+                                   for k, v in c.available.items()},
+                    )
+                    for c in clusters
+                ]
+            got = engine.schedule(units, clusters)
+            fresh = SchedulerEngine(
+                chunk_size=32, min_bucket=16, min_cluster_bucket=8
+            ).schedule(units, clusters)
+            results_equal(got, fresh)
+        # The sequence must actually have exercised the gate.
+        assert engine.drift_stats["gated"] >= 2, engine.drift_stats
+        assert engine.drift_stats["skip"] > 0, engine.drift_stats
+
+    def test_infeasible_drift_column_skips_everything(self):
+        """A drifted column that no row can use (untolerated taint) is
+        provably inert: the gate must skip every row without any
+        recompute dispatch."""
+        from kubeadmiral_tpu.models.types import (
+            ClusterState, SchedulingUnit, Taint, MODE_DIVIDE,
+        )
+
+        gvk = "apps/v1/Deployment"
+        clusters = [
+            ClusterState(
+                name=f"m-{j}",
+                labels={},
+                taints=(Taint("walled", "off", "NoSchedule"),) if j == 0 else (),
+                allocatable=parse_resources({"cpu": "32", "memory": "64Gi"}),
+                available=parse_resources({"cpu": "16", "memory": "32Gi"}),
+                api_resources=frozenset({gvk}),
+            )
+            for j in range(6)
+        ]
+        units = [
+            SchedulingUnit(
+                gvk=gvk, namespace="ns", name=f"w-{i}",
+                scheduling_mode=MODE_DIVIDE, desired_replicas=9,
+                resource_request=parse_resources({"cpu": "100m"}),
+            )
+            for i in range(24)
+        ]
+        engine = SchedulerEngine(chunk_size=32, min_bucket=8)
+        first = engine.schedule(units, clusters)
+        dispatches0 = engine.dispatches_total
+        drifted = [halve_available(c) if j == 0 else c
+                   for j, c in enumerate(clusters)]
+        got = engine.schedule(units, drifted)
+        assert engine.drift_stats["recompute"] == 0, engine.drift_stats
+        assert engine.drift_stats["skip"] == len(units), engine.drift_stats
+        # One gate dispatch, zero tick/fetch dispatches.
+        assert engine.dispatches_total == dispatches0 + 1
+        results_equal(got, first)  # placements can't have moved
+
+    def test_sticky_rows_never_recompute_on_drift(self):
+        """Sticky rows with current placements short-circuit to them —
+        cluster drift cannot move them, and the gate must know."""
+        units, clusters = make_world(b=32, c=8)
+        units = [
+            dataclasses.replace(
+                u, sticky_cluster=True,
+                current_clusters={clusters[i % 8].name: 3},
+            )
+            for i, u in enumerate(units)
+        ]
+        engine = SchedulerEngine(chunk_size=32, min_bucket=8)
+        engine.schedule(units, clusters)
+        drifted = [halve_available(c) for c in clusters]  # mass cpu drift
+        # Mass drift bails to full dispatch; narrow the drift so the
+        # gate engages.
+        drifted = [drifted[0]] + clusters[1:]
+        got = engine.schedule(units, drifted)
+        assert engine.drift_stats["gated"] >= 1
+        assert engine.drift_stats["recompute"] == 0, engine.drift_stats
+        fresh = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units, drifted
+        )
+        results_equal(got, fresh)
+
+    def test_finite_max_clusters_rank_refinement(self):
+        """Top-K rows with a feasible drifted column are skipped ONLY
+        when the exact rank test proves no membership flip; a drift
+        that pushes a column across the K boundary must recompute and
+        move the placement."""
+        # Part 1: a mild drift that reorders nothing — the refined gate
+        # proves every row unchanged (the coarse rule would have
+        # recomputed all of them).
+        units, clusters = make_world(b=24, c=8)
+        units = [
+            dataclasses.replace(u, max_clusters=3, tolerations=()) for u in units
+        ]
+        engine = SchedulerEngine(chunk_size=32, min_bucket=8)
+        engine.schedule(units, clusters)
+        drifted = [
+            halve_available(c) if j == 1 else c for j, c in enumerate(clusters)
+        ]
+        got = engine.schedule(units, drifted)
+        assert engine.drift_stats["gated"] >= 1
+        assert engine.drift_stats["skip"] == len(units), engine.drift_stats
+        fresh = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units, drifted
+        )
+        results_equal(got, fresh)
+
+        # Part 2: a drift that crosses the K boundary — the previous
+        # winner's availability collapses, the runner-up must take the
+        # single slot, and the gate must have recomputed.
+        from kubeadmiral_tpu.models.types import ClusterState, SchedulingUnit
+
+        gvk = "apps/v1/Deployment"
+
+        def cluster(name, cpu_avail):
+            return ClusterState(
+                name=name, labels={},
+                allocatable=parse_resources({"cpu": "64", "memory": "64Gi"}),
+                available=parse_resources(
+                    {"cpu": str(cpu_avail), "memory": "60Gi"}
+                ),
+                api_resources=frozenset({gvk}),
+            )
+
+        clusters2 = [cluster("lead", 60), cluster("next", 50)]
+        units2 = [
+            SchedulingUnit(
+                gvk=gvk, namespace="ns", name=f"s-{i}",
+                scheduling_mode="Duplicate", max_clusters=1,
+                resource_request=parse_resources({"cpu": "100m"}),
+            )
+            for i in range(6)
+        ]
+        eng2 = SchedulerEngine(chunk_size=32, min_bucket=8)
+        before = eng2.schedule(units2, clusters2)
+        assert all(r.cluster_set == {"lead"} for r in before)
+        drifted2 = [
+            dataclasses.replace(
+                clusters2[0],
+                available=parse_resources({"cpu": "4", "memory": "60Gi"}),
+            ),
+            clusters2[1],
+        ]
+        after = eng2.schedule(units2, drifted2)
+        assert eng2.drift_stats["gated"] >= 1, eng2.drift_stats
+        assert (
+            eng2.drift_stats["recompute"] + eng2.drift_stats["fallback"] > 0
+        ), eng2.drift_stats
+        fresh2 = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units2, drifted2
+        )
+        results_equal(after, fresh2)
+        assert all(r.cluster_set == {"next"} for r in after)
+
+
+class TestWantScoresBypass:
+    def test_want_scores_drift_bypasses_gate_and_stays_exact(self):
+        """Score-carrying consumers need exact score planes, which the
+        gate's skip rows don't refresh per-decode — so a want_scores
+        drift tick must take the full dispatch path, scores included."""
+        units, clusters = make_world(b=32, c=8)
+        engine = SchedulerEngine(chunk_size=32, min_bucket=8)
+        engine.schedule(units, clusters, want_scores=True)
+        drifted = [halve_available(c) if j == 0 else c
+                   for j, c in enumerate(clusters)]
+        got = engine.schedule(units, drifted, want_scores=True)
+        assert engine.drift_stats["gated"] == 0, engine.drift_stats
+        fresh = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units, drifted, want_scores=True
+        )
+        for a, b in zip(got, fresh):
+            assert a.clusters == b.clusters and a.scores == b.scores
+
+
+class TestFiniteKDynamicWeights:
+    def test_topk_dynamic_weight_row_recomputes_on_cpu_drift(self):
+        """A finite-K Divide row without given weights whose top-K
+        selection contains the cpu-drifted column must RECOMPUTE: its
+        weight set is the selection (not the feasible set), so the
+        feasible-set weight check cannot decide it."""
+        from kubeadmiral_tpu.models.types import (
+            ClusterState, SchedulingUnit, MODE_DIVIDE,
+        )
+
+        gvk = "apps/v1/Deployment"
+
+        def cluster(name, cpu_avail):
+            return ClusterState(
+                name=name,
+                labels={},
+                allocatable=parse_resources({"cpu": "64", "memory": "256Gi"}),
+                available=parse_resources(
+                    {"cpu": str(cpu_avail), "memory": "128Gi"}
+                ),
+                api_resources=frozenset({gvk}),
+            )
+
+        clusters = [cluster("big", 48), cluster("mid", 24), cluster("sml", 6)]
+        units = [
+            SchedulingUnit(
+                gvk=gvk, namespace="ns", name=f"w-{i}",
+                scheduling_mode=MODE_DIVIDE, desired_replicas=100,
+                max_clusters=2,
+                resource_request=parse_resources({"cpu": "100m"}),
+            )
+            for i in range(8)
+        ]
+        engine = SchedulerEngine(chunk_size=32, min_bucket=8)
+        engine.schedule(units, clusters)
+        drifted = [
+            dataclasses.replace(
+                clusters[0],
+                available=parse_resources({"cpu": "12", "memory": "128Gi"}),
+            )
+        ] + clusters[1:]
+        got = engine.schedule(units, drifted)
+        fresh = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units, drifted
+        )
+        results_equal(got, fresh)
+        assert engine.drift_stats["gated"] >= 1, engine.drift_stats
+        # The weight shift really moved replicas, and the gate must have
+        # routed these rows through a real recompute (slab or fallback).
+        pre_drift = SchedulerEngine(chunk_size=32, min_bucket=8).schedule(
+            units, clusters
+        )
+        assert any(g.clusters != p.clusters for g, p in zip(got, pre_drift))
+        assert (
+            engine.drift_stats["recompute"] > 0
+            or engine.drift_stats["fallback"] > 0
+        ), engine.drift_stats
+
+
+class TestGeometryInvariance:
+    def test_megachunk_and_small_chunks_identical(self):
+        """Satellite (c): megachunk and 256-row-chunk geometries must
+        produce identical placements for the same world — and the
+        megachunk engine must issue fewer dispatches."""
+        rng = np.random.default_rng(99)
+        clusters = [random_cluster(rng, j) for j in range(12)]
+        names = [c.name for c in clusters]
+        units = [random_unit(rng, i, names) for i in range(200)]
+
+        mega = SchedulerEngine(chunk_size=4096, min_bucket=16,
+                               min_cluster_bucket=8)
+        small = SchedulerEngine(chunk_size=32, min_bucket=16,
+                                min_cluster_bucket=8)
+        got_mega = mega.schedule(units, clusters)
+        got_small = small.schedule(units, clusters)
+        results_equal(got_mega, got_small)
+        assert mega.dispatches_total < small.dispatches_total
+
+        # And after a drift both geometries still agree.
+        drifted = [halve_available(c) if j == 2 else c
+                   for j, c in enumerate(clusters)]
+        results_equal(
+            mega.schedule(units, drifted), small.schedule(units, drifted)
+        )
+
+    def test_cell_budget_knob_bounds_rows(self):
+        """KT_CELL_BUDGET / KT_MEGACHUNK_ROWS shape the chunk geometry."""
+        eng = SchedulerEngine(cell_budget=512 * 64, megachunk_rows=4096)
+        c_bucket, eff_chunk, _ = eng._tick_geometry(512)
+        assert c_bucket == 512 and eff_chunk == 64
+        eng2 = SchedulerEngine(megachunk_rows=256)
+        _, eff2, _ = eng2._tick_geometry(512)
+        assert eff2 == 256
+        # Default budget keeps full megachunks through the 5k config.
+        eng3 = SchedulerEngine()
+        _, eff3, _ = eng3._tick_geometry(5000)
+        assert eff3 == 4096, eff3
+
+
+class TestPrewarmLadder:
+    def test_prewarm_with_ladder_warms_drift_and_repair_programs(self, caplog):
+        """The laddered prewarm path (wide-C geometries) must complete —
+        including the drift-gate, weight-check and donated repair-chain
+        warms (prewarm swallows exceptions into a warning; a swallowed
+        failure here is a real bug) — and the engine must then schedule
+        exactly."""
+        import logging
+
+        units, clusters = make_world(b=48, c=12)
+        engine = SchedulerEngine(
+            chunk_size=64, min_bucket=8, min_cluster_bucket=8, canonical_c=8
+        )
+        assert engine._tick_geometry(len(clusters))[2] is not None  # ladder on
+        with caplog.at_level(logging.WARNING, logger="kubeadmiral.engine"):
+            engine.prewarm(len(units), len(clusters), wait=True)
+        assert not [r for r in caplog.records if "prewarm failed" in r.message], (
+            [r.message for r in caplog.records]
+        )
+        got = engine.schedule(units, clusters)
+        fresh = SchedulerEngine(
+            chunk_size=64, min_bucket=8, min_cluster_bucket=8, canonical_c=8
+        ).schedule(units, clusters)
+        results_equal(got, fresh)
+        drifted = [halve_available(c) if j == 0 else c
+                   for j, c in enumerate(clusters)]
+        results_equal(
+            engine.schedule(units, drifted),
+            SchedulerEngine(
+                chunk_size=64, min_bucket=8, min_cluster_bucket=8,
+                canonical_c=8,
+            ).schedule(units, drifted),
+        )
+
+
+class TestNoopGate:
+    def test_fresh_list_same_rows_rides_noop_gate(self):
+        """A re-submitted batch that is a FRESH list of the SAME row
+        objects must replay through the no-op gate's content-identity
+        arm: no signature walk, no dispatch (the 100k-row no-op floor
+        satellite)."""
+        units, clusters = make_world(b=48, c=8)
+        engine = SchedulerEngine(chunk_size=32, min_bucket=8)
+        first = engine.schedule(units, clusters)
+        noop0 = engine.fetch_stats["noop"]
+        dispatch0 = engine.dispatches_total
+        hits0 = engine.cache_stats["hit"]
+
+        again = engine.schedule(list(units), clusters)  # fresh container
+        assert engine.fetch_stats["noop"] > noop0
+        assert engine.dispatches_total == dispatch0
+        assert engine.cache_stats["hit"] == hits0  # no per-chunk walk
+        assert engine.last_changed == []
+        results_equal(first, again)
+
+        # A genuinely changed fresh list must fall through.
+        churned = list(units)
+        row = next(
+            i for i, u in enumerate(units) if u.scheduling_mode == "Divide"
+        )
+        churned[row] = dataclasses.replace(churned[row], desired_replicas=41)
+        changed = engine.schedule(churned, clusters)
+        assert sum(r != f for r, f in zip(changed, first)) >= 1

@@ -60,9 +60,12 @@ class TestEngineCache:
         units, clusters = make_world()
         engine = SchedulerEngine(chunk_size=32)
         first = engine.schedule(units, clusters)
-        # Fresh list: bypass the O(1) same-list gate so the PER-CHUNK
-        # hit path is what's exercised here.
-        second = engine.schedule(list(units), clusters)
+        # Rebuild one row as an equal-but-distinct object: a plain
+        # fresh list now replays through the no-op gate's content-
+        # identity arm, and the point here is the PER-CHUNK hit path
+        # (equal featurize signature -> cache hit, no re-featurize).
+        resubmitted = [dataclasses.replace(units[0])] + list(units[1:])
+        second = engine.schedule(resubmitted, clusters)
         assert engine.cache_stats["hit"] >= 2  # both chunks
         results_equal(first, second)
 

@@ -66,9 +66,14 @@ def load_rounds(root: Path) -> list[dict]:
                 "tick_ms": detail.get("tick_ms"),
                 # Informational fields carried through (never gated, and
                 # absent in pre-packed rounds): the fetch wire format and
-                # per-tick transfer volume of the packed-export work.
+                # per-tick transfer volume of the packed-export work, and
+                # the full-revalidation latency of the megachunk+drift-
+                # gate work (ISSUE 4).
                 "fetch_format": detail.get("fetch_format"),
                 "fetch_bytes": detail.get("fetch_bytes"),
+                "drift_tick_ms": (detail.get("stage_ms") or {}).get(
+                    "drift_tick_ms"
+                ),
             }
         )
     rounds.sort(key=lambda r: r["round"])
@@ -111,6 +116,16 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             f"bench-gate: fetch_format={latest['fetch_format']} "
             f"fetch_bytes={latest['fetch_bytes']}{note} — informational, "
             f"not gated"
+        )
+    if latest.get("drift_tick_ms") is not None:
+        prior_drift = [
+            r["drift_tick_ms"] for r in priors
+            if r.get("drift_tick_ms") is not None
+        ]
+        note = f" (best prior {min(prior_drift):.1f})" if prior_drift else ""
+        print(
+            f"bench-gate: drift_tick_ms={latest['drift_tick_ms']:.1f}{note} "
+            f"— informational, not gated"
         )
     if latest["value"] < floor:
         print(
