@@ -313,6 +313,16 @@ def time_batched(rng, units, clusters, followers):
     detail["fetch_bytes"] = round(tick_fetch_bytes)
     detail["fetch_bytes_run_total"] = engine.fetch_bytes_total
     detail["fetch_overflow_rows"] = engine.overflow_rows_total
+    # Narrow solve (ISSUE 5): candidate width, certified-vs-fallback row
+    # split for the whole run.  The per-phase wall split (gate_wait /
+    # overflow_fetch / narrow_fallback sub-phases) rides stage_ms /
+    # drift_stage_ms above via engine.timings.
+    detail["narrow"] = {
+        "enabled": engine.narrow,
+        "m": engine.narrow_last_m,
+        "rows": engine.narrow_stats["rows"],
+        "fallback_rows": engine.narrow_stats["fallback"],
+    }
     detail["cache"] = dict(engine.cache_stats)
     detail["fetch_paths"] = dict(engine.fetch_stats)
     detail["program_shapes"] = sorted(map(list, engine.program_shapes))
@@ -492,6 +502,7 @@ def main():
     fetch_bytes = detail.pop("fetch_bytes", None)
     fetch_bytes_run = detail.pop("fetch_bytes_run_total", None)
     fetch_overflow = detail.pop("fetch_overflow_rows", None)
+    narrow = detail.pop("narrow", None)
     result = {
         "metric": f"objects_scheduled_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
         "value": round(batched_rate, 1),
@@ -505,6 +516,7 @@ def main():
             "fetch_bytes": fetch_bytes,
             "fetch_bytes_run_total": fetch_bytes_run,
             "fetch_overflow_rows": fetch_overflow,
+            "narrow": narrow,
             "stage_ms": detail,
             "telemetry": telemetry,
             "baseline": "native-seqsched(g++ -O3)"

@@ -104,9 +104,22 @@ def _distribute(
     member: jax.Array,
     total: jax.Array,
     keep_unschedulable: jax.Array,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    tail_weight=None,
+    return_active: bool = False,
+):
     """getDesiredPlan (planner.go:211-304) for one object. Returns
-    (plan, overflow, unplaced_remainder) in original cluster order."""
+    (plan, overflow, unplaced_remainder) in original cluster order.
+
+    ``tail_weight``/``return_active`` serve the NARROW planner
+    (``plan_batch_narrow``): the cluster axis then holds only the top-M
+    member slots in this pass's own processing order, and
+    ``tail_weight`` is the summed (clamped) weight of the member columns
+    left OUT of the slots — added to every round's ``weight_sum`` so
+    ceil quotas match the full-width run exactly, while the tail slots
+    themselves receive nothing (the narrow certificate in
+    ``_plan_one_narrow`` proves the remainder never reaches them, or the
+    row falls back to the dense solve).  Both are Python-static for the
+    dense path, so the compiled full-width program is unchanged."""
     c_slots = weight.shape[0]
 
     # Processing order: members first, weight desc, tiebreak hash asc,
@@ -145,13 +158,19 @@ def _distribute(
 
     # --- weighted rounds until fixed point ---
     def round_cond(state):
-        _, _, _, remaining, moved = state
+        remaining, moved = state[3], state[4]
         return moved & (remaining > 0)
 
     def round_body(state):
-        plan, overflow, active, remaining, _ = state
+        plan, overflow, active, remaining, _ = state[:5]
         w_active = jnp.where(active, w, 0)
         weight_sum = jnp.sum(w_active, dtype=jnp.int32)
+        if tail_weight is not None:
+            # Phantom tail: out-of-slot members keep contributing their
+            # weight to the quota denominator every round (they never
+            # saturate — the narrow certificate rejects rows whose tail
+            # carries min/max/capacity structure).
+            weight_sum = weight_sum + tail_weight
         d = remaining  # round-start snapshot
         safe_sum = jnp.maximum(weight_sum, 1)
         quota = (d * w_active + safe_sum - 1) // safe_sum
@@ -172,13 +191,22 @@ def _distribute(
         plan = plan + jnp.where(active, take, 0)
         remaining = d - jnp.sum(jnp.where(active, take, 0), dtype=jnp.int32)
         moved = jnp.any(jnp.where(active, take, 0) > 0) & (weight_sum > 0)
-        return plan, overflow, active & ~full, remaining, moved
+        out = (plan, overflow, active & ~full, remaining, moved)
+        if tail_weight is not None:
+            # A round whose remainder survives past the slots is the
+            # narrow certificate's kill condition: the full-width run
+            # hands that remainder to tail members WITHIN this round's
+            # cascade (their ceil quota is >= 1 whenever tail_weight >
+            # 0), which no later prefix-only round can reproduce.
+            out = out + (state[5] | (remaining > 0),)
+        return out
 
-    plan, overflow, _, remaining, _ = jax.lax.while_loop(
-        round_cond,
-        round_body,
-        (plan, overflow, mem, remaining, jnp.asarray(True)),
-    )
+    init = (plan, overflow, mem, remaining, jnp.asarray(True))
+    if tail_weight is not None:
+        init = init + (jnp.asarray(False),)
+    state = jax.lax.while_loop(round_cond, round_body, init)
+    plan, overflow, active, remaining = state[:4]
+    spilled = state[5] if tail_weight is not None else None
 
     # Without keep_unschedulable, overflow is trimmed to what could not be
     # placed anywhere at all.
@@ -191,6 +219,9 @@ def _distribute(
     # Back to the caller's cluster order.
     inv_plan = jnp.zeros_like(plan).at[perm].set(plan)
     inv_overflow = jnp.zeros_like(overflow).at[perm].set(overflow)
+    if return_active:
+        inv_active = jnp.zeros_like(active).at[perm].set(active)
+        return inv_plan, inv_overflow, remaining, inv_active, spilled
     return inv_plan, inv_overflow, remaining
 
 
@@ -265,6 +296,149 @@ def _plan_one(inp: PlannerInputs) -> PlannerOutputs:
     )
     plan = jnp.where(inp.avoid_disruption, steady, desired)
     return PlannerOutputs(plan=plan, overflow=overflow)
+
+
+# -- narrow solve ---------------------------------------------------------
+# The planner's decision for one object touches only a PREFIX of its
+# processing order (weight desc, tiebreak asc, index asc): clusters past
+# the point where the running remainder hits zero receive nothing, and —
+# when they carry no min/max/capacity/current structure — contribute
+# nothing but their weight to the ceil-quota denominator.  The narrow
+# solve exploits that: run the planner over the top-M member slots in
+# processing-order, feed the left-out members' summed weight in as a
+# phantom ``tail_weight``, and certify per row that the result equals
+# the full-width run (ops/pipeline.py's narrow tick routes uncertified
+# rows back through the dense program).
+
+# Bit layout of the processing-order composite key (int64): the weight
+# field clamps at 2^20-1 — far above the featurizer's sum<=1000 contract
+# — and a clamp collision merely fails the strict certificate (dense
+# fallback), never silently reorders.
+_KEY_W_BITS = 20
+_KEY_TB_BITS = 32
+_KEY_SPECIAL_SHIFT = _KEY_W_BITS + _KEY_TB_BITS
+
+
+def processing_key(weight, tiebreak, special):
+    """int64 composite ordering members by (special desc, clamped weight
+    desc, tiebreak asc): larger key = processed earlier, modulo the
+    final index tie-break (left to the consumer — the narrow solve
+    packs an inverted iota under this key, preferring the lower index
+    on equal keys, matching the planner's iota comparator).
+    ``special`` marks columns carrying planner structure (min/max/
+    capacity/current) that must never land in the phantom tail."""
+    w = jnp.clip(jnp.maximum(weight, 0), 0, (1 << _KEY_W_BITS) - 1).astype(
+        jnp.int64
+    )
+    # tiebreak asc preferred -> invert into an unsigned 32-bit field.
+    tbu = jnp.int64(np.iinfo(np.int32).max) - tiebreak.astype(jnp.int64)
+    return (
+        (special.astype(jnp.int64) << _KEY_SPECIAL_SHIFT)
+        + (w << _KEY_TB_BITS)
+        + tbu
+    )
+
+
+def _plan_one_narrow(
+    inp: PlannerInputs, tail_weight, best_tail, comp
+) -> tuple[PlannerOutputs, jax.Array]:
+    """_plan_one over top-M member slots (processing-order prefix), plus
+    the exactness certificate.  ``tail_weight`` is the summed clamped
+    weight of member columns outside the slots, ``best_tail`` the
+    largest processing_key among them (-1 when none), ``comp`` the slots'
+    own processing keys.  Returns (outputs, cert bool): cert True iff
+    the narrow result provably equals the full-width planner:
+
+    * every slot that received replicas, accrued overflow, or saturated
+      out of the active set orders strictly before the best tail member
+      (so the true remainder cascade never interleaves with the tail),
+      and
+    * NO weighted round's remainder survived past the slots — the
+      full-width cascade would have handed it to the tail within that
+      round (or the tail carries zero weight, making it inert: zero
+      quota, and the caller guarantees zero min/max/capacity/current
+      structure outside the slots).
+    """
+    zeros = jnp.zeros_like(inp.weight)
+    no_cap = jnp.full_like(inp.weight, INT32_INF)
+    keep = inp.keep_unschedulable | ~inp.avoid_disruption
+
+    desired, overflow, remaining, active_end, spilled = _distribute(
+        inp.weight,
+        inp.min_replicas,
+        inp.max_replicas,
+        inp.capacity,
+        inp.tiebreak,
+        inp.member,
+        inp.total,
+        keep,
+        tail_weight=tail_weight,
+        return_active=True,
+    )
+    touched = (desired > 0) | (overflow > 0) | (inp.member & ~active_end)
+    cert = (tail_weight == 0) | (
+        ~spilled & jnp.all(~touched | (comp > best_tail))
+    )
+
+    # --- avoid-disruption scale passes: members derive from desired and
+    # current, both zero outside the slots for certified rows (desired
+    # nonzero => touched; current nonzero => special => in-slot), so
+    # these run full-fidelity on the narrow shapes with no phantom tail.
+    current_ok = jnp.where(
+        inp.member, jnp.minimum(inp.current, inp.capacity), 0
+    )
+    current_total = jnp.sum(current_ok, dtype=jnp.int32)
+    desired_total = jnp.sum(desired, dtype=jnp.int32)
+
+    up_member = inp.member & (desired > current_ok)
+    up_weight = jnp.where(up_member, desired - current_ok, 0)
+    up_max = jnp.where(
+        inp.scale_max == INT32_INF, INT32_INF, inp.scale_max - current_ok
+    )
+    grow, _, _ = _distribute(
+        up_weight,
+        zeros,
+        up_max,
+        no_cap,
+        inp.tiebreak,
+        up_member,
+        jnp.maximum(desired_total - current_total, 0),
+        jnp.asarray(False),
+    )
+
+    down_member = inp.member & (desired < current_ok)
+    down_weight = jnp.where(down_member, current_ok - desired, 0)
+    shrink, _, _ = _distribute(
+        down_weight,
+        zeros,
+        jnp.where(down_member, current_ok, INT32_INF),
+        no_cap,
+        inp.tiebreak,
+        down_member,
+        jnp.maximum(current_total - desired_total, 0),
+        jnp.asarray(False),
+    )
+
+    steady = jnp.where(
+        current_total == desired_total,
+        current_ok,
+        jnp.where(
+            current_total > desired_total,
+            current_ok - shrink,
+            current_ok + grow,
+        ),
+    )
+    plan = jnp.where(inp.avoid_disruption, steady, desired)
+    return PlannerOutputs(plan=plan, overflow=overflow), cert
+
+
+def plan_batch_narrow(
+    inp: PlannerInputs, tail_weight, best_tail, comp
+) -> tuple[PlannerOutputs, jax.Array]:
+    """Narrow planner over [B, M] slots; see _plan_one_narrow.  Jitted
+    by the caller (ops.pipeline's narrow tick) — not here, so the trace
+    fuses with the surrounding gather/scatter."""
+    return jax.vmap(_plan_one_narrow)(inp, tail_weight, best_tail, comp)
 
 
 @jax.jit

@@ -9,6 +9,7 @@ sentinel INT32_INF means "no limit".
 
 from __future__ import annotations
 
+import jax
 from jax import lax
 import jax.numpy as jnp
 
@@ -18,29 +19,48 @@ from kubeadmiral_tpu.ops.planner import INT32_INF
 def select_topk(scores, feasible, max_clusters):
     """scores i64[B,C], feasible bool[B,C], max_clusters i32[B] -> bool[B,C].
 
-    The sort runs on int32 keys: plugin totals are bounded by 5 x 100
-    (normalized in-tree scores) plus webhook scores clamped to
-    int32max/2 by the featurizer, so every total fits int32 with room —
-    and 64-bit sorts are disproportionately expensive to compile (and,
-    on TPU, to run: int64 is emulated).
+    Shape-polymorphic over the cluster axis: the narrow solve
+    (ops.pipeline.schedule_tick_narrow) calls this on [B, M] candidate
+    planes gathered in ascending column order, so the (score desc,
+    index asc) comparator ranks narrow slots exactly as it ranks the
+    dense columns they came from.
 
-    The index tie-break is a comparator KEY (lax.sort num_keys=2), not
-    argsort stability: jnp.argsort(stable=True) carries the iota as a
-    value operand and trusts the backend's is_stable flag, which the
-    axon TPU sort ignores at wide rows — caught by the r5 on-chip
-    parity check as ~3% placement mismatches at 100k x 5120 (ties at
-    the top-K boundary selected backend-dependent clusters) while
-    narrow shapes agreed exactly."""
+    The keys are int32-bounded: plugin totals are bounded by 5 x 100
+    (normalized in-tree scores) plus webhook scores clamped to
+    int32max/2 by the featurizer, so every total fits int32 with room.
+
+    The index tie-break is part of the sort KEY, not argsort stability:
+    jnp.argsort(stable=True) carries the iota as a value operand and
+    trusts the backend's is_stable flag, which the axon TPU sort
+    ignores at wide rows — caught by the r5 on-chip parity check as ~3%
+    placement mismatches at 100k x 5120 (ties at the top-K boundary
+    selected backend-dependent clusters) while narrow shapes agreed
+    exactly.  Two key encodings give the same bit-exact rank:
+
+    * CPU: the (key, index) pair packs into one collision-free int64
+      (key * C + iota) and a SINGLE-key sort ranks it — XLA:CPU lowers
+      variadic sorts to a slow row-serial comparator loop, so the
+      packed form is ~3x faster (70.5 -> 21.6ms at [256, 512]).
+    * TPU: the comparator form (lax.sort num_keys=2 on int32 keys) —
+      int64 is emulated on TPU, where the variadic int32 sort is the
+      cheaper one.
+    """
     c = scores.shape[-1]
     # Rank feasible clusters by score desc, index asc; infeasible last.
     sort_key = jnp.where(
         feasible, -scores.astype(jnp.int32), jnp.iinfo(jnp.int32).max
     )
     iota = lax.broadcasted_iota(jnp.int32, sort_key.shape, sort_key.ndim - 1)
-    _, order = lax.sort((sort_key, iota), dimension=-1, num_keys=2)
+    if jax.default_backend() == "tpu":
+        _, order = lax.sort((sort_key, iota), dimension=-1, num_keys=2)
+    else:
+        comp = sort_key.astype(jnp.int64) * c + iota
+        order = (lax.sort(comp, dimension=-1) % c).astype(jnp.int32)
     # Inverting a permutation: values are unique, so any correct sort
-    # yields the same rank regardless of backend stability.
-    rank = jnp.argsort(order, axis=-1, stable=False)  # rank[b,c] = position of c
+    # yields the same rank regardless of backend stability.  Scatter
+    # inversion (rank[order[i]] = i) beats a second argsort.
+    rows = jnp.arange(sort_key.shape[0], dtype=jnp.int32)[:, None]
+    rank = jnp.zeros_like(order).at[rows, order].set(iota)
     k = jnp.where(
         max_clusters < 0,
         0,

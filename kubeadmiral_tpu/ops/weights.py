@@ -40,6 +40,13 @@ def dynamic_weights(selected, cpu_alloc, cpu_avail):
     """selected bool[B,C]; cpu_alloc/cpu_avail i64[C] -> i32[B,C] weights.
 
     Weights are zero outside the selection mask.
+
+    Sums/maxima here range over the SELECTION, so the result for a row
+    depends only on its selected columns — the narrow solve relies on
+    that: it computes weights dense (this is elementwise + reductions,
+    no sorts) and gathers them into the [B, M] planner slots, and the
+    residual's first-max tie-break (index order) survives the gather
+    because candidate slots preserve ascending column order.
     """
     sel = selected
     n = jnp.maximum(jnp.sum(sel, axis=-1, keepdims=True), 1).astype(jnp.int64)

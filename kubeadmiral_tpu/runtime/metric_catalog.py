@@ -75,7 +75,11 @@ CATALOG: dict[str, MetricSpec] = {
     "engine_tick_stage_seconds": MetricSpec(
         "histogram", "seconds", ("stage",),
         "Per-tick wall time of one stage: featurize, device, fetch, "
-        "decode (+ follower when a FollowerIndex is applied)."),
+        "decode (+ follower when a FollowerIndex is applied), plus "
+        "sub-phase splits — gate_wait and overflow_fetch overlap the "
+        "fetch stage (drift-gate compute blocked on, and wide [n, C] "
+        "K-overflow re-fetches), narrow_fallback is the dense re-solve "
+        "+ repair of certificate-failed narrow rows."),
     "engine_chunk_cache_total": MetricSpec(
         "counter", "chunks", ("result",),
         "Incremental-featurization outcomes per chunk: hit, patch, miss."),
@@ -104,6 +108,13 @@ CATALOG: dict[str, MetricSpec] = {
         "comparison rows, wcheck_changed = weight comparisons that "
         "found a difference, recompute = rows re-scheduled through the "
         "sub-batch slabs."),
+    "engine_narrow_rows_total": MetricSpec(
+        "counter", "rows", ("path",),
+        "Narrow-solve (KT_NARROW) row outcomes: narrow = rows whose "
+        "per-row exactness certificate held (solved over the top-M "
+        "candidate columns), fallback = uncertified rows re-solved "
+        "through the full-width dense program (bit-identical by "
+        "construction either way)."),
     "engine_persistent_cache_total": MetricSpec(
         "counter", "traces", ("result",),
         "Persistent XLA compilation-cache outcome per observed trace: "

@@ -43,12 +43,14 @@ from kubeadmiral_tpu.ops.pipeline import (
     NIL_REPLICAS,
     PackedRows,
     TickInputs,
+    TickOutputs,
     drift_gate_compact,
     drift_gate_dense,
     drift_wcheck,
     expand_compact,
     pack_wire,
     schedule_tick,
+    schedule_tick_narrow,
     unpack_wire,
 )
 from kubeadmiral_tpu.ops.planner import INT32_INF
@@ -299,6 +301,27 @@ class _CachedChunk:
     # were merged host-side by the sub-batch pass): the next delta fetch
     # force-gathers them, everything else still rides the device diff.
     stale_out_rows: Optional[list] = None
+    # Adaptive packed-export K hint: pow2 over the chunk's observed
+    # nsel distribution (99.5th percentile, halving decay — see
+    # SchedulerEngine._observe_nsel); 0 = no observation yet, use the
+    # static maxClusters bound.
+    pack_k_hint: int = 0
+
+
+def _diff_bits(out, prev: tuple):
+    """Per-row diff mask vs the previous tick's output planes:
+    _DIFF_PLACEMENT when any of selected/replicas/counted changed,
+    _DIFF_SCORES when the score plane changed (only consulted by
+    want_scores consumers, so resource drift that shifts scores without
+    moving placements stays on the skip path)."""
+    psel, prep, pcnt, psco = prev
+    place_diff = (
+        (out.selected != psel) | (out.replicas != prep) | (out.counted != pcnt)
+    ).any(axis=1)
+    score_diff = (out.scores != psco).any(axis=1)
+    return place_diff.astype(jnp.int8) * _DIFF_PLACEMENT + score_diff.astype(
+        jnp.int8
+    ) * _DIFF_SCORES
 
 
 def _tick_with_diff(inp: TickInputs, prev: tuple):
@@ -308,22 +331,9 @@ def _tick_with_diff(inp: TickInputs, prev: tuple):
     ships with the tick instead of as a follow-up program.  This single
     program serves cold, steady-state and sub-batch dispatches alike —
     the engine's whole per-shape compile budget is this plus the (tiny)
-    gather program.
-
-    Mask bits per row: _DIFF_PLACEMENT when any of selected/replicas/
-    counted changed, _DIFF_SCORES when the score plane changed (only
-    consulted by want_scores consumers, so resource drift that shifts
-    scores without moving placements stays on the skip path)."""
+    gather program."""
     out = schedule_tick.__wrapped__(inp)
-    psel, prep, pcnt, psco = prev
-    place_diff = (
-        (out.selected != psel) | (out.replicas != prep) | (out.counted != pcnt)
-    ).any(axis=1)
-    score_diff = (out.scores != psco).any(axis=1)
-    mask = place_diff.astype(jnp.int8) * _DIFF_PLACEMENT + score_diff.astype(
-        jnp.int8
-    ) * _DIFF_SCORES
-    return out, mask
+    return out, _diff_bits(out, prev)
 
 
 def _tick_compact_with_diff(ci: CompactInputs, prev: tuple):
@@ -482,6 +492,8 @@ class SchedulerEngine:
         flight_recorder="default",
         fetch_format: Optional[str] = None,
         pack_k_min: Optional[int] = None,
+        narrow: Optional[bool] = None,
+        narrow_m: Optional[int] = None,
     ):
         self.chunk_size = chunk_size
         # Result-fetch wire format: "packed" (default) ships [B, K]
@@ -502,6 +514,28 @@ class SchedulerEngine:
             if pack_k_min is None
             else pack_k_min
         )
+        # Narrow solve (KT_NARROW, default on; KT_NARROW=0 reverts to
+        # the dense program): the tick's expensive select/planner stages
+        # run over M candidate columns per row instead of the full
+        # cluster axis (ops/pipeline.schedule_tick_narrow), with a
+        # per-row exactness certificate; uncertified rows re-solve
+        # through the dense program as a sub-batch (bit-identical
+        # placements by construction).  KT_NARROW_M floors M (default
+        # 128 — capacity-spill headroom over the finite maxClusters
+        # bound); narrow engages only when M < the cluster bucket.
+        if narrow is None:
+            narrow = os.environ.get("KT_NARROW", "1") not in ("0", "false", "no")
+        self.narrow = bool(narrow)
+        self.narrow_m = (
+            int(os.environ.get("KT_NARROW_M", "128"))
+            if narrow_m is None
+            else int(narrow_m)
+        )
+        # rows = rows solved (and certified) by the narrow program,
+        # fallback = uncertified rows re-solved dense; narrow_last_m is
+        # the most recent chunk's candidate width (bench detail).
+        self.narrow_stats = {"rows": 0, "fallback": 0}
+        self.narrow_last_m = 0
         # Cumulative device->host result-transfer volume and packed-
         # overflow rows (rows whose selected set exceeded K and were
         # re-fetched through the dense path); per-tick deltas land in
@@ -722,6 +756,12 @@ class SchedulerEngine:
         self._gate_programs: dict[tuple, object] = {}
         self._wcheck_program_cache: dict[tuple, object] = {}
         self._repair_program_cache: dict[tuple, object] = {}
+        # Narrow-solve programs: the (fmt, M) tick variants, the dense
+        # row re-solve for uncertified rows, and the 4-plane scatter
+        # that repairs the narrow output planes in place.
+        self._narrow_programs: dict[tuple, object] = {}
+        self._fallback_programs: dict[str, object] = {}
+        self._cert_repair_cache: dict[str, object] = {}
         # Donating `prev` (argnums 1) lets XLA alias the previous tick's
         # output planes into the new ones: full dispatches stop holding
         # two [B, C] output generations live at once.
@@ -897,6 +937,153 @@ class SchedulerEngine:
             self._zero_prev[shape] = zp
         return zp
 
+    # -- narrow-solve programs -------------------------------------------
+    def _narrow_m(self, inputs, c_bucket: int) -> Optional[int]:
+        """The chunk's candidate width M, or None for the dense solve:
+        pow2 over the finite maxClusters bound, floored at KT_NARROW_M
+        (capacity-spill headroom — the planner's remainder cascade
+        touches ~total-replicas columns, which the certificate verifies
+        per row).  Narrow only pays off when M is actually narrower
+        than the cluster bucket."""
+        if not self.narrow:
+            return None
+        mc = np.asarray(inputs.max_clusters)
+        finite = mc[(mc >= 0) & (mc < INT32_INF)]
+        bound = int(finite.max()) if finite.size else 0
+        m = _pow2_bucket(max(bound, self.narrow_m), 8, 1 << 30)
+        return m if m < c_bucket else None
+
+    def _narrow_program(self, fmt: str, m: int):
+        """Jitted narrow tick per (format, M): phase-1 dense + top-M
+        candidate solve + diff-vs-prev + per-row certificate, one
+        dispatch — the narrow analogue of _tick_with_diff (same prev
+        donation, same output shardings, plus the i8[B] cert plane)."""
+        key = (fmt, m)
+        fn = self._narrow_programs.get(key)
+        if fn is not None:
+            return fn
+        rows_only = self._rows_only_sharding
+        donate = (1,) if self.donate else ()
+
+        def impl(inp, prev, _m=m, _fmt=fmt):
+            if _fmt == "compact":
+                inp = expand_compact(inp)
+            out, cert = schedule_tick_narrow(inp, _m, rows_only=rows_only)
+            return out, _diff_bits(out, prev), cert
+
+        if self.mesh is None:
+            fn = jax.jit(impl, donate_argnums=donate)
+        else:
+            from kubeadmiral_tpu.parallel import mesh as M
+
+            grid = self._grid_sharding
+            rows = M.rows_sharding(self.mesh)
+            in_sh = (
+                M.compact_input_shardings(self.mesh)
+                if fmt == "compact"
+                else M.input_shardings(self.mesh),
+                (grid, grid, grid, grid),
+            )
+            fn = jax.jit(
+                impl,
+                in_shardings=in_sh,
+                out_shardings=(M.output_shardings(self.mesh), rows, rows),
+                donate_argnums=donate,
+            )
+        self._narrow_programs[key] = fn
+        return fn
+
+    def _fallback_program(self, fmt: str):
+        """Dense re-solve of uncertified narrow rows, straight from the
+        chunk's device-resident inputs: gather the rows, run the full-
+        width tick on [K, C], return the planes the narrow solve may
+        have gotten wrong (scores/feasible come from the shared phase 1
+        and are exact by construction).  jax re-traces per (K, B, C)
+        shape; K is pow2-bucketed by the caller."""
+        fn = self._fallback_programs.get(fmt)
+        if fn is not None:
+            return fn
+        per_object = tuple(self._per_object_fields(fmt))
+        replicated = self._replicated
+
+        def impl(device_in, idx, _fmt=fmt):
+            rows = {name: getattr(device_in, name)[idx] for name in per_object}
+            sub = device_in._replace(**rows)
+            if replicated is not None:
+                # The re-solve is a full-width tick: its select/planner
+                # sorts run along the CLUSTER axis, which must not stay
+                # sharded (GSPMD shard-sums sorted axes — the pack-sort
+                # rule), and the gathered rows are few — so the whole
+                # [K, C] sub-problem replicates, cluster planes included.
+                sub = type(sub)(
+                    *(
+                        jax.lax.with_sharding_constraint(x, replicated)
+                        for x in sub
+                    )
+                )
+            inp = expand_compact(sub) if _fmt == "compact" else sub
+            out = schedule_tick.__wrapped__(inp)
+            return out.selected, out.replicas, out.counted, out.reasons
+
+        fn = jax.jit(impl)
+        self._fallback_programs[fmt] = fn
+        return fn
+
+    def _cert_repair_program(self):
+        """4-plane scatter writing the dense re-solve's rows back into
+        the narrow output planes (selected/replicas/counted/reasons) —
+        donated, so the repair happens in place.  Out-of-range dst rows
+        (the pow2 padding) drop."""
+        fn = self._cert_repair_cache.get("repair")
+        if fn is None:
+
+            def impl(planes, fb, dst):
+                return tuple(
+                    p.at[dst].set(f, mode="drop") for p, f in zip(planes, fb)
+                )
+
+            donate = (0,) if self.donate else ()
+            fn = jax.jit(impl, donate_argnums=donate)
+            self._cert_repair_cache["repair"] = fn
+        return fn
+
+    def _apply_cert_fallback(
+        self, out, cert_np: np.ndarray, device_in, fmt: str, n: int, timings
+    ):
+        """Resolve one narrow dispatch's certificate: certified rows
+        stand as-is (bit-identical to the dense solve by the kernel's
+        proof), uncertified rows re-solve through the dense program and
+        scatter-repair the output planes BEFORE anything downstream
+        (wire packing, prev stores, the flight recorder) reads them.
+        Returns (possibly repaired out, fallback row indices or None)."""
+        rows = np.nonzero(cert_np[:n] == 0)[0]
+        self.narrow_stats["rows"] += int(n - rows.size)
+        if rows.size == 0:
+            return out, None
+        t0 = time.perf_counter()
+        self.narrow_stats["fallback"] += int(rows.size)
+        b_pad = out.selected.shape[0]
+        k = _pow2_bucket(rows.size, 16, 1 << 30)
+        # One index array serves both sides: the gather clamps the pad
+        # rows (wasted lanes), the repair scatter drops them.
+        idx = np.full(k, b_pad, np.int32)
+        idx[: rows.size] = rows
+        self.dispatches_total += 1
+        fb = self._fallback_program(fmt)(device_in, idx)
+        planes = self._cert_repair_program()(
+            (out.selected, out.replicas, out.counted, out.reasons), fb, idx
+        )
+        out = out._replace(
+            selected=planes[0],
+            replicas=planes[1],
+            counted=planes[2],
+            reasons=planes[3],
+        )
+        timings["narrow_fallback"] = (
+            timings.get("narrow_fallback", 0.0) + time.perf_counter() - t0
+        )
+        return out, rows
+
     # -- packed export programs ------------------------------------------
     def _pack_program(self, kind: str, k: int):
         """Jitted packed-export program per (kind, K): "full" compacts a
@@ -952,18 +1139,59 @@ class SchedulerEngine:
         self._pack_programs[key] = fn
         return fn
 
-    def _pack_k(self, inputs, c_bucket: int) -> int:
-        """The chunk's packed-slot count K: the pow2 bucket of the
-        largest finite maxClusters (floored at pack_k_min so Divide-mode
-        rows with unlimited maxClusters but small replica spreads still
-        pack), capped at the cluster bucket (K = C is lossless).  Rows
-        whose selected set exceeds K raise the overflow flag and ride
-        the dense fallback."""
+    def _pack_k(self, inputs, c_bucket: int, hint: int = 0) -> int:
+        """The chunk's packed-slot count K.  With an adaptive ``hint``
+        (cached on the chunk entry from the observed nsel distribution,
+        see _observe_nsel) K follows what rows ACTUALLY select — the
+        static maxClusters-bound pow2 both under-shoots (unlimited
+        Divide rows selecting hundreds of clusters overflowed 55k rows
+        per c5 run into the wide dense re-fetch) and over-shoots (a
+        bound of 19 pads to 32 slots nobody fills).  Cold chunks fall
+        back to the static bound: pow2 of the largest finite
+        maxClusters, floored at pack_k_min, capped at the cluster
+        bucket (K = C is lossless).  Rows whose selected set exceeds K
+        raise the overflow flag and ride the dense re-fetch either way —
+        the hint tunes bytes, never correctness."""
+        if hint:
+            return min(max(hint, 8), c_bucket)
         mc = np.asarray(inputs.max_clusters)
         finite = mc[(mc >= 0) & (mc < INT32_INF)]
         bound = int(finite.max()) if finite.size else 0
         k = _pow2_bucket(max(bound, self.pack_k_min), 8, 1 << 30)
         return min(k, c_bucket)
+
+    def _observe_nsel(self, entry, nsel, c_bucket: int) -> None:
+        """Feed a fetched batch's true selected counts into the chunk's
+        adaptive pack-K hint: pick the pow2 K minimizing expected wire
+        bytes over the OBSERVED distribution — every row pays the
+        (4K+2)-int wire width, overflow rows additionally pay the
+        bit-packed [n, C] re-fetch (~4.25·C bytes: two C-bit masks plus
+        the i32 replica plane).  A c5-style workload whose rows select
+        a few dozen clusters lands on the K that puts overflow under
+        ~1%; a workload whose rows select nearly everything keeps K at
+        the floor (inflating K toward C would cost more wire than the
+        re-fetch it avoids).  The hint decays by halving, so a
+        shrinking distribution eventually shrinks the wire rows while
+        a widening one raises K immediately."""
+        if entry is None:
+            return
+        nsel = np.asarray(nsel)
+        if nsel.size == 0:
+            return
+        over_bytes = 4.25 * c_bucket
+        best_k, best_cost = None, None
+        k = _pow2_bucket(self.pack_k_min, 8, 1 << 30)
+        while True:
+            k_eff = min(k, c_bucket)
+            cost = nsel.size * (4 * k_eff + 2) * 4 + float(
+                (nsel > k_eff).sum()
+            ) * over_bytes
+            if best_cost is None or cost < best_cost:
+                best_k, best_cost = k_eff, cost
+            if k_eff >= c_bucket:
+                break
+            k *= 2
+        entry.pack_k_hint = max(best_k, entry.pack_k_hint // 2)
 
     def _pcache_entries(self) -> int:
         """Entry count of the persistent XLA compilation cache directory
@@ -979,7 +1207,10 @@ class SchedulerEngine:
     def _read_np(self, dev) -> np.ndarray:
         """Blocking device->host read with fetch-byte accounting — every
         result transfer funnels through here so engine_fetch_bytes_total
-        (and bench.py's fetch_bytes) reflect real wire volume."""
+        (and bench.py's fetch_bytes) reflect real wire volume.  Host
+        arrays pass through uncounted (already fetched once)."""
+        if isinstance(dev, np.ndarray):
+            return dev
         arr = np.asarray(dev)
         self.fetch_bytes_total += arr.nbytes
         return arr
@@ -1304,6 +1535,7 @@ class SchedulerEngine:
             overflow0 = self.overflow_rows_total
             upload0 = dict(self.upload_bytes)
             drift0 = dict(self.drift_stats)
+            narrow0 = dict(self.narrow_stats)
             # Arm the flight recorder for this tick: record sites (the
             # fetch/decode helpers) consume _tick_rec; ticks riding the
             # noop/skip fast paths record nothing and the previous
@@ -1327,7 +1559,7 @@ class SchedulerEngine:
                     rec.end_tick()
             self._emit_tick_metrics(
                 len(units), time.perf_counter() - t_start, cache0, fetch0,
-                bytes0, overflow0, upload0, drift0,
+                bytes0, overflow0, upload0, drift0, narrow0,
             )
             return results
 
@@ -1335,6 +1567,7 @@ class SchedulerEngine:
         self, n_units: int, wall: float, cache0: dict, fetch0: dict,
         bytes0: int = 0, overflow0: int = 0,
         upload0: Optional[dict] = None, drift0: Optional[dict] = None,
+        narrow0: Optional[dict] = None,
     ) -> None:
         """Per-tick telemetry: stage-latency histograms, cache/fetch path
         counters (as deltas of the raw dict stats over this call), true
@@ -1370,6 +1603,10 @@ class SchedulerEngine:
             delta = self.drift_stats[kind] - (drift0 or {}).get(kind, 0)
             if delta:
                 m.counter("engine_drift_rows_total", delta, kind=kind)
+        for key, path in (("rows", "narrow"), ("fallback", "fallback")):
+            delta = self.narrow_stats[key] - (narrow0 or {}).get(key, 0)
+            if delta:
+                m.counter("engine_narrow_rows_total", delta, path=path)
         events = pipeline_mod.drain_trace_events()
         for program, b, c in events:
             m.counter("engine_xla_compiles_total", program=program, shape=f"{b}x{c}")
@@ -1570,7 +1807,9 @@ class SchedulerEngine:
                 continue
 
             b_pad = self._bucket_rows(len(chunk), ladder, eff_chunk, multi_chunk)
-            pack_k = self._pack_k(inputs, c_bucket)
+            pack_k = self._pack_k(
+                inputs, c_bucket, entry.pack_k_hint if entry is not None else 0
+            )
 
             drift_info = None
             if (
@@ -1649,9 +1888,17 @@ class SchedulerEngine:
                 prev = (
                     entry.prev_out if delta_ok else self._zeros_for(out_shape)
                 )
-                tick = self._tick_compact if fmt == "compact" else self._tick
+                narrow_m = self._narrow_m(inputs, c_bucket)
                 self._count_dispatch(fmt, b_pad, c_bucket)
-                out, mask_dev = tick(device_in, prev)
+                if narrow_m is not None:
+                    self.narrow_last_m = narrow_m
+                    out, mask_dev, cert_dev = self._narrow_program(
+                        fmt, narrow_m
+                    )(device_in, prev)
+                else:
+                    tick = self._tick_compact if fmt == "compact" else self._tick
+                    out, mask_dev = tick(device_in, prev)
+                    cert_dev = None
                 if delta_ok and self.donate:
                     # The donated prev buffers are dead; every drain
                     # path stores the fresh outputs before they're
@@ -1670,6 +1917,9 @@ class SchedulerEngine:
                         mask_dev if delta_ok else None,
                         len(chunk),
                         pack_k,
+                        cert_dev,
+                        device_in if cert_dev is not None else None,
+                        fmt,
                     )
                 )
                 chunk_results.append(None)
@@ -1687,10 +1937,24 @@ class SchedulerEngine:
             jax.block_until_ready(out)
             t2 = time.perf_counter()
             timings["device"] += t2 - t1
+            mask_host = None
+            if cert_dev is not None:
+                out, fb_rows = self._apply_cert_fallback(
+                    out, self._read_np(cert_dev), device_in, fmt, len(chunk),
+                    timings,
+                )
+                if fb_rows is not None and delta_ok:
+                    # The diff mask was computed against the NARROW
+                    # outputs; re-solved rows must be fetched regardless
+                    # of what it says.
+                    mask_host = self._read_np(mask_dev)[: len(chunk)].copy()
+                    mask_host[fb_rows] |= _DIFF_PLACEMENT
             part, changed = self._fetch_decode(
                 entry,
                 out,
-                mask_dev if delta_ok else None,
+                (mask_host if mask_host is not None else mask_dev)
+                if delta_ok
+                else None,
                 view.names,
                 len(chunk),
                 want_scores,
@@ -1950,7 +2214,20 @@ class SchedulerEngine:
         want_scores = any(e.prev_has_scores for _, e, _, _, _ in pending)
         record = self._tick_rec is not None
         packed_mode = self.fetch_format == "packed"
-        pack_k = self._pack_k(inputs, c_bucket) if packed_mode else 0
+        # Adaptive K: the widest per-chunk hint across the group (the
+        # combined slab serves rows from every chunk), falling back to
+        # the static maxClusters bound for unobserved chunks — without
+        # it, drift recomputes of unlimited-maxClusters rows packed at
+        # the K floor and re-fetched most survivors through the wide
+        # [n, C] overflow path.
+        pack_k = (
+            self._pack_k(
+                inputs, c_bucket,
+                max(p[1].pack_k_hint for p in pending),
+            )
+            if packed_mode
+            else 0
+        )
         planes = 5 if record else (4 if want_scores else 3)
         cls = CompactInputs if fmt == "compact" else TickInputs
         # Cross-slab pipelining: EVERY slab's tick + fetch program is
@@ -1972,7 +2249,12 @@ class SchedulerEngine:
                     cells == best_cells and rung > slab_cut
                 ):
                     slab_cut, best_cells = rung, cells
-        slabs: list[tuple] = []  # (n, out, fetch_dev)
+        # Narrow-solve the slabs like full dispatches: sub-batch rows are
+        # few, but their select/planner sorts still run over the full
+        # cluster axis — at wide C (drift recomputes route through here)
+        # the narrow program is where the dispatch time goes.
+        narrow_m = self._narrow_m(inputs, c_bucket)
+        ticked: list[list] = []  # [n, out, device_in, cert_dev]
         for start in range(0, total, slab_cut):
             piece = cls(
                 **{
@@ -2000,10 +2282,42 @@ class SchedulerEngine:
                 device_in = padded._replace(
                     **self._tables_device(vocab, c_bucket), **cluster_dev
                 )
-                out, _mask = self._tick_compact(device_in, self._zeros_for(shape))
             else:
                 device_in = padded._replace(**cluster_dev)
+            cert_dev = None
+            if narrow_m is not None:
+                self.narrow_last_m = narrow_m
+                out, _mask, cert_dev = self._narrow_program(fmt, narrow_m)(
+                    device_in, self._zeros_for(shape)
+                )
+            elif fmt == "compact":
+                out, _mask = self._tick_compact(device_in, self._zeros_for(shape))
+            else:
                 out, _mask = self._tick(device_in, self._zeros_for(shape))
+            ticked.append([n, out, device_in, cert_dev])
+            timings["device"] += time.perf_counter() - t1
+            t0 = time.perf_counter()
+
+        # Narrow certificates resolve BEFORE the gathers are enqueued —
+        # the wire must carry the (possibly dense re-solved) exact
+        # planes.  Every slab's tick is already in flight, so the cert
+        # reads overlap the remaining device queue.
+        if any(t[3] is not None for t in ticked):
+            t1 = time.perf_counter()
+            certs = [
+                self._read_np(t[3]) if t[3] is not None else None
+                for t in ticked
+            ]
+            timings["fetch"] += time.perf_counter() - t1
+            for t, cert in zip(ticked, certs):
+                if cert is not None:
+                    t[1], _fb = self._apply_cert_fallback(
+                        t[1], cert, t[2], fmt, t[0], timings
+                    )
+
+        slabs: list[tuple] = []  # (n, out, fetch_dev)
+        t1 = time.perf_counter()
+        for n, out, _device_in, _cert in ticked:
             if packed_mode:
                 # Row-bucketed gather-pack, not the whole padded slab:
                 # n changed rows bucket to pow2(n) wire rows instead of
@@ -2033,15 +2347,12 @@ class SchedulerEngine:
                         out.selected, out.replicas, out.counted, idx
                     )
             slabs.append((n, out, fetch_dev))
-            timings["device"] += time.perf_counter() - t1
-            t0 = time.perf_counter()
 
         # All slabs are in flight; wait for device completion ONCE (the
         # last program's completion implies the whole queue), so the
         # reads below measure pure transfer — same stage attribution as
         # the pre-pipelined per-slab block.
         if slabs:
-            t1 = time.perf_counter()
             jax.block_until_ready(slabs[-1][2])
             timings["device"] += time.perf_counter() - t1
 
@@ -2053,16 +2364,18 @@ class SchedulerEngine:
         rec_feas: list[np.ndarray] = []
         rec_ti: list[np.ndarray] = []
         rec_ts: list[np.ndarray] = []
+        all_nsel: list[np.ndarray] = []
         for n, out, fetch_dev in slabs:
             t2 = time.perf_counter()
             arr = self._read_np(fetch_dev)[:n]
             if packed_mode:
                 packed = unpack_wire(arr, pack_k)
+                all_nsel.append(np.asarray(packed.nsel))
                 over_pos = np.nonzero(np.asarray(packed.nsel) > pack_k)[0]
                 over_dense = None
                 if over_pos.size:
                     over_dense = self._fetch_overflow(
-                        out, over_pos.astype(np.int64), want_scores
+                        out, over_pos.astype(np.int64), want_scores, timings
                     )
                 t3 = time.perf_counter()
                 timings["fetch"] += t3 - t2
@@ -2105,6 +2418,7 @@ class SchedulerEngine:
         all_scores = np.concatenate(rec_scores) if rec_scores else None
         all_counts = np.concatenate(rec_counts) if rec_counts else None
         all_feas = np.concatenate(rec_feas) if rec_feas else None
+        nsel_all = np.concatenate(all_nsel) if all_nsel else None
         for slot, entry, changed_rows, _sub, inputs_stale in pending:
             merged = list(entry.prev_results)
             res_rows = []
@@ -2115,6 +2429,8 @@ class SchedulerEngine:
                 merged[row] = res
                 res_rows.append(res)
             span = slice(offset, offset + len(changed_rows))
+            if nsel_all is not None:
+                self._observe_nsel(entry, nsel_all[span], c_bucket)
             if all_reasons is not None:
                 self._record_decisions(
                     entry, changed_rows, res_rows, all_reasons[span],
@@ -2419,6 +2735,13 @@ class SchedulerEngine:
                 )
                 for j, i in enumerate(members):
                     mask_np[i] = stacked[j]
+        # The mask rows are a few KB; this read blocks on the GATE
+        # programs themselves, so its wall time is gate compute, not
+        # transfer — attributed separately (gate_wait) so bench/metrics
+        # can split the drift tick's fetch stage into its real phases.
+        timings["gate_wait"] = (
+            timings.get("gate_wait", 0.0) + time.perf_counter() - t0
+        )
         timings["fetch"] += time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -2486,6 +2809,9 @@ class SchedulerEngine:
                 changed = wrows[warr[i][: wrows.size] != 0]
                 self.drift_stats["wcheck_changed"] += int(changed.size)
                 plans[pi][3] |= set(changed.tolist())
+            timings["gate_wait"] = (
+                timings.get("gate_wait", 0.0) + time.perf_counter() - t0
+            )
             timings["fetch"] += time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -2532,14 +2858,25 @@ class SchedulerEngine:
                     and entry.prev_out[0].shape == shape
                 )
                 prev = entry.prev_out if delta_ok else self._zeros_for(shape)
-                tick = self._tick_compact if fmt == "compact" else self._tick
+                narrow_m = self._narrow_m(entry.inputs, c_bucket)
                 self._count_dispatch(fmt, b_pad, c_bucket)
-                out, mask_dev = tick(device_in, prev)
+                if narrow_m is not None:
+                    self.narrow_last_m = narrow_m
+                    out, mask_dev, cert_dev = self._narrow_program(
+                        fmt, narrow_m
+                    )(device_in, prev)
+                else:
+                    tick = (
+                        self._tick_compact if fmt == "compact" else self._tick
+                    )
+                    out, mask_dev = tick(device_in, prev)
+                    cert_dev = None
                 if delta_ok and self.donate:
                     entry.prev_out = None
                 fitems.append(
                     (slot, entry, out, mask_dev if delta_ok else None, n,
-                     pack_k)
+                     pack_k, cert_dev,
+                     device_in if cert_dev is not None else None, fmt)
                 )
             timings["device"] += time.perf_counter() - t0
             self._drain_fetch_window(
@@ -2698,11 +3035,60 @@ class SchedulerEngine:
         self, item, chunk_results, chunk_changed, view, want_scores: bool, timings
     ) -> None:
         """Complete one in-flight pipelined chunk (see pipeline_depth)."""
-        slot, entry, out, mask_dev, n, pack_k = item
+        slot, entry, out, mask_dev, n, pack_k = item[:6]
+        cert_dev = item[6] if len(item) > 6 else None
+        if cert_dev is not None:
+            out, fb_rows = self._apply_cert_fallback(
+                out, self._read_np(cert_dev), item[7], item[8], n, timings
+            )
+            if fb_rows is not None and mask_dev is not None:
+                mask = self._read_np(mask_dev)[:n].copy()
+                mask[fb_rows] |= _DIFF_PLACEMENT
+                mask_dev = mask
         chunk_results[slot], chunk_changed[slot] = self._fetch_decode(
             entry, out, mask_dev, view.names, n, want_scores, timings, view,
             pack_k,
         )
+
+    def _resolve_cert_window(self, items, timings) -> list[tuple]:
+        """Resolve narrow certificates for a window of in-flight chunks
+        and normalize every item to the 6-tuple (slot, entry, out, mask,
+        n, pack_k) layout the drain helpers consume.  Cert planes are
+        tiny i8[B] rows, so same-shape certs across the window stack
+        into one transfer (the mask-read pattern); uncertified rows then
+        re-solve + repair per chunk BEFORE any plane leaves the device,
+        with the diff mask forced for re-solved rows."""
+        if not any(len(it) > 6 and it[6] is not None for it in items):
+            return [it[:6] for it in items]
+        t0 = time.perf_counter()
+        cert_np: dict[int, np.ndarray] = {}
+        cgroups: dict[tuple, list[int]] = {}
+        for i, it in enumerate(items):
+            if len(it) > 6 and it[6] is not None:
+                cgroups.setdefault(tuple(it[6].shape), []).append(i)
+        for _, members in cgroups.items():
+            if len(members) == 1:
+                cert_np[members[0]] = self._read_np(items[members[0]][6])
+            else:
+                stacked = self._read_np(
+                    self._stack(*[items[i][6] for i in members])
+                )
+                for j, i in enumerate(members):
+                    cert_np[i] = stacked[j]
+        timings["fetch"] += time.perf_counter() - t0
+        out_items: list[tuple] = []
+        for i, it in enumerate(items):
+            slot, entry, out, mask_dev, n, pack_k = it[:6]
+            if i in cert_np:
+                out, fb_rows = self._apply_cert_fallback(
+                    out, cert_np[i], it[7], it[8], n, timings
+                )
+                if fb_rows is not None and mask_dev is not None:
+                    mask = self._read_np(mask_dev)[:n].copy()
+                    mask[fb_rows] |= _DIFF_PLACEMENT
+                    mask_dev = mask
+            out_items.append((slot, entry, out, mask_dev, n, pack_k))
+        return out_items
 
     def _drain_fetch_window(
         self, items, chunk_results, chunk_changed, view, want_scores: bool, timings
@@ -2726,6 +3112,7 @@ class SchedulerEngine:
                 items[0], chunk_results, chunk_changed, view, want_scores, timings
             )
             return
+        items = self._resolve_cert_window(items, timings)
 
         # Phase 1: one stacked transfer per mask shape.
         t0 = time.perf_counter()
@@ -2931,6 +3318,7 @@ class SchedulerEngine:
             entry = item[1]
             k = item[4]
             packed = unpack_wire(wire_np[i][:rows], k)
+            self._observe_nsel(entry, packed.nsel, item[2].selected.shape[1])
             over_pos = np.nonzero(np.asarray(packed.nsel) > k)[0]
             parsed.append((kind, item, packed, over_pos))
             if over_pos.size:
@@ -2971,6 +3359,10 @@ class SchedulerEngine:
                 over_res[pi] = (
                     arr if len(devs) == 1 else arr[gi], c_pad, need_scores,
                 )
+        if over_jobs:
+            timings["overflow_fetch"] = (
+                timings.get("overflow_fetch", 0.0) + time.perf_counter() - t0
+            )
         timings["fetch"] += time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -3134,10 +3526,17 @@ class SchedulerEngine:
         )
         return sel, rep, cnt, sco
 
-    def _fetch_overflow(self, out, gidx: np.ndarray, with_scores: bool) -> tuple:
+    def _fetch_overflow(
+        self, out, gidx: np.ndarray, with_scores: bool, timings=None
+    ) -> tuple:
         """Re-fetch of K-overflow rows (the packed export's escape
         hatch): bit-packed selection/counted masks + the replica plane
-        (+ scores only for want_scores consumers) in one transfer."""
+        (+ scores only for want_scores consumers) in one transfer.
+        The gather program + wide [n, C] read are the packed format's
+        only cluster-width transfers, so their cost is attributed to
+        the ``overflow_fetch`` sub-phase (inside the fetch stage) —
+        the number the adaptive pack-K hint exists to drive to zero."""
+        t0 = time.perf_counter()
         kp = _pow2_bucket(gidx.size, 16, 1 << 30)
         pad = np.zeros(kp, np.int32)
         pad[: gidx.size] = gidx
@@ -3150,7 +3549,12 @@ class SchedulerEngine:
                 out.selected, out.counted, out.replicas, pad
             )
         c_pad = out.selected.shape[1]
-        return (self._read_np(dev), c_pad, with_scores)
+        arr = self._read_np(dev)
+        if timings is not None:
+            timings["overflow_fetch"] = (
+                timings.get("overflow_fetch", 0.0) + time.perf_counter() - t0
+            )
+        return (arr, c_pad, with_scores)
 
     @staticmethod
     def _packed_record_fields(packed: PackedRows, topk: int):
@@ -3283,11 +3687,12 @@ class SchedulerEngine:
                     )
                 )
                 packed = unpack_wire(wire[: idx.size], k)
+                self._observe_nsel(entry, packed.nsel, out.selected.shape[1])
                 over_pos = np.nonzero(np.asarray(packed.nsel) > k)[0]
                 over_dense = None
                 if over_pos.size:
                     over_dense = self._fetch_overflow(
-                        out, idx[over_pos], entry.prev_has_scores
+                        out, idx[over_pos], entry.prev_has_scores, timings
                     )
                 t3 = time.perf_counter()
                 timings["fetch"] += t3 - t2
@@ -3303,11 +3708,12 @@ class SchedulerEngine:
             )
         )
         packed = unpack_wire(wire[:n], k)
+        self._observe_nsel(entry, packed.nsel, out.selected.shape[1])
         over_pos = np.nonzero(np.asarray(packed.nsel) > k)[0]
         over_dense = None
         if over_pos.size:
             over_dense = self._fetch_overflow(
-                out, over_pos.astype(np.int64), want_scores
+                out, over_pos.astype(np.int64), want_scores, timings
             )
         t3 = time.perf_counter()
         timings["fetch"] += t3 - t2
@@ -3489,6 +3895,25 @@ class SchedulerEngine:
                     shape = (b_pad, c_bucket)
                     out, mask = self._tick_compact(padded, self._zeros_for(shape))
                     jax.block_until_ready(mask)
+                    # Narrow solve: at this geometry the narrow program
+                    # (not the dense tick above) is the production
+                    # dispatch — warm it plus its certificate machinery
+                    # (dense row re-solve + in-place plane repair), so a
+                    # first-tick fallback never stalls on a trace.
+                    narrow_m = self._narrow_m(ci, c_bucket)
+                    if narrow_m is not None:
+                        out_n, _mask_n, cert_n = self._narrow_program(
+                            "compact", narrow_m
+                        )(padded, self._zeros_for(shape))
+                        jax.block_until_ready(cert_n)
+                        fb_idx = np.full(16, b_pad, np.int32)
+                        fb = self._fallback_program("compact")(padded, fb_idx)
+                        repaired = self._cert_repair_program()(
+                            (out_n.selected, out_n.replicas, out_n.counted,
+                             out_n.reasons),
+                            fb, fb_idx,
+                        )
+                        jax.block_until_ready(repaired[0])
                     if webhooks:
                         dense = featurize([unit], clusters, view=view).inputs
                         dense_padded = self._pad_for_dispatch(
@@ -3498,6 +3923,11 @@ class SchedulerEngine:
                             dense_padded, self._zeros_for(shape)
                         )
                         jax.block_until_ready(mask_d)
+                        if narrow_m is not None:
+                            _o, _m, cert_nd = self._narrow_program(
+                                "dense", narrow_m
+                            )(dense_padded, self._zeros_for(shape))
+                            jax.block_until_ready(cert_nd)
                     idx = np.zeros(16, np.int32)
                     jax.block_until_ready(
                         self._gather(

@@ -9,7 +9,11 @@ against a cpu-fallback number):
   ``(1 - tolerance) * best prior``;
 * steady-state tick latency (``parsed.detail.tick_ms``) must be at most
   ``(1 + tolerance) * best prior`` (checked only when both rounds
-  report it).
+  report it);
+* device-stage latency (``stage_ms.device`` and
+  ``drift_stage_ms.device``) gates the same way — a select/planner
+  regression must fail here even when an unchanged tick total hides it
+  behind fetch/decode wins (ISSUE 5).
 
 Rounds that failed to run (``rc != 0`` or no parsed value) are skipped;
 with no comparable prior round the gate passes trivially.
@@ -71,9 +75,16 @@ def load_rounds(root: Path) -> list[dict]:
                 # gate work (ISSUE 4).
                 "fetch_format": detail.get("fetch_format"),
                 "fetch_bytes": detail.get("fetch_bytes"),
+                "narrow": detail.get("narrow"),
                 "drift_tick_ms": (detail.get("stage_ms") or {}).get(
                     "drift_tick_ms"
                 ),
+                # Gated like tick_ms (lower is better): the heavy XLA
+                # stages of the steady tick and of the drift recompute.
+                "device_ms": (detail.get("stage_ms") or {}).get("device"),
+                "drift_device_ms": (
+                    (detail.get("stage_ms") or {}).get("drift_stage_ms") or {}
+                ).get("device"),
             }
         )
     rounds.sort(key=lambda r: r["round"])
@@ -135,18 +146,31 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             file=sys.stderr,
         )
         ok = False
-    prior_ticks = [r["tick_ms"] for r in priors if r["tick_ms"] is not None]
-    if latest["tick_ms"] is not None and prior_ticks:
-        best_tick = min(prior_ticks)
-        ceil = best_tick * (1.0 + tolerance)
+    if latest.get("narrow") is not None:
+        nr = latest["narrow"]
         print(
-            f"bench-gate: tick_ms={latest['tick_ms']:.1f} vs best prior "
-            f"{best_tick:.1f} (ceiling {ceil:.1f})"
+            f"bench-gate: narrow m={nr.get('m')} rows={nr.get('rows')} "
+            f"fallback_rows={nr.get('fallback_rows')} — informational, "
+            f"not gated"
         )
-        if latest["tick_ms"] > ceil:
+    for key, label in (
+        ("tick_ms", "tick_ms"),
+        ("device_ms", "stage_ms.device"),
+        ("drift_device_ms", "drift_stage_ms.device"),
+    ):
+        prior_vals = [r.get(key) for r in priors if r.get(key) is not None]
+        if latest.get(key) is None or not prior_vals:
+            continue
+        best = min(prior_vals)
+        ceil = best * (1.0 + tolerance)
+        print(
+            f"bench-gate: {label}={latest[key]:.1f} vs best prior "
+            f"{best:.1f} (ceiling {ceil:.1f})"
+        )
+        if latest[key] > ceil:
             print(
-                f"bench-gate: LATENCY REGRESSION: {latest['tick_ms']:.1f}ms "
-                f"> {ceil:.1f}ms",
+                f"bench-gate: LATENCY REGRESSION: {label} "
+                f"{latest[key]:.1f}ms > {ceil:.1f}ms",
                 file=sys.stderr,
             )
             ok = False
