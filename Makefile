@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-gate graft-check graft-dryrun native metrics-lint
+.PHONY: test test-fast bench bench-gate graft-check graft-dryrun native metrics-lint chaos chaos-e2e
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -16,6 +16,20 @@ kubeadmiral_tpu/native/libkadmhash.so: kubeadmiral_tpu/native/fnvhash.cpp kubead
 
 bench-e2e:
 	$(PYTEST_ENV) python bench_e2e.py
+
+# Fault matrix (tests/test_faults.py): fault injection, circuit
+# breakers, stall-proof dispatch, watch recovery, the hard-down-member
+# acceptance scenario.  The fast subset also runs in tier-1
+# (`-m 'not slow'`); this target runs the WHOLE matrix including the
+# long flapping-member chaos scenarios.
+chaos:
+	$(PYTEST_ENV) python -m pytest tests/test_faults.py -q
+
+# Degraded-fleet e2e bench: 1 hard-down member + 1 flapping during
+# churn, reporting tick-stall p50/p99 and shed-write counts in
+# detail.chaos (see docs/operations.md "Degraded member runbook").
+chaos-e2e:
+	$(PYTEST_ENV) BENCH_E2E_CHAOS=1 python bench_e2e.py
 
 # Fails on metric emissions not in runtime/metric_catalog.py — the
 # exposition, the docs and the source stay one vocabulary (see

@@ -26,6 +26,14 @@ N_CLUSTERS = int(os.environ.get("BENCH_E2E_CLUSTERS", 50))
 # apiserver a real socket server (kwok-lite farm) — measures the
 # transport path the bulk-write batching exists for.
 TRANSPORT = os.environ.get("BENCH_E2E_TRANSPORT", "inproc")
+# BENCH_E2E_CHAOS=1 appends a degraded-fleet phase after the main
+# measurement: one member hard-down (connect-timeout partition), one
+# flapping, while objects churn — reporting per-round settle-time
+# p50/p99 ("tick stall") and shed-write counts under detail.chaos
+# (`make chaos-e2e`).  Off by default so the gated numbers are
+# untouched.
+CHAOS = os.environ.get("BENCH_E2E_CHAOS", "") in ("1", "true", "yes")
+CHAOS_ROUNDS = int(os.environ.get("BENCH_E2E_CHAOS_ROUNDS", 6))
 
 
 class StageTimer:
@@ -69,6 +77,93 @@ class StageTimer:
                 self.stages[name] += time.perf_counter() - t0
             if not progressed:
                 return
+
+
+def run_chaos(fleet, farm, timer, ftc, members) -> dict:
+    """Degraded-fleet phase: partition one member, flap another, churn a
+    slice of objects per round, and report how long each settle round
+    ("tick") stalls plus the shed-write tally — the e2e measurement of
+    ROADMAP item 5's "a member outage can't stall the tick loop"."""
+    from kubeadmiral_tpu.transport import breaker as B
+    from kubeadmiral_tpu.transport.faults import (
+        FaultInjector,
+        FaultPolicy,
+        FaultyKube,
+    )
+
+    names = sorted(members)
+    if len(names) < 3:
+        return {"skipped": "needs >= 3 members"}
+    down, flappy = names[0], names[1]
+    hard = FaultPolicy(partition=True)
+    flap = FaultPolicy(partition=True, flap_period_s=0.5, flap_duty=0.4)
+    injector = None
+    if farm is not None:
+        if farm.member_procs:
+            return {"skipped": "subprocess farm members are not injectable"}
+        # Degraded-mode rounds are bounded by the member-client timeout
+        # (one probe/read pays it before the breaker opens): use a
+        # chaos-appropriate budget instead of the default 10 s.
+        fleet.factory.timeout = 2.0
+        for client in fleet.members.values():
+            client._timeout = 2.0
+        farm.set_fault(down, hard)
+        farm.set_fault(flappy, flap)
+    else:
+        # In-process fleet: wrap the two members in fault proxies (the
+        # client-side half of the injection seam).
+        injector = FaultInjector()
+        for name, policy in ((down, hard), (flappy, flap)):
+            fleet.members[name] = FaultyKube(
+                fleet.members[name], name, injector, timeout=0.2
+            )
+            injector.set_fault(name, policy)
+
+    durations = []
+    for r in range(CHAOS_ROUNDS):
+        for i in range(r % 3, min(N_OBJECTS, 120), 3):
+            try:
+                obj = fleet.host.try_get(
+                    ftc.source.resource, f"default/web-{i:05d}"
+                )
+                if obj is not None:
+                    obj["spec"]["replicas"] = (obj["spec"].get("replicas", 1) % 20) + 1
+                    fleet.host.update(ftc.source.resource, obj)
+            except Exception:
+                pass  # churn races are part of the scenario
+        t0 = time.perf_counter()
+        timer.settle()
+        durations.append(time.perf_counter() - t0)
+
+    # Clear faults and let the world converge before teardown.
+    if farm is not None:
+        farm.clear_fault(down)
+        farm.clear_fault(flappy)
+    else:
+        injector.clear_all()
+        for name in (down, flappy):
+            proxy = fleet.members[name]
+            fleet.members[name] = proxy._inner
+            proxy.drain_stalled()
+    timer.settle()
+
+    registry = getattr(fleet, "_member_breakers", None)
+    ranked = sorted(durations)
+    snapshot = registry.snapshot() if registry is not None else {}
+    return {
+        "rounds": CHAOS_ROUNDS,
+        "down_member": down,
+        "flapping_member": flappy,
+        "stall_p50_s": round(ranked[len(ranked) // 2], 3),
+        "stall_p99_s": round(ranked[min(len(ranked) - 1, int(len(ranked) * 0.99))], 3),
+        "stall_max_s": round(ranked[-1], 3),
+        "shed_writes": registry.shed_total() if registry is not None else 0,
+        "breaker_opens": sum(
+            e.get("opens_total", 0) for e in snapshot.values()
+        ),
+        "breaker_states": {n: e["state"] for n, e in snapshot.items()
+                           if e["state"] != B.CLOSED},
+    }
 
 
 def main():
@@ -282,6 +377,8 @@ def main():
     }
     assert member_objects == expected, (member_objects, expected)
     assert propagated  # first object reached its placed members
+    if CHAOS:
+        result["detail"]["chaos"] = run_chaos(fleet, farm, timer, ftc, members)
     print(json.dumps(result))
     print(f"# stages: {stages}", file=sys.stderr)
     if farm is not None:

@@ -25,6 +25,7 @@ from typing import Optional
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
+from kubeadmiral_tpu.transport import breaker as B
 from kubeadmiral_tpu.testing.fakekube import (
     AlreadyExists,
     ClusterFleet,
@@ -55,6 +56,10 @@ CLUSTER_READY = "ClusterReady"
 CLUSTER_NOT_REACHABLE = "ClusterNotReachable"
 CLUSTER_HEALTHZ_NOT_OK = "HealthzNotOk"
 RESOURCE_COLLECTION_FAILED = "ClusterResourceCollectionFailed"
+# The member answers healthz but its write/read path tripped the
+# per-member circuit breaker (transport/breaker.py): the scheduler's
+# filter stage must see it unhealthy the same tick the breaker opens.
+MEMBER_BREAKER_OPEN = "MemberBreakerOpen"
 
 # Annotation on the FederatedCluster recording that join steps ran and
 # member-side cleanup is owed on removal (controller.go joinPerformed).
@@ -197,13 +202,23 @@ class FederatedClusterController:
         # the controller tracks it in memory — state is lost on restart,
         # which only extends the timeout window).
         self._join_failed_at: dict[str, float] = {}
+        # Per-member circuit breakers shared across this fleet's
+        # controllers: the heartbeat's healthz probe doubles as the
+        # breaker's half-open probe, and breaker transitions re-enqueue
+        # the cluster so its Ready condition flips the SAME tick the
+        # dispatch path discovers a sick member.
+        self.breakers = B.for_fleet(fleet, metrics=self.metrics)
         self.worker = Worker(
             "cluster-controller", self.reconcile, metrics=self.metrics, clock=clock
         )
+        self.breakers.on_transition(self._on_breaker_transition)
         self.host.watch(FEDERATED_CLUSTERS, self._on_event, replay=True)
 
     def _on_event(self, event: str, obj: dict) -> None:
         self.worker.enqueue(obj["metadata"]["name"])
+
+    def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
+        self.worker.enqueue(name)
 
     def run_until_idle(self) -> None:
         while self.worker.step():
@@ -249,7 +264,16 @@ class FederatedClusterController:
                 return self._set_joined(
                     cluster, "False", JOIN_TIMEOUT_EXCEEDED, retry=False
                 )
-            result = self._join(cluster)
+            try:
+                result = self._join(cluster)
+            except Exception:
+                # A member dropping mid-handshake (partition, injected
+                # fault) is a retryable join failure, not a controller
+                # panic.
+                self.breakers.for_member(name).record_failure()
+                result = self._set_joined(
+                    cluster, "False", TOKEN_NOT_OBTAINED, retry=True
+                )
             if not result.success:
                 self._join_failed_at.setdefault(name, self._clock())
                 return result
@@ -376,13 +400,72 @@ class FederatedClusterController:
             # Unreachable: Offline=True, Ready=Unknown.
             changed = set_condition(cluster, OFFLINE, "True", CLUSTER_NOT_REACHABLE)
             changed |= set_condition(cluster, READY, "Unknown", CLUSTER_NOT_REACHABLE)
-        elif not member.healthy:
-            changed = set_condition(cluster, OFFLINE, "False", "")
-            changed |= set_condition(cluster, READY, "False", CLUSTER_HEALTHZ_NOT_OK)
         else:
-            changed = set_condition(cluster, OFFLINE, "False", "")
-            changed |= set_condition(cluster, READY, "True", CLUSTER_READY)
-            changed |= self._update_resources(cluster, member)
+            # The healthz probe is also the breaker's out-of-band probe:
+            # its latency feeds member_probe_latency, its success closes
+            # a cooled-down breaker (the half-open contract), its
+            # failure is breaker evidence like any other round trip.
+            breaker = self.breakers.for_member(name)
+            if not breaker.allow(consume_probe=False):
+                # Open window still cooling: a probe CANNOT close the
+                # breaker yet (record_success(probe=True) honors the
+                # cool-down), so don't park the heartbeat worker on a
+                # dead socket for nothing — once the window elapses,
+                # allow() flips half-open and the next heartbeat probes
+                # for real.
+                changed = set_condition(cluster, OFFLINE, "False", "")
+                changed |= set_condition(
+                    cluster, READY, "False", MEMBER_BREAKER_OPEN
+                )
+                if changed:
+                    try:
+                        self.host.update_status(FEDERATED_CLUSTERS, cluster)
+                    except Conflict:
+                        return Result.retry()
+                    except NotFound:
+                        return Result.ok()
+                return Result.after(
+                    min(self.resync_seconds, self.breakers.config.open_seconds)
+                )
+            start = time.perf_counter()
+            try:
+                healthy = bool(member.healthy)
+            except Exception:
+                healthy = False
+            latency = time.perf_counter() - start
+            self.metrics.histogram("member_probe_latency", latency, cluster=name)
+            if healthy:
+                breaker.record_success(latency, probe=True)
+            else:
+                breaker.record_failure(latency_s=latency)
+            if not healthy:
+                changed = set_condition(cluster, OFFLINE, "False", "")
+                changed |= set_condition(
+                    cluster, READY, "False", CLUSTER_HEALTHZ_NOT_OK
+                )
+            elif not breaker.allow(consume_probe=False):
+                # healthz answers but the read/write path tripped the
+                # breaker (erroring or stalling member): not schedulable
+                # until the breaker closes.
+                changed = set_condition(cluster, OFFLINE, "False", "")
+                changed |= set_condition(
+                    cluster, READY, "False", MEMBER_BREAKER_OPEN
+                )
+            else:
+                changed = set_condition(cluster, OFFLINE, "False", "")
+                try:
+                    resources_changed = self._update_resources(cluster, member)
+                except Exception:
+                    # healthz passed but the listings failed (member
+                    # dropped between probes): collection failure, not a
+                    # worker panic (clusterstatus.go:204-278).
+                    breaker.record_failure()
+                    changed |= set_condition(
+                        cluster, READY, "False", RESOURCE_COLLECTION_FAILED
+                    )
+                else:
+                    changed |= set_condition(cluster, READY, "True", CLUSTER_READY)
+                    changed |= resources_changed
 
         if changed:
             try:

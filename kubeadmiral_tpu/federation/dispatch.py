@@ -16,15 +16,27 @@ through a *sink*:
   conflict/failure results flow back through the same continuations, so
   status/version bookkeeping is identical to the immediate path.
 
+The fan-out is **stall-proof** (docs/operations.md § Degraded member
+runbook): every flush path enforces the per-tick deadline budget
+(KT_DISPATCH_DEADLINE_S), retryable failures get a bounded jittered
+backoff budget (``run_batch_with_retries``), writes to a member whose
+circuit breaker (transport/breaker.py) is open short-circuit to
+ClusterNotReady without touching a socket, and a member that stalls a
+flush sheds its writes to the owning worker's backoff requeue — the
+tick's critical path scales with the HEALTHY members only.
+
 Statuses mirror fedtypesv1a1.PropagationStatus values.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
@@ -75,31 +87,201 @@ FINALIZER_CHECK_FAILED = "FinalizerCheckFailed"
 ADOPTED_ANNOTATION = C.PREFIX + "adopted"
 
 
+# -- retry / deadline budget ----------------------------------------------
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def dispatch_pool_size() -> int:
+    """Bounded in-flight window of the per-op fan-out (KT_DISPATCH_POOL)."""
+    return max(1, int(_env_float("KT_DISPATCH_POOL", 8)))
+
+
+def dispatch_deadline() -> float:
+    """The per-tick member-write deadline budget (KT_DISPATCH_DEADLINE_S):
+    no flush path may block its caller past this, whatever a member
+    socket does."""
+    return _env_float("KT_DISPATCH_DEADLINE_S", 30.0)
+
+
+def retry_delay(attempt: int, rng=None) -> float:
+    """Bounded exponential backoff with jitter for retryable member-write
+    failures: uniform in [span/2, span] of the capped exponential
+    (KT_RETRY_BASE_S doubling per attempt up to KT_RETRY_CAP_S) — the
+    half floor keeps retries off the member's heels, the jitter keeps a
+    fleet of dispatchers from thundering in phase."""
+    base = _env_float("KT_RETRY_BASE_S", 0.05)
+    cap = _env_float("KT_RETRY_CAP_S", 2.0)
+    span = min(cap, base * (2 ** min(attempt, 10)))
+    r = (rng or random).random()
+    return span * (0.5 + 0.5 * r)
+
+
+def retry_max() -> int:
+    return max(0, int(_env_float("KT_RETRY_MAX", 3)))
+
+
+def _refreshed_conflict_op(client, op: dict) -> Optional[dict]:
+    """409-after-conflict-refresh: re-read the member object's current
+    resourceVersion into a COPY of the update op (the staged object may
+    be the shared desired-cache assembly).  None when the refresh read
+    fails — the conflict then stays with the caller as before."""
+    obj = op.get("object") or {}
+    meta = obj.get("metadata", {})
+    ns = meta.get("namespace", "")
+    name = meta.get("name")
+    if not name:
+        return None
+    key = f"{ns}/{name}" if ns else name
+    try:
+        fresh = client.get(op["resource"], key)
+    except Exception:
+        return None
+    new_obj = copy_json(obj)
+    new_obj.setdefault("metadata", {})["resourceVersion"] = (
+        fresh.get("metadata", {}).get("resourceVersion")
+    )
+    return {**op, "object": new_obj}
+
+
+def run_batch_with_retries(
+    client,
+    ops: list[dict],
+    deadline: float,
+    cluster: str = "",
+    breakers=None,
+) -> list[dict]:
+    """``client.batch`` with the bounded retry budget: transport-level
+    failures and 5xx results are re-sent with exponential backoff +
+    jitter while the deadline budget allows (KT_RETRY_MAX attempts
+    beyond the first); 409 Conflicts on update verbs retry once with a
+    refreshed resourceVersion.  Always returns one result per op
+    (transport failures become code-500 entries).  Feeds the member's
+    circuit breaker: a final transport-level failure records a breaker
+    failure (a stall-slow one opens it immediately), a completed batch
+    records success."""
+    n = len(ops)
+    results: list[Optional[dict]] = [None] * n
+    current: dict[int, dict] = dict(enumerate(ops))
+    pending = list(range(n))
+    conflict_refreshed: set[int] = set()
+    breaker = breakers.for_member(cluster) if breakers is not None else None
+    attempt = 0
+    started = time.monotonic()
+    transport_failed = False
+    while True:
+        try:
+            out = list(client.batch([current[i] for i in pending]))
+            transport_failed = False
+        except Exception as e:  # transport-level failure: every op failed
+            out = []
+            transport_failed = True
+            transport_result = {
+                "code": 500,
+                "status": {"reason": "Transport", "message": str(e)},
+            }
+        if len(out) < len(pending):
+            filler = (
+                transport_result
+                if transport_failed
+                else {"code": 500, "status": {"reason": "Transport",
+                                              "message": "batch result missing"}}
+            )
+            out = out + [filler] * (len(pending) - len(out))
+        for slot, res in zip(pending, out):
+            results[slot] = res
+        retryable: list[int] = []
+        for slot in pending:
+            res = results[slot]
+            code = res.get("code") or 0
+            if code >= 500:
+                retryable.append(slot)
+            elif (
+                code == 409
+                and (res.get("status") or {}).get("reason") == "Conflict"
+                and current[slot].get("verb") in ("update", "update_status")
+                and slot not in conflict_refreshed
+            ):
+                refreshed = _refreshed_conflict_op(client, current[slot])
+                if refreshed is not None:
+                    conflict_refreshed.add(slot)
+                    current[slot] = refreshed
+                    retryable.append(slot)
+        if not retryable:
+            break
+        delay = retry_delay(attempt)
+        if attempt >= retry_max() or time.monotonic() + delay >= deadline:
+            break
+        if breakers is not None:
+            breakers.count_retry(cluster, len(retryable))
+        time.sleep(delay)
+        pending = retryable
+        attempt += 1
+    if breaker is not None:
+        elapsed = time.monotonic() - started
+        final_transport = transport_failed or any(
+            (r or {}).get("code") == 500
+            and ((r or {}).get("status") or {}).get("reason") == "Transport"
+            for r in results
+        )
+        if final_transport:
+            breaker.record_failure(latency_s=elapsed)
+        else:
+            breaker.note_ok(elapsed)
+    return [r if r is not None else {"code": 500, "status": {
+        "reason": "Transport", "message": "batch never ran"}} for r in results]
+
+
 # -- sinks ---------------------------------------------------------------
 class ImmediateSink:
-    """One client call per operation, inline or on a pool
-    (operation.go:102-123's per-cluster goroutine fan-out)."""
+    """One client call per operation, inline or on a bounded pool
+    (operation.go:102-123's per-cluster goroutine fan-out; pool size =
+    the in-flight window, KT_DISPATCH_POOL)."""
 
     def __init__(
         self,
         client_for_cluster: Callable[[str], FakeKube],
         pool: Optional[ThreadPoolExecutor] = None,
         inline: bool = False,
+        breakers=None,
     ):
         self.client_for_cluster = client_for_cluster
         self._pool = pool
         self._own_pool = False
         self._inline = inline
         self._futures: list[Future] = []
+        self._finalized = False
+        self.breakers = breakers
 
     def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
+        if self._finalized:
+            # A stale continuation must never write into an already-
+            # finalized status/version map; the sink is single-round.
+            raise RuntimeError(
+                "ImmediateSink already finalized by wait(); build a fresh sink"
+            )
+
         def run() -> None:
             with trace.span("dispatch.member_write", cluster=cluster):
-                client = self.client_for_cluster(cluster)
+                start = time.monotonic()
                 try:
+                    client = self.client_for_cluster(cluster)
                     result = client.batch([op])[0]
                 except Exception as e:  # transport-level failure
                     result = {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+                    if self.breakers is not None:
+                        self.breakers.for_member(cluster).record_failure(
+                            latency_s=time.monotonic() - start
+                        )
+                else:
+                    if self.breakers is not None:
+                        self.breakers.for_member(cluster).note_ok(
+                            time.monotonic() - start
+                        )
                 continuation(result)
 
         if self._inline:
@@ -109,22 +291,35 @@ class ImmediateSink:
                 pass  # continuations record their own failures
             return
         if self._pool is None:
-            self._pool = ThreadPoolExecutor(max_workers=8)
+            self._pool = ThreadPoolExecutor(max_workers=dispatch_pool_size())
             self._own_pool = True
         self._futures.append(self._pool.submit(run))
 
     def wait(self, timeout: float) -> None:
+        """Drain the fan-out under the deadline.  On expiry, not-yet-
+        started futures are CANCELLED (their pre-recorded *_TIMED_OUT
+        statuses stand) and the sink becomes unusable — a late submit
+        raises instead of mutating a finalized status map."""
         deadline = time.monotonic() + timeout
-        for f in self._futures:
-            try:
-                f.result(timeout=max(0.0, deadline - time.monotonic()))
-            except Exception:  # timeout statuses were pre-recorded
-                pass
-        self._futures.clear()
-        if self._own_pool and self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None
-            self._own_pool = False
+        try:
+            for f in self._futures:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    f.cancel()
+                    continue
+                try:
+                    f.result(timeout=remaining)
+                except FuturesTimeout:
+                    f.cancel()
+                except Exception:  # failure statuses were pre-recorded
+                    pass
+        finally:
+            self._futures.clear()
+            self._finalized = True
+            if self._own_pool and self._pool is not None:
+                self._pool.shutdown(wait=False, cancel_futures=True)
+                self._pool = None
+                self._own_pool = False
 
 
 class BatchSink:
@@ -138,11 +333,15 @@ class BatchSink:
         client_for_cluster: Callable[[str], FakeKube],
         pool: Optional[ThreadPoolExecutor] = None,
         thread_registry: Optional[set] = None,
+        breakers=None,
+        deadline: Optional[float] = None,
     ):
         self.client_for_cluster = client_for_cluster
         self._pool = pool
         self._staged: dict[str, list[tuple[dict, Callable[[dict], None]]]] = {}
         self.flushed = True
+        self.breakers = breakers
+        self.deadline = dispatch_deadline() if deadline is None else deadline
         # Threads currently executing this sink's writes.  In-process
         # member stores deliver watch events synchronously on the writing
         # thread, so the owning controller treats events on these threads
@@ -154,14 +353,35 @@ class BatchSink:
         self._staged.setdefault(cluster, []).append((op, continuation))
         self.flushed = False
 
-    def flush(self, timeout: float = 30.0) -> None:
+    def _client_can_stall(self, cluster: str) -> bool:
+        """Whether this cluster's client can park a thread (sockets, or
+        a fault-injecting proxy).  A plain in-process FakeKube cannot,
+        and the serial path keeps calling it directly — no thread spawn
+        on the local hot path."""
+        try:
+            client = self.client_for_cluster(cluster)
+        except Exception:
+            return False  # resolution failures are fast
+        return type(client) is not FakeKube
+
+    def flush(self, timeout: Optional[float] = None) -> None:
         """One batch round trip per member, in parallel across members
         when a pool is present.  Continuations run on the flushing
-        thread(s); per-op failures stay in the results."""
+        thread(s); per-op failures stay in the results.
+
+        The deadline budget (``timeout``, default KT_DISPATCH_DEADLINE_S)
+        is enforced on EVERY path: pooled flushes time out per future,
+        and the serial path runs stall-capable clients on a bounded
+        helper thread — a hung member sheds its writes (statuses stay at
+        their pre-recorded *_TIMED_OUT values and the owning worker's
+        backoff requeue re-drives them) instead of parking the tick."""
+        if timeout is None:
+            timeout = self.deadline
         staged, self._staged = self._staged, {}
         self.flushed = True
         if not staged:
             return
+        deadline = time.monotonic() + timeout
 
         def flush_cluster(cluster: str, entries: list) -> None:
             # Register only our own ident and remove only what we added:
@@ -179,18 +399,18 @@ class BatchSink:
                 ):
                     try:
                         client = self.client_for_cluster(cluster)
-                        results = client.batch([op for op, _ in entries])
                     except Exception as e:
                         results = [
                             {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
                         ] * len(entries)
-                    if len(results) < len(entries):
-                        # A short results array must not strand the tail at its
-                        # pre-recorded *_TIMED_OUT status with no cause.
-                        results = list(results) + [
-                            {"code": 500, "status": {"reason": "Transport",
-                                                     "message": "batch result missing"}}
-                        ] * (len(entries) - len(results))
+                    else:
+                        results = run_batch_with_retries(
+                            client,
+                            [op for op, _ in entries],
+                            deadline,
+                            cluster=cluster,
+                            breakers=self.breakers,
+                        )
                     for (_, continuation), result in zip(entries, results):
                         try:
                             continuation(result)
@@ -200,20 +420,53 @@ class BatchSink:
                 if added:
                     self.thread_registry.discard(ident)
 
-        if self._pool is not None and len(staged) > 1:
-            deadline = time.monotonic() + timeout
-            futures = [
-                self._pool.submit(flush_cluster, cluster, entries)
+        def shed(cluster: str, entries: list, stalled: bool) -> None:
+            """Deadline expired for this member's flush.  Statuses stay
+            at their pre-recorded *_TIMED_OUT values; a genuinely
+            stalled flush (vs one merely queued behind a sick sibling)
+            also opens the member's breaker."""
+            if self.breakers is None:
+                return
+            self.breakers.count_shed(cluster, len(entries))
+            if stalled:
+                self.breakers.for_member(cluster).record_failure(timeout=True)
+
+        if self._pool is not None:
+            futures = {
+                self._pool.submit(flush_cluster, cluster, entries): (cluster, entries)
                 for cluster, entries in staged.items()
-            ]
-            for f in futures:
+            }
+            for f, (cluster, entries) in futures.items():
                 try:
                     f.result(timeout=max(0.0, deadline - time.monotonic()))
+                except FuturesTimeout:
+                    # cancel() succeeds only when the flush never started
+                    # (queued behind siblings): shed without blaming the
+                    # member.  A running one IS stalled in its client.
+                    shed(cluster, entries, stalled=not f.cancel())
                 except Exception:
                     pass
         else:
             for cluster, entries in staged.items():
-                flush_cluster(cluster, entries)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    shed(cluster, entries, stalled=False)
+                    continue
+                if not self._client_can_stall(cluster):
+                    flush_cluster(cluster, entries)
+                    continue
+                t = threading.Thread(
+                    target=flush_cluster,
+                    args=(cluster, entries),
+                    name=f"dispatch-flush-{cluster}",
+                    daemon=True,
+                )
+                t.start()
+                t.join(remaining)
+                if t.is_alive():
+                    # Left to die on the client's own timeout; the tick
+                    # moves on.
+                    shed(cluster, entries, stalled=True)
 
     def wait(self, timeout: float) -> None:
         # Dispatchers sharing this sink call wait() after the controller
@@ -304,6 +557,7 @@ class ManagedDispatcher:
         inline: bool = False,
         sink=None,
         on_written: Optional[Callable[[str, dict], None]] = None,
+        breakers=None,
     ):
         self.client_for_cluster = client_for_cluster
         self.fed = fed_resource
@@ -312,7 +566,12 @@ class ManagedDispatcher:
         self.skip_adopting = skip_adopting
         self.timeout = timeout
         self.rollout_overrides = rollout_overrides
-        self._sink = sink or ImmediateSink(client_for_cluster, pool=pool, inline=inline)
+        self.breakers = breakers if breakers is not None else getattr(
+            sink, "breakers", None
+        )
+        self._sink = sink or ImmediateSink(
+            client_for_cluster, pool=pool, inline=inline, breakers=self.breakers
+        )
         self._on_written = on_written
         self._lock = threading.Lock()
         self._status: dict[str, str] = {}
@@ -326,6 +585,22 @@ class ManagedDispatcher:
         self._desired_cache: dict[str, dict] = {}
 
     # -- bookkeeping -----------------------------------------------------
+    def _submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
+        """Stage one member write, short-circuiting through the member's
+        circuit breaker: an OPEN member costs a status record, never a
+        thread parked on a dead socket (the ClusterNotReady propagation
+        the reference assigns unreachable members).  In HALF_OPEN the
+        first write through is the probe; the rest shed until it lands."""
+        if self.breakers is not None:
+            breaker = self.breakers.for_member(cluster)
+            if not breaker.allow():
+                self.breakers.count_shed(cluster)
+                self.record_error(
+                    cluster, CLUSTER_NOT_READY, "member circuit breaker open"
+                )
+                return
+        self._sink.submit(cluster, op, continuation)
+
     def record_status(self, cluster: str, status: str) -> None:
         with self._lock:
             self._status[cluster] = status
@@ -437,7 +712,7 @@ class ManagedDispatcher:
                 ] = "true"
             self._update_now(cluster, existing, adopting=True)
 
-        self._sink.submit(
+        self._submit(
             cluster, {"verb": "create", "resource": self.resource, "object": obj}, done
         )
 
@@ -510,7 +785,7 @@ class ManagedDispatcher:
         obj = self._prepare_update(cluster, cluster_obj, recorded_version, adopting)
         if obj is None:
             return
-        self._sink.submit(
+        self._submit(
             cluster,
             {"verb": "update", "resource": self.resource, "object": obj},
             self._update_done(cluster),
@@ -571,7 +846,7 @@ class ManagedDispatcher:
         ):
             self._record_version(cluster, recorded_version)
             return
-        self._sink.submit(
+        self._submit(
             cluster,
             {"verb": "update", "resource": self.resource, "object": obj},
             self._update_done(cluster),
@@ -598,7 +873,7 @@ class ManagedDispatcher:
             else:
                 self.record_status(cluster, WAITING_FOR_REMOVAL)
 
-        self._sink.submit(
+        self._submit(
             cluster,
             {"verb": "delete", "resource": self.resource, "key": self.fed.key},
             done,
@@ -622,6 +897,6 @@ class ManagedDispatcher:
             else:
                 self.record_error(cluster, UPDATE_FAILED, _result_error(result))
 
-        self._sink.submit(
+        self._submit(
             cluster, {"verb": "update", "resource": self.resource, "object": obj}, done
         )

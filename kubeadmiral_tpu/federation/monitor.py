@@ -208,6 +208,13 @@ class MonitorController:
                 ready += 1
         self.metrics.store("monitor.clusters.total", total_clusters)
         self.metrics.store("monitor.clusters.ready", ready)
+        # Member circuit-breaker health (transport/breaker.py): how many
+        # members the fleet's shared registry currently short-circuits.
+        registry = getattr(self.fleet, "_member_breakers", None)
+        self.metrics.store(
+            "monitor.clusters.breaker_open",
+            len(registry.open_members()) if registry is not None else 0,
+        )
         self._detect_drift()
 
     # -- placement drift --------------------------------------------------

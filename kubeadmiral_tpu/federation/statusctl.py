@@ -26,6 +26,7 @@ from kubeadmiral_tpu.runtime.informer import MemberStore
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
 from kubeadmiral_tpu.testing.fakekube import ClusterFleet, obj_key
+from kubeadmiral_tpu.transport import breaker as B
 from kubeadmiral_tpu.utils.unstructured import copy_json, get_path, set_path
 
 
@@ -97,6 +98,13 @@ class StatusController:
         self.store = MemberStore(
             fleet, self._target_resource, on_event=self._on_member_event
         )
+        # A member coming back from a breaker-open window may have
+        # changed out from under its stalled watch stream: refresh every
+        # status CR (and retry any pending member-watch attach) when the
+        # fleet's shared breaker closes.
+        B.for_fleet(fleet, metrics=self.metrics).on_transition(
+            self._on_breaker_transition
+        )
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
         # Drift repair: a status CR deleted or modified out-of-band must
@@ -109,6 +117,13 @@ class StatusController:
 
     def _on_member_event(self, cluster: str, event: str, obj: dict) -> None:
         self.worker.enqueue(obj_key(obj))
+
+    def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
+        if new == B.CLOSED:
+            _retry_pending_attach(
+                self.store, self.worker, self.host, self._fed_resource
+            )
+            self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
     def _on_status_event(self, event: str, obj: dict) -> None:
         key = obj_key(obj)

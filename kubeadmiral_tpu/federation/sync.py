@@ -20,6 +20,7 @@ instead of re-reconciling itself forever.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, Union
 
@@ -44,6 +45,7 @@ from kubeadmiral_tpu.runtime import pending
 from kubeadmiral_tpu.runtime.hostbatch import HostBatch
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
+from kubeadmiral_tpu.transport import breaker as B
 from kubeadmiral_tpu.testing.fakekube import (
     DELETED,
     ClusterFleet,
@@ -208,6 +210,12 @@ class SyncController:
             if self._inline
             else ThreadPoolExecutor(max_workers=max_dispatch_workers)
         )
+        # Per-member circuit breakers, SHARED across this fleet's
+        # controllers (transport/breaker.py): a member that stalled one
+        # flush short-circuits the next tick's reads and writes to
+        # ClusterNotReady immediately instead of re-parking threads.
+        self.breakers = B.for_fleet(fleet, metrics=self.metrics)
+        self.breakers.on_transition(self._on_breaker_transition)
         self.worker = BatchWorker(
             f"sync-{ftc.name}", self.reconcile_batch, metrics=self.metrics, clock=clock
         )
@@ -331,8 +339,43 @@ class SyncController:
         self.worker.enqueue(_CLUSTER_KEY_PREFIX + name)
         self.worker.enqueue_all(self.host.keys(self._fed_resource))
 
+    def _on_breaker_transition(self, name: str, old: str, new: str) -> None:
+        # A member's breaker closing means its shed writes can land now:
+        # re-drive every federated object instead of waiting out each
+        # key's exponential backoff (the recovery-latency half of the
+        # "shed to a background requeue" contract).
+        if new == B.CLOSED:
+            self.worker.enqueue_all(self.host.keys(self._fed_resource))
+
     def _member_client(self, cluster: str) -> FakeKube:
         return self.fleet.member(cluster)
+
+    def _guarded_member_read(
+        self, dispatcher: D.ManagedDispatcher, cname: str, key: str
+    ):
+        """Member read feeding the breaker: transport failures (a hung
+        or erroring member) record breaker evidence and settle the
+        cluster at ClusterNotReady — they must not escape and poison the
+        whole object's plan.  Returns (ok, cluster_obj)."""
+        breaker = self.breakers.for_member(cname)
+        start = time.monotonic()
+        try:
+            obj = self._member_read(
+                self._member_client(cname), self._target_resource, key
+            )
+        except NotFound:
+            dispatcher.record_error(
+                cname, D.CACHED_RETRIEVAL_FAILED, "cluster unavailable"
+            )
+            return False, None
+        except Exception as e:  # transport-level: the member is sick
+            breaker.record_failure(latency_s=time.monotonic() - start)
+            dispatcher.record_error(
+                cname, D.CLUSTER_NOT_READY, f"member read failed: {e}"
+            )
+            return False, None
+        breaker.note_ok(time.monotonic() - start)
+        return True, obj
 
     @staticmethod
     def _member_read(client, resource: str, key: str):
@@ -380,6 +423,7 @@ class SyncController:
                 self._member_client,
                 pool=self.pool,
                 thread_registry=self._flush_threads,
+                breakers=self.breakers,
             )
             finishers: list[tuple[str, Callable[..., Result]]] = []
             for key in fed_keys:
@@ -615,14 +659,18 @@ class SyncController:
                         cname, D.CLUSTER_NOT_READY, "cluster not ready"
                     )
                 continue
-            try:
-                cluster_obj = self._member_read(
-                    self._member_client(cname), self._target_resource, fed.key
-                )
-            except NotFound:
-                dispatcher.record_error(
-                    cname, D.CACHED_RETRIEVAL_FAILED, "cluster unavailable"
-                )
+            if not self.breakers.allow(cname, consume_probe=False):
+                # Breaker hard-open: the member already stalled or
+                # errored past threshold this window — short-circuit to
+                # ClusterNotReady without a read, write or thread.
+                if not should_be_deleted:
+                    self.breakers.count_shed(cname)
+                    dispatcher.record_error(
+                        cname, D.CLUSTER_NOT_READY, "member circuit breaker open"
+                    )
+                continue
+            ok, cluster_obj = self._guarded_member_read(dispatcher, cname, fed.key)
+            if not ok:
                 continue
             if cluster_obj is not None and C.MANAGED_LABEL not in cluster_obj[
                 "metadata"
@@ -987,16 +1035,20 @@ class SyncController:
             replicas_path=self.ftc.path.replicas_spec,
             pool=self.pool,
             inline=self._inline,
+            breakers=self.breakers,
         )
         remaining: list[str] = []
         unreachable: list[str] = []
         for cluster in self._joined_members():
             cname = cluster["metadata"]["name"]
-            if not is_cluster_ready(cluster):
-                # Cannot confirm removal from an unready cluster; block
-                # finalizer removal until it is reachable again
-                # (controller.go:846-887 errs when a cluster store is
-                # unavailable, keeping the finalizer in place).
+            if not is_cluster_ready(cluster) or not self.breakers.allow(
+                cname, consume_probe=False
+            ):
+                # Cannot confirm removal from an unready (or breaker-
+                # open) cluster; block finalizer removal until it is
+                # reachable again (controller.go:846-887 errs when a
+                # cluster store is unavailable, keeping the finalizer in
+                # place).
                 unreachable.append(cname)
                 continue
             try:
@@ -1005,6 +1057,12 @@ class SyncController:
                 )
             except NotFound:
                 continue  # cluster client gone mid-leave; nothing to delete
+            except Exception:
+                # Transport failure mid-read: same contract as unready —
+                # removal unconfirmed, finalizer held.
+                self.breakers.for_member(cname).record_failure()
+                unreachable.append(cname)
+                continue
             if cluster_obj is None:
                 continue
             if C.MANAGED_LABEL not in cluster_obj["metadata"].get("labels", {}):
@@ -1028,6 +1086,9 @@ class SyncController:
                 )
             except NotFound:
                 continue
+            except Exception:
+                still.append(c)  # unconfirmed: keep the finalizer held
+                continue
             if obj is None:
                 continue
             if C.MANAGED_LABEL not in obj.get("metadata", {}).get("labels", {}):
@@ -1038,12 +1099,14 @@ class SyncController:
     def _remove_managed_labels_everywhere(self, fed: FederatedResource) -> bool:
         dispatcher = D.ManagedDispatcher(
             self._member_client, fed, self._target_resource, pool=self.pool,
-            inline=self._inline,
+            inline=self._inline, breakers=self.breakers,
         )
         all_reachable = True
         for cluster in self._joined_members():
             cname = cluster["metadata"]["name"]
-            if not is_cluster_ready(cluster):
+            if not is_cluster_ready(cluster) or not self.breakers.allow(
+                cname, consume_probe=False
+            ):
                 all_reachable = False  # cannot strip labels there yet
                 continue
             try:
@@ -1051,6 +1114,10 @@ class SyncController:
                     self._member_client(cname), self._target_resource, fed.key
                 )
             except NotFound:
+                continue
+            except Exception:
+                self.breakers.for_member(cname).record_failure()
+                all_reachable = False
                 continue
             if cluster_obj is None or cluster_obj["metadata"].get("deletionTimestamp"):
                 continue

@@ -63,10 +63,11 @@ class HealthServer:
     health HTTP server, default port 11257).  When given a ``metrics``
     registry / ``tracer`` it additionally serves ``/metrics`` (Prometheus
     text format) and ``/debug/trace`` (Chrome trace JSON) alongside the
-    pprof-analogue ``/debug/*`` routes and the decision-audit routes
+    pprof-analogue ``/debug/*`` routes, the decision-audit routes
     ``/debug/decisions`` / ``/debug/explain`` / ``/debug/drift``
-    (runtime/flightrec.py) — one port for the whole operability
-    surface."""
+    (runtime/flightrec.py) and the member-health route
+    ``/debug/members`` (transport/breaker.py) — one port for the whole
+    operability surface."""
 
     def __init__(
         self,
@@ -77,12 +78,14 @@ class HealthServer:
         tracer=None,
         flightrec=None,
         drift=None,
+        members=None,
     ):
         self.registry = registry
         self.metrics = metrics
         self.tracer = tracer
         self.flightrec = flightrec
         self.drift = drift
+        self.members = members
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
@@ -109,6 +112,7 @@ class HealthServer:
                         self, path, raw_query,
                         metrics=outer.metrics, tracer=outer.tracer,
                         flightrec=outer.flightrec, drift=outer.drift,
+                        members=outer.members,
                     ):
                         self.send_error(404)
                     return

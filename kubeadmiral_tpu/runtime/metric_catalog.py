@@ -166,6 +166,29 @@ CATALOG: dict[str, MetricSpec] = {
         "absent from the member), orphan (member object outside the "
         "desired set), replicas (member replicas != scheduler override), "
         "decision (persisted placement != flight-recorder decision)."),
+    # -- member fault tolerance (transport/breaker.py, federation/) ------
+    "member_breaker_state": MetricSpec(
+        "gauge", "state", ("cluster",),
+        "Per-member circuit-breaker state: 0 closed (healthy), 1 "
+        "half-open (cooled down, probing), 2 open (short-circuiting). "
+        "Surfaced with full detail at GET /debug/members."),
+    "member_dispatch_retries_total": MetricSpec(
+        "counter", "retries", ("cluster",),
+        "Member-write operations re-sent by the dispatch retry budget "
+        "(transport failures, 5xx results, 409-after-conflict-refresh) "
+        "with bounded exponential backoff + jitter under the per-tick "
+        "deadline (KT_RETRY_*, KT_DISPATCH_DEADLINE_S)."),
+    "member_shed_writes_total": MetricSpec(
+        "counter", "writes", ("cluster",),
+        "Member writes shed off the tick's critical path: breaker-open "
+        "short-circuits (recorded as ClusterNotReady immediately) and "
+        "flush-deadline expiries (statuses stay *_TIMED_OUT); the "
+        "owning worker's backoff requeue re-drives them."),
+    "member_probe_latency": MetricSpec(
+        "histogram", "seconds", ("cluster",),
+        "Member /healthz heartbeat probe latency (the cluster "
+        "controller's reachability probe, which doubles as the "
+        "breaker's half-open probe)."),
 }
 
 # -- decision audit vocabulary -------------------------------------------

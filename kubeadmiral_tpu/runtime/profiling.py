@@ -29,6 +29,10 @@ main.go:21).  The Python control plane's equivalent serves:
 * ``GET /debug/drift`` — desired-vs-observed placement drift, from the
   providers registered with the flight recorder module (the monitor
   controller's drift detector).
+* ``GET /debug/members`` — per-member circuit-breaker health
+  (transport/breaker.py): state, consecutive failures, latency EWMA,
+  shed-write and dispatch-retry tallies — the degraded-member runbook's
+  first stop (docs/operations.md).
 
 ``respond_debug`` is the shared route handler: the health server mounts
 it so one port serves livez/readyz/metrics/debug, and
@@ -141,7 +145,7 @@ def _send(http_handler, body: bytes, content_type: str) -> None:
 
 def respond_debug(
     http_handler, path: str, raw_query: str, metrics=None, tracer=None,
-    flightrec=None, drift=None,
+    flightrec=None, drift=None, members=None,
 ) -> bool:
     """Serve a /metrics or /debug/* route on any BaseHTTPRequestHandler;
     returns False when the path isn't one of ours (caller handles it).
@@ -153,7 +157,9 @@ def respond_debug(
     the reconcile path records into; ``flightrec`` defaults to the
     process-wide decision flight recorder the engine feeds; ``drift``
     (a callable returning the drift listing) defaults to the registered
-    drift providers (flightrec.drift_report)."""
+    drift providers (flightrec.drift_report); ``members`` (a callable
+    returning the member-health listing) defaults to the aggregated
+    circuit-breaker registries (transport/breaker.members_report)."""
     if path == "/metrics":
         if metrics is None:
             return False
@@ -172,6 +178,12 @@ def respond_debug(
             active.chrome_trace_json().encode(),
             "application/json",
         )
+        return True
+    if path == "/debug/members":
+        from kubeadmiral_tpu.transport import breaker as breaker_mod
+
+        report = members() if members is not None else breaker_mod.members_report()
+        _send(http_handler, json.dumps(report).encode(), "application/json")
         return True
     if path in ("/debug/decisions", "/debug/explain", "/debug/drift"):
         from kubeadmiral_tpu.runtime import flightrec as flightrec_mod
@@ -212,7 +224,7 @@ class ProfilingServer:
 
     def __init__(
         self, host: str = "127.0.0.1", port: int = 0, metrics=None,
-        tracer=None, flightrec=None, drift=None,
+        tracer=None, flightrec=None, drift=None, members=None,
     ):
         self._host = host
         self._port = port
@@ -220,6 +232,7 @@ class ProfilingServer:
         self.tracer = tracer
         self.flightrec = flightrec
         self.drift = drift
+        self.members = members
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -238,6 +251,7 @@ class ProfilingServer:
                     self, split.path, split.query,
                     metrics=outer.metrics, tracer=outer.tracer,
                     flightrec=outer.flightrec, drift=outer.drift,
+                    members=outer.members,
                 ):
                     self.send_error(404)
 

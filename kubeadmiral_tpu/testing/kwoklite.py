@@ -26,6 +26,7 @@ from kubeadmiral_tpu.transport.client import (
     HttpFleet,
     HttpKube,
 )
+from kubeadmiral_tpu.transport.faults import FaultInjector, FaultPolicy
 
 
 class KwokLiteFarm:
@@ -49,6 +50,10 @@ class KwokLiteFarm:
         member_subprocess: bool | None = None,
     ):
         self.host_store = FakeKube("host")
+        # Fault-injection seam: per-member FaultPolicy honored by every
+        # in-process member apiserver (set_fault/clear_fault below) —
+        # how `make chaos` partitions, stalls and flaps members.
+        self.faults = FaultInjector()
         self.host_server = KubeApiServer(
             self.host_store, admin_token=host_token, port=host_port
         )
@@ -67,6 +72,21 @@ class KwokLiteFarm:
 
     def endpoint(self, name: str) -> str:
         return self._member_urls[name]
+
+    # -- fault injection --------------------------------------------------
+    def set_fault(self, name: str, policy: FaultPolicy) -> None:
+        """Apply a FaultPolicy to one member apiserver (in-process
+        members only; subprocess members run in their own interpreter
+        where this injector cannot reach)."""
+        if name in self.member_procs:
+            raise RuntimeError(
+                f"member {name} runs as a subprocess; fault injection "
+                "requires in-process members (member_subprocess=False)"
+            )
+        self.faults.set_fault(name, policy)
+
+    def clear_fault(self, name: str) -> None:
+        self.faults.clear(name)
 
     def cluster_spec(self, name: str) -> dict:
         """The FederatedCluster spec fields pointing at this member."""
@@ -98,7 +118,8 @@ class KwokLiteFarm:
             admin_token = f"admin-{name}-{pysecrets.token_hex(8)}"
             store = FakeKube(name)
             server = KubeApiServer(
-                store, admin_token=admin_token, mint_sa_tokens=True
+                store, admin_token=admin_token, mint_sa_tokens=True,
+                fault_injector=self.faults, fault_name=name,
             )
             self.member_servers[name] = server
             url = server.url

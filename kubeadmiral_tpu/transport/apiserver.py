@@ -31,6 +31,7 @@ import json
 import secrets as pysecrets
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
@@ -113,9 +114,17 @@ class KubeApiServer:
         mint_sa_tokens: bool = False,
         event_log_cap: int = 100_000,
         sa_signing_key: Optional[str] = None,
+        fault_injector=None,
+        fault_name: Optional[str] = None,
     ):
         self.store = store
         self.admin_token = admin_token
+        # Fault-injection seam (transport/faults.py): when given, every
+        # request and watch stream resolves this member's FaultPolicy
+        # first — added latency, injected 500s, severed connections,
+        # connect-timeout partitions, silent watch streams.
+        self.fault_injector = fault_injector
+        self.fault_name = fault_name or store.name
         self._tokens: set[str] = set()
         # Minted tokens are self-authenticating: HMAC(signing key,
         # secret key + SA name) — the analogue of the real apiserver's
@@ -361,13 +370,19 @@ class _Handler(BaseHTTPRequestHandler):
     # -- plumbing --------------------------------------------------------
     def _send_json(self, code: int, payload: dict, extra: Optional[dict] = None) -> None:
         body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in (extra or {}).items():
-            self.send_header(k, v)
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # The client gave up mid-response (e.g. its timeout fired
+            # while a fault held this request): a vanished peer is a
+            # closed connection, not a handler crash to traceback.
+            self.close_connection = True
 
     def _send_status(self, code: int, reason: str, message: str) -> None:
         self._send_json(
@@ -403,8 +418,56 @@ class _Handler(BaseHTTPRequestHandler):
     def _object_key(self, parsed) -> str:
         return f"{parsed.namespace}/{parsed.name}" if parsed.namespace else parsed.name
 
+    # -- fault injection (transport/faults.py seam) ----------------------
+    def _fault_gate(self) -> bool:
+        """Resolve this member's fault policy for one request; True when
+        the request was consumed by the fault (severed or 500'd)."""
+        inj = self.api.fault_injector
+        if inj is None:
+            return False
+        act = inj.action(self.api.fault_name)
+        if act is None:
+            return False
+        if act.latency_s:
+            time.sleep(act.latency_s)
+        if act.partition:
+            # Connect-timeout partition: hold the request unanswered —
+            # the client's own socket timeout fires first — until the
+            # fault clears or the hang cap elapses, then sever.
+            deadline = time.monotonic() + inj.partition_hang_s
+            while time.monotonic() < deadline and not self.api._closed.is_set():
+                if not inj.partitioned(self.api.fault_name):
+                    return False  # flap cleared mid-request: serve it late
+                time.sleep(0.05)
+            self._sever()
+            return True
+        if act.drop:
+            self._sever()
+            return True
+        if act.error:
+            self._send_status(500, "InternalError", "injected fault")
+            return True
+        return False
+
+    def _sever(self) -> None:
+        """Close the connection without a response: the client sees EOF
+        / connection reset, never an HTTP status."""
+        self.close_connection = True
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    def _watch_stalled(self) -> bool:
+        inj = self.api.fault_injector
+        if inj is None:
+            return False
+        return inj.watch_stalled(self.api.fault_name)
+
     # -- verbs -----------------------------------------------------------
     def do_GET(self):
+        if self._fault_gate():
+            return
         split = urlsplit(self.path)
         if split.path == "/healthz":
             if self.api.store.healthy:
@@ -493,6 +556,8 @@ class _Handler(BaseHTTPRequestHandler):
         # would be parsed as the next request line on this keep-alive
         # connection, corrupting the client's pooled connection.
         obj = self._read_body()
+        if self._fault_gate():
+            return
         if not self._check_auth():
             return
         if obj is None:
@@ -516,6 +581,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         obj = self._read_body()  # drain before any error response
+        if self._fault_gate():
+            return
         if not self._check_auth():
             return
         if obj is None:
@@ -542,6 +609,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status(404, "NotFound", str(e))
 
     def do_DELETE(self):
+        if self._fault_gate():
+            return
         if not self._check_auth():
             return
         try:
@@ -592,6 +661,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             while not self.api._closed.is_set():
                 heartbeat = False
+                # Watch-stall fault: hold delivery (lines AND heartbeats)
+                # until the stall clears — the client sees a silent,
+                # still-open stream, times out as a dead peer and
+                # reconnects with backoff; events deliver late, never
+                # lost (the log keeps them, resume rv catches up).
+                while self._watch_stalled() and not self.api._closed.is_set():
+                    time.sleep(0.05)
                 for line in lines:
                     self._write_chunk(line)
                 # cursor from since() is the latest logged seq at query
@@ -608,7 +684,7 @@ class _Handler(BaseHTTPRequestHandler):
                         if not log.cond.wait(timeout=15.0):
                             heartbeat = True
                             break
-                if heartbeat:
+                if heartbeat and not self._watch_stalled():
                     self._write_chunk(HEARTBEAT)
         except (BrokenPipeError, ConnectionResetError):
             return

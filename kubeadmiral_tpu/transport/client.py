@@ -23,6 +23,7 @@ import functools
 import http.client
 import json
 import logging
+import random
 import socket as pysocket
 import threading
 import time
@@ -56,6 +57,21 @@ class Gone(Exception):
     """410: watch resourceVersion expired — relist."""
 
 
+def watch_backoff(
+    attempt: int, base: float = 0.1, cap: float = 5.0, rng=None
+) -> float:
+    """Reconnect delay for the reflector loop: exponential with jitter,
+    capped.  Uniform in [span/2, span] of ``min(cap, base * 2^attempt)``
+    — the floor stops a partitioned fleet's watchers from retrying in
+    lockstep at zero, the cap bounds recovery latency once the member
+    returns, and the jitter de-phases a reconnect storm (hundreds of
+    streams dropped by one member restart must not re-dial as one
+    thundering herd)."""
+    span = min(cap, base * (2 ** min(max(attempt, 0), 16)))
+    r = (rng or random).random()
+    return span * (0.5 + 0.5 * r)
+
+
 class _NoDelayConnection(http.client.HTTPConnection):
     """HTTPConnection with TCP_NODELAY: requests are small multi-write
     payloads, and Nagle + the peer's delayed ACK add ~40 ms per call on
@@ -78,12 +94,17 @@ class HttpKube:
         token: Optional[str] = None,
         name: str = "",
         timeout: float = 10.0,
+        watch_timeout: float = 30.0,
     ):
         split = urlsplit(base_url)
         self.name = name or split.netloc
         self._netloc = split.netloc
         self._token = token
         self._timeout = timeout
+        # Watch-stream read timeout: a stream silent past this (no
+        # events, no heartbeats — the server sends one every ~15 s when
+        # idle) is presumed dead and reconnects.
+        self._watch_timeout = watch_timeout
         self._local = threading.local()
         self._mux: dict[str, _ResourceWatch] = {}
         self._mux_lock = threading.Lock()
@@ -293,6 +314,9 @@ class _ResourceWatch:
         self._known: dict[str, dict] = {}  # stream-thread only
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Reconnect delays actually slept (bounded) — the observable
+        # backoff schedule tests assert against.
+        self.reconnect_delays: list[float] = []
 
     def add(self, handler: Handler, replay: bool) -> None:
         # Register BEFORE the replay list: an object created between the
@@ -362,6 +386,7 @@ class _ResourceWatch:
     def _run(self) -> None:
         rv = 0
         need_list = True
+        attempt = 0
         while not self._stop.is_set() and not self.kube._closed.is_set():
             try:
                 if need_list:
@@ -376,17 +401,37 @@ class _ResourceWatch:
                     for obj in items:
                         self._dispatch(ADDED, obj)
                     need_list = False
-                rv = self._stream(rv)
+                rv, got_any = self._stream(rv)
+                if got_any:
+                    attempt = 0  # a live stream resets the backoff ladder
+                else:
+                    # Closed (or read-timed-out) without delivering a
+                    # single line: a member restart loop or partition.
+                    # Reconnecting flat-out turns that into a storm —
+                    # back off, capped and jittered.
+                    attempt += 1
+                    self._sleep_backoff(attempt)
             except Gone:
-                need_list = True
+                need_list = True  # relist immediately: 410 is not a fault
             except (TransportError, OSError, http.client.HTTPException, ValueError):
-                time.sleep(0.2)
+                attempt += 1
+                self._sleep_backoff(attempt)
 
-    def _stream(self, rv: int) -> int:
-        """One watch connection; returns the last seen resourceVersion."""
+    def _sleep_backoff(self, attempt: int) -> None:
+        delay = watch_backoff(attempt - 1)
+        if len(self.reconnect_delays) < 256:
+            self.reconnect_delays.append(delay)
+        self._stop.wait(delay)
+
+    def _stream(self, rv: int) -> tuple[int, bool]:
+        """One watch connection; returns (last seen resourceVersion,
+        whether ANY line — event or heartbeat — arrived).  A silent
+        stream past the watch timeout reads as dead-peer and returns for
+        a (backed-off) reconnect."""
         conn = http.client.HTTPConnection(
-            self.kube._netloc, timeout=30.0
+            self.kube._netloc, timeout=self.kube._watch_timeout
         )
+        got_any = False
         try:
             path = resource_to_path(self.resource) + f"?watch=true&resourceVersion={rv}"
             conn.request("GET", path, headers=self.kube._headers())
@@ -398,9 +443,13 @@ class _ResourceWatch:
                 resp.read()
                 raise TransportError(f"watch {self.resource}: HTTP {resp.status}")
             while not self._stop.is_set() and not self.kube._closed.is_set():
-                line = resp.readline()
+                try:
+                    line = resp.readline()
+                except (TimeoutError, pysocket.timeout):
+                    return rv, got_any  # silent stream: reconnect from rv
                 if not line:
-                    return rv  # stream closed; reconnect from rv
+                    return rv, got_any  # stream closed; reconnect from rv
+                got_any = True
                 event = json.loads(line)
                 if event.get("type") == "HEARTBEAT":
                     continue
@@ -408,7 +457,7 @@ class _ResourceWatch:
                 obj_rv = int(obj.get("metadata", {}).get("resourceVersion", 0))
                 rv = max(rv, obj_rv)
                 self._dispatch(event["type"], obj)
-            return rv
+            return rv, got_any
         finally:
             conn.close()
 

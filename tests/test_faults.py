@@ -1,0 +1,888 @@
+"""Fault-matrix suite: member outages must not stall the tick loop.
+
+Run as ``make chaos`` (whole matrix) or inside tier-1 (`-m 'not slow'`
+keeps the fast subset).  Covers the fault-injection seam
+(transport/faults.py), the per-member circuit breakers
+(transport/breaker.py), the stall-proof dispatch fan-out
+(federation/dispatch.py), watch-stream recovery (410 relist, reconnect
+backoff), and the end-to-end acceptance scenario: one hard-down member
+of 8 under the kwok-lite farm, breaker opens after one deadline, ticks
+stay fast, ClusterNotReady statuses, and bit-identical convergence on
+recovery.
+"""
+
+import threading
+import time
+
+import pytest
+
+from test_e2e_slice import make_deployment, make_node
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation import dispatch as D
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.testing.fakekube import FakeKube
+from kubeadmiral_tpu.transport import breaker as B
+from kubeadmiral_tpu.transport.client import TransportError, watch_backoff
+from kubeadmiral_tpu.transport.faults import (
+    FaultInjector,
+    FaultPolicy,
+    FaultyKube,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- fault policies -------------------------------------------------------
+class TestFaultPolicy:
+    def test_schedule_start_and_expiry(self):
+        clock = FakeClock()
+        inj = FaultInjector(clock=clock)
+        inj.set_fault("m", FaultPolicy(partition=True, start_s=1.0, duration_s=2.0))
+        assert inj.action("m") is None  # not engaged yet
+        clock.advance(1.5)
+        act = inj.action("m")
+        assert act is not None and act.partition
+        clock.advance(2.0)  # past start + duration
+        assert inj.action("m") is None
+        assert inj.policy("m") is None  # expired policies self-clean
+
+    def test_flap_phases(self):
+        clock = FakeClock()
+        inj = FaultInjector(clock=clock)
+        inj.set_fault(
+            "m", FaultPolicy(partition=True, flap_period_s=1.0, flap_duty=0.5)
+        )
+        clock.advance(0.25)  # phase 0.25 < duty 0.5: partitioned
+        assert inj.partitioned("m")
+        clock.advance(0.5)  # phase 0.75: healthy half of the period
+        assert not inj.partitioned("m")
+        clock.advance(0.5)  # next period's partitioned half
+        assert inj.partitioned("m")
+
+    def test_error_rate_and_latency(self):
+        clock = FakeClock()
+        inj = FaultInjector(clock=clock, seed=7)
+        inj.set_fault("m", FaultPolicy(error_rate=1.0, latency_s=0.25))
+        act = inj.action("m")
+        assert act.error and act.latency_s == pytest.approx(0.25)
+        inj.set_fault("m", FaultPolicy(error_rate=0.0))
+        assert not inj.action("m").error
+
+
+class TestFaultyKube:
+    def test_partition_blocks_briefly_then_raises(self):
+        inj = FaultInjector()
+        kube = FaultyKube(FakeKube("m"), "m", inj, timeout=0.1)
+        inj.set_fault("m", FaultPolicy(partition=True))
+        t0 = time.monotonic()
+        with pytest.raises(TransportError):
+            kube.keys("v1/pods")
+        elapsed = time.monotonic() - t0
+        assert 0.05 <= elapsed < 1.0  # bounded by the proxy timeout
+        assert kube.healthy is False
+        inj.clear("m")
+        assert kube.keys("v1/pods") == []
+        assert kube.healthy is True
+
+    def test_watch_stall_buffers_then_catches_up(self):
+        inj = FaultInjector()
+        inner = FakeKube("m")
+        kube = FaultyKube(inner, "m", inj, timeout=0.1)
+        seen = []
+        kube.watch("v1/pods", lambda ev, obj: seen.append(obj["metadata"]["name"]))
+        inj.set_fault("m", FaultPolicy(watch_stall=True))
+        inner.create("v1/pods", {"metadata": {"name": "p1"}})
+        inner.create("v1/pods", {"metadata": {"name": "p2"}})
+        assert seen == []  # stalled: buffered, not lost
+        inj.clear("m")
+        kube.drain_stalled()
+        assert seen == ["p1", "p2"]  # order preserved
+        inner.create("v1/pods", {"metadata": {"name": "p3"}})
+        assert seen == ["p1", "p2", "p3"]
+
+
+# -- circuit breakers -----------------------------------------------------
+class TestBreaker:
+    def _registry(self, clock, **cfg):
+        defaults = dict(
+            failure_threshold=3, open_seconds=5.0,
+            latency_threshold_s=0, stall_threshold_s=1.0,
+        )
+        defaults.update(cfg)
+        return B.BreakerRegistry(
+            metrics=Metrics(), config=B.BreakerConfig(**defaults), clock=clock
+        )
+
+    def test_consecutive_failures_open_then_probe_closes(self):
+        clock = FakeClock()
+        reg = self._registry(clock)
+        b = reg.for_member("m")
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == B.CLOSED  # below threshold
+        b.record_failure()
+        assert b.state == B.OPEN
+        assert not b.allow()
+        clock.advance(5.1)  # cool-down elapsed: half-open, ONE probe slot
+        assert b.allow()
+        assert b.state == B.HALF_OPEN
+        assert not b.allow()  # second concurrent probe is refused
+        b.record_success(0.01)
+        assert b.state == B.CLOSED
+        assert b.allow()
+
+    def test_stall_opens_immediately(self):
+        reg = self._registry(FakeClock())
+        b = reg.for_member("m")
+        b.record_failure(timeout=True)  # ONE parked deadline is enough
+        assert b.state == B.OPEN
+        b2 = reg.for_member("m2")
+        b2.record_failure(latency_s=2.0)  # slower than stall_threshold_s
+        assert b2.state == B.OPEN
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        reg = self._registry(clock)
+        b = reg.for_member("m")
+        b.record_failure(timeout=True)
+        clock.advance(5.1)
+        assert b.allow()
+        b.record_failure()
+        assert b.state == B.OPEN
+        assert not b.allow()  # a fresh cool-down window started
+
+    def test_latency_ewma_opens(self):
+        reg = self._registry(FakeClock(), latency_threshold_s=0.5, ewma_alpha=1.0)
+        b = reg.for_member("m")
+        b.record_success(2.0)  # answers, but slower than the tick can afford
+        assert b.state == B.OPEN
+
+    def test_probe_success_respects_cooldown(self):
+        clock = FakeClock()
+        reg = self._registry(clock)
+        b = reg.for_member("m")
+        b.record_failure(timeout=True)
+        b.record_success(0.01, probe=True)  # heartbeat inside the window
+        assert b.state == B.OPEN  # must not defeat load shedding early
+        clock.advance(5.1)
+        b.record_success(0.01, probe=True)
+        assert b.state == B.CLOSED
+
+    def test_registry_transitions_metrics_and_report(self):
+        clock = FakeClock()
+        metrics = Metrics()
+        reg = B.BreakerRegistry(
+            metrics=metrics,
+            config=B.BreakerConfig(failure_threshold=1, open_seconds=1.0,
+                                   latency_threshold_s=0),
+            clock=clock,
+        )
+        transitions = []
+        reg.on_transition(lambda name, old, new: transitions.append((name, old, new)))
+        reg.for_member("reg-m").record_failure()
+        assert transitions == [("reg-m", B.CLOSED, B.OPEN)]
+        assert metrics.stores.get("member_breaker_state{cluster=reg-m}") == 2
+        reg.count_shed("reg-m", 3)
+        reg.count_retry("reg-m", 2)
+        snap = reg.snapshot()["reg-m"]
+        assert snap["state"] == B.OPEN
+        assert snap["shed_writes"] == 3 and snap["dispatch_retries"] == 2
+        report = B.members_report()
+        assert "reg-m" in report["members"] and "reg-m" in report["open"]
+        assert reg.open_members() == ["reg-m"]
+
+    def test_debug_members_route(self):
+        import json
+        from urllib.request import urlopen
+
+        from kubeadmiral_tpu.runtime.healthcheck import (
+            HealthCheckRegistry,
+            HealthServer,
+        )
+
+        reg = B.BreakerRegistry(metrics=Metrics())
+        reg.for_member("route-m").record_failure(timeout=True)
+        server = HealthServer(HealthCheckRegistry(), metrics=Metrics())
+        port = server.start()
+        try:
+            body = urlopen(f"http://127.0.0.1:{port}/debug/members").read()
+            payload = json.loads(body)
+            assert payload["members"]["route-m"]["state"] == B.OPEN
+            assert "route-m" in payload["open"]
+        finally:
+            server.stop()
+
+
+# -- dispatch retry budget ------------------------------------------------
+class _FlakyKube:
+    """Raises on the first N batch calls, then delegates to a FakeKube."""
+
+    def __init__(self, failures: int):
+        self.inner = FakeKube("flaky")
+        self.failures = failures
+        self.calls = 0
+
+    def batch(self, ops):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise TransportError("flaky: connection reset")
+        return self.inner.batch(ops)
+
+    def get(self, resource, key):
+        return self.inner.get(resource, key)
+
+
+class TestDispatchRetry:
+    def test_retry_delay_jittered_and_capped(self, monkeypatch):
+        monkeypatch.setenv("KT_RETRY_BASE_S", "0.1")
+        monkeypatch.setenv("KT_RETRY_CAP_S", "1.0")
+        import random
+
+        rng = random.Random(42)
+        for attempt in range(8):
+            span = min(1.0, 0.1 * 2**attempt)
+            for _ in range(20):
+                d = D.retry_delay(attempt, rng=rng)
+                assert span * 0.5 <= d <= span  # jittered within the band
+        assert D.retry_delay(30, rng=rng) <= 1.0  # capped
+
+    def test_transport_failures_retried_within_budget(self, monkeypatch):
+        monkeypatch.setenv("KT_RETRY_BASE_S", "0.01")
+        monkeypatch.setenv("KT_RETRY_CAP_S", "0.02")
+        client = _FlakyKube(failures=2)
+        reg = B.BreakerRegistry(metrics=Metrics())
+        results = D.run_batch_with_retries(
+            client,
+            [{"verb": "create", "resource": "v1/pods",
+              "object": {"metadata": {"name": "p"}}}],
+            deadline=time.monotonic() + 5.0,
+            cluster="m",
+            breakers=reg,
+        )
+        assert results[0]["code"] == 201
+        assert client.calls == 3
+        assert reg.snapshot()["m"]["dispatch_retries"] == 2
+        assert reg.for_member("m").state == B.CLOSED  # it recovered in-budget
+
+    def test_budget_exhaustion_returns_transport_result(self, monkeypatch):
+        monkeypatch.setenv("KT_RETRY_BASE_S", "0.01")
+        monkeypatch.setenv("KT_RETRY_MAX", "1")
+        client = _FlakyKube(failures=99)
+        reg = B.BreakerRegistry(
+            metrics=Metrics(),
+            config=B.BreakerConfig(failure_threshold=1, latency_threshold_s=0),
+        )
+        results = D.run_batch_with_retries(
+            client,
+            [{"verb": "create", "resource": "v1/pods",
+              "object": {"metadata": {"name": "p"}}}],
+            deadline=time.monotonic() + 5.0,
+            cluster="m",
+            breakers=reg,
+        )
+        assert results[0]["code"] == 500
+        assert reg.for_member("m").state == B.OPEN
+
+    def test_conflict_refresh_retries_update(self):
+        member = FakeKube("m")
+        created = member.create(
+            "v1/pods", {"metadata": {"name": "p"}, "spec": {"v": 1}}
+        )
+        # Bump the stored object so the staged update's rv goes stale.
+        bump = {"metadata": {"name": "p",
+                             "resourceVersion": created["metadata"]["resourceVersion"]},
+                "spec": {"v": 2}}
+        member.update("v1/pods", bump)
+        stale = {"metadata": {"name": "p",
+                              "resourceVersion": created["metadata"]["resourceVersion"]},
+                 "spec": {"v": 3}}
+        results = D.run_batch_with_retries(
+            member,
+            [{"verb": "update", "resource": "v1/pods", "object": stale}],
+            deadline=time.monotonic() + 5.0,
+        )
+        assert results[0]["code"] == 200  # 409 → refresh rv → retried
+        assert member.get("v1/pods", "p")["spec"]["v"] == 3
+
+
+# -- deadline enforcement on every flush path -----------------------------
+class TestDeadlines:
+    def _staged_sink(self, sink, cluster="m", n=2):
+        outcomes = []
+        for i in range(n):
+            sink.submit(
+                cluster,
+                {"verb": "create", "resource": "v1/pods",
+                 "object": {"metadata": {"name": f"p{i}"}}},
+                outcomes.append,
+            )
+        return outcomes
+
+    def test_serial_flush_enforces_deadline(self):
+        """The satellite-1 bug: the no-pool serial path used to ignore
+        its timeout argument entirely — a hung member parked the
+        flushing thread forever."""
+        inj = FaultInjector()
+        proxied = FaultyKube(FakeKube("m"), "m", inj, timeout=0.4)
+        inj.set_fault("m", FaultPolicy(partition=True))
+        reg = B.BreakerRegistry(metrics=Metrics())
+        sink = D.BatchSink(lambda c: proxied, breakers=reg)
+        outcomes = self._staged_sink(sink)
+        t0 = time.monotonic()
+        sink.flush(timeout=0.15)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.35  # returned at the deadline, not the client timeout
+        assert outcomes == []  # continuations never ran: *_TIMED_OUT stands
+        assert reg.for_member("m").state == B.OPEN  # stall opened the breaker
+        assert reg.snapshot()["m"]["shed_writes"] == 2
+        # The helper thread dies on the client's own timeout, not ours.
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and any(
+            t.name.startswith("dispatch-flush-") for t in threading.enumerate()
+        ):
+            time.sleep(0.05)
+        assert not any(
+            t.name.startswith("dispatch-flush-") for t in threading.enumerate()
+        )
+
+    def test_serial_flush_stays_inline_for_plain_fakekube(self):
+        """The local hot path must not pay a thread spawn per member."""
+        member = FakeKube("m")
+        sink = D.BatchSink(lambda c: member)
+        seen_threads = []
+        sink.submit(
+            "m",
+            {"verb": "create", "resource": "v1/pods",
+             "object": {"metadata": {"name": "p"}}},
+            lambda res: seen_threads.append(threading.current_thread().name),
+        )
+        sink.flush(timeout=5.0)
+        assert seen_threads == [threading.current_thread().name]
+
+    def test_pooled_single_cluster_flush_honors_timeout(self):
+        """Regression: with a pool present but only ONE staged cluster,
+        the old code fell into the serial branch and dropped the
+        timeout."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        inj = FaultInjector()
+        proxied = FaultyKube(FakeKube("m"), "m", inj, timeout=1.0)
+        inj.set_fault("m", FaultPolicy(partition=True))
+        pool = ThreadPoolExecutor(max_workers=2)
+        try:
+            sink = D.BatchSink(lambda c: proxied, pool=pool,
+                               breakers=B.BreakerRegistry(metrics=Metrics()))
+            self._staged_sink(sink, n=1)
+            t0 = time.monotonic()
+            sink.flush(timeout=0.15)
+            assert time.monotonic() - t0 < 0.6
+        finally:
+            pool.shutdown(wait=True)
+
+    def test_immediate_sink_wait_cancels_and_finalizes(self):
+        inj = FaultInjector()
+        proxied = FaultyKube(FakeKube("m"), "m", inj, timeout=0.6)
+        inj.set_fault("m", FaultPolicy(partition=True))
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(max_workers=1)  # second op queues behind
+        try:
+            sink = D.ImmediateSink(lambda c: proxied, pool=pool)
+            outcomes = []
+            for i in range(2):
+                sink.submit(
+                    "m",
+                    {"verb": "create", "resource": "v1/pods",
+                     "object": {"metadata": {"name": f"p{i}"}}},
+                    outcomes.append,
+                )
+            t0 = time.monotonic()
+            sink.wait(timeout=0.1)
+            assert time.monotonic() - t0 < 0.5
+            # The queued future was cancelled: at most the in-flight op's
+            # continuation can still land, the other never will.
+            with pytest.raises(RuntimeError):
+                sink.submit("m", {"verb": "get", "resource": "v1/pods",
+                                  "key": "p0"}, outcomes.append)
+        finally:
+            pool.shutdown(wait=True, cancel_futures=True)
+
+    def test_immediate_sink_pool_size_knob(self, monkeypatch):
+        monkeypatch.setenv("KT_DISPATCH_POOL", "3")
+        assert D.dispatch_pool_size() == 3
+        sink = D.ImmediateSink(lambda c: FakeKube("m"))
+        outcomes = []
+        sink.submit("m", {"verb": "create", "resource": "v1/pods",
+                          "object": {"metadata": {"name": "p"}}},
+                    outcomes.append)
+        assert sink._pool._max_workers == 3
+        sink.wait(timeout=2.0)
+        assert outcomes and outcomes[0]["code"] == 201
+
+
+# -- watch-stream recovery ------------------------------------------------
+class TestWatchRecovery:
+    def test_backoff_schedule_capped_and_jittered(self):
+        import random
+
+        rng = random.Random(1)
+        delays = [watch_backoff(a, base=0.1, cap=5.0, rng=rng) for a in range(12)]
+        for a, d in enumerate(delays):
+            span = min(5.0, 0.1 * 2**a)
+            assert span * 0.5 <= d <= span
+        assert max(delays) <= 5.0  # capped
+        assert delays[0] < 0.11  # first retry stays prompt
+        # Jitter: two seeded schedules differ.
+        rng2 = random.Random(2)
+        delays2 = [watch_backoff(a, base=0.1, cap=5.0, rng=rng2) for a in range(12)]
+        assert delays != delays2
+
+    def test_watch_stall_reconnect_and_410_relist(self):
+        """A stalled watch stream goes silent (no heartbeats), the
+        client reconnects with backoff, and — with the event log rolled
+        over meanwhile — takes the 410 Gone relist to converge."""
+        from kubeadmiral_tpu.testing.fakekube import FakeKube as FK
+        from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+        from kubeadmiral_tpu.transport.client import HttpKube
+
+        inj = FaultInjector()
+        store = FK("m")
+        server = KubeApiServer(store, event_log_cap=8, fault_injector=inj,
+                               fault_name="m")
+        client = HttpKube(server.url, name="m", watch_timeout=0.4)
+        try:
+            seen = {}
+            lock = threading.Lock()
+
+            def handler(ev, obj):
+                with lock:
+                    seen[obj["metadata"]["name"]] = ev
+
+            client.watch("v1/pods", handler)
+            store.create("v1/pods", {"metadata": {"name": "before"}})
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and "before" not in seen:
+                time.sleep(0.02)
+            assert "before" in seen
+
+            inj.set_fault("m", FaultPolicy(watch_stall=True))
+            time.sleep(0.6)  # stream goes silent past the watch timeout
+            # Roll the event log far past its cap while stalled, so the
+            # reconnect's resume rv is evicted → 410 Gone → relist.
+            for i in range(40):
+                store.create("v1/pods", {"metadata": {"name": f"p{i}"}})
+            inj.clear("m")
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and "p39" not in seen:
+                time.sleep(0.05)
+            assert "p39" in seen  # converged through relist
+            mux = client._mux["v1/pods"]
+            assert mux.reconnect_delays  # the silent stream backed off
+            assert all(d <= 5.0 for d in mux.reconnect_delays)
+        finally:
+            client.close()
+            server.close()
+
+    def test_reconnect_storm_backs_off_under_partition(self):
+        from kubeadmiral_tpu.testing.fakekube import FakeKube as FK
+        from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+        from kubeadmiral_tpu.transport.client import HttpKube
+
+        inj = FaultInjector(partition_hang_s=0.2)
+        store = FK("m")
+        server = KubeApiServer(store, fault_injector=inj, fault_name="m")
+        client = HttpKube(server.url, name="m", timeout=0.2, watch_timeout=0.3)
+        try:
+            seen = []
+            client.watch("v1/pods", lambda ev, obj: seen.append(obj))
+            inj.set_fault("m", FaultPolicy(partition=True))
+            time.sleep(2.0)  # let the reflector churn against the partition
+            mux = client._mux["v1/pods"]
+            delays = list(mux.reconnect_delays)
+            assert len(delays) >= 2  # it retried...
+            # ...but NOT flat-out: the later delays grew past the first
+            # rung, and everything stayed under the cap.
+            assert max(delays) > 0.11
+            assert all(d <= 5.0 for d in delays)
+            inj.clear("m")
+            store.create("v1/pods", {"metadata": {"name": "after"}})
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not seen:
+                time.sleep(0.05)
+            assert seen  # recovered after the partition cleared
+        finally:
+            client.close()
+            server.close()
+
+
+# -- the acceptance scenario ----------------------------------------------
+def _settle(named, deadline_s=60.0, idle_rounds=8):
+    """Step every controller until nothing progresses for a few idle
+    polls (watch events over sockets arrive asynchronously)."""
+    deadline = time.monotonic() + deadline_s
+    idle = 0
+    while time.monotonic() < deadline and idle < idle_rounds:
+        progressed = False
+        for _, ctl in named:
+            while ctl.worker.step():
+                progressed = True
+        if progressed:
+            idle = 0
+        else:
+            idle += 1
+            time.sleep(0.03)
+
+
+class TestMemberFaultToleranceE2E:
+    """ISSUE 6 acceptance: 1 of 8 members hard-down (connect-timeout
+    partition) under the kwok-lite farm — the first post-fault tick may
+    pay one deadline, after the breaker opens ticks stay fast, the down
+    member's objects carry ClusterNotReady, and on fault clearance the
+    half-open probe closes the breaker and shed writes converge with
+    placements bit-identical to the pre-fault state."""
+
+    N_MEMBERS = 8
+    N_OBJECTS = 10
+
+    def test_hard_down_member_short_circuits_and_recovers(self, monkeypatch):
+        monkeypatch.setenv("KT_DISPATCH_DEADLINE_S", "2.0")
+        monkeypatch.setenv("KT_BREAKER_OPEN_S", "4.0")
+        monkeypatch.setenv("KT_BREAKER_STALL_S", "0.5")
+        monkeypatch.setenv("KT_BREAKER_FAILURES", "2")
+        monkeypatch.setenv("KT_RETRY_BASE_S", "0.02")
+        monkeypatch.setenv("KT_RETRY_CAP_S", "0.05")
+        monkeypatch.setenv("KT_RETRY_MAX", "1")
+
+        import dataclasses
+
+        from kubeadmiral_tpu.federation.clusterctl import (
+            FEDERATED_CLUSTERS,
+            FederatedClusterController,
+            NODES,
+        )
+        from kubeadmiral_tpu.federation.federate import FederateController
+        from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+        from kubeadmiral_tpu.federation.sync import SyncController
+        from kubeadmiral_tpu.models.ftc import default_ftcs
+        from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        ftc = dataclasses.replace(
+            next(f for f in default_ftcs() if f.name == "deployments.apps"),
+            controllers=(("kubeadmiral.io/global-scheduler",),),
+        )
+        farm = KwokLiteFarm()
+        farm.fleet.factory.timeout = 1.0  # member round trips: 1 s timeout
+        fleet = farm.fleet
+        try:
+            for i in range(self.N_MEMBERS):
+                name = f"m{i}"
+                member = farm.add_member(name)
+                member.create(NODES, make_node("n1", "64", "128Gi"))
+                fleet.host.create(
+                    FEDERATED_CLUSTERS,
+                    {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                     "kind": "FederatedCluster",
+                     "metadata": {"name": name},
+                     "spec": farm.cluster_spec(name)},
+                )
+            fleet.host.create(
+                PROPAGATION_POLICIES,
+                {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                 "kind": "PropagationPolicy",
+                 "metadata": {"name": "pp", "namespace": "default"},
+                 "spec": {"schedulingMode": "Divide"}},
+            )
+            named = [
+                ("cluster", FederatedClusterController(
+                    fleet, api_resource_probe=["apps/v1/Deployment"],
+                    resync_seconds=3.0,
+                )),
+                ("federate", FederateController(fleet.host, ftc)),
+                ("schedule", SchedulerController(fleet.host, ftc)),
+                ("sync", SyncController(fleet, ftc)),
+            ]
+            sync = named[-1][1]
+            clusterctl = named[0][1]
+            _settle(named)  # joins
+
+            for i in range(self.N_OBJECTS):
+                fleet.host.create(
+                    ftc.source.resource,
+                    make_deployment(name=f"app-{i}", replicas=4 + i),
+                )
+            _settle(named)
+
+            # Pre-fault truth: every object propagated OK somewhere.
+            placements: dict[str, set] = {}
+            for key in fleet.host.keys(ftc.federated.resource):
+                fed = fleet.host.get(ftc.federated.resource, key)
+                placed = C.get_placement(fed, C.SCHEDULER)
+                assert placed, f"{key} never scheduled"
+                placements[key] = set(placed)
+                statuses = {
+                    e["cluster"]: e["status"]
+                    for e in fed.get("status", {}).get("clusters", [])
+                }
+                assert all(s == "OK" for s in statuses.values()), (key, statuses)
+            down = sorted(
+                {c for placed in placements.values() for c in placed}
+            )[0]
+            down_keys = [k for k, p in placements.items() if down in p]
+            assert down_keys, "no object placed on the chosen member"
+
+            def timed_sync_tick() -> float:
+                sync.worker.enqueue_all(fleet.host.keys(ftc.federated.resource))
+                t0 = time.monotonic()
+                while sync.worker.step():
+                    pass
+                return time.monotonic() - t0
+
+            baseline = min(timed_sync_tick() for _ in range(2))
+
+            # -- fault: hard partition (connect-timeout) ------------------
+            farm.set_fault(down, FaultPolicy(partition=True))
+            breaker = B.for_fleet(fleet).for_member(down)
+
+            first = timed_sync_tick()
+            # The first post-fault tick pays (at most) one deadline-ish
+            # member read, never the whole fan-out serialized behind it.
+            assert first < 2.0 + 2.0 + 1.0, f"first post-fault tick {first:.1f}s"
+            assert breaker.state != B.CLOSED, "breaker never opened"
+
+            post = [timed_sync_tick() for _ in range(3)]
+            # After the breaker opens, ticks short-circuit: bounded well
+            # under the deadline (and within 1.5x-ish of baseline plus
+            # scheduling noise).
+            for t in post:
+                assert t < max(1.0, baseline * 1.5 + 0.5), (
+                    f"post-open tick {t:.2f}s vs baseline {baseline:.2f}s"
+                )
+
+            # Down member's objects carry ClusterNotReady.
+            for key in down_keys:
+                fed = fleet.host.get(ftc.federated.resource, key)
+                statuses = {
+                    e["cluster"]: e["status"]
+                    for e in fed.get("status", {}).get("clusters", [])
+                }
+                assert statuses.get(down) == D.CLUSTER_NOT_READY, (key, statuses)
+            assert B.for_fleet(fleet).shed_total() > 0
+
+            # The same tick's breaker transition re-enqueued the cluster:
+            # its Ready condition flips without waiting a resync period.
+            while clusterctl.worker.step():
+                pass
+            cluster_obj = fleet.host.get(FEDERATED_CLUSTERS, down)
+            conds = {c["type"]: c for c in cluster_obj["status"]["conditions"]}
+            assert conds["Ready"]["status"] != "True"
+
+            # No reconcile/flush thread left parked past the budget.
+            time.sleep(0.2)
+            stuck = [
+                t.name for t in threading.enumerate()
+                if t.name.startswith("dispatch-flush-")
+            ]
+            assert not stuck, stuck
+
+            # -- recovery -------------------------------------------------
+            farm.clear_fault(down)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and breaker.state != B.CLOSED:
+                clusterctl.worker.enqueue(down)  # heartbeat = half-open probe
+                while clusterctl.worker.step():
+                    pass
+                time.sleep(0.2)
+            assert breaker.state == B.CLOSED, "probe never closed the breaker"
+
+            deadline = time.monotonic() + 30.0
+            converged = False
+            while time.monotonic() < deadline and not converged:
+                _settle(named, deadline_s=10.0, idle_rounds=4)
+                converged = True
+                for key in fleet.host.keys(ftc.federated.resource):
+                    fed = fleet.host.get(ftc.federated.resource, key)
+                    statuses = {
+                        e["cluster"]: e["status"]
+                        for e in fed.get("status", {}).get("clusters", [])
+                    }
+                    if not statuses or not all(
+                        s == "OK" for s in statuses.values()
+                    ):
+                        converged = False
+                        break
+            assert converged, "shed writes never converged after recovery"
+
+            # Placements bit-identical to the never-faulted (pre-fault)
+            # run, and the down member holds every shed object again.
+            for key, placed in placements.items():
+                fed = fleet.host.get(ftc.federated.resource, key)
+                assert set(C.get_placement(fed, C.SCHEDULER)) == placed, key
+            member = fleet.member(down)
+            for key in down_keys:
+                assert member.try_get(ftc.source.resource, key) is not None, key
+        finally:
+            farm.close()
+
+
+@pytest.mark.slow
+class TestFlappingMemberChaos:
+    """Long scenario: threaded controllers over the kwok-lite farm with
+    one member flapping (partition toggling) during churn — the fleet
+    must converge after the flap expires with no worker panics and no
+    leaked reconcile threads."""
+
+    def test_flapping_member_converges(self, monkeypatch):
+        monkeypatch.setenv("KT_DISPATCH_DEADLINE_S", "2.0")
+        monkeypatch.setenv("KT_BREAKER_OPEN_S", "0.5")
+        monkeypatch.setenv("KT_BREAKER_STALL_S", "0.5")
+
+        import dataclasses
+        import random
+
+        from kubeadmiral_tpu.federation.clusterctl import (
+            FEDERATED_CLUSTERS,
+            FederatedClusterController,
+            NODES,
+        )
+        from kubeadmiral_tpu.federation.federate import FederateController
+        from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+        from kubeadmiral_tpu.federation.sync import SyncController
+        from kubeadmiral_tpu.models.ftc import default_ftcs
+        from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+        from kubeadmiral_tpu.testing.fakekube import (
+            AlreadyExists,
+            Conflict,
+            NotFound,
+        )
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        ftc = dataclasses.replace(
+            next(f for f in default_ftcs() if f.name == "deployments.apps"),
+            controllers=(("kubeadmiral.io/global-scheduler",),),
+        )
+        farm = KwokLiteFarm()
+        farm.fleet.factory.timeout = 1.0
+        fleet = farm.fleet
+        controllers = []
+        before_threads = {t.ident for t in threading.enumerate()}
+        try:
+            for name in ("f1", "f2", "f3", "f4"):
+                member = farm.add_member(name)
+                member.create(NODES, make_node("n1", "64", "128Gi"))
+                fleet.host.create(
+                    FEDERATED_CLUSTERS,
+                    {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                     "kind": "FederatedCluster",
+                     "metadata": {"name": name},
+                     "spec": farm.cluster_spec(name)},
+                )
+            fleet.host.create(
+                PROPAGATION_POLICIES,
+                {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                 "kind": "PropagationPolicy",
+                 "metadata": {"name": "pp", "namespace": "default"},
+                 "spec": {"schedulingMode": "Divide"}},
+            )
+            controllers = [
+                FederatedClusterController(
+                    fleet, api_resource_probe=["apps/v1/Deployment"],
+                    resync_seconds=1.0,
+                ),
+                FederateController(fleet.host, ftc),
+                SchedulerController(fleet.host, ftc),
+                SyncController(fleet, ftc),
+            ]
+            for ctl in controllers:
+                ctl.worker.run(workers=2)
+
+            rng = random.Random(0)
+            # Flap f2 while objects churn: partitioned 40% of every
+            # 0.5 s period, expiring after 4 s.
+            farm.set_fault(
+                "f2",
+                FaultPolicy(partition=True, flap_period_s=0.5,
+                            flap_duty=0.4, duration_s=4.0),
+            )
+            for i in range(60):
+                name = f"app-{rng.randint(0, 11)}"
+                try:
+                    if rng.random() < 0.6:
+                        fleet.host.create(
+                            ftc.source.resource,
+                            make_deployment(name=name,
+                                            replicas=rng.randint(1, 12)),
+                        )
+                    else:
+                        obj = fleet.host.try_get(
+                            ftc.source.resource, f"default/{name}"
+                        )
+                        if obj is not None:
+                            obj["spec"]["replicas"] = rng.randint(1, 12)
+                            fleet.host.update(ftc.source.resource, obj)
+                except (AlreadyExists, Conflict, NotFound):
+                    pass
+                time.sleep(0.05)
+
+            def divergence():
+                for key in fleet.host.keys(ftc.source.resource):
+                    src = fleet.host.try_get(ftc.source.resource, key)
+                    if src is None:
+                        continue
+                    fed = fleet.host.try_get(ftc.federated.resource, key)
+                    if fed is None:
+                        return f"{key}: no federated object"
+                    placed = C.get_placement(fed, C.SCHEDULER)
+                    if not placed:
+                        return f"{key}: never scheduled"
+                    total = 0
+                    for cname in placed:
+                        obj = fleet.member(cname).try_get(
+                            ftc.source.resource, key
+                        )
+                        if obj is None:
+                            return f"{key}: missing in {cname}"
+                        total += obj["spec"].get("replicas", 0)
+                    if total != src["spec"]["replicas"]:
+                        return f"{key}: {total} != {src['spec']['replicas']}"
+                return None
+
+            deadline = time.monotonic() + 120.0
+            last = "never checked"
+            while time.monotonic() < deadline:
+                time.sleep(0.5)
+                last = divergence()
+                if last is None:
+                    break
+            assert last is None, last
+            for ctl in controllers:
+                panic_count = ctl.metrics.counters.get(
+                    f"{ctl.worker.name}.panic", 0
+                )
+                assert not panic_count, (
+                    f"{ctl.worker.name}: {panic_count} reconcile panics"
+                )
+        finally:
+            for ctl in controllers:
+                ctl.worker.stop()
+            farm.close()
+        # No leaked reconcile threads: everything we started is joined.
+        time.sleep(0.5)
+        leaked = [
+            t.name for t in threading.enumerate()
+            if t.ident not in before_threads
+            and any(t.name.startswith(p) for p in
+                    ("cluster-controller", "federate-", "scheduler-", "sync-"))
+            and t.is_alive()
+        ]
+        assert not leaked, leaked

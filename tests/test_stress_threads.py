@@ -32,6 +32,7 @@ from kubeadmiral_tpu.testing.fakekube import (
     Conflict,
     NotFound,
 )
+from kubeadmiral_tpu.transport.faults import FaultInjector, FaultPolicy, FaultyKube
 
 
 class TestThreadStress:
@@ -41,6 +42,11 @@ class TestThreadStress:
             controllers=(("kubeadmiral.io/global-scheduler",),),
         )
         fleet = ClusterFleet()
+        # c3 is injectable: mid-storm it FLAPS (partition toggling) so
+        # the breaker/dispatch fault-tolerance path runs under the same
+        # thread fire as everything else.  Wrapped BEFORE controllers
+        # attach their member watches.
+        self.injector = FaultInjector()
         controllers = [
             FederatedClusterController(
                 fleet, api_resource_probe=["apps/v1/Deployment"],
@@ -53,6 +59,10 @@ class TestThreadStress:
         for name in ("c1", "c2", "c3"):
             member = fleet.add_member(name)
             member.create(NODES, make_node("n1", "64", "128Gi"))
+            if name == "c3":
+                fleet.members[name] = FaultyKube(
+                    member, name, self.injector, timeout=0.05
+                )
             fleet.host.create(
                 FEDERATED_CLUSTERS,
                 {"apiVersion": "core.kubeadmiral.io/v1alpha1",
@@ -87,6 +97,12 @@ class TestThreadStress:
             assert not panic_count, (
                 f"{ctl.worker.name}: {panic_count} reconcile panics"
             )
+        # No leaked reconcile threads: every worker thread stop() started
+        # joining is actually gone (a flapping member must not strand a
+        # reconcile parked on a fault).
+        for ctl in controllers:
+            leaked = [t.name for t in ctl.worker._threads if t.is_alive()]
+            assert not leaked, leaked
 
     def _storm_and_converge(self, fleet, ftc, controllers):
         fuzz_errors: list[BaseException] = []
@@ -120,10 +136,21 @@ class TestThreadStress:
                         pass  # expected races
                     if i % 20 == 19:
                         # Flap a member's health mid-storm.
-                        member = fleet.members[f"c{rng.randint(1, 3)}"]
+                        member = fleet.members[f"c{rng.randint(1, 2)}"]
                         member.healthy = False
                         time.sleep(0.002)
                         member.healthy = True
+                    if seed == 0 and i == 40:
+                        # Mid-storm, c3 starts FLAPPING at the transport
+                        # level: partitions toggling every 100 ms for
+                        # 1.5 s, then the policy self-expires — the
+                        # breaker/shed/requeue machinery must absorb it
+                        # and the world must still converge.
+                        self.injector.set_fault(
+                            "c3",
+                            FaultPolicy(partition=True, flap_period_s=0.1,
+                                        flap_duty=0.4, duration_s=1.5),
+                        )
                     time.sleep(0.001)
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 fuzz_errors.append(e)

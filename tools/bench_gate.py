@@ -178,6 +178,37 @@ def gate(rounds: list[dict], tolerance: float) -> int:
     return 0 if ok else 1
 
 
+def report_e2e_chaos(root: Path) -> None:
+    """Informational: surface the newest e2e artifact's degraded-fleet
+    (chaos) numbers — tick-stall p99 and shed-write counts — next to
+    the gate output.  Never gated: chaos rounds measure fault handling,
+    not steady-state throughput."""
+    candidates = sorted(
+        root.glob("BENCH_E2E*.json"), key=lambda p: p.stat().st_mtime
+    )
+    for path in reversed(candidates):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        detail = (doc.get("parsed") or {}).get("detail") or doc.get("detail") or {}
+        chaos = detail.get("chaos")
+        if not chaos:
+            continue
+        if chaos.get("skipped"):
+            print(f"bench-gate: {path.name} chaos skipped ({chaos['skipped']})")
+            return
+        print(
+            f"bench-gate: {path.name} chaos: down={chaos.get('down_member')} "
+            f"flap={chaos.get('flapping_member')} "
+            f"stall_p99_s={chaos.get('stall_p99_s')} "
+            f"shed_writes={chaos.get('shed_writes')} "
+            f"breaker_opens={chaos.get('breaker_opens')} — informational, "
+            f"not gated"
+        )
+        return
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -191,7 +222,9 @@ def main() -> int:
         "--root", type=Path, default=REPO, help="artifact directory"
     )
     args = parser.parse_args()
-    return gate(load_rounds(args.root), args.tolerance)
+    rc = gate(load_rounds(args.root), args.tolerance)
+    report_e2e_chaos(args.root)
+    return rc
 
 
 if __name__ == "__main__":
