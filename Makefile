@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-gate graft-check graft-dryrun native metrics-lint chaos chaos-e2e
+.PHONY: test test-fast bench bench-churn bench-gate graft-check graft-dryrun native metrics-lint chaos chaos-e2e
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -51,6 +51,18 @@ test-fast: metrics-lint
 
 bench:
 	python bench.py
+
+# Sustained-churn streaming scenario at a tier-1-budget config: object
+# arrivals/updates + periodic capacity drift stream through the slab
+# scheduler; reports sustained objects-revalidated/s and event ->
+# placement-visible latency p50/p99, and writes BENCH_CHURN_r<n>.json
+# for bench-gate (see docs/operations.md "Streaming tick").
+bench-churn:
+	$(PYTEST_ENV) BENCH_SCENARIO=churn_rate \
+		BENCH_OBJECTS=$${BENCH_OBJECTS:-4096} \
+		BENCH_CLUSTERS=$${BENCH_CLUSTERS:-256} \
+		BENCH_CHURN_SECONDS=$${BENCH_CHURN_SECONDS:-8} \
+		python bench.py
 
 graft-check:
 	python -c "import __graft_entry__ as g; fn, args = g.entry(); fn(*args); print('entry ok')"

@@ -260,6 +260,7 @@ def time_batched(rng, units, clusters, followers):
     # patch + on-device delta-fetch machinery.
     detail = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
     fetch_bytes0 = engine.fetch_bytes_total
+    overflow_t0 = engine.overflow_rows_total
     t0 = time.perf_counter()
     for _ in range(TICKS):
         units = churn(rng, units)
@@ -268,6 +269,7 @@ def time_batched(rng, units, clusters, followers):
             detail[stage] = detail.get(stage, 0.0) + secs
     dt = (time.perf_counter() - t0) / TICKS
     tick_fetch_bytes = (engine.fetch_bytes_total - fetch_bytes0) / TICKS
+    tick_overflow_rows = (engine.overflow_rows_total - overflow_t0) / TICKS
     placed = sum(1 for r in results if r.clusters)
 
     # Drift tick: one cluster's resources changed — every row must be
@@ -281,6 +283,7 @@ def time_batched(rng, units, clusters, followers):
     )
     drift_dispatches0 = engine.dispatches_total
     drift_upload0 = dict(engine.upload_bytes)
+    drift_overflow0 = engine.overflow_rows_total
     t_drift = time.perf_counter()
     engine.schedule(units, drifted, follower_index=fidx)
     drift_ms = (time.perf_counter() - t_drift) * 1e3
@@ -313,6 +316,13 @@ def time_batched(rng, units, clusters, followers):
     detail["fetch_bytes"] = round(tick_fetch_bytes)
     detail["fetch_bytes_run_total"] = engine.fetch_bytes_total
     detail["fetch_overflow_rows"] = engine.overflow_rows_total
+    # Per-phase engine_fetch_overflow_rows_total deltas (ISSUE 7): the
+    # adaptive-K hysteresis/widen-once escape is judged by these, and
+    # bench-gate surfaces them so a K-policy regression is visible.
+    detail["fetch_overflow_rows_tick"] = round(tick_overflow_rows, 1)
+    detail["drift_overflow_rows"] = (
+        engine.overflow_rows_total - drift_overflow0
+    )
     # Narrow solve (ISSUE 5): candidate width, certified-vs-fallback row
     # split for the whole run.  The per-phase wall split (gate_wait /
     # overflow_fetch / narrow_fallback sub-phases) rides stage_ms /
@@ -341,6 +351,243 @@ def time_batched(rng, units, clusters, followers):
     # The units/results of the LAST timed tick: the parity check runs
     # the sequential baseline over this exact world.
     return dt, placed, detail, units, results
+
+
+def run_churn_scenario() -> None:
+    """--scenario churn_rate: sustained-churn streaming benchmark.
+
+    Injects object arrivals/updates (plus periodic single-member
+    capacity drift) into a StreamingScheduler during steady operation
+    and measures what the always-on pipeline sustains: every slab flush
+    re-decides the WHOLE world through the engine's incremental paths,
+    so the headline value is objects-revalidated/s — directly comparable
+    to the steady-tick objects/s metric — with the event ingest rate and
+    event->placement-visible latency p50/p99 in detail.
+
+    Knobs: BENCH_CHURN_SECONDS (measurement window, default 10),
+    BENCH_CHURN_RATE (events/s; 0 = saturate, the default),
+    BENCH_CHURN_ARRIVALS (fraction of events that are NEW objects,
+    default 0.25), BENCH_CHURN_DRIFT_EVERY (capacity-drift event every
+    N flushes, 0 = off, default 10), KT_SLAB_ROWS / KT_SLAB_AGE_MS
+    (slab watermarks).  ``make bench-churn`` runs this at a small config
+    inside the tier-1 time budget and writes BENCH_CHURN_r<n>.json for
+    tools/bench_gate.py."""
+    import dataclasses
+
+    from kubeadmiral_tpu.runtime.metrics import Metrics
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+    from kubeadmiral_tpu.scheduler.streaming import StreamingScheduler
+
+    rng = np.random.default_rng(20260729)
+    units, clusters, _followers = build_world(rng)
+    names = [c.name for c in clusters]
+    metrics = Metrics()
+    engine = SchedulerEngine(chunk_size=CHUNK, metrics=metrics)
+    t_warm = time.perf_counter()
+    engine.prewarm(
+        N_OBJECTS,
+        N_CLUSTERS,
+        scalar_resources=("nvidia.com/gpu",) if CONFIG == "5" else (),
+        wait=True,
+    )
+    prewarm_s = time.perf_counter() - t_warm
+    stream = StreamingScheduler(engine, clusters, units, metrics=metrics)
+    t_cold = time.perf_counter()
+    stream.flush()  # cold tick
+    cold_ms = (time.perf_counter() - t_cold) * 1e3
+    # Warm the streaming shapes: one churn slab + one capacity drift.
+    for i in rng.integers(0, len(units), max(1, len(units) // 100)):
+        su = units[int(i)]
+        stream.offer(
+            dataclasses.replace(
+                su, desired_replicas=(su.desired_replicas or 1) + 1
+            )
+        )
+    stream.flush()
+    stream.update_cluster(
+        dataclasses.replace(
+            clusters[1],
+            available={
+                k: max(0, int(v * 0.9)) for k, v in clusters[1].available.items()
+            },
+        )
+    )
+    stream.flush()
+
+    duration = float(os.environ.get("BENCH_CHURN_SECONDS", "10"))
+    rate = float(os.environ.get("BENCH_CHURN_RATE", "0"))
+    arrivals_frac = float(os.environ.get("BENCH_CHURN_ARRIVALS", "0.25"))
+    drift_every = int(os.environ.get("BENCH_CHURN_DRIFT_EVERY", "10"))
+
+    def make_event(seq: int):
+        if rng.random() < arrivals_frac:
+            return T_unit_arrival(rng, seq, names)
+        i = int(rng.integers(0, len(units)))
+        su = units[i]
+        return dataclasses.replace(
+            su,
+            desired_replicas=(su.desired_replicas or 1)
+            + int(rng.integers(1, 9)),
+        )
+
+    flushes0 = stream.flushes
+    rows0 = stream.rows_flushed
+    events = 0
+    drifts = 0
+    seq = 0
+    overflow0 = engine.overflow_rows_total
+    stage_totals: dict[str, float] = {}
+    lat0 = len(stream.latencies)
+    last_flushes = stream.flushes
+    t0 = time.perf_counter()
+    deadline = t0 + duration
+    credit = 0.0
+    t_prev = t0
+    while True:
+        now = time.perf_counter()
+        if now >= deadline:
+            break
+        if rate > 0:
+            credit += (now - t_prev) * rate
+            t_prev = now
+            burst = int(min(credit, stream.slab_rows))
+            credit -= burst
+        else:
+            burst = stream.slab_rows
+        for _ in range(burst):
+            stream.offer(make_event(seq))
+            seq += 1
+            events += 1
+        if rate > 0 and burst == 0:
+            time.sleep(0.001)
+        if stream.pump() is not None:
+            for stage, secs in engine.timings.items():
+                stage_totals[stage] = stage_totals.get(stage, 0.0) + secs
+            if (
+                drift_every
+                and (stream.flushes - last_flushes) >= 0
+                and stream.flushes % drift_every == 0
+            ):
+                j = int(rng.integers(0, len(clusters)))
+                base = stream.clusters[j]
+                stream.update_cluster(
+                    dataclasses.replace(
+                        base,
+                        available={
+                            k: max(1, int(v * float(rng.uniform(0.6, 1.0))))
+                            for k, v in base.available.items()
+                        },
+                    )
+                )
+                drifts += 1
+    if stream.pending():
+        stream.flush()
+        for stage, secs in engine.timings.items():
+            stage_totals[stage] = stage_totals.get(stage, 0.0) + secs
+    elapsed = time.perf_counter() - t0
+    flushes = stream.flushes - flushes0
+    rows = stream.rows_flushed - rows0
+    world = len(stream.units)
+    lat = np.asarray(list(stream.latencies)[lat0:], float) * 1e3
+    value = world * flushes / elapsed if elapsed > 0 else 0.0
+
+    from kubeadmiral_tpu.bench_support import bench_platform_detail
+
+    detail = {
+        "config": CONFIG,
+        "scenario": "churn_rate",
+        **bench_platform_detail(),
+        "world_rows": world,
+        "flushes": flushes,
+        "events": events,
+        "events_per_sec": round(events / elapsed, 1) if elapsed else 0.0,
+        "rows_flushed": rows,
+        "capacity_drifts": drifts,
+        "elapsed_s": round(elapsed, 2),
+        "latency_ms_p50": round(float(np.percentile(lat, 50)), 2)
+        if lat.size
+        else None,
+        "latency_ms_p99": round(float(np.percentile(lat, 99)), 2)
+        if lat.size
+        else None,
+        "latency_ms_max": round(float(lat.max()), 2) if lat.size else None,
+        "slab_rows": stream.slab_rows,
+        "slab_age_ms": stream.slab_age_ms,
+        "flush_triggers": dict(stream.flush_stats),
+        "stage_totals_ms": {
+            k: round(v * 1e3, 1) for k, v in stage_totals.items()
+        },
+        "drift_gate": dict(engine.drift_stats),
+        "fetch_overflow_rows": engine.overflow_rows_total - overflow0,
+        "narrow": {
+            "enabled": engine.narrow,
+            "m": engine.narrow_last_m,
+            "rows": engine.narrow_stats["rows"],
+            "fallback_rows": engine.narrow_stats["fallback"],
+        },
+        "prewarm_s": round(prewarm_s, 1),
+        "cold_tick_ms": round(cold_ms, 1),
+    }
+    result = {
+        "metric": f"churn_objs_per_sec_{N_OBJECTS}x{N_CLUSTERS}",
+        "value": round(value, 1),
+        "unit": "objects/s",
+        "detail": detail,
+    }
+    print(json.dumps(result))
+    print(
+        f"# churn_rate config {CONFIG}: {value:.0f} obj/s revalidated "
+        f"({events} events, {flushes} flushes, {drifts} drifts) in "
+        f"{elapsed:.1f}s; latency p50={detail['latency_ms_p50']}ms "
+        f"p99={detail['latency_ms_p99']}ms",
+        file=sys.stderr,
+    )
+    _save_churn_artifact(result)
+
+
+def T_unit_arrival(rng, seq: int, names) -> object:
+    """A fresh arriving object (the streaming scheduler places it in a
+    placeholder slot)."""
+    from kubeadmiral_tpu.models.types import (
+        MODE_DIVIDE,
+        SchedulingUnit,
+        parse_resources,
+    )
+
+    divide = seq % 3 != 0
+    return SchedulingUnit(
+        gvk="apps/v1/Deployment",
+        namespace=f"arrivals-{seq % 13}",
+        name=f"arrival-{seq:07d}",
+        scheduling_mode=MODE_DIVIDE if divide else "Duplicate",
+        desired_replicas=int(rng.integers(1, 50)) if divide else None,
+        resource_request=parse_resources(
+            {
+                "cpu": f"{int(rng.integers(0, 8)) * 250}m",
+                "memory": f"{int(rng.integers(0, 16)) * 256}Mi",
+            }
+        ),
+        max_clusters=int(rng.integers(1, 20)) if seq % 5 == 0 else None,
+    )
+
+
+def _save_churn_artifact(result: dict) -> None:
+    """Persist the scenario result as BENCH_CHURN_r<n>.json (next free
+    round number) so tools/bench_gate.py can compare rounds."""
+    import re as _re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(m.group(1))
+        for f in os.listdir(root)
+        if (m := _re.match(r"BENCH_CHURN_r(\d+)\.json$", f))
+    ]
+    path = os.path.join(
+        root, f"BENCH_CHURN_r{max(rounds, default=0) + 1:02d}.json"
+    )
+    with open(path, "w") as fh:
+        json.dump({"rc": 0, "parsed": result}, fh, indent=1)
+    print(f"# churn artifact: {os.path.basename(path)}", file=sys.stderr)
 
 
 def _fingerprint_native(sel, rep, cnt) -> np.ndarray:
@@ -461,6 +708,14 @@ def main():
     from kubeadmiral_tpu.runtime.gctune import tune_gc_for_service
 
     tune_gc_for_service()
+    scenario = os.environ.get("BENCH_SCENARIO", "")
+    if "--scenario" in sys.argv:
+        scenario = sys.argv[sys.argv.index("--scenario") + 1]
+    if scenario == "churn_rate":
+        run_churn_scenario()
+        return
+    if scenario:
+        raise SystemExit(f"unknown bench scenario {scenario!r}")
     rng = np.random.default_rng(20260729)
     units, clusters, followers = build_world(rng)
 

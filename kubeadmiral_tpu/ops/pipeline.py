@@ -698,9 +698,13 @@ def schedule_tick_narrow(
 
 DRIFT_RECOMPUTE = 1  # gate-mask bit: row must be re-scheduled
 DRIFT_WCHECK = 2     # gate-mask bit: row needs the dynamic-weight check
+DRIFT_FITFLIP = 4    # gate-mask bit: feasibility flipped at a changed
+#                      column — the row's score normalization may shift,
+#                      so the sort-free drift_resolve path cannot take it
+#                      (the engine routes it through the slab re-solve).
 
 # Widest delta the exact top-K membership refinement runs at: the rank
-# counts cost O(B x C x D) compares, so wider drifts use the
+# counts cost O(Bfin x C x D) compares, so wider drifts use the
 # conservative any-delta-column-feasible rule instead.
 DRIFT_REFINE_MAX_COLS = 8
 
@@ -735,6 +739,10 @@ def _drift_classify(
     mode_divide,    # bool[B]
     weights_given,  # bool[B]
     sticky_active,  # bool[B]
+    fin_idx,        # i32[Nf] rows with a finite maxClusters (host-known;
+    #                 pad: out of range).  Only those rows can have an
+    #                 engaged top-K cut, so the rank-count refinement
+    #                 runs on this gathered subset instead of all B rows.
 ):
     """Shared tail of the dense/compact drift gates.
 
@@ -773,44 +781,67 @@ def _drift_classify(
         # delta column's top-K membership flips (unchanged columns keep
         # their relative order, so one can only enter/leave when a
         # delta column leaves/enters).  Membership is counted with the
-        # select stage's own comparator: (-total, index) ascending.
+        # select stage's own comparator — (-total, index) ascending —
+        # packed into ONE collision-free int64 key per column (the
+        # narrow solve's composite-key trick): "column j outranks delta
+        # column d" is a single int64 compare instead of the
+        # (>, ==, index<) triple, and the counts run over the gathered
+        # finite-K rows only.  kinf rows never consult sel_exposed
+        # (`~kinf & sel_exposed` below), so skipping them is exact; the
+        # r08 gate computed these counts on a dense [B, C, D] int64
+        # broadcast over ALL rows, which was ~95% of the 60.4s c5
+        # gate_wait.
         is_delta = jnp.zeros(c, bool).at[delta_idx].set(
             delta_valid, mode="drop"
         )
-        s_plane = prev_scores.astype(jnp.int64)[:, :, None]   # [B, C, 1]
-        j_idx = jnp.arange(c, dtype=jnp.int32)[None, :, None]
+        ridx = jnp.clip(fin_idx, 0, b - 1)
+        pf_g = pf[ridx]                            # [Nf, C]
+        pf_d_g = pf_d[ridx]                        # [Nf, D]
+        iota64 = lax.broadcasted_iota(jnp.int64, pf_g.shape, 1)
+        comp = (-prev_scores[ridx].astype(jnp.int64)) * c + iota64
+        comp_u = jnp.where(pf_g & ~is_delta[None, :], comp, _CERT_INF)
+        didx64 = delta_idx.astype(jnp.int64)[None, :]
+        tot_old_g = tot_old_d[ridx]
+        tot_new_g = tot_new_d[ridx]
+        key_old = (-tot_old_g) * c + didx64        # [Nf, D]
+        key_new = (-tot_new_g) * c + didx64
 
-        def above_counts(tot_d):
-            t = tot_d[:, None, :]                              # [B, 1, D]
-            beats = (s_plane > t) | (
-                (s_plane == t) & (j_idx < delta_idx[None, None, :])
+        def above_counts(key_d):
+            # Unchanged-column counts: one fused [Nf, C] compare+reduce
+            # per delta column (python loop over the static D).
+            cnt = jnp.stack(
+                [
+                    jnp.sum(comp_u < key_d[:, t : t + 1], axis=1,
+                            dtype=jnp.int32)
+                    for t in range(d)
+                ],
+                axis=1,
             )
-            unchanged = (pf & ~is_delta[None, :])[:, :, None]
-            cnt = jnp.sum(beats & unchanged, axis=1, dtype=jnp.int32)
-            # Delta-vs-delta comparisons use the same snapshot's totals.
-            te = tot_d[:, :, None]                             # [B, D(e), 1]
-            td = tot_d[:, None, :]                             # [B, 1, D(d)]
-            e_beats = (te > td) | (
-                (te == td)
-                & (delta_idx[:, None] < delta_idx[None, :])[None, :, :]
-            )
-            e_mask = (pf_d & valid)[:, :, None]
+            # Delta-vs-delta comparisons use the same snapshot's totals
+            # ([Nf, D, D] is tiny; keys are collision-free, so one int64
+            # compare reproduces the (total desc, index asc) order).
+            e_beats = key_d[:, :, None] < key_d[:, None, :]
+            e_mask = (pf_d_g & valid)[:, :, None]
             return cnt + jnp.sum(e_beats & e_mask, axis=1, dtype=jnp.int32)
 
-        k = jnp.clip(max_clusters, 0, c)[:, None]
-        member_old = pf_d & (above_counts(tot_old_d) < k)
-        member_new = pf_d & (above_counts(tot_new_d) < k)
-        sel_exposed = ((member_old != member_new) & valid).any(axis=1)
+        k = jnp.clip(max_clusters[ridx], 0, c)[:, None]
+        member_old = pf_d_g & (above_counts(key_old) < k)
+        member_new = pf_d_g & (above_counts(key_new) < k)
+        sel_moved_g = ((member_old != member_new) & valid).any(axis=1)
         # Finite-K rows with DYNAMIC weights whose top-K selection
         # touches a cpu-changed column: their weight set is the top-K
         # selection (not the feasible set), so the wcheck comparison
         # below cannot decide them — recompute.  (member_old|member_new
         # is exact top-K membership from the rank counts.)
-        dyn_fin = (
+        dyn_fin_g = (
             (member_old | member_new) & (delta_cpu & delta_valid)[None, :]
         ).any(axis=1)
-        sel_exposed = sel_exposed | (
-            mode_divide & ~weights_given & dyn_fin
+        exposed_g = sel_moved_g | (
+            mode_divide[ridx] & ~weights_given[ridx] & dyn_fin_g
+        )
+        # Scatter back to [B]; padded fin slots are out of range -> drop.
+        sel_exposed = (
+            jnp.zeros(b, bool).at[fin_idx].set(exposed_g, mode="drop")
         )
     else:
         # Conservative: any feasible delta column may cross the K cut
@@ -834,6 +865,7 @@ def _drift_classify(
     mask = (
         recompute.astype(jnp.int8) * DRIFT_RECOMPUTE
         + wcheck.astype(jnp.int8) * DRIFT_WCHECK
+        + (fitflip & ~sticky_active).astype(jnp.int8) * DRIFT_FITFLIP
     )
     return mask, new_scores
 
@@ -849,6 +881,7 @@ def drift_gate_dense(
     delta_idx,
     delta_valid,
     delta_cpu,
+    fin_idx,
 ):
     """Drift gate over dense cached per-object planes.
 
@@ -856,7 +889,9 @@ def drift_gate_dense(
     field that is not cluster-axis-only); ``*_old_d``/``*_new_d`` are
     the OLD/NEW cluster tensors pre-sliced at the changed columns
     (i64[D, R]); ``delta_idx`` i32[D] names the changed columns (padded
-    entries carry an out-of-range index and ``delta_valid`` False).
+    entries carry an out-of-range index and ``delta_valid`` False);
+    ``fin_idx`` i32[Nf] the rows with a finite maxClusters (the only
+    rows the rank-count refinement must visit; pad out of range).
     Returns (i8[B] mask, i32[B, C] refreshed score plane)."""
     b = per_object["total"].shape[0]
     _note_trace("drift_gate", b, prev_feas.shape[1])
@@ -894,6 +929,7 @@ def drift_gate_dense(
         per_object["mode_divide"],
         per_object["weights_given"],
         sticky_active,
+        fin_idx,
     )
 
 
@@ -909,6 +945,7 @@ def drift_gate_compact(
     delta_idx,
     delta_valid,
     delta_cpu,
+    fin_idx,
     cur_absent,
 ):
     """Compact-format drift gate: the changed columns' filter masks are
@@ -962,6 +999,7 @@ def drift_gate_compact(
         per_object["mode_divide"],
         per_object["weights_given"],
         sticky_active,
+        fin_idx,
     )
 
 
@@ -984,6 +1022,185 @@ def drift_wcheck(
     w_old = dynamic_weights(sel, cpu_alloc_old, cpu_avail_old)
     w_new = dynamic_weights(sel, cpu_alloc_new, cpu_avail_new)
     return (w_old != w_new).any(axis=-1).astype(jnp.int8)
+
+
+def drift_resolve(
+    inp: TickInputs,   # gathered survivor rows [n, C] (expanded)
+    prev_feas_rows,    # i8[n, C] previous feasibility at those rows
+    scores_rows,       # i32[n, C] gate-refreshed score plane rows (NEW totals)
+    reasons_rows,      # i32[n, C] previous reason plane rows
+    alloc_old_d,       # i64[D, R] old cluster tensors at the changed columns
+    used_old_d,
+    alloc_new_d,       # i64[D, R] new cluster tensors at the changed columns
+    used_new_d,
+    delta_idx,         # i32[D] changed column indices (pad: out of range)
+    delta_valid,       # bool[D]
+    m: int,            # static candidate-slot budget (engine narrow M)
+) -> tuple[TickOutputs, jax.Array]:
+    """Sort-free re-solve of drift-gate survivors from stored state.
+
+    The gate proves (for rows without a feasibility flip) that phase 1
+    is already known: feasibility is the stored plane untouched, and the
+    refreshed score plane IS the new totals (normalization cannot move
+    without a fit flip — the gate's exactness argument, step 2).  What
+    remains is select + planner, and both run over a candidate set built
+    WITHOUT the narrow solve's full-C sorts — the r08 drift recompute
+    spent ~35s at c5 re-running generic narrow slabs whose per-slab cost
+    is dominated by exactly those sorts plus a phase 1 the gate had
+    already answered.
+
+    Candidate completeness (provable, not hoped-for): the new top-K is a
+    subset of
+        old top-K  ∪  changed columns  ∪  best-D feasible outsiders,
+    because unchanged columns keep their relative order: an outsider can
+    enter the top-K only when a changed column leaves it (at most D of
+    those), and entering outsiders must be the best-ranked outsiders —
+    their keys did not move.  The old top-K is recovered exactly from
+    the stored planes: feasible with no MAX_CLUSTERS reason bit (the
+    select-stage cut is the only thing that separates a feasible column
+    from the selection, and stored reasons are 0 exactly where
+    selected).  The best-D outsiders come from D iterated argmins over
+    the composite (-total, index) key — D fused [n, C] passes, no sort.
+
+    The planner then runs `plan_batch_narrow` over the same candidate
+    slots with a ZERO phantom tail: selection ⊆ candidates, so no member
+    weight lives outside the slots and the narrow planner is exact by
+    its own certificate.
+
+    Returns (outputs [n, C], cert i8[n]).  cert == 1 guarantees the
+    row's outputs are bit-identical to a full re-solve; rows with 0
+    (fit moved at a changed column, kinf, sticky, candidate overflow,
+    planner cert failure) must take the slab path instead.  Reason
+    planes are exact, not merely fresh-as-of-last-recompute: the
+    topology-derived filter bits cannot move under capacity drift, and
+    the ONE capacity-derived bit (resources_fit, which the skip path is
+    allowed to leave stale on infeasible columns) is recomputed dense
+    for these few rows; _finalize then re-derives every select/
+    replica-stage bit from the new selection."""
+    n, c = prev_feas_rows.shape
+    _note_trace("drift_resolve", n, c)
+    d = delta_idx.shape[0]
+    feas = prev_feas_rows != 0
+    totals = scores_rows
+    rows_n = jnp.arange(n, dtype=jnp.int32)[:, None]
+
+    # --- cert leg 1: fit must not move at any changed column (a fit
+    # flip on an already-infeasible column would stale the reason plane;
+    # a feasibility flip would shift normalization — both bail).
+    fit_old_d = F.resources_fit(inp.request, alloc_old_d, used_old_d)
+    fit_new_d = F.resources_fit(inp.request, alloc_new_d, used_new_d)
+    cert = ~jnp.any((fit_old_d != fit_new_d) & delta_valid[None, :], axis=1)
+
+    # --- recover the old select-stage selection from stored planes.
+    sel_stage = feas & (
+        (reasons_rows & jnp.int32(RSN.REASON_MAX_CLUSTERS)) == 0
+    )
+    nfeas = jnp.sum(feas, axis=-1, dtype=jnp.int32)
+    k_eff = jnp.where(
+        inp.max_clusters < 0, 0, jnp.minimum(inp.max_clusters, jnp.int32(c))
+    )
+    kinf = (
+        (inp.max_clusters == INT32_INF)
+        | (inp.max_clusters < 0)
+        | (k_eff >= nfeas)
+    )
+    sticky_active = inp.sticky & jnp.any(inp.current_mask, axis=-1)
+    k_sel = jnp.sum(sel_stage, axis=-1, dtype=jnp.int32)
+    cert = cert & ~kinf & ~sticky_active & (k_sel == k_eff)
+
+    # --- candidate set: old selection ∪ feasible changed columns ∪
+    # best-D feasible outsiders by the NEW composite key.
+    is_delta = jnp.zeros(c, bool).at[delta_idx].set(delta_valid, mode="drop")
+    iota64 = lax.broadcasted_iota(jnp.int64, (n, c), 1)
+    comp = (-totals.astype(jnp.int64)) * c + iota64
+    avail = feas & ~sel_stage & ~is_delta[None, :]
+    compm = jnp.where(avail, comp, _CERT_INF)
+    entrant = jnp.zeros((n, c), bool)
+    # At most one outsider can enter per VALID delta column (an entry
+    # requires a delta leaving the top-K), so the static D-iteration
+    # loop masks picks past that count — smaller candidate sets, and
+    # narrow M budgets that a padded delta axis would otherwise blow.
+    nvd = jnp.sum(delta_valid.astype(jnp.int32))
+    for t in range(d):
+        mval = jnp.min(compm, axis=-1, keepdims=True)
+        pick = (compm == mval) & (mval < _CERT_INF) & (t < nvd)
+        entrant = entrant | pick
+        compm = jnp.where(pick, _CERT_INF, compm)
+    cand_mask = sel_stage | (is_delta[None, :] & feas) | entrant
+    n_cand = jnp.sum(cand_mask, axis=-1, dtype=jnp.int32)
+    cert = cert & (n_cand <= m)
+
+    # Compact candidate columns into m ascending slots (sentinel c on
+    # unused slots; cumsum positions keep them unique and ordered, so
+    # slot rank order == column order, the narrow tie-break contract).
+    pos = jnp.cumsum(cand_mask, axis=-1) - 1
+    colidx = lax.broadcasted_iota(jnp.int32, (n, c), 1)
+    cand = jnp.full((n, m), c, jnp.int32).at[
+        rows_n, jnp.where(cand_mask, pos, m)
+    ].set(colidx, mode="drop")
+    valid_slot = cand < c
+    cand_c = jnp.minimum(cand, c - 1)
+
+    def take(plane):
+        return jnp.take_along_axis(plane, cand_c, axis=-1)
+
+    # --- select over the candidate slots.
+    fea_s = take(feas) & valid_slot
+    sel_n = select_topk(take(totals), fea_s, inp.max_clusters)
+    selected = (
+        jnp.zeros((n, c), bool).at[rows_n, cand].set(sel_n, mode="drop")
+    )
+
+    # --- planner over the same slots, zero phantom tail.
+    weights = _planner_weights(inp, selected)
+    member_p = sel_n
+    zero_tail = jnp.zeros(n, jnp.int32)
+    no_tail = jnp.full(n, -1, jnp.int64)
+    comp_true = processing_key(
+        take(weights), take(inp.tiebreak), jnp.zeros((n, m), bool)
+    )
+    plan_out, pcert = plan_batch_narrow(
+        PlannerInputs(
+            weight=jnp.where(member_p, take(weights), 0),
+            min_replicas=jnp.where(member_p, take(inp.min_replicas), 0),
+            max_replicas=take(inp.max_replicas),
+            scale_max=take(inp.scale_max),
+            capacity=take(inp.capacity),
+            tiebreak=take(inp.tiebreak),
+            member=member_p,
+            total=inp.total,
+            current=take(_current_plane(inp)),
+            avoid_disruption=inp.avoid_disruption,
+            keep_unschedulable=inp.keep_unschedulable,
+        ),
+        zero_tail,
+        no_tail,
+        comp_true,
+    )
+    divide_n = (plan_out.plan + plan_out.overflow).astype(jnp.int64)
+    divide_replicas = (
+        jnp.zeros((n, c), jnp.int64).at[rows_n, cand].set(divide_n, mode="drop")
+    )
+    cert = cert & (~inp.mode_divide | pcert)
+
+    # Filter-stage reasons: stored bits minus the select/replica-stage
+    # bits (re-derived below) with the resources_fit bit RECOMPUTED
+    # against the new cluster planes — the only filter bit capacity
+    # drift can move, and the one the skip path may have left stale on
+    # infeasible columns of earlier drifts.  [n, C, R] over the few
+    # survivor rows, a fraction of the phase 1 these rows never re-ran.
+    fit_ok = F.resources_fit(inp.request, inp.alloc, inp.used)
+    fit_bit = jnp.where(
+        inp.filter_enabled[:, F.F_RESOURCES_FIT, None] & ~fit_ok,
+        jnp.int32(RSN.REASON_RESOURCES_FIT),
+        0,
+    )
+    base_reasons = (
+        reasons_rows
+        & ~jnp.int32(RSN.SELECT_REASON_MASK | RSN.REASON_RESOURCES_FIT)
+    ) | fit_bit
+    out = _finalize(inp, feas, base_reasons, totals, selected, divide_replicas)
+    return out, cert.astype(jnp.int8)
 
 
 # -- packed placement export ---------------------------------------------

@@ -106,8 +106,39 @@ CATALOG: dict[str, MetricSpec] = {
         "Drift-gate row classification on cluster-capacity drift ticks: "
         "skip = provably identical outputs, wcheck = dynamic-weight "
         "comparison rows, wcheck_changed = weight comparisons that "
-        "found a difference, recompute = rows re-scheduled through the "
-        "sub-batch slabs."),
+        "found a difference, resolve = survivors settled by the "
+        "sort-free drift-resolve program, resolve_fallback = resolve "
+        "rows whose certificate failed (slab re-solve), recompute = "
+        "rows re-scheduled through the sub-batch slabs."),
+    "engine_gate_inflight": MetricSpec(
+        "gauge", "gates", (),
+        "Drift-gate programs currently in flight on the device (set at "
+        "gate-drain entry, cleared when every gated chunk settles)."),
+    "engine_stream_events_total": MetricSpec(
+        "counter", "events", ("kind",),
+        "Streaming-scheduler events flushed, by kind: upsert (object "
+        "add/update), delete, capacity (cluster drift snapshot)."),
+    "engine_stream_flushes_total": MetricSpec(
+        "counter", "flushes", ("trigger",),
+        "Row-slab flushes by watermark trigger: rows (KT_SLAB_ROWS "
+        "reached), age (KT_SLAB_AGE_MS exceeded), manual."),
+    "engine_stream_slab_depth": MetricSpec(
+        "gauge", "events", (),
+        "Events currently coalescing in the pending row slab."),
+    "engine_stream_slab_rows": MetricSpec(
+        "gauge", "rows", (),
+        "Object rows carried by the most recent slab flush."),
+    "engine_stream_world_rows": MetricSpec(
+        "gauge", "rows", (),
+        "Total unit-list rows owned by the streaming scheduler "
+        "(placeholder slots included)."),
+    "engine_stream_latency_seconds": MetricSpec(
+        "histogram", "seconds", (),
+        "Event enqueue to placement-visible latency (per event, "
+        "recorded at its slab's flush)."),
+    "engine_stream_flush_seconds": MetricSpec(
+        "histogram", "seconds", (),
+        "Wall time of one slab flush (apply events + engine tick)."),
     "engine_narrow_rows_total": MetricSpec(
         "counter", "rows", ("path",),
         "Narrow-solve (KT_NARROW) row outcomes: narrow = rows whose "

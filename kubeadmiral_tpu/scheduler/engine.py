@@ -38,7 +38,9 @@ import numpy as np
 from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.ops import pipeline as pipeline_mod
 from kubeadmiral_tpu.ops.pipeline import (
+    DRIFT_FITFLIP,
     DRIFT_RECOMPUTE,
+    DRIFT_REFINE_MAX_COLS,
     DRIFT_WCHECK,
     NIL_REPLICAS,
     PackedRows,
@@ -46,6 +48,7 @@ from kubeadmiral_tpu.ops.pipeline import (
     TickOutputs,
     drift_gate_compact,
     drift_gate_dense,
+    drift_resolve,
     drift_wcheck,
     expand_compact,
     pack_wire,
@@ -281,6 +284,12 @@ class _CachedChunk:
     # gate's substrate — which rows a cluster-capacity drift can
     # actually move is a function of feasibility at the changed columns.
     prev_feas: Optional[object] = None
+    # Previous tick's reason plane (device i32[B, C]): the drift-resolve
+    # substrate — the old select-stage selection is recovered as
+    # "feasible with no MAX_CLUSTERS bit", and survivor rows' filter
+    # reasons are provably unchanged without a fit flip, so the
+    # sort-free resolve can emit exact reason planes too.
+    prev_reasons: Optional[object] = None
     prev_results: Optional[list] = None
     # Whether prev_results carry decoded score dicts — a want_scores
     # consumer can only ride the noop/delta/sub-batch fast paths when
@@ -302,10 +311,14 @@ class _CachedChunk:
     # force-gathers them, everything else still rides the device diff.
     stale_out_rows: Optional[list] = None
     # Adaptive packed-export K hint: pow2 over the chunk's observed
-    # nsel distribution (99.5th percentile, halving decay — see
-    # SchedulerEngine._observe_nsel); 0 = no observation yet, use the
-    # static maxClusters bound.
+    # nsel distribution (see SchedulerEngine._observe_nsel); 0 = no
+    # observation yet, use the static maxClusters bound.
     pack_k_hint: int = 0
+    # Shrink hysteresis: consecutive observations whose byte-optimal K
+    # was below the standing hint.  The hint only decays after two in a
+    # row, so one narrow-selecting batch can't whipsaw K down and force
+    # the next ordinary batch through the overflow re-fetch.
+    pack_shrink_votes: int = 0
 
 
 def _diff_bits(out, prev: tuple):
@@ -549,14 +562,24 @@ class SchedulerEngine:
         # tick only the cluster planes changed, so the object counter
         # must stay flat (tests/test_drift_tick.py pins this).
         self.upload_bytes = {"object": 0, "cluster": 0}
-        # Drift-gate row classification totals (see _schedule_drift):
+        # Drift-gate row classification totals (see _drain_drift_gates):
         # skip = provably identical, wcheck = dynamic-weight check rows
         # (wcheck_changed of them actually recomputed), recompute = rows
-        # re-scheduled through the sub-batch slabs.
+        # re-scheduled (resolve of them through the sort-free
+        # drift-resolve program, resolve_fallback of THOSE failing its
+        # certificate and dropping to the slab path; the rest slab
+        # directly).
         self.drift_stats = {
             "gated": 0, "skip": 0, "wcheck": 0, "wcheck_changed": 0,
-            "recompute": 0, "fallback": 0,
+            "recompute": 0, "resolve": 0, "resolve_fallback": 0,
+            "fallback": 0,
         }
+        # Sort-free drift resolve (KT_DRIFT_RESOLVE=0 opts out): gate
+        # survivors without a fit flip re-solve from stored planes in
+        # one pass instead of riding full-width narrow slabs.
+        self.drift_resolve = os.environ.get(
+            "KT_DRIFT_RESOLVE", "1"
+        ) not in ("0", "false", "no")
         # Raw device-dispatch count (the number bench.py reports for the
         # cold/drift dispatch-count acceptance): every tick/gather/pack/
         # gate program launch increments it.
@@ -752,9 +775,11 @@ class SchedulerEngine:
         # hetero-height slabs); jax traces one variant per shape tuple.
         self._concat = jax.jit(lambda *xs: jnp.concatenate(xs))
         # Per-shape program caches for the drift gate, its dynamic-
-        # weight check, and the prev-plane scatter repair.
+        # weight check, the sort-free survivor resolve, and the
+        # prev-plane scatter repair.
         self._gate_programs: dict[tuple, object] = {}
         self._wcheck_program_cache: dict[tuple, object] = {}
+        self._resolve_programs: dict[tuple, object] = {}
         self._repair_program_cache: dict[tuple, object] = {}
         # Narrow-solve programs: the (fmt, M) tick variants, the dense
         # row re-solve for uncertified rows, and the 4-plane scatter
@@ -1172,26 +1197,65 @@ class SchedulerEngine:
         the floor (inflating K toward C would cost more wire than the
         re-fetch it avoids).  The hint decays by halving, so a
         shrinking distribution eventually shrinks the wire rows while
-        a widening one raises K immediately."""
+        a widening one raises K immediately.
+
+        Two guards close the adaptive loop's loose ends (ISSUE 7):
+
+        * **Widen-once escape**: when the byte-optimal K still leaves
+          more than KT_PACK_OVERFLOW_PCT (default 1%) of rows
+          overflowing, K widens to the smallest pow2 that meets the
+          target — but only if that costs at most KT_PACK_WIDEN
+          (default 1.25x) of the byte-optimal wire volume.  Narrow-
+          selecting workloads thus hold overflow under the target
+          without a meaningful byte regression; a heavy-Divide tail
+          whose capture would inflate every wire row (c5: widening K
+          costs more than the re-fetch it avoids) stays put, by
+          design — the gate watches the emitted overflow deltas
+          instead.
+        * **Shrink hysteresis**: the halving decay engages only after
+          two consecutive shrink votes, so alternating batch mixes
+          can't oscillate K and re-pay the overflow path every other
+          tick."""
         if entry is None:
             return
         nsel = np.asarray(nsel)
         if nsel.size == 0:
             return
         over_bytes = 4.25 * c_bucket
+
+        def cost_at(k_eff: int) -> float:
+            return nsel.size * (4 * k_eff + 2) * 4 + float(
+                (nsel > k_eff).sum()
+            ) * over_bytes
+
         best_k, best_cost = None, None
         k = _pow2_bucket(self.pack_k_min, 8, 1 << 30)
         while True:
             k_eff = min(k, c_bucket)
-            cost = nsel.size * (4 * k_eff + 2) * 4 + float(
-                (nsel > k_eff).sum()
-            ) * over_bytes
+            cost = cost_at(k_eff)
             if best_cost is None or cost < best_cost:
                 best_k, best_cost = k_eff, cost
             if k_eff >= c_bucket:
                 break
             k *= 2
-        entry.pack_k_hint = max(best_k, entry.pack_k_hint // 2)
+        target = float(os.environ.get("KT_PACK_OVERFLOW_PCT", "0.01"))
+        widen_cap = float(os.environ.get("KT_PACK_WIDEN", "1.25"))
+        if float((nsel > best_k).mean()) > target:
+            k2 = best_k
+            while k2 < c_bucket:
+                k2 = min(k2 * 2, c_bucket)
+                if float((nsel > k2).mean()) <= target:
+                    break
+            if cost_at(k2) <= best_cost * widen_cap:
+                best_k = k2
+        if best_k >= entry.pack_k_hint:
+            entry.pack_k_hint = best_k
+            entry.pack_shrink_votes = 0
+        else:
+            entry.pack_shrink_votes += 1
+            if entry.pack_shrink_votes >= 2:
+                entry.pack_k_hint = max(best_k, entry.pack_k_hint // 2)
+                entry.pack_shrink_votes = 0
 
     def _pcache_entries(self) -> int:
         """Entry count of the persistent XLA compilation cache directory
@@ -1453,15 +1517,16 @@ class SchedulerEngine:
         # Budget charge covers everything the entry pins, not just the
         # host arrays: a device-resident copy of the (padded, so up to
         # 2x along each axis) per-object tensors, plus the previous
-        # tick's device outputs (i8+i32+i8+i32 = 10 bytes/cell) and the
-        # drift gate's feasibility plane (+1 byte/cell).
+        # tick's device outputs (i8+i32+i8+i32 = 10 bytes/cell), the
+        # drift gate's feasibility plane (+1 byte/cell) and the
+        # drift-resolve reason plane (+4 bytes/cell).
         # Decoded result dicts are small relative to the tensor planes.
         b = len(chunk)
         c = np.asarray(inputs.cluster_valid).shape[0]
         # prev_out device planes live at PADDED shape — charge for it.
         b_pad = _pow2_bucket(b, self.min_bucket, 1 << 30)
         c_pad = _cluster_bucket(c, self.min_cluster_bucket)
-        nbytes = host_bytes * 3 + b_pad * c_pad * 11
+        nbytes = host_bytes * 3 + b_pad * c_pad * 15
         entry = None
         if self._cache_used + nbytes <= self.cache_bytes:
             entry = _CachedChunk(
@@ -1498,6 +1563,7 @@ class SchedulerEngine:
                 # indices to the WRONG cluster names.
                 entry.prev_out = cached.prev_out
                 entry.prev_feas = cached.prev_feas
+                entry.prev_reasons = cached.prev_reasons
                 entry.prev_results = cached.prev_results
                 entry.prev_has_scores = cached.prev_has_scores
                 entry.stale_out_rows = cached.stale_out_rows
@@ -1599,7 +1665,10 @@ class SchedulerEngine:
             delta = value - (upload0 or {}).get(plane, 0)
             if delta:
                 m.counter("engine_upload_bytes_total", delta, plane=plane)
-        for kind in ("skip", "wcheck", "wcheck_changed", "recompute"):
+        for kind in (
+            "skip", "wcheck", "wcheck_changed", "recompute", "resolve",
+            "resolve_fallback",
+        ):
             delta = self.drift_stats[kind] - (drift0 or {}).get(kind, 0)
             if delta:
                 m.counter("engine_drift_rows_total", delta, kind=kind)
@@ -1858,7 +1927,7 @@ class SchedulerEngine:
                 )
                 pending_gate.append(
                     (len(chunk_results), entry, len(chunk), gate_dev, fmt,
-                     b_pad, pack_k)
+                     b_pad, pack_k, drift_info)
                 )
                 chunk_results.append(None)
                 chunk_changed.append(None)
@@ -2475,7 +2544,7 @@ class SchedulerEngine:
         timings["decode"] += time.perf_counter() - t3
 
     def _repair_program(self):
-        """Jitted 5-plane scatter: prev planes .at[dst].set(slab[src])
+        """Jitted 6-plane scatter: prev planes .at[dst].set(slab[src])
         (dst padded out-of-range -> mode='drop').  The planes are
         DONATED: XLA updates them in place instead of copying ~20MB of
         [B, C] state per repaired chunk (the engine re-references the
@@ -2493,8 +2562,8 @@ class SchedulerEngine:
                 grid, rep = self._grid_sharding, self._replicated
                 fn = jax.jit(
                     impl,
-                    in_shardings=((grid,) * 5, (grid,) * 5, rep, rep),
-                    out_shardings=(grid,) * 5,
+                    in_shardings=((grid,) * 6, (grid,) * 6, rep, rep),
+                    out_shardings=(grid,) * 6,
                     donate_argnums=donate,
                 )
             else:
@@ -2506,14 +2575,25 @@ class SchedulerEngine:
         self, entry, changed_rows, offset: int, slabs, slab_cut: int
     ) -> bool:
         """Write the sub-batch slab outputs for this chunk's rows back
-        into entry.prev_out/prev_feas on device.  Returns False (caller
-        keeps the stale-marking fallback) when the cached planes are
-        absent or any touched slab's cluster axis disagrees."""
-        if entry.prev_out is None or entry.prev_feas is None or not changed_rows:
-            return entry.prev_out is not None and entry.prev_feas is not None
+        into entry.prev_out/prev_feas/prev_reasons on device.  Returns
+        False (caller keeps the stale-marking fallback) when the cached
+        planes are absent or any touched slab's cluster axis disagrees."""
+        if (
+            entry.prev_out is None
+            or entry.prev_feas is None
+            or entry.prev_reasons is None
+            or not changed_rows
+        ):
+            return (
+                entry.prev_out is not None
+                and entry.prev_feas is not None
+                and entry.prev_reasons is not None
+            )
         c_pad = entry.prev_out[0].shape[1]
         b_pad = entry.prev_out[0].shape[0]
         if entry.prev_feas.shape != (b_pad, c_pad):
+            return False
+        if entry.prev_reasons.shape != (b_pad, c_pad):
             return False
         # Split this chunk's combined-array span into per-slab segments.
         segments: dict[int, tuple[list, list]] = {}
@@ -2527,26 +2607,32 @@ class SchedulerEngine:
         for s in segments:
             if s >= len(slabs) or slabs[s][1].selected.shape[1] != c_pad:
                 return False
-        planes = entry.prev_out + (entry.prev_feas,)
+        planes = entry.prev_out + (entry.prev_feas, entry.prev_reasons)
         fn = self._repair_program()
         for s, (srcs, dsts) in segments.items():
             out = slabs[s][1]
             slab_planes = (
                 out.selected, out.replicas, out.counted, out.scores,
-                out.feasible,
+                out.feasible, out.reasons,
             )
-            # Floor the index bucket at 128: repair shapes then come
-            # from a tiny set (prewarmed below), so steady-state churn
-            # ticks never stall on a scatter-program trace.
-            k = _pow2_bucket(len(srcs), 128, 1 << 30)
-            src = np.zeros(k, np.int32)
-            src[: len(srcs)] = srcs
-            dst = np.full(k, b_pad, np.int32)  # pad scatters drop
-            dst[: len(dsts)] = dsts
-            self.dispatches_total += 1
-            planes = fn(planes, slab_planes, src, dst)
+            # FIXED 128-row scatter groups, not a pow2 index bucket:
+            # the repair program then has exactly one index shape per
+            # (chunk, slab) plane pair — prewarmed — so a drift/churn
+            # tick can never stall on a scatter-program trace (the
+            # scatters are in-place under donation; extra dispatches
+            # are cheap next to one compile).
+            for g in range(0, len(srcs), 128):
+                src = np.zeros(128, np.int32)
+                seg = srcs[g : g + 128]
+                src[: len(seg)] = seg
+                dst = np.full(128, b_pad, np.int32)  # pad scatters drop
+                dseg = dsts[g : g + 128]
+                dst[: len(dseg)] = dseg
+                self.dispatches_total += 1
+                planes = fn(planes, slab_planes, src, dst)
         entry.prev_out = planes[:4]
         entry.prev_feas = planes[4]
+        entry.prev_reasons = planes[5]
         entry.stale_out_rows = (
             sorted(set(entry.stale_out_rows) - set(changed_rows))
             if entry.stale_out_rows
@@ -2621,10 +2707,10 @@ class SchedulerEngine:
             cur_absent = Cmp.CUR_ABSENT
 
             def impl(per_object, tables, prev_feas, prev_scores, ao, uo,
-                     an, un, didx, dvalid, dcpu):
+                     an, un, didx, dvalid, dcpu, fin_idx):
                 return drift_gate_compact(
                     per_object, tables, prev_feas, prev_scores, ao, uo,
-                    an, un, didx, dvalid, dcpu, cur_absent,
+                    an, un, didx, dvalid, dcpu, fin_idx, cur_absent,
                 )
 
             if self._grid_sharding is not None:
@@ -2636,7 +2722,7 @@ class SchedulerEngine:
                         self._per_object_shardings_compact,
                         self._table_shardings,
                         grid, grid,
-                        rep, rep, rep, rep, rep, rep, rep,
+                        rep, rep, rep, rep, rep, rep, rep, rep,
                     ),
                     out_shardings=(rep, grid),
                 )
@@ -2652,7 +2738,7 @@ class SchedulerEngine:
                     in_shardings=(
                         self._per_object_shardings,
                         grid, grid,
-                        rep, rep, rep, rep, rep, rep, rep,
+                        rep, rep, rep, rep, rep, rep, rep, rep,
                     ),
                     out_shardings=(rep, grid),
                 )
@@ -2681,19 +2767,90 @@ class SchedulerEngine:
             self._wcheck_program_cache["wcheck"] = fn
         return fn
 
+    def _fin_rows(self, entry, b_pad: int) -> np.ndarray:
+        """The chunk's finite-maxClusters row indices, padded with
+        out-of-range fill — the only rows whose top-K cut can engage, so
+        the gate's rank-count refinement gathers them instead of
+        scanning every row (at bench mixes ~20% of rows are finite-K,
+        which is most of the gate program's former cost).  The pad
+        bucket is a TWO-rung ladder (b_pad/4, b_pad), not free pow2: the
+        gate program traces per fin shape, and a drift tick must never
+        stall on a gate compile the prewarm ladder didn't cover."""
+        mc = np.asarray(entry.inputs.max_clusters)
+        fin = np.nonzero((mc >= 0) & (mc < INT32_INF))[0]
+        cap = max(64, b_pad // 4)
+        nb = cap if fin.size <= cap else b_pad
+        idx = np.full(nb, 1 << 30, np.int32)
+        idx[: fin.size] = fin
+        return idx
+
+    def _repair_stale_inputs(self, entry, fmt: str, c_bucket: int) -> None:
+        """Scatter just the stale rows' host inputs into the cached
+        device per-object tensors (width-aligned to the cached padded
+        shape).  Row-sliced, never a whole-chunk pad, and scattered in
+        FIXED 128-row groups — one prewarmable patch-program shape, so
+        neither a drift tick nor a churn tick can stall on a scatter
+        trace whatever the churned-row count."""
+        stale = entry.stale_rows
+        if not stale or entry.device_per_object is None:
+            return
+        b_pad = entry.padded_shape[0]
+        n = len(stale)
+        idx = np.full(-(-n // 128) * 128, stale[0], np.int64)  # pad: valid row
+        idx[:n] = stale
+        piece = self._slice_rows(entry, idx.tolist())
+        if fmt == "compact":
+            _b, _c, p_pad, l_pad = entry.padded_shape
+            piece = Cmp.pad_axis1(piece, Cmp.SPARSE_FILLS, p_pad)
+            piece = Cmp.pad_axis1(piece, {"key_bytes": 0}, l_pad)
+            patch = self._patch_compact
+        else:
+            piece = _pad_clusters(piece, c_bucket, skip=_CLUSTER_ONLY_FIELDS)
+            patch = self._patch
+        per_object = self._per_object_fields(fmt)
+        arrays = {
+            name: np.asarray(getattr(piece, name)) for name in per_object
+        }
+        dst_all = np.full(idx.shape[0], b_pad, np.int32)  # pad scatters drop
+        dst_all[:n] = stale
+        dev = entry.device_per_object
+        for g in range(0, idx.shape[0], 128):
+            rows = {
+                name: np.ascontiguousarray(arr[g : g + 128])
+                for name, arr in arrays.items()
+            }
+            self.upload_bytes["object"] += sum(
+                a.nbytes for a in rows.values()
+            )
+            dev = patch(dev, rows, dst_all[g : g + 128])
+        entry.device_per_object = dev
+        entry.stale_rows = None
+
     def _dispatch_drift_gate(
         self, entry, fmt: str, c_bucket: int, info: dict, vocab, view,
     ):
-        """Launch the drift gate for one chunk (async; the mask is
-        drained batched in _drain_drift_gates).  Returns the (mask,
-        refreshed score plane) device pair."""
+        """Launch the drift gate for one chunk (async; the masks are
+        drained incrementally in _drain_drift_gates so survivor work
+        dispatches while later gates still compute).  Returns the
+        (mask, refreshed score plane) device pair."""
         gate = self._gate_program(fmt)
+        b_pad = entry.padded_shape[0]
+        if entry.stale_rows:
+            # Rows churned since the last full dispatch left stale
+            # device INPUT copies — scatter-repair them now so the gate
+            # classifies them like everyone else.  Without this, every
+            # row churned during steady operation is gate-blind and
+            # forced into the recompute set at the next drift — at
+            # bench churn rates that was ~30% of all drift recompute
+            # work, none of it reflecting a real decision change.
+            self._repair_stale_inputs(entry, fmt, c_bucket)
         self.dispatches_total += 1
         slices = (
             info["alloc_old_d"], info["used_old_d"],
             info["alloc_new_d"], info["used_new_d"],
         )
         self.upload_bytes["cluster"] += sum(a.nbytes for a in slices)
+        fin_idx = self._fin_rows(entry, b_pad)
         if fmt == "compact":
             return gate(
                 entry.device_per_object,
@@ -2701,59 +2858,309 @@ class SchedulerEngine:
                 entry.prev_feas,
                 entry.prev_out[3],
                 *slices,
-                info["didx"], info["dvalid"], info["dcpu"],
+                info["didx"], info["dvalid"], info["dcpu"], fin_idx,
             )
         return gate(
             entry.device_per_object,
             entry.prev_feas,
             entry.prev_out[3],
             *slices,
-            info["didx"], info["dvalid"], info["dcpu"],
+            info["didx"], info["dvalid"], info["dcpu"], fin_idx,
         )
+
+    def _resolve_program(self, fmt: str, m: int):
+        """Jitted sort-free drift resolve per (format, M): gather the
+        survivor rows' cached device inputs plus the stored prev planes,
+        expand (compact) and run ops.pipeline.drift_resolve — select +
+        planner from gate-refreshed state, no full-width sorts, no
+        phase 1.  Like the narrow fallback, the gathered sub-problem is
+        replicated under a mesh (survivor rows are few and the
+        resolve's per-row scans must see the whole cluster axis); the
+        output planes are constrained back to the grid layout so both
+        the in-place prev-plane repair and the (separately dispatched,
+        cheap-to-trace) wire pack consume them directly.  The wire pack
+        is NOT fused in here: its K comes from the per-chunk adaptive
+        hint, and keying this kernel's (expensive) trace on K would
+        recompile it mid-drift whenever the hint moves."""
+        key = (fmt, m)
+        fn = self._resolve_programs.get(key)
+        if fn is not None:
+            return fn
+        per_object = tuple(self._per_object_fields(fmt))
+        replicated = self._replicated
+        grid = self._grid_sharding
+
+        def impl(device_in, idx, prev_feas, prev_scores, prev_reasons,
+                 ao, uo, an, un, didx, dvalid, _fmt=fmt, _m=m):
+            rows = {name: getattr(device_in, name)[idx] for name in per_object}
+            sub = device_in._replace(**rows)
+            feas_r = prev_feas[idx]
+            sco_r = prev_scores[idx]
+            rsn_r = prev_reasons[idx]
+            if replicated is not None:
+                sub = type(sub)(
+                    *(
+                        jax.lax.with_sharding_constraint(x, replicated)
+                        for x in sub
+                    )
+                )
+                feas_r, sco_r, rsn_r = (
+                    jax.lax.with_sharding_constraint(x, replicated)
+                    for x in (feas_r, sco_r, rsn_r)
+                )
+            inp = expand_compact(sub) if _fmt == "compact" else sub
+            out, cert = drift_resolve(
+                inp, feas_r, sco_r, rsn_r, ao, uo, an, un, didx, dvalid, _m
+            )
+            if grid is not None:
+                out = TickOutputs(
+                    *(
+                        jax.lax.with_sharding_constraint(x, grid)
+                        for x in out
+                    )
+                )
+            return out, cert
+
+        fn = jax.jit(impl)
+        self._resolve_programs[key] = fn
+        return fn
+
+    def _dispatch_drift_resolve(
+        self, pi: int, entry, n: int, fmt: str, b_pad: int, pack_k: int,
+        info: dict, mask: np.ndarray, rec: set, forced: set, cluster_dev,
+        vocab, c_bucket: int,
+    ) -> Optional[dict]:
+        """Dispatch the sort-free resolve for one gated chunk's eligible
+        survivors (recompute rows without a fit flip, prev planes
+        intact), or None when the chunk cannot take it — narrow
+        disabled, dense fetch format, wide delta, or no eligible rows.
+        The program (and its wire pack) goes into the device queue
+        immediately, overlapping later chunks' gate compute; results are
+        drained batched by _drain_drift_resolve."""
+        if not self.drift_resolve or self.fetch_format != "packed":
+            return None
+        if (
+            entry.prev_reasons is None
+            or entry.device_per_object is None
+            or entry.prev_reasons.shape != entry.prev_feas.shape
+        ):
+            return None
+        if info["didx"].shape[0] > DRIFT_REFINE_MAX_COLS:
+            return None
+        m = self._narrow_m(entry.inputs, c_bucket)
+        if m is None:
+            return None
+        fitflip = set(np.nonzero(mask & DRIFT_FITFLIP)[0].tolist())
+        rows = sorted(rec - fitflip - forced)
+        if not rows:
+            return None
+        # Resolve rows are all finite-K (kinf rows never reach the
+        # refined recompute set), so the narrow candidate width M —
+        # a pow2 at or above the finite maxClusters bound by
+        # construction — covers every selection with zero overflow.
+        # Unlike the adaptive hint it is stable across drift ticks AND
+        # known to prewarm, so the wire pack program never traces
+        # mid-drift.
+        pack_k = min(m, c_bucket)
+        # Row-bucket ladder {64, 256, b_pad/4, b_pad}: the resolve
+        # program traces per idx shape, so the prewarm ladder must
+        # cover every shape a live drift can hit, and the resolve's
+        # per-row [kb, C] scans must not pay 4x padding waste for the
+        # common few-hundred-survivors chunk.
+        cap = max(64, b_pad // 4)
+        kb = b_pad
+        for rung in (64, 256, cap):
+            if len(rows) <= rung:
+                kb = rung
+                break
+        idx = np.full(kb, b_pad, np.int32)
+        idx[: len(rows)] = rows
+        if fmt == "compact":
+            device_in = CompactInputs(
+                **entry.device_per_object,
+                **self._tables_device(vocab, c_bucket),
+                **cluster_dev,
+            )
+        else:
+            device_in = TickInputs(**entry.device_per_object, **cluster_dev)
+        self.dispatches_total += 1
+        out, cert = self._resolve_program(fmt, m)(
+            device_in, idx, entry.prev_feas, entry.prev_out[3],
+            entry.prev_reasons,
+            info["alloc_old_d"], info["used_old_d"],
+            info["alloc_new_d"], info["used_new_d"],
+            info["didx"], info["dvalid"],
+        )
+        # The packed wire for every resolve slot ships now too
+        # (uncertified slots are simply never decoded), so the whole
+        # survivor settle overlaps the remaining gates in the device
+        # queue.  Separate (cheap, per-K) pack program — see
+        # _resolve_program on why the pack is not fused.
+        self.dispatches_total += 1
+        wire = self._pack_program("gather", pack_k)(
+            out.selected, out.replicas, out.counted, out.scores,
+            out.reasons, np.arange(kb, dtype=np.int32),
+        )
+        return {
+            "pi": pi, "entry": entry, "rows": rows, "out": out,
+            "cert": cert, "wire": wire, "pack_k": pack_k, "fmt": fmt,
+        }
+
+    def _repair_entry_rows(self, entry, out, src_pos, dst_rows) -> bool:
+        """Scatter resolve-output rows back into the chunk's cached prev
+        planes in place (the 6-plane donated repair: selection planes +
+        feasibility + reasons).  Returns False when the cached planes
+        cannot take the scatter (caller falls back to stale marking)."""
+        if (
+            entry.prev_out is None
+            or entry.prev_feas is None
+            or entry.prev_reasons is None
+        ):
+            return False
+        b_pad, c_pad = entry.prev_out[0].shape
+        if (
+            entry.prev_feas.shape != (b_pad, c_pad)
+            or entry.prev_reasons.shape != (b_pad, c_pad)
+            or out.selected.shape[1] != c_pad
+            or max(dst_rows, default=0) >= b_pad
+        ):
+            return False
+        planes = entry.prev_out + (entry.prev_feas, entry.prev_reasons)
+        fn = self._repair_program()
+        out_planes = (
+            out.selected, out.replicas, out.counted, out.scores,
+            out.feasible, out.reasons,
+        )
+        # Fixed 128-row scatter groups (see _repair_prev_planes): one
+        # prewarmable index shape, never a trace stall mid-drift.
+        for g in range(0, len(src_pos), 128):
+            src = np.zeros(128, np.int32)
+            seg = np.asarray(src_pos[g : g + 128])
+            src[: seg.size] = seg
+            dst = np.full(128, b_pad, np.int32)  # pad scatters drop
+            dseg = np.asarray(dst_rows[g : g + 128])
+            dst[: dseg.size] = dseg
+            self.dispatches_total += 1
+            planes = fn(planes, out_planes, src, dst)
+        entry.prev_out = planes[:4]
+        entry.prev_feas = planes[4]
+        entry.prev_reasons = planes[5]
+        return True
+
+    def _drain_drift_resolve(
+        self, jobs, plans, plan_resolved, view, timings,
+    ) -> None:
+        """Drain the in-flight resolve programs: batched cert + wire
+        reads, decode of certified rows, merge into the cached decodes,
+        in-place prev-plane repair.  Cert failures stay in the chunk's
+        recompute set and take the slab path."""
+        t0 = time.perf_counter()
+        cert_np: dict[int, np.ndarray] = {}
+        wire_np: dict[int, np.ndarray] = {}
+        for arrs, field in ((cert_np, "cert"), (wire_np, "wire")):
+            groups: dict[tuple, list[int]] = {}
+            for i, job in enumerate(jobs):
+                groups.setdefault(tuple(job[field].shape), []).append(i)
+            for _, members in groups.items():
+                if len(members) == 1:
+                    arrs[members[0]] = self._read_np(jobs[members[0]][field])
+                else:
+                    stacked = self._read_np(
+                        self._stack(*[jobs[i][field] for i in members])
+                    )
+                    for j, i in enumerate(members):
+                        arrs[i] = stacked[j]
+        timings["fetch"] += time.perf_counter() - t0
+
+        for i, job in enumerate(jobs):
+            t0 = time.perf_counter()
+            entry, rows, out, k = (
+                job["entry"], job["rows"], job["out"], job["pack_k"]
+            )
+            nr = len(rows)
+            cert = cert_np[i][:nr]
+            ok_pos = np.nonzero(cert != 0)[0]
+            self.drift_stats["resolve"] += int(ok_pos.size)
+            self.drift_stats["resolve_fallback"] += int(nr - ok_pos.size)
+            handled = {rows[p] for p in ok_pos.tolist()}
+            plans[job["pi"]][3] -= handled
+            if not ok_pos.size:
+                timings["decode"] += time.perf_counter() - t0
+                continue
+            full = unpack_wire(wire_np[i][:nr], k)
+            packed = PackedRows(*(np.asarray(f)[ok_pos] for f in full))
+            self._observe_nsel(entry, packed.nsel, out.selected.shape[1])
+            over_pos = np.nonzero(np.asarray(packed.nsel) > k)[0]
+            over_dense = None
+            if over_pos.size:
+                t1 = time.perf_counter()
+                timings["decode"] += t1 - t0
+                over_dense = self._fetch_overflow(
+                    out, ok_pos[over_pos].astype(np.int64), False, timings
+                )
+                t0 = time.perf_counter()
+            results = self._decode_packed_mixed(
+                packed, over_pos, over_dense, view.names, False
+            )
+            res_rows = [rows[p] for p in ok_pos.tolist()]
+            merged = list(entry.prev_results)
+            for r, res in zip(res_rows, results):
+                merged[r] = res
+            entry.prev_results = merged
+            self._record_packed(
+                entry, res_rows, results, packed, over_pos, over_dense,
+                view, program=f"{job['fmt']}:resolve",
+            )
+            if not self._repair_entry_rows(entry, out, ok_pos, res_rows):
+                entry.stale_out_rows = sorted(
+                    set(entry.stale_out_rows or ()) | set(res_rows)
+                )
+            plan_resolved.setdefault(job["pi"], []).extend(res_rows)
+            timings["decode"] += time.perf_counter() - t0
 
     def _drain_drift_gates(
         self, items, chunk_results, chunk_changed, view, want_scores: bool,
         timings, pending_sub, c_bucket, eff_chunk, ladder, vocab,
     ) -> None:
-        """Resolve every gated chunk: batched mask reads, the batched
-        dynamic-weight check, then either a provable skip, a sub-batch
-        recompute of the candidate rows, or (mass change) a fallback
-        full dispatch with the regular delta fetch."""
+        """Resolve every gated chunk as a streaming pipeline, never
+        stopping the world: gate masks are read IN DISPATCH ORDER (the
+        read for chunk i blocks only on gate i — gates i+1.. keep
+        computing), and each chunk's survivor work (the sort-free
+        drift-resolve program, the dynamic-weight check) dispatches
+        immediately after its classification, so the device queue flows
+        gate -> survivors -> gate without a host-side barrier.  Only
+        then are the survivor outputs drained (batched reads), cert
+        failures and wcheck-changed rows folded into the slab path, and
+        the remaining chunks settled as provable skips / slab
+        recomputes / (mass change) fallback full dispatches."""
         if not items:
             return
-        t0 = time.perf_counter()
-        mask_np: dict[int, np.ndarray] = {}
-        groups: dict[tuple, list[int]] = {}
-        for i, it in enumerate(items):
-            groups.setdefault(tuple(it[3][0].shape), []).append(i)
-        for _, members in groups.items():
-            if len(members) == 1:
-                mask_np[members[0]] = self._read_np(items[members[0]][3][0])
-            else:
-                stacked = self._read_np(
-                    self._stack(*[items[i][3][0] for i in members])
-                )
-                for j, i in enumerate(members):
-                    mask_np[i] = stacked[j]
-        # The mask rows are a few KB; this read blocks on the GATE
-        # programs themselves, so its wall time is gate compute, not
-        # transfer — attributed separately (gate_wait) so bench/metrics
-        # can split the drift tick's fetch stage into its real phases.
-        timings["gate_wait"] = (
-            timings.get("gate_wait", 0.0) + time.perf_counter() - t0
-        )
-        timings["fetch"] += time.perf_counter() - t0
-
-        t0 = time.perf_counter()
+        self.metrics.store("engine_gate_inflight", len(items))
+        resolve_jobs: list[dict] = []
         plans: list[list] = []  # [slot, entry, n, recompute set, fmt, b_pad, k]
-        wcheck_jobs: list[tuple] = []  # (plan index, wcheck rows)
-        for i, (slot, entry, n, devs, fmt, b_pad, pack_k) in enumerate(items):
+        wcheck_jobs: list[tuple] = []  # (plan index, wcheck rows, dev)
+        plan_resolved: dict[int, list] = {}  # plan index -> merged rows
+        newc = self._cluster_planes_device(view, c_bucket)
+        wfn = self._wcheck_program()
+        for i, (slot, entry, n, devs, fmt, b_pad, pack_k, info) in enumerate(
+            items
+        ):
+            # The mask rows are a few KB; this read blocks on gate i's
+            # COMPUTE (gates past i and any already-dispatched survivor
+            # programs keep running), so its wall time is attributed
+            # separately (gate_wait) — bench/metrics split the drift
+            # tick's fetch stage into its real phases.
+            t0 = time.perf_counter()
+            mask = self._read_np(devs[0])[:n]
+            dt = time.perf_counter() - t0
+            timings["gate_wait"] = timings.get("gate_wait", 0.0) + dt
+            timings["fetch"] += dt
+            t0 = time.perf_counter()
             self.drift_stats["gated"] += 1
             # The gate refreshed the changed columns of the stored score
             # plane (skipped rows stay exact for future drift gates;
             # recomputed rows are overwritten by the slab repair).
             entry.prev_out = entry.prev_out[:3] + (devs[1],)
-            mask = mask_np[i][:n]
             rec = set(np.nonzero(mask & DRIFT_RECOMPUTE)[0].tolist())
             # Rows whose cached prev planes are unreliable (patched
             # without a successful device write-back) are gate-blind:
@@ -2769,43 +3176,60 @@ class SchedulerEngine:
                 wrows = wrows[~np.isin(wrows, sorted(forced))]
             plans.append([slot, entry, n, rec, fmt, b_pad, pack_k])
             if wrows.size:
-                wcheck_jobs.append((len(plans) - 1, wrows))
-        timings["decode"] += time.perf_counter() - t0
-
-        if wcheck_jobs:
-            t0 = time.perf_counter()
-            newc = self._cluster_planes_device(view, c_bucket)
-            fn = self._wcheck_program()
-            wdevs: list[tuple] = []
-            for pi, wrows in wcheck_jobs:
-                entry = plans[pi][1]
+                # Dispatch the weight check NOW; its result is read in
+                # the batched drain below.  Row shapes come from the
+                # same {64, b_pad/4, b_pad} ladder as the resolve/gate
+                # programs (prewarmed) — a free pow2 bucket would trace
+                # a fresh wcheck program mid-drift.
                 self.drift_stats["wcheck"] += int(wrows.size)
-                kb = _pow2_bucket(wrows.size, 16, 1 << 30)
+                cap = max(64, b_pad // 4)
+                kb = (
+                    64 if wrows.size <= 64
+                    else (cap if wrows.size <= cap else b_pad)
+                )
                 ridx = np.zeros(kb, np.int32)
                 ridx[: wrows.size] = wrows
                 oldc = self._wcheck_cpu_device(entry.prev_view, c_bucket)
                 self.dispatches_total += 1
-                wdevs.append(
-                    (pi, wrows, fn(
+                wcheck_jobs.append(
+                    (len(plans) - 1, wrows, wfn(
                         entry.prev_feas, ridx,
                         oldc["cpu_alloc"], oldc["cpu_avail"],
                         newc["cpu_alloc"], newc["cpu_avail"],
                     ))
                 )
+            # Sort-free resolve of the eligible survivors (recompute
+            # rows without a fit flip): dispatched immediately, so the
+            # resolve program overlaps the remaining gates' compute.
+            job = self._dispatch_drift_resolve(
+                len(plans) - 1, entry, n, fmt, b_pad, pack_k, info,
+                mask, rec, forced, newc, vocab, c_bucket,
+            )
+            if job is not None:
+                resolve_jobs.append(job)
+            timings["decode"] += time.perf_counter() - t0
+
+        if resolve_jobs:
+            self._drain_drift_resolve(
+                resolve_jobs, plans, plan_resolved, view, timings,
+            )
+
+        if wcheck_jobs:
+            t0 = time.perf_counter()
             wgroups: dict[tuple, list[int]] = {}
-            for i, (_, _, dev) in enumerate(wdevs):
+            for i, (_, _, dev) in enumerate(wcheck_jobs):
                 wgroups.setdefault(tuple(dev.shape), []).append(i)
             warr: dict[int, np.ndarray] = {}
             for _, members in wgroups.items():
                 if len(members) == 1:
-                    warr[members[0]] = self._read_np(wdevs[members[0]][2])
+                    warr[members[0]] = self._read_np(wcheck_jobs[members[0]][2])
                 else:
                     stacked = self._read_np(
-                        self._stack(*[wdevs[i][2] for i in members])
+                        self._stack(*[wcheck_jobs[i][2] for i in members])
                     )
                     for j, i in enumerate(members):
                         warr[i] = stacked[j]
-            for i, (pi, wrows, _dev) in enumerate(wdevs):
+            for i, (pi, wrows, _dev) in enumerate(wcheck_jobs):
                 changed = wrows[warr[i][: wrows.size] != 0]
                 self.drift_stats["wcheck_changed"] += int(changed.size)
                 plans[pi][3] |= set(changed.tolist())
@@ -2816,14 +3240,22 @@ class SchedulerEngine:
 
         t0 = time.perf_counter()
         fallback: list[tuple] = []
-        for slot, entry, n, rec, fmt, b_pad, pack_k in plans:
+        for pi, (slot, entry, n, rec, fmt, b_pad, pack_k) in enumerate(plans):
             rec = {r for r in rec if r < n}
+            resolved = plan_resolved.get(pi, [])
             if not rec:
-                self.fetch_stats["skip"] += 1
-                self.drift_stats["skip"] += n
                 entry.prev_view = view
                 chunk_results[slot] = entry.prev_results
-                chunk_changed[slot] = []
+                if resolved:
+                    # Every recompute row was settled by drift_resolve;
+                    # the merged decodes already carry them.
+                    self.fetch_stats["delta"] += 1
+                    self.drift_stats["skip"] += n - len(resolved)
+                    chunk_changed[slot] = sorted(resolved)
+                else:
+                    self.fetch_stats["skip"] += 1
+                    self.drift_stats["skip"] += n
+                    chunk_changed[slot] = []
             elif len(rec) > n // 2:
                 # Mass change: the whole-chunk dispatch with the regular
                 # delta fetch beats slabbing most of the chunk.
@@ -2833,12 +3265,13 @@ class SchedulerEngine:
                 rows = sorted(rec)
                 self.fetch_stats["delta"] += 1
                 self.drift_stats["recompute"] += len(rows)
-                self.drift_stats["skip"] += n - len(rows)
+                self.drift_stats["skip"] += n - len(rows) - len(resolved)
                 pending_sub.append(
                     (slot, entry, rows, self._slice_rows(entry, rows), False)
                 )
-                chunk_changed[slot] = list(rows)
+                chunk_changed[slot] = sorted(rec | set(resolved))
         timings["featurize"] += time.perf_counter() - t0
+        self.metrics.store("engine_gate_inflight", 0)
 
         if fallback:
             t0 = time.perf_counter()
@@ -2919,11 +3352,9 @@ class SchedulerEngine:
                 np.asarray(padded.key_bytes).shape[1],
             )
             shardings = self._per_object_shardings_compact
-            patch = self._patch_compact
         else:
             shape = (b_pad, c_pad)
             shardings = self._per_object_shardings
-            patch = self._patch
         if (
             entry is not None
             and status == "hit"
@@ -2931,28 +3362,11 @@ class SchedulerEngine:
             and entry.padded_shape == shape
         ):
             if entry.stale_rows:
-                # Scatter-repair the rows churned since the last upload
-                # from the (current) padded host arrays: K rows over the
-                # link instead of the whole chunk.
-                stale = entry.stale_rows
-                k = _pow2_bucket(len(stale), 16, 1 << 30)
-                src = np.zeros(k, np.int32)
-                src[: len(stale)] = stale
-                # Scatter targets padded out-of-range -> mode='drop'.
-                dst = np.full(k, b_pad, np.int32)
-                dst[: len(stale)] = stale
-                rows = {
-                    name: np.ascontiguousarray(np.asarray(fields[name])[src])
-                    for name in per_object_names
-                }
-                self.upload_bytes["object"] += sum(
-                    a.nbytes for a in rows.values()
-                )
-                per_object = patch(entry.device_per_object, rows, dst)
-                entry.device_per_object = per_object
-                entry.stale_rows = None
-            else:
-                per_object = entry.device_per_object
+                # Scatter-repair the rows churned since the last upload:
+                # K rows over the link instead of the whole chunk, in
+                # the shape-stable 128-row patch groups.
+                self._repair_stale_inputs(entry, fmt, c_pad)
+            per_object = entry.device_per_object
         else:
             self.upload_bytes["object"] += sum(
                 np.asarray(a).nbytes for a in per_object.values()
@@ -3415,6 +3829,7 @@ class SchedulerEngine:
         self.fetch_stats["skip"] += 1
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
         entry.prev_feas = out.feasible
+        entry.prev_reasons = out.reasons
         entry.stale_out_rows = None
         entry.prev_view = view
 
@@ -3471,6 +3886,7 @@ class SchedulerEngine:
         )
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
         entry.prev_feas = out.feasible
+        entry.prev_reasons = out.reasons
         entry.stale_out_rows = None
         entry.prev_results = merged
         entry.prev_view = view
@@ -3505,6 +3921,7 @@ class SchedulerEngine:
             # stored list's rows — frozen results make that safe.
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
             entry.prev_feas = out.feasible
+            entry.prev_reasons = out.reasons
             entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
@@ -3564,11 +3981,14 @@ class SchedulerEngine:
         K slots are the global top-K by score)."""
         idx = np.asarray(packed.idx)[:, :topk]
         sco = np.asarray(packed.sco)[:, :topk]
-        topk_i, topk_s = [], []
-        for p in range(idx.shape[0]):
-            valid = idx[p] >= 0
-            topk_i.append(idx[p][valid].astype(np.int32))
-            topk_s.append(sco[p][valid].astype(np.int64))
+        # One flat masked gather + split, not a per-row python loop —
+        # at drift-recompute row counts the loop was the decode stage's
+        # single biggest line.
+        valid = idx >= 0
+        counts = valid.sum(axis=1)
+        splits = np.cumsum(counts)[:-1]
+        topk_i = np.split(idx[valid].astype(np.int32), splits)
+        topk_s = np.split(sco[valid].astype(np.int64), splits)
         return topk_i, topk_s
 
     def _record_packed(
@@ -3629,6 +4049,7 @@ class SchedulerEngine:
         )
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
         entry.prev_feas = out.feasible
+        entry.prev_reasons = out.reasons
         entry.stale_out_rows = None
         entry.prev_results = merged
         entry.prev_view = view
@@ -3654,6 +4075,7 @@ class SchedulerEngine:
         if entry is not None:
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
             entry.prev_feas = out.feasible
+            entry.prev_reasons = out.reasons
             entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
@@ -3975,26 +4397,86 @@ class SchedulerEngine:
                         (8,) + np.asarray(padded.alloc).shape[1:],
                         np.asarray(padded.alloc).dtype,
                     )
+                    # Both rungs of the gate's fin-row ladder (see
+                    # _fin_rows): a drift tick must never stall on a
+                    # gate compile, whatever the finite-K row fraction.
+                    for fin_n in sorted({max(64, b_pad // 4), b_pad}):
+                        fin_pad = np.full(fin_n, 1 << 30, np.int32)
+                        jax.block_until_ready(
+                            self._gate_program("compact")(
+                                per_object,
+                                Cmp.pad_tables(vocab.tables(), c_bucket),
+                                np.zeros(shape, np.int8),
+                                np.zeros(shape, np.int32),
+                                slice8, slice8, slice8, slice8,
+                                didx8, dflag8, dflag8, fin_pad,
+                            )
+                        )
+                    # The 128-row input-patch group (stale-row repair):
+                    # every churn/drift scatter-repair uses exactly this
+                    # shape (see _repair_stale_inputs).
+                    idx0 = np.zeros(128, np.int64)
                     jax.block_until_ready(
-                        self._gate_program("compact")(
+                        self._patch_compact(
                             per_object,
-                            Cmp.pad_tables(vocab.tables(), c_bucket),
-                            np.zeros(shape, np.int8),
-                            np.zeros(shape, np.int32),
-                            slice8, slice8, slice8, slice8,
-                            didx8, dflag8, dflag8,
-                        )
+                            {
+                                name: np.ascontiguousarray(
+                                    np.asarray(per_object[name])[idx0]
+                                )
+                                for name in Cmp.PER_OBJECT_FIELDS
+                            },
+                            np.full(128, b_pad, np.int32),
+                        )["total"]
                     )
-                    jax.block_until_ready(
-                        self._wcheck_program()(
-                            np.zeros(shape, np.int8),
-                            np.zeros(16, np.int32),
-                            np.asarray(padded.cpu_alloc),
-                            np.asarray(padded.cpu_avail),
-                            np.asarray(padded.cpu_alloc),
-                            np.asarray(padded.cpu_avail),
+                    if narrow_m is not None and self.drift_resolve:
+                        # The sort-free drift resolve (+ its wire pack)
+                        # is the FIRST capacity-drift tick's survivor
+                        # path — warm its row-bucket ladder so live
+                        # drifts never stall on its trace.
+                        device_in_warm = padded._replace(
+                            **Cmp.pad_tables(vocab.tables(), c_bucket)
                         )
-                    )
+                        # The live resolve wire packs at K = narrow M
+                        # (see _dispatch_drift_resolve) — warm exactly
+                        # that program.
+                        pk = (
+                            min(narrow_m, c_bucket)
+                            if self.fetch_format == "packed"
+                            else 0
+                        )
+                        for kb in sorted({64, 256, max(64, b_pad // 4)}):
+                            ridx = np.full(kb, b_pad, np.int32)
+                            r_out, r_cert = self._resolve_program(
+                                "compact", narrow_m
+                            )(
+                                device_in_warm, ridx,
+                                np.zeros(shape, np.int8),
+                                np.zeros(shape, np.int32),
+                                np.zeros(shape, np.int32),
+                                slice8, slice8, slice8, slice8,
+                                didx8, dflag8,
+                            )
+                            jax.block_until_ready(r_cert)
+                            if pk:
+                                jax.block_until_ready(
+                                    self._pack_program("gather", pk)(
+                                        r_out.selected, r_out.replicas,
+                                        r_out.counted, r_out.scores,
+                                        r_out.reasons,
+                                        np.arange(kb, dtype=np.int32),
+                                    )
+                                )
+                    for wn in sorted({64, max(64, b_pad // 4), b_pad}):
+                        jax.block_until_ready(
+                            self._wcheck_program()(
+                                np.zeros(shape, np.int8),
+                                np.zeros(wn, np.int32),
+                                np.asarray(padded.cpu_alloc),
+                                np.asarray(padded.cpu_avail),
+                                np.asarray(padded.cpu_alloc),
+                                np.asarray(padded.cpu_avail),
+                            )
+                        )
                     outs[b_pad] = out
                     log.info("prewarmed tick program %s", shape)
                 # Sub-batch write-back repair: full-chunk planes get
@@ -4013,6 +4495,7 @@ class SchedulerEngine:
                         jnp.zeros(pshape, jnp.int8),
                         jnp.zeros(pshape, jnp.int32),
                         jnp.zeros(pshape, jnp.int8),
+                        jnp.zeros(pshape, jnp.int32),
                     )
                 )()
                 src128 = np.zeros(128, np.int32)
@@ -4022,7 +4505,7 @@ class SchedulerEngine:
                     planes = self._repair_program()(
                         planes,
                         (slab.selected, slab.replicas, slab.counted,
-                         slab.scores, slab.feasible),
+                         slab.scores, slab.feasible, slab.reasons),
                         src128, dst128,
                     )
                     jax.block_until_ready(planes[0])

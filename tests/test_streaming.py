@@ -1,0 +1,364 @@
+"""Streaming-scheduler suite (ISSUE 7).
+
+The always-on pipeline's contract is exactness under interleave: row
+churn, object arrivals/deletes and cluster-capacity drift streaming
+through coalesced slab flushes must land bit-identical to a
+stop-the-world engine deciding the same worlds — including when the
+drift gate bails (mass drift) mid-stream, and on the sort-free
+drift-resolve survivor path.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from kubeadmiral_tpu.models.types import (
+    ClusterState,
+    MODE_DIVIDE,
+    SchedulingUnit,
+    parse_resources,
+)
+from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+from kubeadmiral_tpu.scheduler.streaming import (
+    StreamingScheduler,
+    is_placeholder,
+    make_placeholder,
+)
+
+from test_engine_cache import make_world, results_equal
+from test_engine_vs_sequential import random_cluster, random_unit
+
+
+def fresh_results(units, clusters, **engine_kw):
+    return SchedulerEngine(**engine_kw).schedule(units, clusters)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestSlabMechanics:
+    def test_rows_watermark_triggers_pump(self):
+        units, clusters = make_world(b=32, c=8)
+        engine = SchedulerEngine(chunk_size=32)
+        stream = StreamingScheduler(
+            engine, clusters, units, slab_rows=4, slab_age_ms=1e9
+        )
+        stream.flush()
+        for i in range(3):
+            stream.offer(
+                dataclasses.replace(units[i], desired_replicas=40 + i)
+            )
+            assert stream.pump() is None  # below both watermarks
+        stream.offer(dataclasses.replace(units[3], desired_replicas=50))
+        got = stream.pump()
+        assert got is not None
+        assert stream.flush_stats["rows"] == 1
+        results_equal(got, fresh_results(stream.units, clusters,
+                                         chunk_size=32))
+
+    def test_age_watermark_triggers_pump(self):
+        units, clusters = make_world(b=16, c=8)
+        clock = FakeClock()
+        engine = SchedulerEngine(chunk_size=32)
+        stream = StreamingScheduler(
+            engine, clusters, units, slab_rows=1024, slab_age_ms=50,
+            clock=clock,
+        )
+        stream.flush()
+        stream.offer(dataclasses.replace(units[0], desired_replicas=33))
+        assert stream.pump() is None
+        clock.t += 0.06  # 60ms > the 50ms age watermark
+        assert stream.pump() is not None
+        assert stream.flush_stats["age"] == 1
+        assert stream.oldest_age() == 0.0
+        # Latency accounting saw the wait.
+        assert stream.latencies and stream.latencies[-1] >= 0.059
+
+    def test_arrivals_fill_placeholders_then_grow(self):
+        units, clusters = make_world(b=8, c=8)
+        engine = SchedulerEngine(chunk_size=32)
+        stream = StreamingScheduler(
+            engine, clusters, units, slab_rows=64, slab_age_ms=1e9,
+            grow_block=4,
+        )
+        stream.flush()
+        world0 = len(stream.units)
+        arrivals = [
+            SchedulingUnit(
+                gvk="apps/v1/Deployment",
+                namespace="arr",
+                name=f"new-{i}",
+                scheduling_mode=MODE_DIVIDE,
+                desired_replicas=3,
+                resource_request=parse_resources({"cpu": "100m"}),
+            )
+            for i in range(6)
+        ]
+        for a in arrivals:
+            stream.offer(a)
+        got = stream.flush()
+        assert len(stream.units) == world0 + 8  # two 4-row blocks
+        assert sum(1 for u in stream.units if is_placeholder(u)) == 2
+        results_equal(got, fresh_results(stream.units, clusters,
+                                         chunk_size=32))
+        # Every arrival landed somewhere real.
+        for a in arrivals:
+            res = stream.result_of(a.key)
+            assert res is not None and res.clusters
+
+    def test_delete_reverts_to_placeholder(self):
+        units, clusters = make_world(b=8, c=8)
+        engine = SchedulerEngine(chunk_size=32)
+        stream = StreamingScheduler(engine, clusters, units,
+                                    slab_rows=64, slab_age_ms=1e9)
+        stream.flush()
+        key = units[2].key
+        stream.remove(key)
+        got = stream.flush()
+        assert stream.result_of(key) is None
+        assert is_placeholder(stream.units[2])
+        assert not got[2].clusters  # the placeholder row schedules nowhere
+        results_equal(got, fresh_results(stream.units, clusters,
+                                         chunk_size=32))
+
+    def test_placeholder_rows_schedule_nowhere(self):
+        units, clusters = make_world(b=6, c=8)
+        padded = units + [make_placeholder(i) for i in range(6, 10)]
+        got = fresh_results(padded, clusters, chunk_size=32)
+        for r in got[6:]:
+            assert not r.clusters
+
+    def test_capacity_event_rides_drift_gate(self):
+        units, clusters = make_world(b=64, c=12)
+        engine = SchedulerEngine(chunk_size=32)
+        stream = StreamingScheduler(engine, clusters, units,
+                                    slab_rows=64, slab_age_ms=1e9)
+        stream.flush()
+        stream.flush()  # device prev planes armed
+        drifted = dataclasses.replace(
+            clusters[0],
+            available={k: max(0, v // 2)
+                       for k, v in clusters[0].available.items()},
+        )
+        stream.update_cluster(drifted)
+        got = stream.flush()
+        assert engine.drift_stats["gated"] >= 1, engine.drift_stats
+        assert stream.clusters[0].available == drifted.available
+        results_equal(got, fresh_results(stream.units, stream.clusters,
+                                         chunk_size=32))
+
+
+class TestStreamingInterleaveDifferential:
+    def test_randomized_event_log_bit_identical_to_stop_the_world(self):
+        """The satellite differential: concurrent capacity drift + row
+        churn + arrivals/deletes through the streaming loop, flushed on
+        watermarks, versus a stop-the-world replay (fresh engine per
+        flush point) — placements, reason summaries and flight-recorder
+        records bit-identical.  Step 6 forces the mass-drift gate bail
+        mid-stream."""
+        rng = np.random.default_rng(11)
+        clusters = [random_cluster(rng, j) for j in range(14)]
+        names = [c.name for c in clusters]
+        units = [random_unit(rng, i, names) for i in range(64)]
+
+        rec = FlightRecorder(max_ticks=2, max_bytes=1 << 26)
+        engine = SchedulerEngine(chunk_size=32, min_bucket=16,
+                                 min_cluster_bucket=8, flight_recorder=rec)
+        stream = StreamingScheduler(engine, clusters, units,
+                                    slab_rows=6, slab_age_ms=1e9)
+        stream.flush()
+        stream.flush()
+
+        arrivals = 0
+        for step in range(10):
+            kind = step % 5
+            if kind == 0:  # updates
+                for r in rng.integers(0, 64, 4):
+                    u = stream.units[int(r)]
+                    if is_placeholder(u):
+                        continue
+                    stream.offer(dataclasses.replace(
+                        u, desired_replicas=int(rng.integers(1, 60))))
+            elif kind == 1:  # arrivals
+                for _ in range(3):
+                    stream.offer(random_unit(
+                        rng, 1000 + arrivals, names))
+                    arrivals += 1
+            elif kind == 2:  # deletes + updates
+                live = [u for u in stream.units if not is_placeholder(u)]
+                for r in rng.integers(0, len(live), 2):
+                    stream.remove(live[int(r)].key)
+            elif kind == 3:  # single-column capacity drift + churn
+                j = int(rng.integers(0, len(clusters)))
+                base = stream.clusters[j]
+                stream.update_cluster(dataclasses.replace(
+                    base,
+                    available={k: max(0, v // 2)
+                               for k, v in base.available.items()},
+                ))
+                u = stream.units[int(rng.integers(0, 64))]
+                if not is_placeholder(u):
+                    stream.offer(dataclasses.replace(
+                        u, desired_replicas=int(rng.integers(1, 60))))
+            else:  # mass drift: every column moves -> gate bails
+                fleet = [
+                    dataclasses.replace(
+                        c,
+                        available={k: max(0, v - v // 7)
+                                   for k, v in c.available.items()},
+                    )
+                    for c in stream.clusters
+                ]
+                stream.offer_capacity(fleet)
+
+            got = stream.flush()
+            changed = engine.last_changed
+            oracle_rec = FlightRecorder(max_ticks=2, max_bytes=1 << 26)
+            oracle = SchedulerEngine(
+                chunk_size=32, min_bucket=16, min_cluster_bucket=8,
+                flight_recorder=oracle_rec,
+            )
+            want = oracle.schedule(stream.units, stream.clusters)
+            results_equal(got, want)
+            # Flight-recorder parity for the rows this flush actually
+            # re-decided (skipped rows keep their prior records, by
+            # design): placements, per-reason rejection counts,
+            # feasible counts, and the recorded top-k — bit-identical.
+            for row in (changed or []):
+                u = stream.units[row]
+                if is_placeholder(u):
+                    continue
+                a = rec.lookup(u.key)
+                b = oracle_rec.lookup(u.key)
+                assert a is not None and b is not None, u.key
+                assert a.placements == b.placements, u.key
+                assert np.array_equal(a.reason_counts, b.reason_counts), (
+                    u.key, a.reason_counts, b.reason_counts,
+                )
+                assert a.feasible_n == b.feasible_n, u.key
+                assert np.array_equal(a.topk_idx, b.topk_idx), u.key
+                assert np.array_equal(a.topk_scores, b.topk_scores), u.key
+        # The log must actually have exercised the paths under test.
+        assert engine.drift_stats["gated"] >= 1, engine.drift_stats
+        assert engine.fetch_stats["full"] >= 1  # mass-drift bail ran
+        assert stream.flushes >= 10
+
+
+class TestDriftResolvePath:
+    def _world(self, b=96, c=24):
+        """Finite-K rows over ample capacity: score drift moves top-K
+        membership, fit never flips — the sort-free resolve's home
+        turf."""
+        gvk = "apps/v1/Deployment"
+        clusters = [
+            ClusterState(
+                name=f"m-{j:03d}",
+                labels={},
+                taints=(),
+                allocatable=parse_resources(
+                    {"cpu": "256", "memory": "1024Gi"}
+                ),
+                available=parse_resources(
+                    {"cpu": f"{40 + 7 * j}", "memory": f"{200 + 13 * j}Gi"}
+                ),
+                api_resources=frozenset({gvk}),
+            )
+            for j in range(c)
+        ]
+        units = [
+            SchedulingUnit(
+                gvk=gvk,
+                namespace="ns",
+                name=f"w-{i:04d}",
+                scheduling_mode=MODE_DIVIDE if i % 4 else "Duplicate",
+                desired_replicas=(i % 30) + 2,
+                resource_request=parse_resources({"cpu": "50m"}),
+                max_clusters=3 + i % 4,
+                weights={f"m-{j:03d}": 10 + (i + j) % 7 for j in range(c)}
+                if i % 2
+                else {},
+            )
+            for i in range(b)
+        ]
+        return units, clusters
+
+    def test_resolve_settles_score_drift_exactly(self):
+        units, clusters = self._world()
+        engine = SchedulerEngine(chunk_size=128, min_bucket=32,
+                                 min_cluster_bucket=8, narrow_m=16)
+        engine.schedule(units, clusters)
+        engine.schedule(list(units), clusters)
+        # One column goes fully free: its resource scores jump to the
+        # top, finite-K memberships flip, nobody's fit changes.
+        drifted = [
+            dataclasses.replace(c, available=dict(c.allocatable))
+            if j == 5
+            else c
+            for j, c in enumerate(clusters)
+        ]
+        got = engine.schedule(units, drifted)
+        assert engine.drift_stats["gated"] >= 1, engine.drift_stats
+        assert engine.drift_stats["resolve"] > 0, engine.drift_stats
+        want = fresh_results(units, drifted, chunk_size=128,
+                             min_bucket=32, min_cluster_bucket=8,
+                             narrow_m=16)
+        results_equal(got, want)
+
+    def test_resolve_chain_stays_exact_across_consecutive_drifts(self):
+        """The gate scatters refreshed totals and the resolve repairs
+        the prev planes in place — a CHAIN of drifts must stay exact
+        (stale state would compound)."""
+        units, clusters = self._world(b=64, c=20)
+        engine = SchedulerEngine(chunk_size=64, min_bucket=32,
+                                 min_cluster_bucket=8, narrow_m=16)
+        engine.schedule(units, clusters)
+        engine.schedule(list(units), clusters)
+        world = list(clusters)
+        rng = np.random.default_rng(3)
+        for step in range(5):
+            j = int(rng.integers(0, len(world)))
+            world = [
+                dataclasses.replace(
+                    c,
+                    available={
+                        "cpu": int(c.available["cpu"] * (0.5 + 0.2 * step)),
+                        "memory": c.available["memory"],
+                    },
+                )
+                if i == j
+                else c
+                for i, c in enumerate(world)
+            ]
+            got = engine.schedule(units, world)
+            want = fresh_results(units, world, chunk_size=64,
+                                 min_bucket=32, min_cluster_bucket=8,
+                                 narrow_m=16)
+            results_equal(got, want)
+        assert engine.drift_stats["resolve"] > 0, engine.drift_stats
+
+    def test_resolve_disabled_falls_back_to_slabs(self):
+        units, clusters = self._world(b=48, c=20)
+        engine = SchedulerEngine(chunk_size=64, min_bucket=32,
+                                 min_cluster_bucket=8, narrow_m=16)
+        engine.drift_resolve = False
+        engine.schedule(units, clusters)
+        engine.schedule(list(units), clusters)
+        drifted = [
+            dataclasses.replace(
+                c, available={"cpu": 180_000, "memory": c.available["memory"]}
+            )
+            if j == 2
+            else c
+            for j, c in enumerate(clusters)
+        ]
+        got = engine.schedule(units, drifted)
+        assert engine.drift_stats["resolve"] == 0
+        want = fresh_results(units, drifted, chunk_size=64, min_bucket=32,
+                             min_cluster_bucket=8, narrow_m=16)
+        results_equal(got, want)

@@ -75,6 +75,12 @@ def load_rounds(root: Path) -> list[dict]:
                 # gate work (ISSUE 4).
                 "fetch_format": detail.get("fetch_format"),
                 "fetch_bytes": detail.get("fetch_bytes"),
+                "tick_overflow": (detail.get("stage_ms") or {}).get(
+                    "fetch_overflow_rows_tick"
+                ),
+                "drift_overflow": (detail.get("stage_ms") or {}).get(
+                    "drift_overflow_rows"
+                ),
                 "narrow": detail.get("narrow"),
                 "drift_tick_ms": (detail.get("stage_ms") or {}).get(
                     "drift_tick_ms"
@@ -85,6 +91,14 @@ def load_rounds(root: Path) -> list[dict]:
                 "drift_device_ms": (
                     (detail.get("stage_ms") or {}).get("drift_stage_ms") or {}
                 ).get("device"),
+                # GATED (not informational): the drift tick's gate-wait
+                # attribution.  r08 measured 60.4s of a 98.8s c5 drift
+                # tick blocked on gate compute; the streaming-scheduler
+                # work drove it to ~0, and this gate keeps that
+                # regression class from silently returning.
+                "drift_gate_wait_ms": (
+                    (detail.get("stage_ms") or {}).get("drift_stage_ms") or {}
+                ).get("gate_wait"),
             }
         )
     rounds.sort(key=lambda r: r["round"])
@@ -128,6 +142,14 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             f"fetch_bytes={latest['fetch_bytes']}{note} — informational, "
             f"not gated"
         )
+    if latest.get("tick_overflow") is not None or latest.get(
+        "drift_overflow"
+    ) is not None:
+        print(
+            f"bench-gate: overflow rows/tick={latest.get('tick_overflow')} "
+            f"drift={latest.get('drift_overflow')} — adaptive-K watch, "
+            f"informational"
+        )
     if latest.get("drift_tick_ms") is not None:
         prior_drift = [
             r["drift_tick_ms"] for r in priors
@@ -157,12 +179,20 @@ def gate(rounds: list[dict], tolerance: float) -> int:
         ("tick_ms", "tick_ms"),
         ("device_ms", "stage_ms.device"),
         ("drift_device_ms", "drift_stage_ms.device"),
+        ("drift_gate_wait_ms", "drift_stage_ms.gate_wait"),
     ):
         prior_vals = [r.get(key) for r in priors if r.get(key) is not None]
         if latest.get(key) is None or not prior_vals:
             continue
         best = min(prior_vals)
         ceil = best * (1.0 + tolerance)
+        if key == "drift_gate_wait_ms":
+            # gate_wait sits near zero once the gates pipeline; a pure
+            # percentage ceiling over a ~25ms best would fail on timer
+            # jitter.  The absolute slack still catches the regression
+            # class this gate exists for (60.4s at r08) by 2+ orders of
+            # magnitude.
+            ceil += 250.0
         print(
             f"bench-gate: {label}={latest[key]:.1f} vs best prior "
             f"{best:.1f} (ceiling {ceil:.1f})"
@@ -175,6 +205,92 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             )
             ok = False
     print("bench-gate: ok" if ok else "bench-gate: FAILED")
+    return 0 if ok else 1
+
+
+_CHURN_RE = re.compile(r"^BENCH_CHURN_r(\d+)\.json$")
+
+
+def gate_churn(root: Path, tolerance: float) -> int:
+    """Gate the sustained-churn scenario artifacts (BENCH_CHURN_r*.json,
+    written by ``make bench-churn``): sustained objects-revalidated/s is
+    gated like the main throughput metric, and event->placement latency
+    p99 is gated once a comparable prior round carries it (informational
+    on first landing)."""
+    rounds = []
+    for path in sorted(root.glob("BENCH_CHURN_r*.json")):
+        m = _CHURN_RE.match(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench-gate: {path.name}: unreadable ({e})", file=sys.stderr)
+            return 2
+        parsed = doc.get("parsed") or {}
+        if doc.get("rc", 0) != 0 or parsed.get("value") is None:
+            continue
+        detail = parsed.get("detail") or {}
+        rounds.append(
+            {
+                "round": int(m.group(1)),
+                "path": path.name,
+                "metric": parsed.get("metric", ""),
+                "platform": detail.get("platform") or "unknown",
+                "value": float(parsed["value"]),
+                "p99": detail.get("latency_ms_p99"),
+            }
+        )
+    if not rounds:
+        return 0
+    rounds.sort(key=lambda r: r["round"])
+    latest = rounds[-1]
+    priors = [
+        r
+        for r in rounds[:-1]
+        if r["metric"] == latest["metric"]
+        and r["platform"] == latest["platform"]
+    ]
+    if not priors:
+        print(
+            f"bench-gate: {latest['path']} ({latest['metric']}) has no "
+            f"comparable prior churn round; informational only"
+        )
+        return 0
+    ok = True
+    best = max(r["value"] for r in priors)
+    floor = best * (1.0 - tolerance)
+    print(
+        f"bench-gate: churn {latest['path']} value={latest['value']:.1f} "
+        f"vs best prior {best:.1f} (floor {floor:.1f})"
+    )
+    if latest["value"] < floor:
+        print(
+            f"bench-gate: CHURN THROUGHPUT REGRESSION: "
+            f"{latest['value']:.1f} < {floor:.1f}",
+            file=sys.stderr,
+        )
+        ok = False
+    prior_p99 = [r["p99"] for r in priors if r.get("p99") is not None]
+    if latest.get("p99") is not None:
+        if prior_p99:
+            ceil = min(prior_p99) * (1.0 + tolerance) + 250.0
+            print(
+                f"bench-gate: churn latency_ms_p99={latest['p99']:.1f} vs "
+                f"best prior {min(prior_p99):.1f} (ceiling {ceil:.1f})"
+            )
+            if latest["p99"] > ceil:
+                print(
+                    f"bench-gate: CHURN LATENCY REGRESSION: p99 "
+                    f"{latest['p99']:.1f}ms > {ceil:.1f}ms",
+                    file=sys.stderr,
+                )
+                ok = False
+        else:
+            print(
+                f"bench-gate: churn latency_ms_p99={latest['p99']:.1f} — "
+                f"informational (first round carrying it)"
+            )
     return 0 if ok else 1
 
 
@@ -223,8 +339,9 @@ def main() -> int:
     )
     args = parser.parse_args()
     rc = gate(load_rounds(args.root), args.tolerance)
+    churn_rc = gate_churn(args.root, args.tolerance)
     report_e2e_chaos(args.root)
-    return rc
+    return rc or churn_rc
 
 
 if __name__ == "__main__":
