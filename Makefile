@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-churn bench-gate graft-check graft-dryrun native metrics-lint chaos chaos-e2e
+.PHONY: test test-fast bench bench-churn bench-gate graft-check graft-dryrun native metrics-lint chaos chaos-e2e profile profile-smoke
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -51,6 +51,22 @@ test-fast: metrics-lint
 
 bench:
 	python bench.py
+
+# jax.profiler capture around live scheduling ticks (tools/
+# profile_smoke.py): writes the trace directory + the dispatch
+# ledger's waterfall.json under KT_PROFILE_DIR and prints the paths.
+# `profile` runs a config-3-sized world; `profile-smoke` is the 1-tick
+# CPU sanity check (see docs/observability.md § Device-time
+# attribution).
+profile:
+	PROFILE_OBJECTS=$${PROFILE_OBJECTS:-10000} \
+		PROFILE_CLUSTERS=$${PROFILE_CLUSTERS:-500} \
+		PROFILE_TICKS=$${PROFILE_TICKS:-3} \
+		python tools/profile_smoke.py
+
+profile-smoke:
+	$(PYTEST_ENV) PROFILE_OBJECTS=1024 PROFILE_CLUSTERS=64 \
+		PROFILE_TICKS=1 python tools/profile_smoke.py
 
 # Sustained-churn streaming scenario at a tier-1-budget config: object
 # arrivals/updates + periodic capacity drift stream through the slab

@@ -261,12 +261,35 @@ def time_batched(rng, units, clusters, followers):
     detail = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
     fetch_bytes0 = engine.fetch_bytes_total
     overflow_t0 = engine.overflow_rows_total
+    # Optional jax.profiler capture around the timed ticks
+    # (KT_PROFILE_TICKS=N, artifact under KT_PROFILE_DIR): what
+    # tpu_capture.py uses to grab one on-chip trace per window.
+    profile_ticks = int(os.environ.get("KT_PROFILE_TICKS", "0") or 0)
+    profile_dir = None
+    timed_tick_ids = []
     t0 = time.perf_counter()
-    for _ in range(TICKS):
+    for i in range(TICKS):
+        if profile_ticks and i == 0:
+            from kubeadmiral_tpu.runtime import devprof as _devprof
+
+            import jax as _jax
+
+            profile_dir = os.path.join(
+                _devprof.profile_dir(),
+                time.strftime("%Y%m%d-%H%M%S") + f"-bench-c{CONFIG}",
+            )
+            os.makedirs(profile_dir, exist_ok=True)
+            _jax.profiler.start_trace(profile_dir)
         units = churn(rng, units)
         results = engine.schedule(units, clusters, follower_index=fidx)
+        timed_tick_ids.append(engine.last_tick_id)
         for stage, secs in engine.timings.items():
             detail[stage] = detail.get(stage, 0.0) + secs
+        if profile_ticks and i + 1 == min(profile_ticks, TICKS):
+            import jax as _jax
+
+            _jax.profiler.stop_trace()
+            profile_ticks = 0
     dt = (time.perf_counter() - t0) / TICKS
     tick_fetch_bytes = (engine.fetch_bytes_total - fetch_bytes0) / TICKS
     tick_overflow_rows = (engine.overflow_rows_total - overflow_t0) / TICKS
@@ -294,7 +317,65 @@ def time_batched(rng, units, clusters, followers):
         for k in engine.upload_bytes
     }
 
+    # Device-time attribution (ISSUE 8): decompose the host stage
+    # timers into per-program device occupancy + queue wait from the
+    # dispatch ledger (runtime/devprof.py).  reconcile_pct compares the
+    # summed device_ms against the host-measured device stage — the
+    # acceptance check that the attribution is measuring the same
+    # physics the stage timers do (steady ticks reconcile tightly; the
+    # drift tick's queue_ms is the measured dispatch-backpressure number
+    # PR 7 could only infer).
+    drift_tick_id = engine.last_tick_id
+
+    def _attr(summaries):
+        merged = {"device_ms": 0.0, "queue_ms": 0.0, "records": 0,
+                  "stage_device_ms": 0.0, "by_program": {}}
+        for s in summaries:
+            if not s or s.get("records") is None:
+                continue
+            merged["device_ms"] += s.get("device_ms", 0.0)
+            merged["queue_ms"] += s.get("queue_ms", 0.0)
+            merged["records"] += s.get("records", 0)
+            merged["stage_device_ms"] += (s.get("stage_ms") or {}).get(
+                "device", 0.0
+            )
+            for kind, slot in (s.get("by_program") or {}).items():
+                dst = merged["by_program"].setdefault(
+                    kind, {"n": 0, "device_ms": 0.0, "queue_ms": 0.0}
+                )
+                dst["n"] += slot["n"]
+                dst["device_ms"] += slot["device_ms"]
+                dst["queue_ms"] += slot["queue_ms"]
+        for k in ("device_ms", "queue_ms", "stage_device_ms"):
+            merged[k] = round(merged[k], 1)
+        for slot in merged["by_program"].values():
+            slot["device_ms"] = round(slot["device_ms"], 1)
+            slot["queue_ms"] = round(slot["queue_ms"], 1)
+        if merged["stage_device_ms"]:
+            merged["reconcile_pct"] = round(
+                100.0 * merged["device_ms"] / merged["stage_device_ms"], 1
+            )
+        return merged
+
+    ledger = engine.devprof
+    steady_attr = _attr(
+        [ledger.tick_summary(t) for t in timed_tick_ids]
+    )
+    drift_attr = _attr([ledger.tick_summary(drift_tick_id)])
+    drift_wf = ledger.waterfall(tick=drift_tick_id, max_records=160)
+    device_attr = {
+        "enabled": ledger.enabled,
+        "steady": steady_attr,
+        "drift": drift_attr,
+        "waterfall_drift": (
+            drift_wf["ticks"][-1] if drift_wf.get("ticks") else None
+        ),
+    }
+    if profile_dir is not None:
+        device_attr["profile_dir"] = profile_dir
+
     detail = {k: round(v / TICKS * 1e3, 1) for k, v in detail.items()}
+    detail["device_attr"] = device_attr
     detail["drift_tick_ms"] = round(drift_ms, 1)
     # ISSUE 4: the drift-path stage breakdown + dispatch counts +
     # host->device byte split, so the full-revalidation win (and the
@@ -753,6 +834,7 @@ def main():
     )
 
     telemetry = detail.pop("telemetry", None)
+    device_attr = detail.pop("device_attr", None)
     fetch_format = detail.pop("fetch_format", None)
     fetch_bytes = detail.pop("fetch_bytes", None)
     fetch_bytes_run = detail.pop("fetch_bytes_run_total", None)
@@ -773,6 +855,7 @@ def main():
             "fetch_overflow_rows": fetch_overflow,
             "narrow": narrow,
             "stage_ms": detail,
+            "device_attr": device_attr,
             "telemetry": telemetry,
             "baseline": "native-seqsched(g++ -O3)"
             if native_seconds is not None

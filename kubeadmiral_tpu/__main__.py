@@ -89,6 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
+    from kubeadmiral_tpu.runtime.logconf import setup_logging
+
+    setup_logging()  # KT_LOG_LEVEL / KT_LOG_JSON (docs/operations.md)
+
     from kubeadmiral_tpu.models.ftc import FEDERATED_TYPE_CONFIGS, default_ftcs, ftc_to_object
     from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
     from kubeadmiral_tpu.runtime.leaderelection import LeaderElector

@@ -31,6 +31,7 @@ Statuses mirror fedtypesv1a1.PropagationStatus values.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import random
 import threading
@@ -85,6 +86,8 @@ MANAGED_LABEL_FALSE = "ManagedLabelFalse"
 FINALIZER_CHECK_FAILED = "FinalizerCheckFailed"
 
 ADOPTED_ANNOTATION = C.PREFIX + "adopted"
+
+log = logging.getLogger("kubeadmiral.dispatch")
 
 
 # -- retry / deadline budget ----------------------------------------------
@@ -218,7 +221,17 @@ def run_batch_with_retries(
             break
         if breakers is not None:
             breakers.count_retry(cluster, len(retryable))
-        time.sleep(delay)
+        log.debug(
+            "retrying %d member-write op(s): cluster=%s attempt=%d "
+            "delay_ms=%.0f", len(retryable), cluster, attempt + 1, delay * 1e3,
+        )
+        # The backoff wait IS the retry path's latency — a span makes it
+        # visible in /debug/trace next to the member_flush it delays.
+        with trace.span(
+            "dispatch.retry", cluster=cluster, attempt=attempt + 1,
+            ops=len(retryable),
+        ):
+            time.sleep(delay)
         pending = retryable
         attempt += 1
     if breaker is not None:
@@ -425,11 +438,23 @@ class BatchSink:
             at their pre-recorded *_TIMED_OUT values; a genuinely
             stalled flush (vs one merely queued behind a sick sibling)
             also opens the member's breaker."""
-            if self.breakers is None:
-                return
-            self.breakers.count_shed(cluster, len(entries))
-            if stalled:
-                self.breakers.for_member(cluster).record_failure(timeout=True)
+            log.warning(
+                "shedding %d member write(s): cluster=%s stalled=%s "
+                "(deadline %.1fs expired; statuses stay *_TIMED_OUT, the "
+                "owning worker's backoff requeue re-drives them)",
+                len(entries), cluster, stalled, timeout,
+            )
+            with trace.span(
+                "dispatch.shed", cluster=cluster, ops=len(entries),
+                stalled=stalled,
+            ):
+                if self.breakers is None:
+                    return
+                self.breakers.count_shed(cluster, len(entries))
+                if stalled:
+                    self.breakers.for_member(cluster).record_failure(
+                        timeout=True
+                    )
 
         if self._pool is not None:
             futures = {

@@ -506,7 +506,9 @@ class SchedulerController:
             return results
         with trace.span(
             "scheduler.engine_tick", ftc=self.ftc.name, units=len(units)
-        ), self.metrics.timer(f"scheduler-{self.ftc.name}.engine_latency"):
+        ) as tick_span, self.metrics.timer(
+            f"scheduler-{self.ftc.name}.engine_latency"
+        ):
             # ONE watch-thread-safe snapshot for the whole tick: the
             # score-decode decision and the select pass must agree on
             # the plugin set, or a select plugin registered mid-tick
@@ -522,14 +524,19 @@ class SchedulerController:
             outcomes = self._apply_webhook_selects(
                 units, clusters, outcomes, plugins, webhook_eval
             )
+            tick_span.set(tick=getattr(self.engine, "last_tick_id", 0))
         self.metrics.counter(f"scheduler-{self.ftc.name}.scheduled", len(units))
         self.metrics.counter(
             "scheduler_scheduled_total", len(units), ftc=self.ftc.name
         )
 
         hb = HostBatch(self.host)
+        # The engine tick id rides the persist span too, so the event ->
+        # engine -> member-write timeline joins on one id in
+        # /debug/trace (and against /debug/waterfall).
         with trace.span(
-            "scheduler.persist", ftc=self.ftc.name, units=len(to_schedule)
+            "scheduler.persist", ftc=self.ftc.name, units=len(to_schedule),
+            tick=getattr(self.engine, "last_tick_id", 0),
         ):
             try:
                 for (key, fed_obj, policy, trigger), outcome in zip(

@@ -1,0 +1,504 @@
+"""Device-time attribution: the dispatch ledger + on-demand profiler.
+
+Every stage number the engine reports is a host-side ``perf_counter``
+interval, which conflates XLA device compute with dispatch backpressure
+— a pipelined tick can spend 12s of its "decode" stage blocked behind
+queued device work and the stage histograms cannot say so.  This module
+turns "the tick took X ms" into "program P at shape S occupied the
+device for Y ms and waited Z ms in queue":
+
+* **Dispatch ledger** (:class:`DispatchLedger`).  Every engine/pipeline
+  program launch calls :meth:`DispatchLedger.observe` with the program
+  kind and the dispatched output; the hot path records only a
+  ``perf_counter`` timestamp and a deque append (~1µs — the ledger
+  stays on in production, ``KT_DEVPROF=0`` disables).  A daemon watcher
+  thread observes readiness asynchronously: it blocks on a small
+  representative output leaf of each record IN DISPATCH ORDER and
+  applies the single-stream chain model —
+
+      start_i   = max(dispatch_ts_i, ready_ts_{i-1})
+      device_s  = ready_ts_i - start_i
+      queue_s   = start_i - dispatch_ts_i
+
+  which is exact for an in-order device queue (both CPU and TPU
+  streams execute enqueued programs FIFO): ``device_s`` is the time
+  the program actually occupied the device, ``queue_s`` the time it
+  sat enqueued behind earlier work (the backpressure the host-side
+  stage timers misattribute).  Records dispatched while no tick is
+  open (the prewarm thread) land in a bounded "untracked" ring.
+
+* **Per-tick waterfalls.**  The engine brackets each ``schedule()``
+  call with :meth:`begin_tick`/:meth:`end_tick`; the resulting
+  waterfall (ordered dispatch records with the host-side stage split
+  attached) is served at ``GET /debug/waterfall`` and embedded in
+  bench ``detail.device_attr``, so BENCH_DETAIL stage numbers decompose
+  into device-attributed per-program costs.
+
+* **On-demand ``jax.profiler`` capture** (:func:`capture_jax_profile`).
+  ``GET /debug/profile?seconds=N&mode=jax`` starts/stops a profiler
+  trace around live ticks and writes the artifact under
+  ``KT_PROFILE_DIR`` (works on CPU and TPU; load the directory in
+  TensorBoard's profile plugin / xprof).  ``make profile`` /
+  ``make profile-smoke`` drive the same capture from the CLI.
+
+Holding an output reference could collide with buffer donation (the
+engine donates prev planes into the next tick): a donated-away array
+raises on ``block_until_ready``, which the watcher treats as "ready at
+observation time" and tags ``note="donated"`` — attribution degrades
+gracefully instead of crashing the hot path.
+
+See docs/observability.md § Device-time attribution.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# Known program kinds (the engine's central wrappers): documented in
+# docs/observability.md so waterfall readers have one vocabulary.
+PROGRAM_KINDS = (
+    "tick",            # fused dense/compact tick (full-width solve + diff)
+    "tick_narrow",     # narrow tick (phase 1 + top-M candidate solve + cert)
+    "narrow_fallback", # dense re-solve of certificate-failed narrow rows
+    "gate",            # drift gate (row classification from cached planes)
+    "wcheck",          # drift dynamic-weight comparison
+    "resolve",         # sort-free drift survivor resolve
+    "gather",          # delta-row plane gathers (dense wire)
+    "pack",            # packed-export wire compaction (gather/full)
+    "overflow",        # K-overflow bit-packed row re-fetch gather
+    "repair",          # in-place prev-plane / narrow-output scatter repair
+    "patch",           # stale-row device input scatter repair
+    "stack",           # window-drain same-shape transfer stacking
+    "zeros",           # device-resident zero prev-plane builders
+)
+
+_UNTRACKED_RING = 4096
+
+
+class DispatchRecord:
+    __slots__ = (
+        "seq", "tick", "kind", "shape", "t_dispatch", "t_ready",
+        "queue_s", "device_s", "note",
+    )
+
+    def __init__(self, seq: int, tick: Optional[int], kind: str):
+        self.seq = seq
+        self.tick = tick
+        self.kind = kind
+        self.shape = ""
+        self.t_dispatch = time.perf_counter()
+        self.t_ready: Optional[float] = None
+        self.queue_s = 0.0
+        self.device_s = 0.0
+        self.note = "ok"
+
+
+class _TickEntry:
+    __slots__ = (
+        "tick", "t0", "t1", "meta", "stage_s", "records", "closed",
+        "owner",
+    )
+
+    def __init__(self, tick: int, meta: dict):
+        self.tick = tick
+        self.t0 = time.perf_counter()
+        self.t1: Optional[float] = None
+        self.meta = meta
+        self.stage_s: dict = {}
+        self.records: list[DispatchRecord] = []
+        self.closed = False
+        # Dispatches are attributed to this tick only from the thread
+        # that opened the bracket: a concurrent prewarm thread's
+        # programs must not pollute a live tick's waterfall.
+        self.owner = threading.get_ident()
+
+
+def _pick_leaf(out):
+    """A small representative jax.Array leaf of a dispatched output
+    pytree: readiness of one output of a fused program implies the
+    program ran to completion, and holding the smallest leaf pins the
+    least memory until the watcher retires the record."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(out)
+    except Exception:
+        leaves = [out]
+    best = None
+    best_bytes = None
+    for leaf in leaves:
+        if not hasattr(leaf, "block_until_ready"):
+            continue
+        nbytes = getattr(leaf, "nbytes", 0)
+        if best is None or nbytes < best_bytes:
+            best, best_bytes = leaf, nbytes
+    return best
+
+
+class DispatchLedger:
+    """Central dispatch-site wrapper state: observe() on the hot path,
+    a single watcher thread retiring records in dispatch order."""
+
+    def __init__(
+        self,
+        enabled: Optional[bool] = None,
+        ring_ticks: Optional[int] = None,
+        metrics=None,
+    ):
+        if enabled is None:
+            enabled = os.environ.get("KT_DEVPROF", "1") not in (
+                "0", "false", "no",
+            )
+        self.enabled = bool(enabled)
+        if ring_ticks is None:
+            ring_ticks = int(os.environ.get("KT_DEVPROF_TICKS", "8"))
+        self.metrics = metrics
+        self._cv = threading.Condition()
+        self._pending: deque = deque()  # (record, leaf)
+        self._ticks: deque[_TickEntry] = deque(maxlen=max(1, ring_ticks))
+        self._open: Optional[_TickEntry] = None
+        self._untracked: deque[DispatchRecord] = deque(maxlen=_UNTRACKED_RING)
+        self._seq = 0
+        self._retired_seq = 0
+        self._tick_seq = 0
+        self._chain_ready: Optional[float] = None
+        self.inflight = 0
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring -----------------------------------------------------------
+    def attach(self, metrics) -> None:
+        """Point histogram emission at a registry (the engine attaches
+        its own; last writer wins for the process-default ledger)."""
+        self.metrics = metrics
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._watch, name="devprof-watcher", daemon=True
+            )
+            self._thread.start()
+
+    # -- hot path ---------------------------------------------------------
+    def observe(self, kind: str, out) -> None:
+        """Record one device dispatch (call immediately after the
+        program launch returns).  Cost: one perf_counter read, a leaf
+        pick, and a lock-guarded deque append."""
+        if not self.enabled:
+            return
+        leaf = _pick_leaf(out)
+        if leaf is None:
+            return  # host-only output: nothing dispatched
+        with self._cv:
+            self._seq += 1
+            open_entry = self._open
+            tick = (
+                open_entry.tick
+                if open_entry is not None
+                and open_entry.owner == threading.get_ident()
+                else None
+            )
+            rec = DispatchRecord(self._seq, tick, kind)
+            self._pending.append((rec, leaf))
+            self.inflight += 1
+            self._ensure_thread()
+            self._cv.notify_all()
+
+    # -- tick bracketing --------------------------------------------------
+    def begin_tick(self, **meta) -> int:
+        """Open a tick bracket; returns the ledger-wide tick id.  The
+        engine serializes schedule(), so one bracket is open at a time
+        (a nested/overlapping begin closes the previous bracket)."""
+        if not self.enabled:
+            return 0
+        with self._cv:
+            self._tick_seq += 1
+            if self._open is not None and not self._open.closed:
+                self._finish_open_locked()
+            self._open = _TickEntry(self._tick_seq, dict(meta))
+            return self._tick_seq
+
+    def _finish_open_locked(self) -> None:
+        entry = self._open
+        entry.closed = True
+        if entry.t1 is None:
+            entry.t1 = time.perf_counter()
+        self._ticks.append(entry)
+
+    def end_tick(self, stage_s: Optional[dict] = None) -> None:
+        """Close the open bracket, attaching the host-side stage split
+        (seconds).  Non-blocking: readiness observation may still be in
+        flight — waterfall() drains before reading."""
+        if not self.enabled:
+            return
+        with self._cv:
+            if self._open is None:
+                return
+            self._open.t1 = time.perf_counter()
+            if stage_s:
+                self._open.stage_s = {
+                    k: float(v) for k, v in stage_s.items()
+                }
+            self._finish_open_locked()
+            self._open = None
+
+    # -- watcher ----------------------------------------------------------
+    def _watch(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending:
+                    self._cv.wait()
+                rec, leaf = self._pending.popleft()
+            try:
+                leaf.block_until_ready()
+            except Exception:
+                # Donated/deleted buffer: the program certainly finished
+                # before its output could be donated into a later
+                # dispatch, so "ready by now" is the best lower bound.
+                rec.note = "donated"
+            t_ready = time.perf_counter()
+            try:
+                rec.shape = "x".join(str(d) for d in leaf.shape)
+            except Exception:
+                rec.shape = "?"
+            del leaf
+            start = rec.t_dispatch
+            if self._chain_ready is not None and self._chain_ready > start:
+                start = self._chain_ready
+            if t_ready < start:
+                t_ready = start
+            rec.queue_s = start - rec.t_dispatch
+            rec.device_s = t_ready - start
+            rec.t_ready = t_ready
+            self._chain_ready = t_ready
+            m = self.metrics
+            if m is not None:
+                try:
+                    m.histogram(
+                        "engine_device_seconds", rec.device_s,
+                        program=rec.kind,
+                    )
+                    m.histogram(
+                        "engine_queue_wait_seconds", rec.queue_s,
+                        program=rec.kind,
+                    )
+                except Exception:
+                    pass
+            with self._cv:
+                self.inflight -= 1
+                self._retired_seq = rec.seq
+                entry = None
+                if rec.tick is not None:
+                    if self._open is not None and self._open.tick == rec.tick:
+                        entry = self._open
+                    else:
+                        for e in reversed(self._ticks):
+                            if e.tick == rec.tick:
+                                entry = e
+                                break
+                if entry is not None:
+                    entry.records.append(rec)
+                else:
+                    self._untracked.append(rec)
+                if m is not None:
+                    try:
+                        m.store("engine_dispatch_inflight", self.inflight)
+                    except Exception:
+                        pass
+                self._cv.notify_all()
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Wait until every observed record has been retired (the
+        programs themselves have long finished by the time callers ask
+        — this waits out the watcher, not the device)."""
+        if not self.enabled:
+            return True
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending or self.inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=remaining)
+        return True
+
+    # -- readback ---------------------------------------------------------
+    @staticmethod
+    def _summarize(records) -> dict:
+        by: dict[str, dict] = {}
+        dev = queue = 0.0
+        for r in records:
+            slot = by.setdefault(
+                r.kind, {"n": 0, "device_ms": 0.0, "queue_ms": 0.0}
+            )
+            slot["n"] += 1
+            slot["device_ms"] += r.device_s * 1e3
+            slot["queue_ms"] += r.queue_s * 1e3
+            dev += r.device_s
+            queue += r.queue_s
+        for slot in by.values():
+            slot["device_ms"] = round(slot["device_ms"], 3)
+            slot["queue_ms"] = round(slot["queue_ms"], 3)
+        return {
+            "records": len(records),
+            "device_ms": round(dev * 1e3, 3),
+            "queue_ms": round(queue * 1e3, 3),
+            "by_program": by,
+        }
+
+    def tick_summary(self, tick: Optional[int] = None, timeout: float = 5.0) -> dict:
+        """Per-program device/queue totals for one tick (default: the
+        most recently closed one)."""
+        if not self.enabled:
+            return {"enabled": False}
+        self.drain(timeout)
+        with self._cv:
+            entry = self._find_locked(tick)
+            if entry is None:
+                return {"enabled": True, "tick": None, "records": 0}
+            summary = self._summarize(entry.records)
+            summary.update(
+                tick=entry.tick,
+                wall_ms=round(((entry.t1 or entry.t0) - entry.t0) * 1e3, 3),
+                stage_ms={
+                    k: round(v * 1e3, 3) for k, v in entry.stage_s.items()
+                },
+                meta=dict(entry.meta),
+            )
+            return summary
+
+    def _find_locked(self, tick: Optional[int]) -> Optional[_TickEntry]:
+        if tick is None:
+            return self._ticks[-1] if self._ticks else None
+        for e in reversed(self._ticks):
+            if e.tick == tick:
+                return e
+        return None
+
+    def waterfall(
+        self,
+        tick: Optional[int] = None,
+        max_ticks: int = 4,
+        max_records: int = 512,
+        timeout: float = 5.0,
+    ) -> dict:
+        """The waterfall artifact: the most recent ticks' ordered
+        dispatch records with the host/device split attached (schema in
+        docs/observability.md)."""
+        if not self.enabled:
+            return {"enabled": False, "ticks": []}
+        self.drain(timeout)
+        out_ticks = []
+        with self._cv:
+            entries = (
+                [e for e in self._ticks if e.tick == tick]
+                if tick is not None
+                else list(self._ticks)[-max_ticks:]
+            )
+            for e in entries:
+                records = sorted(e.records, key=lambda r: r.seq)
+                trimmed = len(records) > max_records
+                rows = [
+                    {
+                        "seq": r.seq,
+                        "kind": r.kind,
+                        "shape": r.shape,
+                        "t_ms": round((r.t_dispatch - e.t0) * 1e3, 3),
+                        "queue_ms": round(r.queue_s * 1e3, 3),
+                        "device_ms": round(r.device_s * 1e3, 3),
+                        "ready_ms": round(
+                            ((r.t_ready or r.t_dispatch) - e.t0) * 1e3, 3
+                        ),
+                        **({"note": r.note} if r.note != "ok" else {}),
+                    }
+                    for r in records[:max_records]
+                ]
+                summary = self._summarize(records)
+                out_ticks.append(
+                    {
+                        "tick": e.tick,
+                        "meta": dict(e.meta),
+                        "wall_ms": round(
+                            ((e.t1 or e.t0) - e.t0) * 1e3, 3
+                        ),
+                        "stage_ms": {
+                            k: round(v * 1e3, 3)
+                            for k, v in e.stage_s.items()
+                        },
+                        "device_ms": summary["device_ms"],
+                        "queue_ms": summary["queue_ms"],
+                        "by_program": summary["by_program"],
+                        "records": rows,
+                        **({"records_trimmed": True} if trimmed else {}),
+                    }
+                )
+            untracked = self._summarize(self._untracked)
+        return {
+            "enabled": True,
+            "inflight": self.inflight,
+            "ticks": out_ticks,
+            "untracked": untracked,
+        }
+
+
+_default = DispatchLedger()
+
+
+def get_default() -> DispatchLedger:
+    return _default
+
+
+# -- on-demand jax.profiler capture ---------------------------------------
+_capture_lock = threading.Lock()
+
+
+def profile_dir() -> str:
+    """Root directory for on-demand profiler artifacts
+    (``KT_PROFILE_DIR``, default ``/tmp/kt-jax-profile``)."""
+    return os.environ.get("KT_PROFILE_DIR", "/tmp/kt-jax-profile")
+
+
+def capture_jax_profile(seconds: float = 2.0, out_dir: Optional[str] = None) -> dict:
+    """Capture a ``jax.profiler`` trace of whatever the process is
+    doing for ``seconds`` (live ticks included) into a fresh
+    timestamped subdirectory of ``out_dir`` (default
+    :func:`profile_dir`).  One capture at a time — overlapping traces
+    would corrupt each other.  Works on CPU and TPU; load the directory
+    with TensorBoard's profile plugin (``tensorboard --logdir <dir>``)
+    or xprof."""
+    seconds = max(0.05, min(float(seconds), 120.0))
+    root = out_dir or profile_dir()
+    target = os.path.join(
+        root, time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}"
+    )
+    if not _capture_lock.acquire(blocking=False):
+        return {"error": "a profiler capture is already running"}
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        os.makedirs(target, exist_ok=True)
+        jax.profiler.start_trace(target)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as e:
+        return {"error": f"profiler capture failed: {e}", "dir": target}
+    finally:
+        _capture_lock.release()
+    n_files = sum(len(files) for _, _, files in os.walk(target))
+    # wall_s >> seconds is expected on a BUSY process: start/stop_trace
+    # serialize against in-flight XLA activity (measured ~8s activation
+    # under continuous dispatch on CPU) — the capture itself still
+    # covers ~`seconds` of live ticks.  HTTP callers must budget the
+    # wall, not `seconds` (docs/observability.md profiler runbook).
+    return {
+        "dir": target,
+        "seconds": seconds,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "files": n_files,
+    }

@@ -307,8 +307,13 @@ class ControllerManager:
         Controllers started later — new/changed FTCs — are threaded as
         they appear."""
         from kubeadmiral_tpu.runtime.gctune import tune_gc_for_service
+        from kubeadmiral_tpu.runtime.logconf import setup_logging
 
         tune_gc_for_service()
+        # One process-wide handler for the kubeadmiral.* logger tree
+        # (KT_LOG_LEVEL / KT_LOG_JSON; idempotent — an embedder that
+        # configured logging first wins via its own handlers).
+        setup_logging()
         self._threaded_workers = workers_per_controller
         # Pre-warm the engine's XLA programs for the current topology in
         # a background thread: the first real scheduling tick should hit

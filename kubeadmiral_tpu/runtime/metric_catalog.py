@@ -114,6 +114,31 @@ CATALOG: dict[str, MetricSpec] = {
         "gauge", "gates", (),
         "Drift-gate programs currently in flight on the device (set at "
         "gate-drain entry, cleared when every gated chunk settles)."),
+    # -- dispatch ledger (runtime/devprof.py) -----------------------------
+    "engine_device_seconds": MetricSpec(
+        "histogram", "seconds", ("program",),
+        "Measured device occupancy per dispatched program (the dispatch "
+        "ledger's in-order chain model: ready_i - max(dispatch_i, "
+        "ready_{i-1})), labeled by program kind (tick, tick_narrow, "
+        "gate, resolve, pack, ...).  Pure execution time — jit tracing "
+        "happens host-side before the observation and never lands "
+        "here."),
+    "engine_queue_wait_seconds": MetricSpec(
+        "histogram", "seconds", ("program",),
+        "Time each dispatched program sat enqueued behind earlier "
+        "device work before executing — the dispatch backpressure the "
+        "host-side stage timers misattribute to fetch/decode."),
+    "engine_dispatch_inflight": MetricSpec(
+        "gauge", "dispatches", (),
+        "Dispatched programs whose readiness the ledger has not yet "
+        "observed (the device queue depth as the ledger sees it)."),
+    "engine_stream_stage_seconds": MetricSpec(
+        "histogram", "seconds", ("stage",),
+        "Streaming event latency decomposed by stage: queued (event "
+        "enqueue -> its slab's flush start, per event), apply (event "
+        "application + world snapshot, per flush), engine (the flush's "
+        "engine tick, per flush).  queued+apply+engine bounds the "
+        "event->placement-visible latency histogram."),
     "engine_stream_events_total": MetricSpec(
         "counter", "events", ("kind",),
         "Streaming-scheduler events flushed, by kind: upsert (object "

@@ -12,6 +12,18 @@ main.go:21).  The Python control plane's equivalent serves:
   Sampling, not tracing, because a tracer (cProfile) only sees the
   installing thread — useless for worker-thread controllers — and adds
   overhead to the very loops being measured.
+* ``GET /debug/profile?seconds=N&mode=jax`` — an on-demand
+  ``jax.profiler`` capture around whatever the process is doing
+  (live ticks included): the trace artifact is written to a fresh
+  timestamped subdirectory of ``KT_PROFILE_DIR`` and the response
+  carries its path (load in TensorBoard's profile plugin / xprof).
+  Works on CPU and TPU; one capture at a time
+  (runtime/devprof.capture_jax_profile).
+* ``GET /debug/waterfall`` — the dispatch ledger's per-tick waterfall
+  (runtime/devprof.py): ordered device-dispatch records with the
+  chain-model device/queue attribution and the host-side stage split,
+  for the most recent ticks (``?tick=``/``?ticks=``/``?records=``
+  narrow it).  See docs/observability.md § Device-time attribution.
 * ``GET /debug/stacks`` — current stack of every thread (pprof's
   ``goroutine?debug=2`` role) — the first thing to pull from a wedged
   control plane.
@@ -127,7 +139,26 @@ def handle_debug_path(path: str, query: dict) -> Optional[dict]:
             seconds = float(query.get("seconds", 5))
         except (TypeError, ValueError):
             return {"error": f"bad seconds value: {query.get('seconds')!r}"}
+        mode = query.get("mode", "stack")
+        if mode in ("jax", "device"):
+            from kubeadmiral_tpu.runtime import devprof
+
+            return devprof.capture_jax_profile(
+                seconds, out_dir=query.get("dir") or None
+            )
         return collect_profile(seconds)
+    if path == "/debug/waterfall":
+        from kubeadmiral_tpu.runtime import devprof
+
+        try:
+            tick = int(query["tick"]) if "tick" in query else None
+            max_ticks = int(query.get("ticks", 4))
+            max_records = int(query.get("records", 512))
+        except (TypeError, ValueError):
+            return {"error": "bad tick/ticks/records value"}
+        return devprof.get_default().waterfall(
+            tick=tick, max_ticks=max_ticks, max_records=max_records
+        )
     if path == "/debug/stacks":
         return collect_stacks()
     if path == "/debug/threads":

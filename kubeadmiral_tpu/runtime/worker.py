@@ -19,15 +19,17 @@ revisit.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-import traceback
 from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.queue import Backoff, DirtyQueue
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
+
+log = logging.getLogger("kubeadmiral.worker")
 
 
 @dataclass
@@ -162,7 +164,9 @@ class Worker(_WorkerBase):
             # returning Result.retry().
             self.metrics.counter(f"{self.name}.panic")
             self.metrics.counter("worker_exceptions_total", controller=self.name)
-            traceback.print_exc()
+            log.exception(
+                "reconcile panic: controller=%s key=%s", self.name, key
+            )
             result = Result.retry()
         finally:
             self._exit(ident)
@@ -211,7 +215,9 @@ class BatchWorker(_WorkerBase):
         except Exception:
             self.metrics.counter(f"{self.name}.panic")
             self.metrics.counter("worker_exceptions_total", controller=self.name)
-            traceback.print_exc()
+            log.exception(
+                "batch-tick panic: controller=%s keys=%d", self.name, len(keys)
+            )
             results = {k: Result.retry() for k in keys}
         finally:
             self._exit(ident)

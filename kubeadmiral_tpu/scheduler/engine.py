@@ -57,6 +57,7 @@ from kubeadmiral_tpu.ops.pipeline import (
     unpack_wire,
 )
 from kubeadmiral_tpu.ops.planner import INT32_INF
+from kubeadmiral_tpu.runtime import devprof as devprof_mod
 from kubeadmiral_tpu.runtime import flightrec as flightrec_mod
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
@@ -507,6 +508,7 @@ class SchedulerEngine:
         pack_k_min: Optional[int] = None,
         narrow: Optional[bool] = None,
         narrow_m: Optional[int] = None,
+        devprof="default",
     ):
         self.chunk_size = chunk_size
         # Result-fetch wire format: "packed" (default) ships [B, K]
@@ -595,6 +597,20 @@ class SchedulerEngine:
             else flight_recorder
         )
         self._tick_rec = None
+        # Dispatch ledger (runtime/devprof.py): every program launch is
+        # observed through the _obs_wrap proxies below, so per-tick
+        # waterfalls decompose the host stage timers into device-
+        # attributed per-program costs.  "default" = the process-wide
+        # ledger behind GET /debug/waterfall (KT_DEVPROF=0 disables);
+        # pass a DispatchLedger (or None) to isolate/opt out.
+        if devprof == "default":
+            devprof = devprof_mod.get_default()
+        self.devprof = devprof or devprof_mod.DispatchLedger(enabled=False)
+        # Monotonic engine tick counter: stamped on spans and logs so
+        # /debug/trace, /debug/waterfall and the structured logs share
+        # one correlation id per schedule() call.
+        self.tick_seq = 0
+        self.last_tick_id = 0
         # Telemetry registry (runtime/metrics.py): stage histograms,
         # compile-cache and fetch-path counters land here alongside the
         # raw dict stats below.  The manager passes its shared registry;
@@ -709,6 +725,12 @@ class SchedulerEngine:
 
         self.mesh = self._resolve_mesh(mesh)
         self._build_programs()
+        # Device-time attribution: route the shared jitted programs
+        # through the dispatch ledger (per-key program caches wrap at
+        # creation inside their builders).  The ledger emits into this
+        # engine's registry from here on.
+        self.devprof.attach(self.metrics)
+        self._instrument_programs()
         # (B, C) -> device-resident zero "previous outputs" (created by a
         # trivial on-device program, NOT a host upload): the unified tick
         # always takes a prev argument; cold chunks diff against zeros
@@ -764,6 +786,40 @@ class SchedulerEngine:
         from kubeadmiral_tpu.parallel.mesh import make_mesh
 
         return make_mesh(devices[: obj * clus], objects_axis=obj)
+
+    def _obs_wrap(self, kind: str, fn):
+        """The dispatch ledger's central wrapper: every jitted program
+        the engine launches funnels through one of these proxies, so
+        device-time attribution covers every dispatch site without
+        touching the sites themselves.  Overhead per dispatch is one
+        perf_counter read + a deque append (see runtime/devprof.py);
+        compile time stays out of the attribution because jit tracing
+        happens synchronously inside ``fn`` and the observation
+        timestamp is taken after it returns (= enqueue time)."""
+        ledger = self.devprof
+
+        def observed(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            ledger.observe(kind, out)
+            return out
+
+        return observed
+
+    def _instrument_programs(self) -> None:
+        """Wrap the shared programs _build_programs assigned (the
+        per-key caches — narrow/fallback/pack/gate/wcheck/resolve/
+        repair — wrap at creation in their builders)."""
+        self._stack = self._obs_wrap("stack", self._stack)
+        self._concat = self._obs_wrap("stack", self._concat)
+        self._tick = self._obs_wrap("tick", self._tick)
+        self._tick_compact = self._obs_wrap("tick", self._tick_compact)
+        self._gather = self._obs_wrap("gather", self._gather)
+        self._gather3 = self._obs_wrap("gather", self._gather3)
+        self._gather5 = self._obs_wrap("gather", self._gather5)
+        self._gather_over3 = self._obs_wrap("overflow", self._gather_over3)
+        self._gather_over4 = self._obs_wrap("overflow", self._gather_over4)
+        self._patch = self._obs_wrap("patch", self._patch)
+        self._patch_compact = self._obs_wrap("patch", self._patch_compact)
 
     def _build_programs(self) -> None:
         # Window-drain stacker: one device-side stack of same-shape
@@ -956,6 +1012,7 @@ class SchedulerEngine:
                 if sharding is not None
                 else jax.jit(make)
             )
+            fn = self._obs_wrap("zeros", fn)
             self._zero_fns[shape] = fn
         zp = fn()
         if not self.donate:
@@ -1015,6 +1072,7 @@ class SchedulerEngine:
                 out_shardings=(M.output_shardings(self.mesh), rows, rows),
                 donate_argnums=donate,
             )
+        fn = self._obs_wrap("tick_narrow", fn)
         self._narrow_programs[key] = fn
         return fn
 
@@ -1051,6 +1109,7 @@ class SchedulerEngine:
             return out.selected, out.replicas, out.counted, out.reasons
 
         fn = jax.jit(impl)
+        fn = self._obs_wrap("narrow_fallback", fn)
         self._fallback_programs[fmt] = fn
         return fn
 
@@ -1069,6 +1128,7 @@ class SchedulerEngine:
 
             donate = (0,) if self.donate else ()
             fn = jax.jit(impl, donate_argnums=donate)
+            fn = self._obs_wrap("repair", fn)
             self._cert_repair_cache["repair"] = fn
         return fn
 
@@ -1161,6 +1221,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(impl)
+        fn = self._obs_wrap("pack", fn)
         self._pack_programs[key] = fn
         return fn
 
@@ -1611,10 +1672,20 @@ class SchedulerEngine:
             self._tick_rec = rec
             if rec is not None:
                 rec.begin_tick(len(units), len(clusters))
+            self.tick_seq += 1
+            # One correlation id per tick, shared by the trace span, the
+            # dispatch-ledger waterfall and the structured logs.
+            tick_id = self.devprof.begin_tick(
+                engine_tick=self.tick_seq,
+                objects=len(units),
+                clusters=len(clusters),
+            ) or self.tick_seq
+            self.last_tick_id = tick_id
             t_start = time.perf_counter()
             try:
                 with trace.span(
-                    "engine.schedule", objects=len(units), clusters=len(clusters)
+                    "engine.schedule", objects=len(units),
+                    clusters=len(clusters), tick=tick_id,
                 ):
                     results = self._schedule_impl(
                         units, clusters, view=view, webhook_eval=webhook_eval,
@@ -1623,10 +1694,24 @@ class SchedulerEngine:
             finally:
                 if rec is not None:
                     rec.end_tick()
+                self.devprof.end_tick(self.timings)
+            wall = time.perf_counter() - t_start
             self._emit_tick_metrics(
-                len(units), time.perf_counter() - t_start, cache0, fetch0,
+                len(units), wall, cache0, fetch0,
                 bytes0, overflow0, upload0, drift0, narrow0,
             )
+            if log.isEnabledFor(logging.DEBUG):
+                log.debug(
+                    "tick=%d objects=%d clusters=%d wall_ms=%.1f stages=%s "
+                    "fetch_paths=%s",
+                    tick_id, len(units), len(clusters), wall * 1e3,
+                    {k: round(v * 1e3, 1) for k, v in self.timings.items()},
+                    {
+                        k: v - fetch0.get(k, 0)
+                        for k, v in self.fetch_stats.items()
+                        if v - fetch0.get(k, 0)
+                    },
+                )
             return results
 
     def _emit_tick_metrics(
@@ -2568,6 +2653,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(impl, donate_argnums=donate)
+            fn = self._obs_wrap("repair", fn)
             self._repair_program_cache["repair"] = fn
         return fn
 
@@ -2744,6 +2830,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(impl)
+        fn = self._obs_wrap("gate", fn)
         self._gate_programs[fmt] = fn
         return fn
 
@@ -2764,6 +2851,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(drift_wcheck)
+            fn = self._obs_wrap("wcheck", fn)
             self._wcheck_program_cache["wcheck"] = fn
         return fn
 
@@ -2922,6 +3010,7 @@ class SchedulerEngine:
             return out, cert
 
         fn = jax.jit(impl)
+        fn = self._obs_wrap("resolve", fn)
         self._resolve_programs[key] = fn
         return fn
 

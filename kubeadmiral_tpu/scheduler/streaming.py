@@ -37,6 +37,7 @@ docs/operations.md ("Streaming tick").
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -45,7 +46,10 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
+
+log = logging.getLogger("kubeadmiral.streaming")
 
 # A gvk no member cluster serves: the row fails the APIResources filter
 # everywhere, selects nothing, and carries no policy structure — the
@@ -142,44 +146,53 @@ class StreamingScheduler:
         self.events_total = {"upsert": 0, "delete": 0, "capacity": 0}
         self.rows_flushed = 0
         self.flushes = 0
+        # Monotonic flush correlation id: stamped on the stream.flush
+        # span (with the engine tick id it produced) so /debug/trace
+        # shows one connected event -> placement-written timeline.
+        self._flush_seq = 0
+        self.last_flush_id = 0
         # Bounded recent event->placement-visible latencies (seconds).
         self.latencies: deque[float] = deque(maxlen=200_000)
 
     # -- event ingestion --------------------------------------------------
     def offer(self, unit: T.SchedulingUnit) -> None:
         """Object add/update (a watch upsert)."""
-        with self._lock:
-            self._pending.append(_Event("upsert", unit, self.clock()))
-            self.events_total["upsert"] += 1
-            self._note_depth()
+        with trace.span("stream.offer", kind="upsert", key=unit.key):
+            with self._lock:
+                self._pending.append(_Event("upsert", unit, self.clock()))
+                self.events_total["upsert"] += 1
+                self._note_depth()
 
     def remove(self, key: str) -> None:
         """Object delete: the row reverts to an inert placeholder."""
-        with self._lock:
-            self._pending.append(_Event("delete", key, self.clock()))
-            self.events_total["delete"] += 1
-            self._note_depth()
+        with trace.span("stream.offer", kind="delete", key=key):
+            with self._lock:
+                self._pending.append(_Event("delete", key, self.clock()))
+                self.events_total["delete"] += 1
+                self._note_depth()
 
     def offer_capacity(self, clusters: Sequence[T.ClusterState]) -> None:
         """Whole-fleet capacity snapshot (cheap: the engine diffs it
         column-wise against the previous view)."""
-        with self._lock:
-            self._pending.append(
-                _Event("capacity", list(clusters), self.clock())
-            )
-            self.events_total["capacity"] += 1
-            self._note_depth()
+        with trace.span("stream.offer", kind="capacity"):
+            with self._lock:
+                self._pending.append(
+                    _Event("capacity", list(clusters), self.clock())
+                )
+                self.events_total["capacity"] += 1
+                self._note_depth()
 
     def update_cluster(self, cluster: T.ClusterState) -> None:
         """Single-member capacity update — the common drift event."""
-        with self._lock:
-            base = self._pending_clusters_locked()
-            fleet = [
-                cluster if c.name == cluster.name else c for c in base
-            ]
-            self._pending.append(_Event("capacity", fleet, self.clock()))
-            self.events_total["capacity"] += 1
-            self._note_depth()
+        with trace.span("stream.offer", kind="capacity", key=cluster.name):
+            with self._lock:
+                base = self._pending_clusters_locked()
+                fleet = [
+                    cluster if c.name == cluster.name else c for c in base
+                ]
+                self._pending.append(_Event("capacity", fleet, self.clock()))
+                self.events_total["capacity"] += 1
+                self._note_depth()
 
     def _pending_clusters_locked(self) -> list[T.ClusterState]:
         for ev in reversed(self._pending):
@@ -245,55 +258,95 @@ class StreamingScheduler:
     def _flush(self, trigger: str) -> list:
         t_flush = self.clock()
         with self._lock:
-            drained = list(self._pending)
-            self._pending.clear()
-            self.metrics.store("engine_stream_slab_depth", 0)
-            had_capacity = False
-            for ev in drained:
-                if ev.kind == "capacity":
-                    self._clusters = list(ev.payload)
-                    had_capacity = True
-                    continue
-                if ev.kind == "delete":
-                    row = self._row_of.pop(ev.payload, None)
-                    if row is not None:
-                        self._units[row] = make_placeholder(row)
-                        self._free.append(row)
-                    continue
-                unit = ev.payload
-                row = self._row_of.get(unit.key)
-                if row is None:
-                    if not self._free:
-                        self._grow_locked(1)
-                    row = self._free.pop()
-                    self._row_of[unit.key] = row
-                self._units[row] = unit
-            # Fresh list: the engine's no-op gate treats the container
-            # as immutable (content-identity replays still work).
-            units = list(self._units)
-            clusters = self._clusters
-        results = self.engine.schedule(
-            units, clusters, follower_index=self.follower_index
-        )
-        now = self.clock()
-        with self._lock:
-            self.results = results
-            self.flushes += 1
-            n_rows = sum(1 for ev in drained if ev.kind != "capacity")
-            self.rows_flushed += n_rows
-            self.flush_stats[trigger] = self.flush_stats.get(trigger, 0) + 1
-            if had_capacity:
-                self.flush_stats["capacity"] += 1
-            m = self.metrics
-            m.counter("engine_stream_flushes_total", trigger=trigger)
-            for ev in drained:
-                m.counter("engine_stream_events_total", kind=ev.kind)
-                lat = now - ev.t
-                m.histogram("engine_stream_latency_seconds", lat)
-                self.latencies.append(lat)
-            m.store("engine_stream_slab_rows", n_rows)
-            m.histogram(
-                "engine_stream_flush_seconds", now - t_flush
+            self._flush_seq += 1
+            fid = self._flush_seq
+        with trace.span("stream.flush", flush=fid, trigger=trigger) as f_span:
+            with self._lock:
+                drained = list(self._pending)
+                self._pending.clear()
+                self.metrics.store("engine_stream_slab_depth", 0)
+                had_capacity = False
+                for ev in drained:
+                    if ev.kind == "capacity":
+                        self._clusters = list(ev.payload)
+                        had_capacity = True
+                        continue
+                    if ev.kind == "delete":
+                        row = self._row_of.pop(ev.payload, None)
+                        if row is not None:
+                            self._units[row] = make_placeholder(row)
+                            self._free.append(row)
+                        continue
+                    unit = ev.payload
+                    row = self._row_of.get(unit.key)
+                    if row is None:
+                        if not self._free:
+                            self._grow_locked(1)
+                        row = self._free.pop()
+                        self._row_of[unit.key] = row
+                    self._units[row] = unit
+                # Fresh list: the engine's no-op gate treats the container
+                # as immutable (content-identity replays still work).
+                units = list(self._units)
+                clusters = self._clusters
+            t_engine = self.clock()
+            results = self.engine.schedule(
+                units, clusters, follower_index=self.follower_index
+            )
+            now = self.clock()
+            tick_id = getattr(self.engine, "last_tick_id", 0)
+            # Correlate the flush with the engine tick it produced: the
+            # engine.schedule span nests under this one on the thread,
+            # and the shared tick id links the /debug/waterfall entry.
+            f_span.set(
+                events=len(drained), tick=tick_id,
+                engine_ms=round((now - t_engine) * 1e3, 3),
+            )
+            with self._lock:
+                self.results = results
+                self.flushes += 1
+                self.last_flush_id = fid
+                n_rows = sum(1 for ev in drained if ev.kind != "capacity")
+                self.rows_flushed += n_rows
+                self.flush_stats[trigger] = self.flush_stats.get(trigger, 0) + 1
+                if had_capacity:
+                    self.flush_stats["capacity"] += 1
+                m = self.metrics
+                m.counter("engine_stream_flushes_total", trigger=trigger)
+                # Stage-decomposed event latency: how long events sat
+                # coalescing in the slab vs the engine solve itself vs
+                # the publish bookkeeping — the split the e2e p99 budget
+                # is tuned against (docs/observability.md).
+                m.histogram(
+                    "engine_stream_stage_seconds",
+                    max(0.0, t_engine - t_flush),
+                    stage="apply",
+                )
+                m.histogram(
+                    "engine_stream_stage_seconds",
+                    max(0.0, now - t_engine),
+                    stage="engine",
+                )
+                for ev in drained:
+                    m.counter("engine_stream_events_total", kind=ev.kind)
+                    lat = now - ev.t
+                    m.histogram("engine_stream_latency_seconds", lat)
+                    m.histogram(
+                        "engine_stream_stage_seconds",
+                        max(0.0, t_flush - ev.t),
+                        stage="queued",
+                    )
+                    self.latencies.append(lat)
+                m.store("engine_stream_slab_rows", n_rows)
+                m.histogram(
+                    "engine_stream_flush_seconds", now - t_flush
+                )
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug(
+                "flush=%d tick=%d trigger=%s events=%d rows=%d "
+                "capacity=%s engine_ms=%.1f",
+                fid, tick_id, trigger, len(drained), n_rows, had_capacity,
+                (now - t_engine) * 1e3,
             )
         return results
 

@@ -82,6 +82,11 @@ def load_rounds(root: Path) -> list[dict]:
                     "drift_overflow_rows"
                 ),
                 "narrow": detail.get("narrow"),
+                # Informational (ISSUE 8): the dispatch ledger's
+                # per-program device-time attribution — first landing is
+                # informational; per-program gating can follow once a
+                # few rounds carry it.
+                "device_attr": detail.get("device_attr"),
                 "drift_tick_ms": (detail.get("stage_ms") or {}).get(
                     "drift_tick_ms"
                 ),
@@ -117,10 +122,15 @@ def gate(rounds: list[dict], tolerance: float) -> int:
         and r["platform"] == latest["platform"]
     ]
     if not priors:
+        # Pass, but LOUDLY: nothing was actually gated this round (the
+        # first artifact on a new platform — e.g. the first TPU round
+        # after a CPU-only stretch — must not read as a green gate).
         print(
-            f"bench-gate: {latest['path']} "
+            f"bench-gate: WARNING: {latest['path']} "
             f"({latest['metric']}, platform={latest['platform']}) has no "
-            f"comparable prior round; trivially ok"
+            f"prior same-platform baseline — NOTHING GATED this round; "
+            f"this artifact becomes the baseline the next round gates "
+            f"against"
         )
         return 0
     best_value = max(r["value"] for r in priors)
@@ -175,6 +185,27 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             f"fallback_rows={nr.get('fallback_rows')} — informational, "
             f"not gated"
         )
+    if latest.get("device_attr"):
+        da = latest["device_attr"]
+        for phase in ("steady", "drift"):
+            attr = da.get(phase) or {}
+            if not attr.get("records"):
+                continue
+            progs = ", ".join(
+                f"{k}={v.get('device_ms')}ms"
+                for k, v in sorted(
+                    (attr.get("by_program") or {}).items(),
+                    key=lambda kv: -kv[1].get("device_ms", 0),
+                )[:6]
+            )
+            print(
+                f"bench-gate: device_attr[{phase}]: "
+                f"device_ms={attr.get('device_ms')} "
+                f"queue_ms={attr.get('queue_ms')} "
+                f"reconcile={attr.get('reconcile_pct')}% of "
+                f"stage device {attr.get('stage_device_ms')}ms; "
+                f"per-program: {progs} — informational, not gated"
+            )
     for key, label in (
         ("tick_ms", "tick_ms"),
         ("device_ms", "stage_ms.device"),
@@ -182,7 +213,17 @@ def gate(rounds: list[dict], tolerance: float) -> int:
         ("drift_gate_wait_ms", "drift_stage_ms.gate_wait"),
     ):
         prior_vals = [r.get(key) for r in priors if r.get(key) is not None]
-        if latest.get(key) is None or not prior_vals:
+        if latest.get(key) is None:
+            continue
+        if not prior_vals:
+            # The satellite fix (ISSUE 8): a gated metric with no prior
+            # same-platform baseline must WARN, not silently skip — the
+            # first TPU round after a CPU stretch carries gated metrics
+            # that nothing checks.
+            print(
+                f"bench-gate: WARNING: {label}={latest[key]:.1f} has no "
+                f"prior same-platform baseline — not gated this round"
+            )
             continue
         best = min(prior_vals)
         ceil = best * (1.0 + tolerance)

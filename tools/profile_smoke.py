@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""profile capture driver: run scheduling ticks under jax.profiler.
+
+``make profile-smoke`` runs ONE small tick under JAX_PLATFORMS=cpu (the
+CI-sized sanity check that the capture machinery works end to end);
+``make profile`` runs a config-3-sized world for a few churned ticks on
+whatever platform the environment selects.  Both write:
+
+* a ``jax.profiler`` trace directory (TensorBoard profile plugin /
+  xprof) under ``KT_PROFILE_DIR`` (default /tmp/kt-jax-profile),
+* ``waterfall.json`` next to it — the dispatch ledger's per-tick
+  device-time attribution for the captured ticks,
+
+and print exactly one JSON line describing the artifacts.
+
+Knobs: PROFILE_OBJECTS / PROFILE_CLUSTERS (world shape),
+PROFILE_TICKS (churned ticks inside the capture, default 2),
+KT_PROFILE_DIR (artifact root).  See docs/observability.md
+§ Device-time attribution (profiler runbook).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import numpy as np
+
+    n_objects = int(os.environ.get("PROFILE_OBJECTS", "2048"))
+    n_clusters = int(os.environ.get("PROFILE_CLUSTERS", "128"))
+    n_ticks = int(os.environ.get("PROFILE_TICKS", "2"))
+
+    sys.path.insert(0, REPO)  # bench.py world builder
+    import bench
+    from kubeadmiral_tpu.runtime import devprof
+    from kubeadmiral_tpu.runtime.metrics import Metrics
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+    bench.N_OBJECTS = n_objects
+    bench.N_CLUSTERS = n_clusters
+    rng = np.random.default_rng(20260804)
+    units, clusters, _followers = bench.build_world(rng)
+
+    metrics = Metrics()
+    engine = SchedulerEngine(metrics=metrics)
+    engine.prewarm(n_objects, n_clusters, wait=True)
+    # Cold tick outside the capture: the trace should show steady tick
+    # structure, not one giant featurize+upload.
+    engine.schedule(units, clusters)
+
+    import jax
+
+    target = os.path.join(
+        devprof.profile_dir(),
+        time.strftime("%Y%m%d-%H%M%S") + f"-smoke-{os.getpid()}",
+    )
+    os.makedirs(target, exist_ok=True)
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(target)
+    try:
+        ticks = []
+        for _ in range(max(1, n_ticks)):
+            units = bench.churn(rng, units)
+            t1 = time.perf_counter()
+            engine.schedule(units, clusters)
+            ticks.append(round((time.perf_counter() - t1) * 1e3, 1))
+    finally:
+        jax.profiler.stop_trace()
+    capture_s = time.perf_counter() - t0
+
+    wf = engine.devprof.waterfall(max_ticks=max(1, n_ticks))
+    wf_path = os.path.join(target, "waterfall.json")
+    with open(wf_path, "w") as fh:
+        json.dump(wf, fh, indent=1)
+    n_files = sum(len(files) for _, _, files in os.walk(target))
+    last = wf["ticks"][-1] if wf.get("ticks") else {}
+    print(
+        json.dumps(
+            {
+                "profile_dir": target,
+                "waterfall": wf_path,
+                "files": n_files,
+                "world": f"{n_objects}x{n_clusters}",
+                "ticks_ms": ticks,
+                "capture_s": round(capture_s, 2),
+                "last_tick_device_ms": last.get("device_ms"),
+                "last_tick_queue_ms": last.get("queue_ms"),
+                "last_tick_records": len(last.get("records", ())),
+            }
+        )
+    )
+    print(
+        f"# load the trace: tensorboard --logdir {target}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,15 +40,34 @@ def default_probe() -> bool:
     return probe_tpu(attempts=1, probe_timeout=120.0) == ""
 
 
-def default_runner(config: str) -> dict | None:
+# One on-chip profiler grab per capture process (set after the first
+# successful profiled capture; a failed window retries the grab).
+_profiled = [False]
+
+
+def default_runner(config: str, profile: bool | None = None) -> dict | None:
     """Run bench.py for one config on the chip; returns the parsed
     artifact on an on-chip success, None otherwise (a cpu-fallback
-    artifact is NOT captured — the whole point is TPU evidence)."""
+    artifact is NOT captured — the whole point is TPU evidence).
+
+    ``profile=True`` additionally grabs ONE on-chip ``jax.profiler``
+    trace around the first timed tick (bench.py's KT_PROFILE_TICKS
+    hook): the narrow/megachunk/drift machinery has never been
+    profiled on TPU, and a window that opens is the only chance to —
+    the artifact directory lands under ``profiles/tpu_c<config>`` and
+    the bench detail records it (detail.device_attr.profile_dir)."""
     env = dict(os.environ)
     env["BENCH_CONFIG"] = config
     # One probe attempt: the watcher already established the window;
     # if the chip vanished, fail fast and resume watching.
     env.setdefault("BENCH_TPU_ATTEMPTS", "1")
+    if profile is None:
+        profile = not _profiled[0]
+    if profile and "KT_PROFILE_TICKS" not in env:
+        env["KT_PROFILE_TICKS"] = "1"
+        env.setdefault(
+            "KT_PROFILE_DIR", os.path.join(REPO, "profiles", f"tpu_c{config}")
+        )
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -71,6 +90,8 @@ def default_runner(config: str) -> dict | None:
             except json.JSONDecodeError:
                 continue
             if artifact.get("detail", {}).get("platform") == "tpu":
+                if profile:
+                    _profiled[0] = True
                 return artifact
     return None
 
