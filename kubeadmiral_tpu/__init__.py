@@ -57,6 +57,18 @@ try:
             "jax_compilation_cache_dir",
             _os.path.expanduser("~/.cache/kubeadmiral_tpu/xla-cache"),
         )
+    # Persist EVERY compile, not just the >1s ones (jax's default
+    # threshold): the warm-restart path (scheduler/aot.py preload)
+    # recompiles the exported ladder from StableHLO, and its per-program
+    # compiles are individually sub-second — under the default threshold
+    # none of them would ever land on disk, so every failover would
+    # re-pay the whole ladder's XLA time.  Disk cost is small (the
+    # ladder is ~100 entries) and this control plane owns its process.
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
 except Exception:  # older jax without the option
     pass
 

@@ -192,17 +192,47 @@ def main(argv=None) -> int:
 
     manager.run(args.worker_count)
     print("controller manager running; Ctrl-C to stop")
+
+    # SIGTERM = graceful failover (docs/operations.md § Restart &
+    # failover runbook): drain in-flight dispatch flushes under the
+    # bounded KT_SHUTDOWN_DEADLINE_S budget, write a final engine
+    # snapshot, release leadership so a standby acquires immediately.
+    # SIGKILL gets none of this — which is exactly what the snapshot
+    # store's atomic-write + quarantine design (and make restart-smoke)
+    # exists for.
+    import signal
+    import threading
+
+    stop_event = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        print("SIGTERM: draining for graceful failover")
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use)
+
     deadline = time.monotonic() + args.run_seconds if args.run_seconds else None
     try:
-        while deadline is None or time.monotonic() < deadline:
+        while not stop_event.is_set() and (
+            deadline is None or time.monotonic() < deadline
+        ):
             if args.leader_elect and not elector.try_acquire_or_renew():
                 print("lost leader election; exiting")  # fatal, as in the reference
                 return 1
-            time.sleep(min(elector.lease_seconds / 3, 5.0))
+            stop_event.wait(min(elector.lease_seconds / 3, 5.0))
     except KeyboardInterrupt:
         pass
     finally:
-        manager.stop()
+        summary = manager.shutdown()
+        if args.leader_elect and elector.release():
+            print("leadership released")
+        print(
+            f"shutdown: shed_writes={summary['shed_writes']} "
+            f"snapshot={summary['snapshot']}"
+        )
         server.stop()
         if farm is not None:
             farm.close()

@@ -36,6 +36,7 @@ import os
 import random
 import threading
 import time
+import weakref
 from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Callable, Optional
@@ -250,6 +251,28 @@ def run_batch_with_retries(
 
 
 # -- sinks ---------------------------------------------------------------
+# Live sinks, for graceful shutdown: SIGTERM drains in-flight flushes
+# under a bounded deadline and then finalizes every sink that still
+# holds staged or in-flight writes — queued writes are SHED (recorded
+# via member_shed_writes_total; the apiserver-durable state re-drives
+# them on the next boot), never silently dropped, and no
+# dispatch-flush-<cluster> helper thread survives the drain.
+_LIVE_SINKS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def finalize_all_sinks(deadline_s: float = 0.0) -> int:
+    """Finalize every live sink (manager shutdown path); returns the
+    number of writes shed."""
+    shed = 0
+    end = time.monotonic() + max(0.0, deadline_s)
+    for sink in list(_LIVE_SINKS):
+        try:
+            shed += sink.finalize(max(0.0, end - time.monotonic()))
+        except Exception:
+            log.warning("sink finalize failed", exc_info=True)
+    return shed
+
+
 class ImmediateSink:
     """One client call per operation, inline or on a bounded pool
     (operation.go:102-123's per-cluster goroutine fan-out; pool size =
@@ -266,9 +289,10 @@ class ImmediateSink:
         self._pool = pool
         self._own_pool = False
         self._inline = inline
-        self._futures: list[Future] = []
+        self._futures: list[tuple[str, Future]] = []
         self._finalized = False
         self.breakers = breakers
+        _LIVE_SINKS.add(self)
 
     def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
         if self._finalized:
@@ -306,7 +330,7 @@ class ImmediateSink:
         if self._pool is None:
             self._pool = ThreadPoolExecutor(max_workers=dispatch_pool_size())
             self._own_pool = True
-        self._futures.append(self._pool.submit(run))
+        self._futures.append((cluster, self._pool.submit(run)))
 
     def wait(self, timeout: float) -> None:
         """Drain the fan-out under the deadline.  On expiry, not-yet-
@@ -315,15 +339,17 @@ class ImmediateSink:
         raises instead of mutating a finalized status map."""
         deadline = time.monotonic() + timeout
         try:
-            for f in self._futures:
+            for cluster, f in self._futures:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    f.cancel()
+                    if f.cancel() and self.breakers is not None:
+                        self.breakers.count_shed(cluster)
                     continue
                 try:
                     f.result(timeout=remaining)
                 except FuturesTimeout:
-                    f.cancel()
+                    if f.cancel() and self.breakers is not None:
+                        self.breakers.count_shed(cluster)
                 except Exception:  # failure statuses were pre-recorded
                     pass
         finally:
@@ -333,6 +359,40 @@ class ImmediateSink:
                 self._pool.shutdown(wait=False, cancel_futures=True)
                 self._pool = None
                 self._own_pool = False
+
+    def finalize(self, deadline_s: float = 0.0) -> int:
+        """Graceful-shutdown drain: give in-flight writes ``deadline_s``
+        to land, CANCEL (and count as shed) whatever has not started,
+        and finalize the sink — a late submit raises.  Returns the shed
+        count."""
+        if self._finalized:
+            return 0
+        shed = 0
+        end = time.monotonic() + max(0.0, deadline_s)
+        pending = list(self._futures)
+        for cluster, f in pending:
+            if f.cancel():
+                shed += 1
+                if self.breakers is not None:
+                    self.breakers.count_shed(cluster)
+                continue
+            try:
+                f.result(timeout=max(0.0, end - time.monotonic()))
+            except FuturesTimeout:
+                shed += 1  # running past the drain budget: abandoned
+                if self.breakers is not None:
+                    self.breakers.count_shed(cluster)
+            except Exception:
+                pass
+        self._futures.clear()
+        self._finalized = True
+        if self._own_pool and self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+            self._own_pool = False
+        if shed:
+            log.warning("ImmediateSink finalize shed %d write(s)", shed)
+        return shed
 
 
 class BatchSink:
@@ -355,6 +415,13 @@ class BatchSink:
         self.flushed = True
         self.breakers = breakers
         self.deadline = dispatch_deadline() if deadline is None else deadline
+        # dispatch-flush-<cluster> helper threads this sink spawned for
+        # stall-capable serial flushes; joined by finalize() so a
+        # graceful shutdown leaves none behind (a genuinely stalled one
+        # is daemon and its writes were already shed + accounted).
+        self._helper_threads: list[threading.Thread] = []
+        self._finalized = False
+        _LIVE_SINKS.add(self)
         # Threads currently executing this sink's writes.  In-process
         # member stores deliver watch events synchronously on the writing
         # thread, so the owning controller treats events on these threads
@@ -363,6 +430,12 @@ class BatchSink:
         self.thread_registry = thread_registry if thread_registry is not None else set()
 
     def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
+        if self._finalized:
+            # The shutdown drain already shed this sink's queue; a late
+            # stage would be silently lost — fail loudly instead.
+            raise RuntimeError(
+                "BatchSink already finalized by shutdown; build a fresh sink"
+            )
         self._staged.setdefault(cluster, []).append((op, continuation))
         self.flushed = False
 
@@ -486,12 +559,14 @@ class BatchSink:
                     name=f"dispatch-flush-{cluster}",
                     daemon=True,
                 )
+                self._helper_threads.append(t)
                 t.start()
                 t.join(remaining)
                 if t.is_alive():
                     # Left to die on the client's own timeout; the tick
                     # moves on.
                     shed(cluster, entries, stalled=True)
+        self._helper_threads = [t for t in self._helper_threads if t.is_alive()]
 
     def wait(self, timeout: float) -> None:
         # Dispatchers sharing this sink call wait() after the controller
@@ -499,6 +574,35 @@ class BatchSink:
         # wait, e.g. the deletion path) flushes now.
         if not self.flushed:
             self.flush(timeout)
+
+    def finalize(self, deadline_s: float = 0.0) -> int:
+        """Graceful-shutdown drain (SIGTERM path): writes still STAGED
+        are shed — recorded via the existing member_shed_writes_total
+        counter, with their pre-recorded *_TIMED_OUT statuses standing,
+        exactly like a deadline expiry — and the dispatch-flush helper
+        threads are joined under the remaining budget so none survives
+        the drain (a thread that outlives it belongs to a stalled
+        member whose writes were already shed + breaker-opened).
+        Returns the shed count; a later submit raises."""
+        if self._finalized:
+            return 0
+        self._finalized = True
+        staged, self._staged = self._staged, {}
+        self.flushed = True
+        shed = 0
+        for cluster, entries in staged.items():
+            shed += len(entries)
+            log.warning(
+                "shutdown: shedding %d staged member write(s): cluster=%s",
+                len(entries), cluster,
+            )
+            if self.breakers is not None:
+                self.breakers.count_shed(cluster, len(entries))
+        end = time.monotonic() + max(0.0, deadline_s)
+        for t in self._helper_threads:
+            t.join(max(0.0, end - time.monotonic()))
+        self._helper_threads = [t for t in self._helper_threads if t.is_alive()]
+        return shed
 
 
 def _result_error(result: dict) -> str:

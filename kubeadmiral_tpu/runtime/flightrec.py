@@ -261,6 +261,71 @@ class FlightRecorder:
             self._current = None
             self._names_cache = None
 
+    # -- durable state (runtime/snapshot.py) -------------------------------
+    def export_state(self) -> dict:
+        """Restart-durable image of the ring: every tick entry's records
+        as plain fields (numpy arrays pickle verbatim).  Restoring this
+        into a successor makes /debug/explain and the kill-matrix's
+        reason-count comparison identical to an uninterrupted process —
+        rows the successor resumes via the no-op replay never re-record,
+        so without this their decisions would be unexplainable."""
+        with self._lock:
+            ticks = []
+            for e in self._ring:
+                ticks.append({
+                    "tick": e.tick, "when": e.when, "objects": e.objects,
+                    "clusters": e.clusters, "programs": sorted(e.programs),
+                    "records": [
+                        {
+                            "key": r.key, "program": r.program,
+                            "placements": dict(r.placements),
+                            "reasons": r.reasons,
+                            "reason_counts": r.reason_counts,
+                            "feasible_n": r.feasible_n,
+                            "topk_idx": r.topk_idx,
+                            "topk_scores": r.topk_scores,
+                            "names": tuple(r.names),
+                        }
+                        for r in e.records.values()
+                    ],
+                })
+            return {"tick_seq": self._tick_seq, "ticks": ticks}
+
+    def restore_state(self, payload: dict) -> None:
+        """Rebuild the ring from an exported image.  Tick ids continue
+        from the snapshot's sequence so restored and freshly recorded
+        ticks stay ordered."""
+        with self._lock:
+            self._ring.clear()
+            self._index.clear()
+            self._bytes = 0
+            self._current = None
+            self._tick_seq = max(self._tick_seq, int(payload.get("tick_seq", 0)))
+            for t in payload.get("ticks", ()):
+                entry = _TickEntry(
+                    t["tick"], t["when"], t["objects"], t["clusters"]
+                )
+                entry.programs = set(t.get("programs", ()))
+                for rd in t.get("records", ()):
+                    rec = DecisionRecord(
+                        key=rd["key"], tick=entry.tick, when=entry.when,
+                        program=rd.get("program", ""),
+                        placements=rd["placements"],
+                        reasons=rd.get("reasons"),
+                        reason_counts=np.asarray(rd["reason_counts"], np.int64),
+                        feasible_n=int(rd["feasible_n"]),
+                        topk_idx=np.asarray(rd["topk_idx"], np.int32),
+                        topk_scores=np.asarray(rd["topk_scores"], np.int64),
+                        names=tuple(rd.get("names", ())),
+                    )
+                    entry.records[rec.key] = rec
+                    entry.nbytes += rec.nbytes
+                    self._bytes += rec.nbytes
+                    self._index[rec.key] = rec
+                if entry.records:
+                    self._ring.append(entry)
+            self._evict_locked()
+
     # -- introspection (HTTP-facing) -------------------------------------
     def stats(self) -> dict:
         with self._lock:

@@ -88,6 +88,35 @@ class LeaderElector:
             self._became(False)
             return False
 
+    def release(self) -> bool:
+        """Graceful handoff (the SIGTERM path): zero the lease's
+        renewTime so a standby's next election round acquires
+        immediately instead of waiting out the full lease duration —
+        the k8s resourcelock ReleaseOnCancel behavior.  Best-effort:
+        returns False when the lease is not ours (or already gone),
+        which is fine — the successor then waits out the expiry."""
+        if not self.is_leader:
+            return False
+        try:
+            lease = self.host.try_get(LEASES, self._key)
+            if (
+                lease is None
+                or lease.get("spec", {}).get("holderIdentity") != self.identity
+            ):
+                self._became(False)
+                return False
+            lease["spec"] = {
+                "holderIdentity": "",
+                "leaseDurationSeconds": self.lease_seconds,
+                "renewTime": 0.0,
+            }
+            self.host.update(LEASES, lease)
+        except (Conflict, AlreadyExists, NotFound):
+            self._became(False)
+            return False
+        self._became(False)
+        return True
+
     def _became(self, leading: bool) -> None:
         if self.is_leader and not leading and self.on_stopped_leading is not None:
             self.on_stopped_leading()

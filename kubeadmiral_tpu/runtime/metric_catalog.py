@@ -171,6 +171,31 @@ CATALOG: dict[str, MetricSpec] = {
         "candidate columns), fallback = uncertified rows re-solved "
         "through the full-width dense program (bit-identical by "
         "construction either way)."),
+    "engine_aot_programs_total": MetricSpec(
+        "counter", "programs", ("result",),
+        "AOT program-store resolutions per (program, shape signature): "
+        "loaded = deserialized from the jax.export manifest under "
+        "KT_COMPILE_CACHE_DIR (no Python trace), traced = live trace "
+        "(exported too when the prewarm ladder is running), rejected = "
+        "a manifest entry existed but failed its guard (jax/platform/"
+        "code-hash mismatch, CRC, deserialize or first-call error) and "
+        "fell back to a live trace."),
+    "engine_snapshot_total": MetricSpec(
+        "counter", "snapshots", ("result",),
+        "Durable engine-snapshot outcomes (KT_SNAPSHOT_DIR): written, "
+        "loaded_fresh (restore rode the no-op replay — cluster tensors "
+        "and row signatures bit-identical), loaded_stale (restore "
+        "resumed through the drift-gate/sub-batch revalidation), "
+        "rejected (config/topology/geometry mismatch -> cold), "
+        "quarantined (torn/corrupt/version-mismatched file renamed "
+        "aside, never loaded), skipped (nothing coherent to persist)."),
+    "engine_snapshot_bytes": MetricSpec(
+        "gauge", "bytes", (),
+        "Payload size of the most recent durable engine snapshot."),
+    "engine_snapshot_write_seconds": MetricSpec(
+        "histogram", "seconds", (),
+        "Wall time of one atomic snapshot persist (serialize + fsync + "
+        "rename), inside the post-tick hook."),
     "engine_persistent_cache_total": MetricSpec(
         "counter", "traces", ("result",),
         "Persistent XLA compilation-cache outcome per observed trace: "

@@ -1,0 +1,544 @@
+"""AOT program serialization: warm restarts skip the prewarm trace.
+
+A cold control-plane boot pays two compile-shaped costs per program
+shape: the Python trace + jax lowering (the 141s prewarm at config 5)
+and the XLA compile (absorbed by the persistent compilation cache,
+kubeadmiral_tpu.__init__).  The persistent cache only removes the
+second; a replacement process re-traces every ladder rung from Python.
+This module removes the first: during the prewarm ladder the engine
+exports every program it traces via ``jax.export`` into a versioned
+on-disk manifest under ``KT_COMPILE_CACHE_DIR`` (``aot/<jax>-<platform>``),
+and a warm boot deserializes the StableHLO artifact instead of tracing
+— the XLA compile of the deserialized module then hits the persistent
+cache, so a warm prewarm is disk reads, not compiler time.
+
+Manifest entries are keyed by (program key, argument-shape signature)
+and guarded by (jax version, platform, x64 flag) at the manifest level
+plus a CRC per blob; ANY mismatch or failure falls back to the live
+trace for that program — an AOT artifact can cost a trace, never
+correctness.  Telemetry: ``engine_aot_programs_total{result=
+loaded|traced|rejected}`` counts each (program, shape) resolution, and
+the first call of a loaded program attributes its XLA compile to the
+persistent cache (``engine_persistent_cache_total{result}``) by disk
+entry delta — the restart harness asserts the ladder is 100% hits on a
+second warm boot, catching silent cache-key drift.
+
+Multi-device meshes are out of scope (exports pin the device topology);
+the engine constructs the store disabled under a mesh and every dispatch
+stays a live trace.  Knob: ``KT_AOT`` (default on; ``0`` disables).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import warnings
+import zlib
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+log = logging.getLogger("kubeadmiral.aot")
+
+MANIFEST_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+
+_registered = False
+_code_hash: Optional[str] = None
+
+
+def code_fingerprint() -> str:
+    """Hash of the kernel/engine sources an exported program's semantics
+    depend on.  Part of the manifest guard: an AOT blob exported by a
+    different code version would replay OLD kernel semantics silently —
+    shapes alone cannot catch that, the source hash does."""
+    global _code_hash
+    if _code_hash is not None:
+        return _code_hash
+    import kubeadmiral_tpu
+
+    root = os.path.dirname(os.path.abspath(kubeadmiral_tpu.__file__))
+    h = hashlib.sha1()
+    for rel in (
+        "ops", os.path.join("scheduler", "engine.py"),
+        os.path.join("scheduler", "compact.py"),
+        os.path.join("scheduler", "featurize.py"),
+        os.path.join("parallel", "mesh.py"),
+    ):
+        path = os.path.join(root, rel)
+        files = []
+        if os.path.isdir(path):
+            files = sorted(
+                os.path.join(path, f)
+                for f in os.listdir(path)
+                if f.endswith(".py")
+            )
+        elif os.path.isfile(path):
+            files = [path]
+        for f in files:
+            h.update(f.encode())
+            try:
+                with open(f, "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                pass
+    _code_hash = h.hexdigest()[:16]
+    return _code_hash
+
+
+def _register_pytrees() -> None:
+    """Register the engine's NamedTuple pytypes for jax.export treedef
+    serialization (idempotent; re-registration raises inside jax)."""
+    global _registered
+    if _registered:
+        return
+    from jax import export as jexport
+
+    from kubeadmiral_tpu.ops.pipeline import PackedRows, TickInputs, TickOutputs
+    from kubeadmiral_tpu.scheduler.compact import CompactInputs
+
+    for cls, name in (
+        (TickInputs, "kubeadmiral.TickInputs"),
+        (TickOutputs, "kubeadmiral.TickOutputs"),
+        (PackedRows, "kubeadmiral.PackedRows"),
+        (CompactInputs, "kubeadmiral.CompactInputs"),
+    ):
+        try:
+            jexport.register_namedtuple_serialization(cls, serialized_name=name)
+        except ValueError:
+            pass  # already registered (e.g. two engines in one process)
+    _registered = True
+
+
+def _sig_of(args: tuple) -> str:
+    """Shape/dtype/structure signature of one positional argument list —
+    what a jit cache keys on, minus weak types (the engine passes arrays
+    only).  Non-array leaves (None, python scalars) key by repr."""
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = []
+    for x in leaves:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None or dtype is None:
+            parts.append(repr(x))
+        else:
+            parts.append(f"{np.dtype(dtype).str}{list(shape)}")
+    return str(treedef) + "&" + "|".join(parts)
+
+
+def _entry_id(key: str, sig: str) -> str:
+    return hashlib.sha1(f"{key}\x00{sig}".encode()).hexdigest()[:20]
+
+
+def default_dir() -> Optional[str]:
+    """``<compile-cache-dir>/aot/<jax version>-<platform>`` — versioned
+    next to the persistent XLA cache the blobs' compiles land in.
+    ``KT_AOT_DIR`` overrides the root (bench isolation: a cold-boot
+    measurement must not find a previous round's manifest)."""
+    base = os.environ.get("KT_AOT_DIR") or getattr(
+        jax.config, "jax_compilation_cache_dir", None
+    )
+    if not base:
+        return None
+    return os.path.join(
+        base, "aot", f"{jax.__version__}-{jax.default_backend()}"
+    )
+
+
+class AotStore:
+    """One engine's AOT program manifest: route program calls through
+    deserialized exports when a valid entry exists, export newly traced
+    programs while :meth:`export_mode` is active (the prewarm ladder)."""
+
+    def __init__(
+        self,
+        metrics=None,
+        cache_dir: Optional[str] = None,
+        enabled: Optional[bool] = None,
+    ):
+        self.metrics = metrics
+        if enabled is None:
+            enabled = os.environ.get("KT_AOT", "1") not in ("0", "false", "no")
+        self.dir = cache_dir if cache_dir is not None else default_dir()
+        self.enabled = bool(enabled) and self.dir is not None
+        self._lock = threading.Lock()
+        self._export_tls = threading.local()
+        self._entries: dict[str, dict] = {}
+        # Prewarm-world fingerprints the manifest's export ladder ran at
+        # (see SchedulerEngine.prewarm): a warm boot whose world matches
+        # one of these preloads the WHOLE manifest and skips the example
+        # ladder — no trace, no compile, and no example execution.
+        self._worlds: set[str] = set()
+        # Ahead-of-time compiled executables by entry id (preload_all):
+        # resolution routes straight to these, no per-call deserialize.
+        self._preloaded: dict[str, Callable] = {}
+        self._dirty = False
+        self.stats = {"loaded": 0, "traced": 0, "rejected": 0}
+        if self.enabled:
+            _register_pytrees()
+            self._load_manifest()
+
+    # -- manifest ---------------------------------------------------------
+    def _guard(self) -> dict:
+        return {
+            "jax": jax.__version__,
+            "platform": jax.default_backend(),
+            "x64": bool(jax.config.jax_enable_x64),
+            "code": code_fingerprint(),
+        }
+
+    def _load_manifest(self) -> None:
+        path = os.path.join(self.dir, MANIFEST_NAME)
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except FileNotFoundError:
+            return
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            log.warning("AOT manifest unreadable (%s); ignoring", e)
+            return
+        if doc.get("version") != MANIFEST_VERSION or doc.get("guard") != self._guard():
+            # A manifest exported by a different jax/platform/x64 world:
+            # every program in it would deserialize into the wrong
+            # runtime — treat as absent (the next export-mode prewarm
+            # rewrites it for this world).
+            log.warning(
+                "AOT manifest guard mismatch (have %s, manifest %s); "
+                "falling back to live traces",
+                self._guard(), doc.get("guard"),
+            )
+            self._count("rejected")
+            return
+        self._entries = dict(doc.get("entries") or {})
+        self._worlds = set(doc.get("worlds") or ())
+
+    def save_manifest(self) -> None:
+        """Atomically persist the manifest (blobs are already on disk:
+        each was written tmp+rename before its entry existed)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if not self._dirty:
+                return
+            doc = {
+                "version": MANIFEST_VERSION,
+                "guard": self._guard(),
+                "worlds": sorted(self._worlds),
+                "entries": self._entries,
+            }
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = os.path.join(self.dir, f".{MANIFEST_NAME}.tmp.{os.getpid()}")
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, os.path.join(self.dir, MANIFEST_NAME))
+            self._dirty = False
+
+    # -- export mode ------------------------------------------------------
+    class _ExportMode:
+        def __init__(self, store):
+            self._store = store
+
+        def __enter__(self):
+            self._store._export_tls.active = True
+            return self._store
+
+        def __exit__(self, *exc):
+            self._store._export_tls.active = False
+            self._store.save_manifest()
+            return False
+
+    def export_mode(self) -> "AotStore._ExportMode":
+        """Context manager the prewarm ladder runs under: programs
+        traced inside it (on this thread) are exported + persisted."""
+        return AotStore._ExportMode(self)
+
+    @property
+    def exporting(self) -> bool:
+        return bool(getattr(self._export_tls, "active", False))
+
+    # -- program wrapping -------------------------------------------------
+    def wrap(self, key: str, fn: Callable) -> Callable:
+        """Route ``fn`` (a jax.jit function) through the store: per
+        argument-shape signature, use a deserialized export when the
+        manifest has one, export during export mode, live-trace
+        otherwise.  Disabled stores return ``fn`` unchanged (zero
+        overhead)."""
+        if not self.enabled:
+            return fn
+        return _AotProgram(self, key, fn)
+
+    def _count(self, result: str, n: int = 1) -> None:
+        self.stats[result] = self.stats.get(result, 0) + n
+        if self.metrics is not None:
+            self.metrics.counter("engine_aot_programs_total", n, result=result)
+
+    def _pcache_entries(self) -> int:
+        base = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if not base:
+            return 0
+        try:
+            return sum(1 for _ in os.scandir(base) if _.is_file())
+        except OSError:
+            return 0
+
+    def _note_pcache(self, before: int) -> None:
+        """Attribute a loaded program's first-call XLA compile to the
+        persistent cache: no new on-disk entry means the compile was a
+        disk hit — the signal the restart harness gates on."""
+        if self.metrics is None:
+            return
+        after = self._pcache_entries()
+        result = "miss" if after > before else "hit"
+        self.metrics.counter("engine_persistent_cache_total", result=result)
+
+    # -- prewarm worlds / whole-manifest preload ---------------------------
+    def note_world(self, world_key: str) -> None:
+        """Record that the export ladder ran at this prewarm world, so a
+        later boot at the same world may preload + skip the ladder."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if world_key not in self._worlds:
+                self._worlds.add(world_key)
+                self._dirty = True
+
+    def has_world(self, world_key: str) -> bool:
+        return self.enabled and world_key in self._worlds
+
+    def preload_all(self) -> int:
+        """Ahead-of-time compile EVERY manifest entry from its serialized
+        avals — deserialize, ``jit(call).lower(avals).compile()`` — with
+        no example inputs and no execution.  This is the warm-boot
+        replacement for the prewarm trace ladder: the XLA compiles hit
+        the persistent cache, and live dispatches route straight to the
+        compiled executables.  Returns the number of programs now
+        preloaded; individual failures count ``rejected`` and fall back
+        to live traces at first use."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            entries = dict(self._entries)
+        todo = [
+            (eid, e) for eid, e in entries.items() if eid not in self._preloaded
+        ]
+        n = len(entries) - len(todo)
+        if not todo:
+            return n
+        # XLA compiles (and persistent-cache loads) release the GIL, so
+        # the manifest preloads in parallel — the warm-boot ladder is
+        # bounded by the slowest program, not the sum.
+        from concurrent.futures import ThreadPoolExecutor
+
+        workers = min(8, max(1, (os.cpu_count() or 2) - 1), len(todo))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            compiled_list = list(
+                pool.map(lambda kv: (kv[0], self._compile_entry(kv[1])), todo)
+            )
+        for eid, compiled in compiled_list:
+            if compiled is None:
+                continue
+            self._preloaded[eid] = compiled
+            self._count("loaded")
+            n += 1
+        return n
+
+    def _compile_entry(self, entry: dict) -> Optional[Callable]:
+        from jax import export as jexport
+
+        path = os.path.join(self.dir, entry.get("file", ""))
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            if zlib.crc32(blob) != entry.get("crc"):
+                raise ValueError("CRC mismatch")
+            exported = jexport.deserialize(bytearray(blob))
+            leaves = [
+                jax.ShapeDtypeStruct(a.shape, a.dtype)
+                for a in exported.in_avals
+            ]
+            args, kwargs = jax.tree_util.tree_unflatten(
+                exported.in_tree, leaves
+            )
+            before = self._pcache_entries()
+            compiled = jax.jit(exported.call).lower(*args, **kwargs).compile()
+            self._note_pcache(before)
+        except Exception as e:
+            log.warning(
+                "AOT preload failed for %s (%s); will live-trace",
+                entry.get("key", "?"), e,
+            )
+            self._count("rejected")
+            return None
+        return compiled
+
+    # -- resolution --------------------------------------------------------
+    def _resolve(self, key: str, sig: str, fn: Callable, args: tuple) -> Callable:
+        """Pick the route for one (program, signature): a jitted
+        deserialized export, an export-and-use (export mode), or the
+        live jit function."""
+        eid = _entry_id(key, sig)
+        compiled = self._preloaded.get(eid)
+        if compiled is not None:
+            # Preloaded executable: already compiled and counted; the
+            # guard only covers a pathological first-call failure.
+            return self._precompiled_route(compiled, fn, key)
+        with self._lock:
+            entry = self._entries.get(eid)
+        if entry is not None:
+            loaded = self._load_entry(key, entry)
+            if loaded is not None:
+                return self._guarded(loaded, fn, key)
+        if self.exporting:
+            # Export is a SIDE EFFECT: the route stays the live jit
+            # function, so cold-booted processes keep their donating
+            # programs (export drops donation) — the export's extra
+            # trace is a one-time cost inside the background prewarm
+            # thread, never on a live tick.  Only warm boots (preload)
+            # run the donation-free deserialized executables.
+            self._export_entry(key, sig, eid, fn, args)
+        self._count("traced")
+        return fn
+
+    def _load_entry(self, key: str, entry: dict) -> Optional[Callable]:
+        from jax import export as jexport
+
+        path = os.path.join(self.dir, entry.get("file", ""))
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError as e:
+            log.warning("AOT blob %s unreadable (%s); live-tracing", path, e)
+            self._count("rejected")
+            return None
+        if zlib.crc32(blob) != entry.get("crc"):
+            log.warning(
+                "AOT blob %s failed CRC (program %s); live-tracing", path, key
+            )
+            self._count("rejected")
+            return None
+        try:
+            exported = jexport.deserialize(bytearray(blob))
+        except Exception as e:
+            log.warning("AOT deserialize failed for %s (%s); live-tracing", key, e)
+            self._count("rejected")
+            return None
+        return jax.jit(exported.call)
+
+    def _precompiled_route(
+        self, compiled: Callable, fallback: Callable, key: str
+    ) -> Callable:
+        state = {"dead": False}
+        store = self
+
+        def route(*args):
+            if state["dead"]:
+                return fallback(*args)
+            try:
+                return compiled(*args)
+            except Exception as e:
+                state["dead"] = True
+                log.warning(
+                    "preloaded AOT program %s failed (%s); live-tracing",
+                    key, e,
+                )
+                store._count("rejected")
+                return fallback(*args)
+
+        return route
+
+    def _guarded(self, loaded: Callable, fallback: Callable, key: str) -> Callable:
+        """First-call guard around a loaded program: a call failure
+        (platform refusing the artifact, aval mismatch) rejects the
+        entry and permanently reroutes to the live trace."""
+        state = {"ok": False, "dead": False}
+        store = self
+
+        def route(*args):
+            if state["dead"]:
+                return fallback(*args)
+            if state["ok"]:
+                return loaded(*args)
+            before = store._pcache_entries()
+            try:
+                out = loaded(*args)
+            except Exception as e:
+                state["dead"] = True
+                log.warning(
+                    "AOT program %s failed on first call (%s); live-tracing",
+                    key, e,
+                )
+                store._count("rejected")
+                return fallback(*args)
+            state["ok"] = True
+            store._note_pcache(before)
+            store._count("loaded")
+            return out
+
+        return route
+
+    def _export_entry(
+        self, key: str, sig: str, eid: str, fn: Callable, args: tuple
+    ) -> bool:
+        """Export ``fn`` at these avals and persist blob + manifest
+        entry.  False on any failure — the program simply stays
+        live-trace-only."""
+        from jax import export as jexport
+
+        try:
+            with warnings.catch_warnings():
+                # Donated buffers are dropped by export (a memory trade,
+                # not a correctness one) — don't spam prewarm logs.
+                warnings.simplefilter("ignore")
+                exported = jexport.export(fn)(*args)
+            blob = exported.serialize()
+        except Exception as e:
+            log.warning("AOT export failed for %s (%s); live-tracing", key, e)
+            return False
+        fname = f"{eid}.jaxexp"
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = os.path.join(self.dir, f".{fname}.tmp.{os.getpid()}")
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, os.path.join(self.dir, fname))
+        except OSError as e:
+            log.warning("AOT blob write failed for %s (%s)", key, e)
+            return None
+        with self._lock:
+            self._entries[eid] = {
+                "file": fname,
+                "crc": zlib.crc32(bytes(blob)),
+                "key": key,
+                "sig_sha": hashlib.sha1(sig.encode()).hexdigest()[:12],
+                "nbytes": len(blob),
+            }
+            self._dirty = True
+        return jax.jit(exported.call)
+
+
+class _AotProgram:
+    """Per-program router: one resolved route per argument signature."""
+
+    __slots__ = ("_store", "_key", "_fn", "_routes")
+
+    def __init__(self, store: AotStore, key: str, fn: Callable):
+        self._store = store
+        self._key = key
+        self._fn = fn
+        self._routes: dict[str, Callable] = {}
+
+    def __call__(self, *args):
+        sig = _sig_of(args)
+        route = self._routes.get(sig)
+        if route is None:
+            route = self._store._resolve(self._key, sig, self._fn, args)
+            self._routes[sig] = route
+        return route(*args)

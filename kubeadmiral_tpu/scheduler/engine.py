@@ -59,6 +59,7 @@ from kubeadmiral_tpu.ops.pipeline import (
 from kubeadmiral_tpu.ops.planner import INT32_INF
 from kubeadmiral_tpu.runtime import devprof as devprof_mod
 from kubeadmiral_tpu.runtime import flightrec as flightrec_mod
+from kubeadmiral_tpu.scheduler import aot as aot_mod
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
 from kubeadmiral_tpu.scheduler import compact as Cmp
@@ -320,6 +321,35 @@ class _CachedChunk:
     # row, so one narrow-selecting batch can't whipsaw K down and force
     # the next ordinary batch through the overflow re-fetch.
     pack_shrink_votes: int = 0
+
+
+class _SnapshotView:
+    """The cluster-tensor face of a ClusterView, reconstructed from a
+    durable snapshot (runtime/snapshot.py).  Restored chunk entries hold
+    one of these as ``prev_view`` when the relisted world's cluster
+    tensors differ from the snapshot's: the drift machinery only reads
+    ``names`` plus the four resource planes (``_drift_delta``,
+    ``_wcheck_cpu_device``), so a stale-but-recent snapshot resumes
+    through the exact drift-gate path a live capacity drift uses."""
+
+    __slots__ = ("names", "alloc", "used", "cpu_alloc", "cpu_avail")
+
+    def __init__(self, names, alloc, used, cpu_alloc, cpu_avail):
+        self.names = list(names)
+        self.alloc = np.asarray(alloc)
+        self.used = np.asarray(used)
+        self.cpu_alloc = np.asarray(cpu_alloc)
+        self.cpu_avail = np.asarray(cpu_avail)
+
+
+# Placeholder members of a restored chunk entry's ``units`` list: never
+# identical to a live unit object, so the hit path's identity fast-check
+# always falls through to the signature comparison — every row of a
+# restored chunk is verified against its snapshot signature before the
+# snapshot's outputs are trusted for it.
+_RESTORE_SENTINEL = object()
+
+SNAPSHOT_STATE_VERSION = 1
 
 
 def _diff_bits(out, prev: tuple):
@@ -724,6 +754,29 @@ class SchedulerEngine:
         self._pcache_count = self._pcache_entries()
 
         self.mesh = self._resolve_mesh(mesh)
+        # AOT program store (scheduler/aot.py, KT_AOT): program builders
+        # route through it so a warm boot preloads jax.export artifacts
+        # instead of re-tracing the prewarm ladder; cold processes
+        # export as a side effect and keep their own (donating) live
+        # jits.  Exports pin the device topology, so meshes stay on
+        # live traces.  Documented trade: warm boots' PRELOADED programs
+        # do not donate prev buffers (export drops donation) —
+        # correctness is unaffected (the engine already treats donated
+        # inputs as dead), HBM-tight deployments can set KT_AOT=0.
+        self._aot = aot_mod.AotStore(
+            metrics=self.metrics,
+            enabled=None if self.mesh is None else False,
+        )
+        # Staged crash-recovery state (runtime/snapshot.py): consumed by
+        # the FIRST _schedule_impl call, which has the units + clusters
+        # a restore must verify against.  restore_info records what the
+        # consumption decided (bench/tests assert on it).
+        self._pending_restore: Optional[tuple] = None
+        self.restore_info: Optional[dict] = None
+        # Post-tick hook (SnapshotManager): invoked at the end of every
+        # schedule() call, still under the schedule lock, so a snapshot
+        # captures the converged tick's planes.
+        self.post_tick = None
         self._build_programs()
         # Device-time attribution: route the shared jitted programs
         # through the dispatch ledger (per-key program caches wrap at
@@ -847,19 +900,21 @@ class SchedulerEngine:
         # output planes into the new ones: full dispatches stop holding
         # two [B, C] output generations live at once.
         donate = (1,) if self.donate else ()
+        aot = self._aot.wrap
         if self.mesh is None:
-            self._tick = jax.jit(_tick_with_diff, donate_argnums=donate)
-            self._tick_compact = jax.jit(
-                _tick_compact_with_diff, donate_argnums=donate
+            self._tick = aot("tick", jax.jit(_tick_with_diff, donate_argnums=donate))
+            self._tick_compact = aot(
+                "tick_compact",
+                jax.jit(_tick_compact_with_diff, donate_argnums=donate),
             )
             self._cluster_shardings = None
-            self._gather = jax.jit(_gather_packed)
-            self._gather3 = jax.jit(_gather_packed3)
-            self._gather5 = jax.jit(_gather_packed5)
-            self._gather_over3 = jax.jit(_gather_overflow3)
-            self._gather_over4 = jax.jit(_gather_overflow4)
-            self._patch = jax.jit(_patch_rows)
-            self._patch_compact = jax.jit(_patch_rows)
+            self._gather = aot("gather", jax.jit(_gather_packed))
+            self._gather3 = aot("gather3", jax.jit(_gather_packed3))
+            self._gather5 = aot("gather5", jax.jit(_gather_packed5))
+            self._gather_over3 = aot("over3", jax.jit(_gather_overflow3))
+            self._gather_over4 = aot("over4", jax.jit(_gather_overflow4))
+            self._patch = aot("patch", jax.jit(_patch_rows))
+            self._patch_compact = aot("patch_compact", jax.jit(_patch_rows))
             self._per_object_shardings = None
             self._per_object_shardings_compact = None
             self._table_shardings = None
@@ -1012,6 +1067,7 @@ class SchedulerEngine:
                 if sharding is not None
                 else jax.jit(make)
             )
+            fn = self._aot.wrap(f"zeros:{shape}", fn)
             fn = self._obs_wrap("zeros", fn)
             self._zero_fns[shape] = fn
         zp = fn()
@@ -1072,6 +1128,7 @@ class SchedulerEngine:
                 out_shardings=(M.output_shardings(self.mesh), rows, rows),
                 donate_argnums=donate,
             )
+        fn = self._aot.wrap(f"tick_narrow:{fmt}:m{m}", fn)
         fn = self._obs_wrap("tick_narrow", fn)
         self._narrow_programs[key] = fn
         return fn
@@ -1108,7 +1165,7 @@ class SchedulerEngine:
             out = schedule_tick.__wrapped__(inp)
             return out.selected, out.replicas, out.counted, out.reasons
 
-        fn = jax.jit(impl)
+        fn = self._aot.wrap(f"narrow_fallback:{fmt}", jax.jit(impl))
         fn = self._obs_wrap("narrow_fallback", fn)
         self._fallback_programs[fmt] = fn
         return fn
@@ -1127,7 +1184,7 @@ class SchedulerEngine:
                 )
 
             donate = (0,) if self.donate else ()
-            fn = jax.jit(impl, donate_argnums=donate)
+            fn = self._aot.wrap("cert_repair", jax.jit(impl, donate_argnums=donate))
             fn = self._obs_wrap("repair", fn)
             self._cert_repair_cache["repair"] = fn
         return fn
@@ -1221,6 +1278,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(impl)
+        fn = self._aot.wrap(f"pack:{kind}:k{k}", fn)
         fn = self._obs_wrap("pack", fn)
         self._pack_programs[key] = fn
         return fn
@@ -1700,6 +1758,15 @@ class SchedulerEngine:
                 len(units), wall, cache0, fetch0,
                 bytes0, overflow0, upload0, drift0, narrow0,
             )
+            if self.post_tick is not None:
+                # Durable-snapshot hook (runtime/snapshot.py): runs
+                # under the schedule lock so the captured planes belong
+                # to THIS converged tick.  A persistence failure logs,
+                # never breaks scheduling.
+                try:
+                    self.post_tick(self)
+                except Exception:
+                    log.warning("post-tick hook failed", exc_info=True)
             if log.isEnabledFor(logging.DEBUG):
                 log.debug(
                     "tick=%d objects=%d clusters=%d wall_ms=%.1f stages=%s "
@@ -1803,6 +1870,267 @@ class SchedulerEngine:
         self.dispatches_total += 1
         self.program_shapes.add(shape_key)
 
+
+    # -- crash recovery: durable snapshots (runtime/snapshot.py) ----------
+    def _snapshot_config(self) -> dict:
+        """The engine-shape fingerprint a snapshot must match to be
+        restorable: anything that changes the chunk split, the padded
+        plane shapes, or the solve structure.  A mismatch rejects the
+        snapshot (cold boot) — restore never reinterprets planes."""
+        return {
+            "version": SNAPSHOT_STATE_VERSION,
+            "chunk_size": self.chunk_size,
+            "cell_budget": self.cell_budget,
+            "megachunk_rows": self.megachunk_rows,
+            "min_bucket": self.min_bucket,
+            "min_cluster_bucket": self.min_cluster_bucket,
+            "canonical_c": self.canonical_c,
+            "fetch_format": self.fetch_format,
+            "narrow": self.narrow,
+            "narrow_m": self.narrow_m,
+            "mesh": None if self.mesh is None else tuple(self.mesh.devices.shape),
+        }
+
+    def snapshot_state(self) -> Optional[dict]:
+        """Host-side image of the engine's resumable working set: per
+        converged chunk the prev output planes (placements / scores /
+        feasibility / reasons), row signatures and adaptive-K hints,
+        plus the cluster tensors they were computed against.  None when
+        there is nothing coherent to persist (no converged tick yet, or
+        the cache is mid-transition).  Callers serialize ticks around
+        this (the SnapshotManager hook runs under the schedule lock)."""
+        entries = sorted(self._chunk_cache.items())
+        if not entries:
+            return None
+        view = None
+        for _idx, e in entries:
+            if e.prev_view is not None:
+                view = e.prev_view
+                break
+        if view is None or getattr(view, "names", None) is None:
+            return None
+        chunks: dict[int, dict] = {}
+        rows = 0
+        for idx, e in entries:
+            if (
+                e.prev_view is not view
+                or e.prev_out is None
+                or e.prev_feas is None
+                or e.prev_reasons is None
+                or e.prev_results is None
+                or len(e.prev_results) != len(e.units)
+                or e.stale_out_rows  # device planes disagree with decodes
+            ):
+                continue
+            sel, rep, cnt, sco = (np.asarray(p) for p in e.prev_out)
+            chunks[idx] = {
+                "n": len(e.units),
+                "fmt": e.fmt,
+                "sigs": list(e.sigs),
+                "has_scores": e.prev_has_scores,
+                "pack_k_hint": e.pack_k_hint,
+                "pack_shrink_votes": e.pack_shrink_votes,
+                "sel": sel,
+                "rep": rep,
+                "cnt": cnt,
+                "sco": sco,
+                "feas": np.asarray(e.prev_feas),
+                "rsn": np.asarray(e.prev_reasons),
+            }
+            rows += len(e.units)
+        if not chunks:
+            return None
+        return {
+            "version": SNAPSHOT_STATE_VERSION,
+            "config": self._snapshot_config(),
+            "tick": self.tick_seq,
+            "names": list(view.names),
+            "topo_fp": self._topo_fingerprint(view)
+            if not isinstance(view, _SnapshotView)
+            else None,
+            "view": {
+                "alloc": np.asarray(view.alloc).copy(),
+                "used": np.asarray(view.used).copy(),
+                "cpu_alloc": np.asarray(view.cpu_alloc).copy(),
+                "cpu_avail": np.asarray(view.cpu_avail).copy(),
+            },
+            "rows": rows,
+            "chunks": chunks,
+        }
+
+    def stage_restore(self, payload: Optional[dict], assume_fresh: bool = False) -> None:
+        """Stage a snapshot payload for consumption at the next tick
+        (the first ``schedule()`` call has the relisted units + clusters
+        the restore must verify against).  ``assume_fresh`` records that
+        the caller's resourceVersion watermarks matched the relist —
+        telemetry only: freshness is RE-PROVEN inside the engine by
+        cluster-tensor equality plus the per-row signature walk, so a
+        lying watermark can cost a re-solve, never a wrong placement."""
+        if payload is None:
+            self._pending_restore = None
+            return
+        self._pending_restore = (payload, bool(assume_fresh))
+
+    def _consume_restore(self, units, clusters, view: ClusterView) -> None:
+        payload, assume_fresh = self._pending_restore
+        self._pending_restore = None
+        info = {
+            "result": "rejected", "fresh": False, "chunks": 0, "rows": 0,
+            "watermarks_matched": assume_fresh,
+        }
+        self.restore_info = info
+        try:
+            self._restore_impl(payload, units, clusters, view, info)
+        except Exception:
+            log.warning("snapshot restore failed; falling back cold", exc_info=True)
+            info["result"] = "rejected"
+        result = info["result"]
+        if result == "loaded":
+            result = "loaded_fresh" if info["fresh"] else "loaded_stale"
+        self.metrics.counter("engine_snapshot_total", result=result)
+        log.info(
+            "snapshot restore: %s chunks=%d rows=%d fresh=%s",
+            result, info["chunks"], info["rows"], info["fresh"],
+        )
+
+    def _restore_impl(self, payload, units, clusters, view, info) -> None:
+        if payload.get("version") != SNAPSHOT_STATE_VERSION:
+            return
+        if payload.get("config") != self._snapshot_config():
+            return
+        topo_fp = self._topo_fingerprint(view)
+        if payload.get("topo_fp") != topo_fp:
+            return  # labels/taints/api-resources moved: rows invalid
+        if payload.get("names") != list(view.names):
+            return
+        snap_view = payload["view"]
+        if np.asarray(snap_view["alloc"]).shape != np.asarray(view.alloc).shape:
+            return
+        # Freshness is decided by CONTENT, not by trust: bit-identical
+        # cluster tensors + the per-row signature walk below mean the
+        # snapshot world IS the relisted world, and the first tick rides
+        # the O(B) no-op replay.  Anything else resumes as a capacity
+        # drift against the snapshot view.
+        fresh = all(
+            np.array_equal(np.asarray(snap_view[k]), np.asarray(getattr(view, k)))
+            for k in ("alloc", "used", "cpu_alloc", "cpu_avail")
+        )
+        old_view = (
+            view
+            if fresh
+            else _SnapshotView(
+                payload["names"], snap_view["alloc"], snap_view["used"],
+                snap_view["cpu_alloc"], snap_view["cpu_avail"],
+            )
+        )
+        c_bucket, eff_chunk, ladder = self._tick_geometry(len(view.clusters))
+        multi_chunk = len(units) > eff_chunk
+        vocab = self._vocab_for(view, topo_fp)
+        snap_chunks = payload.get("chunks") or {}
+        restored = rows = 0
+        for chunk_idx, start in enumerate(range(0, len(units), eff_chunk)):
+            cs = snap_chunks.get(chunk_idx)
+            chunk = units[start : start + eff_chunk]
+            if cs is None or cs["n"] != len(chunk):
+                continue
+            b_pad = self._bucket_rows(len(chunk), ladder, eff_chunk, multi_chunk)
+            if tuple(cs["sel"].shape) != (b_pad, c_bucket):
+                continue
+            inputs, fmt = self._featurize_full(chunk, clusters, view, vocab)
+            if fmt != cs["fmt"]:
+                continue
+            host_bytes = sum(
+                np.asarray(getattr(inputs, name)).nbytes
+                for name in self._per_object_fields(fmt)
+            )
+            c = np.asarray(inputs.cluster_valid).shape[0]
+            nbytes = (
+                host_bytes * 3
+                + _pow2_bucket(len(chunk), self.min_bucket, 1 << 30)
+                * _cluster_bucket(c, self.min_cluster_bucket)
+                * 15
+            )
+            if self._cache_used + nbytes > self.cache_bytes:
+                continue
+            entry = _CachedChunk(
+                sigs=list(cs["sigs"]),
+                units=[_RESTORE_SENTINEL] * len(chunk),
+                inputs=inputs,
+                fmt=fmt,
+                topo_fp=topo_fp,
+                nbytes=nbytes,
+                vocab_uid=vocab.uid if (fmt == "compact" and vocab) else 0,
+            )
+            # Device residency: the per-object planes (the drift gate /
+            # sub-batch substrate) and the prev output planes.  This is
+            # the cold upload cost, paid at restore instead of inside
+            # the first tick's critical path.
+            padded = self._pad_for_dispatch(
+                inputs, fmt, b_pad, c_bucket, skip_cluster_fields=True
+            )
+            fields = padded._asdict()
+            per_object = {
+                name: fields[name] for name in self._per_object_fields(fmt)
+            }
+            if fmt == "compact":
+                shape = (
+                    b_pad, c_bucket,
+                    np.asarray(padded.sparse_idx).shape[1],
+                    np.asarray(padded.key_bytes).shape[1],
+                )
+                shardings = self._per_object_shardings_compact
+            else:
+                shape = (b_pad, c_bucket)
+                shardings = self._per_object_shardings
+            self.upload_bytes["object"] += sum(
+                np.asarray(a).nbytes for a in per_object.values()
+            )
+            entry.device_per_object = (
+                jax.device_put(per_object, shardings)
+                if shardings is not None
+                else jax.device_put(per_object)
+            )
+            entry.padded_shape = shape
+            grid = self._grid_sharding
+
+            def put(arr, dtype):
+                arr = np.ascontiguousarray(np.asarray(arr), dtype=dtype)
+                return (
+                    jax.device_put(arr, grid) if grid is not None else jax.device_put(arr)
+                )
+
+            sel, rep = cs["sel"], cs["rep"]
+            cnt, sco = cs["cnt"], cs["sco"]
+            entry.prev_out = (
+                put(sel, np.int8), put(rep, np.int32),
+                put(cnt, np.int8), put(sco, np.int32),
+            )
+            entry.prev_feas = put(cs["feas"], np.int8)
+            entry.prev_reasons = put(cs["rsn"], np.int32)
+            n = len(chunk)
+            entry.prev_results = self._decode_rows(
+                np.asarray(sel)[:n], np.asarray(rep)[:n], np.asarray(cnt)[:n],
+                view.names,
+                scores=np.asarray(sco)[:n] if cs["has_scores"] else None,
+            )
+            entry.prev_has_scores = bool(cs["has_scores"])
+            entry.prev_view = old_view
+            entry.pack_k_hint = int(cs.get("pack_k_hint", 0))
+            entry.pack_shrink_votes = int(cs.get("pack_shrink_votes", 0))
+            existing = self._chunk_cache.pop(chunk_idx, None)
+            if existing is not None:
+                self._cache_used -= existing.nbytes
+            self._chunk_cache[chunk_idx] = entry
+            self._cache_used += nbytes
+            restored += 1
+            rows += n
+        info.update(
+            result="loaded" if restored else "rejected",
+            fresh=fresh and bool(restored),
+            chunks=restored,
+            rows=rows,
+        )
+
     def _schedule_impl(
         self,
         units: Sequence[T.SchedulingUnit],
@@ -1819,6 +2147,13 @@ class SchedulerEngine:
             return []
         if view is None:
             view = self._cached_view(units, clusters)
+        if self._pending_restore is not None:
+            # Crash recovery: a staged snapshot (stage_restore) is
+            # consumed HERE, where the relisted units + clusters it must
+            # be verified against exist.  Restored chunks then ride the
+            # ordinary hit/noop/drift/sub-batch machinery below — the
+            # snapshot only ever seeds ``prev`` state, never outputs.
+            self._consume_restore(units, clusters, view)
         # O(1)/O(B) whole-batch no-op gate: the SAME units list object
         # against the SAME cluster view is byte-identical input (units
         # are frozen by contract, and the list container must be treated
@@ -2653,6 +2988,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(impl, donate_argnums=donate)
+            fn = self._aot.wrap("repair", fn)
             fn = self._obs_wrap("repair", fn)
             self._repair_program_cache["repair"] = fn
         return fn
@@ -2830,6 +3166,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(impl)
+        fn = self._aot.wrap(f"gate:{fmt}", fn)
         fn = self._obs_wrap("gate", fn)
         self._gate_programs[fmt] = fn
         return fn
@@ -2851,6 +3188,7 @@ class SchedulerEngine:
                 )
             else:
                 fn = jax.jit(drift_wcheck)
+            fn = self._aot.wrap("wcheck", fn)
             fn = self._obs_wrap("wcheck", fn)
             self._wcheck_program_cache["wcheck"] = fn
         return fn
@@ -3009,7 +3347,7 @@ class SchedulerEngine:
                 )
             return out, cert
 
-        fn = jax.jit(impl)
+        fn = self._aot.wrap(f"resolve:{fmt}:m{m}", jax.jit(impl))
         fn = self._obs_wrap("resolve", fn)
         self._resolve_programs[key] = fn
         return fn
@@ -4309,6 +4647,276 @@ class SchedulerEngine:
         return results, None
 
     # -- compile pre-warming ----------------------------------------------
+    def _prewarm_ladder(
+        self, n_objects, n_clusters, scalar_resources, key_len,
+        policy_entries, webhooks,
+    ) -> None:
+        """The prewarm ladder body (see prewarm()): builds a
+        representative world at the workload's program-shape drivers
+        and exercises every program a live tick can dispatch.  Runs
+        under the AOT store's export mode, so each traced program is
+        also serialized into the warm-boot manifest."""
+        gvk = "apps/v1/Deployment"
+        alloc = {"cpu": "8", "memory": "16Gi"}
+        avail = {"cpu": "4", "memory": "8Gi"}
+        request = {"cpu": "100m"}
+        for r in scalar_resources:
+            alloc[r] = "8"
+            avail[r] = "4"
+            request[r] = "1"
+        clusters = [
+            T.ClusterState(
+                name=f"warm-{j}",
+                labels={},
+                taints=(),
+                allocatable=T.parse_resources(alloc),
+                available=T.parse_resources(avail),
+                api_resources=frozenset({gvk}),
+            )
+            for j in range(max(1, n_clusters))
+        ]
+        # The warm unit reproduces the workload's program-shape
+        # drivers: a key padded to key_len (-> L bucket) and
+        # policy entries over policy_entries clusters (-> P
+        # bucket).
+        name = "prewarm".ljust(max(1, key_len - len("prewarm/")), "x")
+        unit = T.SchedulingUnit(
+            gvk=gvk,
+            namespace="prewarm",
+            name=name,
+            scheduling_mode=T.MODE_DIVIDE,
+            desired_replicas=1,
+            resource_request=T.parse_resources(request),
+            min_replicas={
+                f"warm-{j}": 0
+                for j in range(
+                    min(max(1, policy_entries), len(clusters))
+                )
+            },
+        )
+        from kubeadmiral_tpu.scheduler.featurize import (
+            _build_cluster_view,
+        )
+
+        view = _build_cluster_view(clusters, [unit])
+        vocab = CompactVocab(view, **self._vocab_caps)
+        ci = featurize_compact([unit], view, vocab)
+        c_bucket, eff_chunk, ladder = self._tick_geometry(len(clusters))
+        if ladder is None:
+            shapes = [
+                self._bucket_rows(
+                    min(max(1, n_objects), eff_chunk), None, eff_chunk, False
+                )
+            ]
+        else:
+            # All rungs: full chunks use the top, sub-batches the
+            # lower ones.
+            shapes = ladder
+        outs: dict[int, object] = {}
+        for b_pad in shapes:
+            # The compact program is the production path; the
+            # dense variant serves webhook ticks (warmed only
+            # when the deployment has webhook plugins).
+            padded = self._pad_for_dispatch(ci, "compact", b_pad, c_bucket)
+            padded = padded._replace(
+                **Cmp.pad_tables(vocab.tables(), c_bucket)
+            )
+            shape = (b_pad, c_bucket)
+            out, mask = self._tick_compact(padded, self._zeros_for(shape))
+            jax.block_until_ready(mask)
+            # Narrow solve: at this geometry the narrow program
+            # (not the dense tick above) is the production
+            # dispatch — warm it plus its certificate machinery
+            # (dense row re-solve + in-place plane repair), so a
+            # first-tick fallback never stalls on a trace.
+            narrow_m = self._narrow_m(ci, c_bucket)
+            if narrow_m is not None:
+                out_n, _mask_n, cert_n = self._narrow_program(
+                    "compact", narrow_m
+                )(padded, self._zeros_for(shape))
+                jax.block_until_ready(cert_n)
+                fb_idx = np.full(16, b_pad, np.int32)
+                fb = self._fallback_program("compact")(padded, fb_idx)
+                repaired = self._cert_repair_program()(
+                    (out_n.selected, out_n.replicas, out_n.counted,
+                     out_n.reasons),
+                    fb, fb_idx,
+                )
+                jax.block_until_ready(repaired[0])
+            if webhooks:
+                dense = featurize([unit], clusters, view=view).inputs
+                dense_padded = self._pad_for_dispatch(
+                    dense, "dense", b_pad, c_bucket
+                )
+                out_d, mask_d = self._tick(
+                    dense_padded, self._zeros_for(shape)
+                )
+                jax.block_until_ready(mask_d)
+                if narrow_m is not None:
+                    _o, _m, cert_nd = self._narrow_program(
+                        "dense", narrow_m
+                    )(dense_padded, self._zeros_for(shape))
+                    jax.block_until_ready(cert_nd)
+            idx = np.zeros(16, np.int32)
+            jax.block_until_ready(
+                self._gather(
+                    out.selected, out.replicas, out.counted, out.scores, idx
+                )
+            )
+            jax.block_until_ready(
+                self._gather3(out.selected, out.replicas, out.counted, idx)
+            )
+            jax.block_until_ready(
+                self._gather5(
+                    out.selected, out.replicas, out.counted,
+                    out.scores, out.reasons, idx,
+                )
+            )
+            if self.fetch_format == "packed":
+                pk = self._pack_k(ci, c_bucket)
+                jax.block_until_ready(
+                    self._pack_program("full", pk)(
+                        out.selected, out.replicas, out.counted,
+                        out.scores, out.reasons,
+                    )
+                )
+                jax.block_until_ready(
+                    self._pack_program("gather", pk)(
+                        out.selected, out.replicas, out.counted,
+                        out.scores, out.reasons, idx,
+                    )
+                )
+                jax.block_until_ready(
+                    self._gather_over3(
+                        out.selected, out.counted, out.replicas, idx
+                    )
+                )
+            # Drift-gate + weight-check programs: tiny traces,
+            # but warming them keeps the FIRST capacity-drift
+            # tick off the compile path too.
+            per_object = {
+                name: np.asarray(getattr(padded, name))
+                for name in Cmp.PER_OBJECT_FIELDS
+            }
+            didx8 = np.full(8, 1 << 30, np.int32)
+            dflag8 = np.zeros(8, bool)
+            slice8 = np.zeros(
+                (8,) + np.asarray(padded.alloc).shape[1:],
+                np.asarray(padded.alloc).dtype,
+            )
+            # Both rungs of the gate's fin-row ladder (see
+            # _fin_rows): a drift tick must never stall on a
+            # gate compile, whatever the finite-K row fraction.
+            for fin_n in sorted({max(64, b_pad // 4), b_pad}):
+                fin_pad = np.full(fin_n, 1 << 30, np.int32)
+                jax.block_until_ready(
+                    self._gate_program("compact")(
+                        per_object,
+                        Cmp.pad_tables(vocab.tables(), c_bucket),
+                        np.zeros(shape, np.int8),
+                        np.zeros(shape, np.int32),
+                        slice8, slice8, slice8, slice8,
+                        didx8, dflag8, dflag8, fin_pad,
+                    )
+                )
+            # The 128-row input-patch group (stale-row repair):
+            # every churn/drift scatter-repair uses exactly this
+            # shape (see _repair_stale_inputs).
+            idx0 = np.zeros(128, np.int64)
+            jax.block_until_ready(
+                self._patch_compact(
+                    per_object,
+                    {
+                        name: np.ascontiguousarray(
+                            np.asarray(per_object[name])[idx0]
+                        )
+                        for name in Cmp.PER_OBJECT_FIELDS
+                    },
+                    np.full(128, b_pad, np.int32),
+                )["total"]
+            )
+            if narrow_m is not None and self.drift_resolve:
+                # The sort-free drift resolve (+ its wire pack)
+                # is the FIRST capacity-drift tick's survivor
+                # path — warm its row-bucket ladder so live
+                # drifts never stall on its trace.
+                device_in_warm = padded._replace(
+                    **Cmp.pad_tables(vocab.tables(), c_bucket)
+                )
+                # The live resolve wire packs at K = narrow M
+                # (see _dispatch_drift_resolve) — warm exactly
+                # that program.
+                pk = (
+                    min(narrow_m, c_bucket)
+                    if self.fetch_format == "packed"
+                    else 0
+                )
+                for kb in sorted({64, 256, max(64, b_pad // 4)}):
+                    ridx = np.full(kb, b_pad, np.int32)
+                    r_out, r_cert = self._resolve_program(
+                        "compact", narrow_m
+                    )(
+                        device_in_warm, ridx,
+                        np.zeros(shape, np.int8),
+                        np.zeros(shape, np.int32),
+                        np.zeros(shape, np.int32),
+                        slice8, slice8, slice8, slice8,
+                        didx8, dflag8,
+                    )
+                    jax.block_until_ready(r_cert)
+                    if pk:
+                        jax.block_until_ready(
+                            self._pack_program("gather", pk)(
+                                r_out.selected, r_out.replicas,
+                                r_out.counted, r_out.scores,
+                                r_out.reasons,
+                                np.arange(kb, dtype=np.int32),
+                            )
+                        )
+            for wn in sorted({64, max(64, b_pad // 4), b_pad}):
+                jax.block_until_ready(
+                    self._wcheck_program()(
+                        np.zeros(shape, np.int8),
+                        np.zeros(wn, np.int32),
+                        np.asarray(padded.cpu_alloc),
+                        np.asarray(padded.cpu_avail),
+                        np.asarray(padded.cpu_alloc),
+                        np.asarray(padded.cpu_avail),
+                    )
+                )
+            outs[b_pad] = out
+            log.info("prewarmed tick program %s", shape)
+        # Sub-batch write-back repair: full-chunk planes get
+        # slab rows scattered in — warm each (chunk, slab-rung)
+        # shape pair so steady-state churn ticks never stall on
+        # the scatter trace.  Planes are DONATED by the repair,
+        # so the chain starts from freshly built zeros (never
+        # from the slab outputs, which must stay alive as the
+        # non-donated inputs) and threads each call's results.
+        big = max(shapes)
+        pshape = (big, c_bucket)
+        planes = jax.jit(
+            lambda: (
+                jnp.zeros(pshape, jnp.int8),
+                jnp.zeros(pshape, jnp.int32),
+                jnp.zeros(pshape, jnp.int8),
+                jnp.zeros(pshape, jnp.int32),
+                jnp.zeros(pshape, jnp.int8),
+                jnp.zeros(pshape, jnp.int32),
+            )
+        )()
+        src128 = np.zeros(128, np.int32)
+        dst128 = np.full(128, big, np.int32)  # out of range: no-op
+        for b_pad in shapes:
+            slab = outs[b_pad]
+            planes = self._repair_program()(
+                planes,
+                (slab.selected, slab.replicas, slab.counted,
+                 slab.scores, slab.feasible, slab.reasons),
+                src128, dst128,
+            )
+            jax.block_until_ready(planes[0])
+
     def prewarm(
         self,
         n_objects: int,
@@ -4336,268 +4944,40 @@ class SchedulerEngine:
         format's key-byte and sparse-width buckets, and ``webhooks=True``
         additionally warms the dense program that webhook ticks use."""
 
+        # The manifest records which prewarm worlds its export ladder
+        # ran at; a matching warm boot replaces the ladder wholesale.
+        world_key = repr((
+            "prewarm-world", n_objects, n_clusters,
+            tuple(scalar_resources), key_len, policy_entries, webhooks,
+        ))
+
         def run():
             try:
-                gvk = "apps/v1/Deployment"
-                alloc = {"cpu": "8", "memory": "16Gi"}
-                avail = {"cpu": "4", "memory": "8Gi"}
-                request = {"cpu": "100m"}
-                for r in scalar_resources:
-                    alloc[r] = "8"
-                    avail[r] = "4"
-                    request[r] = "1"
-                clusters = [
-                    T.ClusterState(
-                        name=f"warm-{j}",
-                        labels={},
-                        taints=(),
-                        allocatable=T.parse_resources(alloc),
-                        available=T.parse_resources(avail),
-                        api_resources=frozenset({gvk}),
+                if self._aot.has_world(world_key):
+                    # Warm boot: the AOT manifest was exported by a
+                    # ladder at THIS world, so ahead-of-time compile
+                    # every entry from its serialized avals — no Python
+                    # trace, no example execution, XLA compiles served
+                    # by the persistent cache — and skip the ladder.
+                    # Entries that fail their guard fall back to live
+                    # traces at first use (counted `rejected`).
+                    n = self._aot.preload_all()
+                    log.info(
+                        "prewarm: AOT manifest preloaded %d programs; "
+                        "trace ladder skipped", n,
                     )
-                    for j in range(max(1, n_clusters))
-                ]
-                # The warm unit reproduces the workload's program-shape
-                # drivers: a key padded to key_len (-> L bucket) and
-                # policy entries over policy_entries clusters (-> P
-                # bucket).
-                name = "prewarm".ljust(max(1, key_len - len("prewarm/")), "x")
-                unit = T.SchedulingUnit(
-                    gvk=gvk,
-                    namespace="prewarm",
-                    name=name,
-                    scheduling_mode=T.MODE_DIVIDE,
-                    desired_replicas=1,
-                    resource_request=T.parse_resources(request),
-                    min_replicas={
-                        f"warm-{j}": 0
-                        for j in range(
-                            min(max(1, policy_entries), len(clusters))
-                        )
-                    },
-                )
-                from kubeadmiral_tpu.scheduler.featurize import (
-                    _build_cluster_view,
-                )
-
-                view = _build_cluster_view(clusters, [unit])
-                vocab = CompactVocab(view, **self._vocab_caps)
-                ci = featurize_compact([unit], view, vocab)
-                c_bucket, eff_chunk, ladder = self._tick_geometry(len(clusters))
-                if ladder is None:
-                    shapes = [
-                        self._bucket_rows(
-                            min(max(1, n_objects), eff_chunk), None, eff_chunk, False
-                        )
-                    ]
-                else:
-                    # All rungs: full chunks use the top, sub-batches the
-                    # lower ones.
-                    shapes = ladder
-                outs: dict[int, object] = {}
-                for b_pad in shapes:
-                    # The compact program is the production path; the
-                    # dense variant serves webhook ticks (warmed only
-                    # when the deployment has webhook plugins).
-                    padded = self._pad_for_dispatch(ci, "compact", b_pad, c_bucket)
-                    padded = padded._replace(
-                        **Cmp.pad_tables(vocab.tables(), c_bucket)
+                    if n:
+                        return
+                # Export mode: every program this ladder traces is
+                # ALSO exported via jax.export into the AOT manifest
+                # (scheduler/aot.py) — the next process deserializes
+                # instead of tracing (engine_aot_programs_total).
+                with self._aot.export_mode():
+                    self._aot.note_world(world_key)
+                    self._prewarm_ladder(
+                        n_objects, n_clusters, scalar_resources,
+                        key_len, policy_entries, webhooks,
                     )
-                    shape = (b_pad, c_bucket)
-                    out, mask = self._tick_compact(padded, self._zeros_for(shape))
-                    jax.block_until_ready(mask)
-                    # Narrow solve: at this geometry the narrow program
-                    # (not the dense tick above) is the production
-                    # dispatch — warm it plus its certificate machinery
-                    # (dense row re-solve + in-place plane repair), so a
-                    # first-tick fallback never stalls on a trace.
-                    narrow_m = self._narrow_m(ci, c_bucket)
-                    if narrow_m is not None:
-                        out_n, _mask_n, cert_n = self._narrow_program(
-                            "compact", narrow_m
-                        )(padded, self._zeros_for(shape))
-                        jax.block_until_ready(cert_n)
-                        fb_idx = np.full(16, b_pad, np.int32)
-                        fb = self._fallback_program("compact")(padded, fb_idx)
-                        repaired = self._cert_repair_program()(
-                            (out_n.selected, out_n.replicas, out_n.counted,
-                             out_n.reasons),
-                            fb, fb_idx,
-                        )
-                        jax.block_until_ready(repaired[0])
-                    if webhooks:
-                        dense = featurize([unit], clusters, view=view).inputs
-                        dense_padded = self._pad_for_dispatch(
-                            dense, "dense", b_pad, c_bucket
-                        )
-                        out_d, mask_d = self._tick(
-                            dense_padded, self._zeros_for(shape)
-                        )
-                        jax.block_until_ready(mask_d)
-                        if narrow_m is not None:
-                            _o, _m, cert_nd = self._narrow_program(
-                                "dense", narrow_m
-                            )(dense_padded, self._zeros_for(shape))
-                            jax.block_until_ready(cert_nd)
-                    idx = np.zeros(16, np.int32)
-                    jax.block_until_ready(
-                        self._gather(
-                            out.selected, out.replicas, out.counted, out.scores, idx
-                        )
-                    )
-                    jax.block_until_ready(
-                        self._gather3(out.selected, out.replicas, out.counted, idx)
-                    )
-                    jax.block_until_ready(
-                        self._gather5(
-                            out.selected, out.replicas, out.counted,
-                            out.scores, out.reasons, idx,
-                        )
-                    )
-                    if self.fetch_format == "packed":
-                        pk = self._pack_k(ci, c_bucket)
-                        jax.block_until_ready(
-                            self._pack_program("full", pk)(
-                                out.selected, out.replicas, out.counted,
-                                out.scores, out.reasons,
-                            )
-                        )
-                        jax.block_until_ready(
-                            self._pack_program("gather", pk)(
-                                out.selected, out.replicas, out.counted,
-                                out.scores, out.reasons, idx,
-                            )
-                        )
-                        jax.block_until_ready(
-                            self._gather_over3(
-                                out.selected, out.counted, out.replicas, idx
-                            )
-                        )
-                    # Drift-gate + weight-check programs: tiny traces,
-                    # but warming them keeps the FIRST capacity-drift
-                    # tick off the compile path too.
-                    per_object = {
-                        name: np.asarray(getattr(padded, name))
-                        for name in Cmp.PER_OBJECT_FIELDS
-                    }
-                    didx8 = np.full(8, 1 << 30, np.int32)
-                    dflag8 = np.zeros(8, bool)
-                    slice8 = np.zeros(
-                        (8,) + np.asarray(padded.alloc).shape[1:],
-                        np.asarray(padded.alloc).dtype,
-                    )
-                    # Both rungs of the gate's fin-row ladder (see
-                    # _fin_rows): a drift tick must never stall on a
-                    # gate compile, whatever the finite-K row fraction.
-                    for fin_n in sorted({max(64, b_pad // 4), b_pad}):
-                        fin_pad = np.full(fin_n, 1 << 30, np.int32)
-                        jax.block_until_ready(
-                            self._gate_program("compact")(
-                                per_object,
-                                Cmp.pad_tables(vocab.tables(), c_bucket),
-                                np.zeros(shape, np.int8),
-                                np.zeros(shape, np.int32),
-                                slice8, slice8, slice8, slice8,
-                                didx8, dflag8, dflag8, fin_pad,
-                            )
-                        )
-                    # The 128-row input-patch group (stale-row repair):
-                    # every churn/drift scatter-repair uses exactly this
-                    # shape (see _repair_stale_inputs).
-                    idx0 = np.zeros(128, np.int64)
-                    jax.block_until_ready(
-                        self._patch_compact(
-                            per_object,
-                            {
-                                name: np.ascontiguousarray(
-                                    np.asarray(per_object[name])[idx0]
-                                )
-                                for name in Cmp.PER_OBJECT_FIELDS
-                            },
-                            np.full(128, b_pad, np.int32),
-                        )["total"]
-                    )
-                    if narrow_m is not None and self.drift_resolve:
-                        # The sort-free drift resolve (+ its wire pack)
-                        # is the FIRST capacity-drift tick's survivor
-                        # path — warm its row-bucket ladder so live
-                        # drifts never stall on its trace.
-                        device_in_warm = padded._replace(
-                            **Cmp.pad_tables(vocab.tables(), c_bucket)
-                        )
-                        # The live resolve wire packs at K = narrow M
-                        # (see _dispatch_drift_resolve) — warm exactly
-                        # that program.
-                        pk = (
-                            min(narrow_m, c_bucket)
-                            if self.fetch_format == "packed"
-                            else 0
-                        )
-                        for kb in sorted({64, 256, max(64, b_pad // 4)}):
-                            ridx = np.full(kb, b_pad, np.int32)
-                            r_out, r_cert = self._resolve_program(
-                                "compact", narrow_m
-                            )(
-                                device_in_warm, ridx,
-                                np.zeros(shape, np.int8),
-                                np.zeros(shape, np.int32),
-                                np.zeros(shape, np.int32),
-                                slice8, slice8, slice8, slice8,
-                                didx8, dflag8,
-                            )
-                            jax.block_until_ready(r_cert)
-                            if pk:
-                                jax.block_until_ready(
-                                    self._pack_program("gather", pk)(
-                                        r_out.selected, r_out.replicas,
-                                        r_out.counted, r_out.scores,
-                                        r_out.reasons,
-                                        np.arange(kb, dtype=np.int32),
-                                    )
-                                )
-                    for wn in sorted({64, max(64, b_pad // 4), b_pad}):
-                        jax.block_until_ready(
-                            self._wcheck_program()(
-                                np.zeros(shape, np.int8),
-                                np.zeros(wn, np.int32),
-                                np.asarray(padded.cpu_alloc),
-                                np.asarray(padded.cpu_avail),
-                                np.asarray(padded.cpu_alloc),
-                                np.asarray(padded.cpu_avail),
-                            )
-                        )
-                    outs[b_pad] = out
-                    log.info("prewarmed tick program %s", shape)
-                # Sub-batch write-back repair: full-chunk planes get
-                # slab rows scattered in — warm each (chunk, slab-rung)
-                # shape pair so steady-state churn ticks never stall on
-                # the scatter trace.  Planes are DONATED by the repair,
-                # so the chain starts from freshly built zeros (never
-                # from the slab outputs, which must stay alive as the
-                # non-donated inputs) and threads each call's results.
-                big = max(shapes)
-                pshape = (big, c_bucket)
-                planes = jax.jit(
-                    lambda: (
-                        jnp.zeros(pshape, jnp.int8),
-                        jnp.zeros(pshape, jnp.int32),
-                        jnp.zeros(pshape, jnp.int8),
-                        jnp.zeros(pshape, jnp.int32),
-                        jnp.zeros(pshape, jnp.int8),
-                        jnp.zeros(pshape, jnp.int32),
-                    )
-                )()
-                src128 = np.zeros(128, np.int32)
-                dst128 = np.full(128, big, np.int32)  # out of range: no-op
-                for b_pad in shapes:
-                    slab = outs[b_pad]
-                    planes = self._repair_program()(
-                        planes,
-                        (slab.selected, slab.replicas, slab.counted,
-                         slab.scores, slab.feasible, slab.reasons),
-                        src128, dst128,
-                    )
-                    jax.block_until_ready(planes[0])
             except Exception:
                 log.warning("engine prewarm failed", exc_info=True)
 

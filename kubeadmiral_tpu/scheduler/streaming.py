@@ -350,6 +350,36 @@ class StreamingScheduler:
             )
         return results
 
+    def drain(self, deadline_s: float = 5.0) -> Optional[list]:
+        """Graceful-shutdown drain: flush whatever is coalescing in the
+        slab so the final pre-exit snapshot describes the post-event
+        world, bounded by ``deadline_s`` (a flush that cannot finish in
+        budget is abandoned — the events are NOT lost, they are already
+        reflected in the canonical unit list and the successor's relist
+        re-derives them).  Returns the final results list, or None when
+        nothing was pending."""
+        with self._lock:
+            if not self._pending:
+                return None
+        done: list = []
+
+        def run():
+            try:
+                done.append(self._flush("manual"))
+            except Exception:
+                log.warning("shutdown drain flush failed", exc_info=True)
+
+        t = threading.Thread(target=run, name="stream-drain", daemon=True)
+        t.start()
+        t.join(max(0.0, deadline_s))
+        if t.is_alive():
+            log.warning(
+                "shutdown drain exceeded %.1fs; abandoning the in-flight "
+                "flush (successor relist re-derives the slab)", deadline_s,
+            )
+            return None
+        return done[0] if done else None
+
     # -- introspection ----------------------------------------------------
     @property
     def units(self) -> list[T.SchedulingUnit]:

@@ -265,6 +265,62 @@ class MemberBreaker:
                 )
         return out
 
+    # -- durable state (runtime/snapshot.py) -------------------------------
+    def export_state(self) -> dict:
+        """Restart-durable image of this breaker.  Open windows export
+        their REMAINING cool-down (clocks are process-local monotonic,
+        so absolute open timestamps would be meaningless to a
+        successor)."""
+        with self._lock:
+            remaining = 0.0
+            if self._state != CLOSED:
+                remaining = max(
+                    0.0,
+                    self.config.open_seconds - (self._clock() - self._opened_at),
+                )
+            return {
+                "state": self._state,
+                "remaining_s": remaining,
+                "consecutive": self._consecutive,
+                "failures_total": self._failures_total,
+                "opens_total": self._opens_total,
+                "ewma_latency_s": self._ewma_latency,
+            }
+
+    def restore_state(self, state: dict, downtime_s: float = 0.0) -> None:
+        """Resume a pre-crash breaker: an OPEN member stays OPEN with the
+        remaining cool-down (minus the measured downtime) instead of
+        getting a free probe storm on the first post-restart tick; a
+        HALF_OPEN member re-enters the open window's tail (its probe
+        outcome died with the old process).  The half-open probe then
+        fires when the ORIGINAL window would have elapsed, never from a
+        restarted full window."""
+        fired = None
+        with self._lock:
+            new = state.get("state", CLOSED)
+            if new == HALF_OPEN:
+                new = OPEN
+            remaining = max(
+                0.0, float(state.get("remaining_s", 0.0)) - max(0.0, downtime_s)
+            )
+            self._consecutive = int(state.get("consecutive", 0))
+            self._failures_total = int(state.get("failures_total", 0))
+            self._opens_total = int(state.get("opens_total", 0))
+            ewma = state.get("ewma_latency_s")
+            self._ewma_latency = float(ewma) if ewma is not None else None
+            self._probe_inflight = False
+            if new == OPEN:
+                # Re-anchor the open window so exactly `remaining`
+                # cool-down is left on this process's clock.
+                self._opened_at = (
+                    self._clock() - (self.config.open_seconds - remaining)
+                )
+                fired = self._transition_locked(OPEN)
+            else:
+                fired = self._transition_locked(CLOSED)
+        if fired:
+            self._fire(*fired)
+
 
 # Live registries, for the aggregated /debug/members report.
 _REGISTRIES: "weakref.WeakSet[BreakerRegistry]" = weakref.WeakSet()
@@ -340,6 +396,27 @@ class BreakerRegistry:
     def shed_total(self) -> int:
         with self._lock:
             return sum(self._shed.values())
+
+    # -- durable state (runtime/snapshot.py) -------------------------------
+    def export_state(self) -> dict:
+        """Restart-durable registry image: per-member breaker states
+        plus a wall-clock stamp so restore can subtract the downtime
+        from open windows."""
+        with self._lock:
+            breakers = list(self._breakers.values())
+        return {
+            "wall": time.time(),
+            "members": {b.name: b.export_state() for b in breakers},
+        }
+
+    def restore_state(self, payload: dict) -> None:
+        """Resume pre-crash breaker states: a member whose breaker was
+        OPEN stays skipped (ClusterNotReady) on the first post-restart
+        tick, and its half-open probe resumes after the REMAINING
+        cool-down — a controller restart is never a probe amnesty."""
+        downtime = max(0.0, time.time() - float(payload.get("wall", time.time())))
+        for name, state in (payload.get("members") or {}).items():
+            self.for_member(name).restore_state(state, downtime_s=downtime)
 
     def open_members(self) -> list[str]:
         with self._lock:

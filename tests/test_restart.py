@@ -1,0 +1,549 @@
+"""Crash-safe control plane: durable snapshots, kill matrix, AOT failover.
+
+Two layers:
+
+* **In-process matrix** (tier-1): snapshot round trips through the real
+  file store — fresh resume rides the O(B) no-op replay with ZERO
+  device dispatches, stale resume rides the drift gate, churned resume
+  re-solves only changed rows, and torn / corrupt / version-mismatched
+  snapshots are quarantined and never loaded.  Plus breaker-state
+  restore, sink finalization, streaming drain and leadership release.
+
+* **Subprocess kill matrix** (``make restart-smoke``; the full sweep is
+  marked slow): a victim process SIGKILLs itself mid-{featurize,
+  dispatch, fetch, snapshot-write, snapshot-rename, dispatch-flush}
+  (tools/restart_driver.py), and a successor process must converge to
+  placements AND flight-recorder reason counts bit-identical to an
+  uninterrupted reference run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.snapshot import SnapshotManager, SnapshotStore
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tools", "restart_driver.py")
+
+
+def small_world(n=220, c=10, seed=11):
+    rng = np.random.default_rng(seed)
+    clusters = [
+        T.ClusterState(
+            name=f"m-{j:03d}",
+            labels={"region": ("us", "eu")[j % 2]},
+            taints=(),
+            allocatable=T.parse_resources({"cpu": "64", "memory": "256Gi"}),
+            available=T.parse_resources(
+                {"cpu": f"{int(rng.integers(8, 60))}", "memory": "128Gi"}
+            ),
+            api_resources=frozenset({"apps/v1/Deployment"}),
+        )
+        for j in range(c)
+    ]
+    units = [
+        T.SchedulingUnit(
+            gvk="apps/v1/Deployment",
+            namespace="ns",
+            name=f"w-{i:04d}",
+            scheduling_mode=T.MODE_DIVIDE if i % 4 else "Duplicate",
+            desired_replicas=int(rng.integers(1, 40)) if i % 4 else None,
+            resource_request=T.parse_resources({"cpu": "250m"}),
+            max_clusters=int(rng.integers(1, 6)) if i % 5 == 0 else None,
+        )
+        for i in range(n)
+    ]
+    return units, clusters
+
+
+def clusters_eq(a, b):
+    return all(x.clusters == y.clusters for x, y in zip(a, b)) and len(a) == len(b)
+
+
+class TestSnapshotStore:
+    def test_atomic_roundtrip(self, tmp_path):
+        store = SnapshotStore(str(tmp_path))
+        payload = {"x": np.arange(10), "y": [("a", 1)]}
+        store.save(7, payload)
+        header, loaded = store.load_latest()
+        assert header["tick"] == 7
+        assert np.array_equal(loaded["x"], payload["x"])
+        assert loaded["y"] == payload["y"]
+
+    def test_keep_prunes_old_generations(self, tmp_path):
+        store = SnapshotStore(str(tmp_path), keep=2)
+        for t in (1, 2, 3, 4):
+            store.save(t, {"t": t})
+        snaps = sorted(f for f in os.listdir(tmp_path) if f.endswith(".ktsnap"))
+        assert len(snaps) == 2
+        assert store.load_latest()[0]["tick"] == 4
+
+    @pytest.mark.parametrize(
+        "corrupt",
+        ["truncate", "flip-payload", "bad-magic", "bad-version"],
+    )
+    def test_corrupt_snapshot_quarantined_never_loaded(self, tmp_path, corrupt):
+        metrics = Metrics()
+        store = SnapshotStore(str(tmp_path), metrics=metrics)
+        store.save(1, {"gen": "old"})
+        path = store.save(2, {"gen": "new"})
+        raw = bytearray(open(path, "rb").read())
+        if corrupt == "truncate":
+            raw = raw[: len(raw) - 7]
+        elif corrupt == "flip-payload":
+            raw[-1] ^= 0xFF
+        elif corrupt == "bad-magic":
+            raw[:8] = b"NOTSNAP0"
+        elif corrupt == "bad-version":
+            # Re-write with a future version: never reinterpreted.
+            import struct as _struct
+            import zlib as _zlib
+
+            blob = pickle.dumps({"gen": "future"}, protocol=4)
+            header = pickle.dumps(
+                {"version": 999, "tick": 2, "crc": _zlib.crc32(blob),
+                 "payload_len": len(blob), "wall": 0.0},
+                protocol=4,
+            )
+            raw = bytearray(
+                b"KTSNAP01" + _struct.pack("<Q", len(header)) + header + blob
+            )
+        open(path, "wb").write(bytes(raw))
+        header, payload = store.load_latest()
+        # The torn newest generation is quarantined; the older valid one
+        # is served instead of anything torn being trusted.
+        assert payload["gen"] == "old"
+        assert any(f.endswith(".quarantined") for f in os.listdir(tmp_path))
+        counters = metrics.snapshot()["counters"]
+        assert counters.get('engine_snapshot_total{result=quarantined}', 0) >= 1
+
+    def test_all_generations_corrupt_falls_back_cold(self, tmp_path):
+        store = SnapshotStore(str(tmp_path))
+        path = store.save(1, {"gen": "only"})
+        raw = bytearray(open(path, "rb").read())
+        raw[-1] ^= 0xFF
+        open(path, "wb").write(bytes(raw))
+        assert store.load_latest() is None
+
+
+class TestEngineRestore:
+    def _converged(self, units, clusters):
+        engine = SchedulerEngine(mesh=None)
+        engine.schedule(units, clusters)
+        snap = pickle.loads(pickle.dumps(engine.snapshot_state()))
+        return engine, snap
+
+    def test_fresh_resume_rides_noop_replay_zero_dispatches(self):
+        units, clusters = small_world()
+        e1, snap = self._converged(units, clusters)
+        r1 = e1.schedule(units, clusters)
+
+        units2, clusters2 = small_world()  # a relist: new objects, same world
+        e2 = SchedulerEngine(mesh=None)
+        e2.stage_restore(snap, assume_fresh=True)
+        d0 = e2.dispatches_total
+        r2 = e2.schedule(units2, clusters2)
+        assert e2.restore_info["result"] == "loaded"
+        assert e2.restore_info["fresh"] is True
+        assert e2.dispatches_total == d0, "fresh resume must not dispatch"
+        assert e2.fetch_stats["noop"] >= 1
+        assert clusters_eq(r1, r2)
+
+    def test_stale_resume_revalidates_through_drift_paths(self):
+        units, clusters = small_world()
+        _e1, snap = self._converged(units, clusters)
+
+        units2, clusters2 = small_world()
+        clusters2[0] = dataclasses.replace(
+            clusters2[0],
+            available={k: max(0, v // 2) for k, v in clusters2[0].available.items()},
+        )
+        e2 = SchedulerEngine(mesh=None)
+        e2.stage_restore(snap)
+        r2 = e2.schedule(units2, clusters2)
+        assert e2.restore_info["result"] == "loaded"
+        assert e2.restore_info["fresh"] is False
+        assert e2.drift_stats["gated"] >= 1, "stale resume must ride the gate"
+
+        ref = SchedulerEngine(mesh=None).schedule(units2, clusters2)
+        assert clusters_eq(ref, r2)
+
+    def test_churned_resume_resolves_only_changed_rows(self):
+        units, clusters = small_world()
+        _e1, snap = self._converged(units, clusters)
+
+        units2, clusters2 = small_world()
+        changed = (3, 17, 100)
+        for i in changed:
+            units2[i] = dataclasses.replace(
+                units2[i], desired_replicas=(units2[i].desired_replicas or 1) + 9
+            )
+        e2 = SchedulerEngine(mesh=None)
+        e2.stage_restore(snap)
+        r2 = e2.schedule(units2, clusters2)
+        assert e2.restore_info["result"] == "loaded"
+        assert e2.fetch_stats["subbatch"] >= 1
+        assert set(e2.last_changed) == set(changed)
+        ref = SchedulerEngine(mesh=None).schedule(units2, clusters2)
+        assert clusters_eq(ref, r2)
+
+    def test_topology_change_rejects_to_cold(self):
+        units, clusters = small_world()
+        _e1, snap = self._converged(units, clusters)
+        units2, clusters2 = small_world()
+        clusters2[0] = dataclasses.replace(
+            clusters2[0], labels={"region": "mars"}
+        )
+        e2 = SchedulerEngine(mesh=None)
+        e2.stage_restore(snap)
+        r2 = e2.schedule(units2, clusters2)
+        assert e2.restore_info["result"] == "rejected"
+        ref = SchedulerEngine(mesh=None).schedule(units2, clusters2)
+        assert clusters_eq(ref, r2)
+
+    def test_config_mismatch_rejects(self):
+        units, clusters = small_world()
+        _e1, snap = self._converged(units, clusters)
+        snap["config"] = dict(snap["config"], narrow_m=999)
+        e2 = SchedulerEngine(mesh=None)
+        e2.stage_restore(snap)
+        e2.schedule(*small_world())
+        assert e2.restore_info["result"] == "rejected"
+
+    def test_want_scores_not_served_by_scoreless_snapshot(self):
+        units, clusters = small_world(n=80)
+        _e1, snap = self._converged(units, clusters)
+        e2 = SchedulerEngine(mesh=None)
+        e2.stage_restore(snap)
+        r2 = e2.schedule(*small_world(n=80), want_scores=True)
+        ref = SchedulerEngine(mesh=None).schedule(
+            *small_world(n=80), want_scores=True
+        )
+        assert clusters_eq(ref, r2)
+        assert all(a.scores == b.scores for a, b in zip(ref, r2))
+
+    def test_snapshot_manager_end_to_end_via_store(self, tmp_path):
+        units, clusters = small_world()
+        metrics = Metrics()
+        e1 = SchedulerEngine(mesh=None, metrics=metrics)
+        store = SnapshotStore(str(tmp_path), metrics=metrics)
+        SnapshotManager(e1, store, every=1, flightrec=None)
+        r1 = e1.schedule(units, clusters)
+        assert store.load_latest() is not None
+
+        e2 = SchedulerEngine(mesh=None)
+        mgr2 = SnapshotManager(e2, store, flightrec=None)
+        assert mgr2.restore() == "staged"
+        r2 = e2.schedule(*small_world())
+        assert e2.restore_info["result"] == "loaded"
+        assert clusters_eq(r1, r2)
+
+
+class TestBreakerRestore:
+    def test_open_breaker_stays_open_with_remaining_cooldown(self):
+        from kubeadmiral_tpu.transport.breaker import (
+            OPEN, BreakerConfig, BreakerRegistry,
+        )
+
+        clock = [100.0]
+        cfg = BreakerConfig(open_seconds=30.0)
+        reg = BreakerRegistry(config=cfg, clock=lambda: clock[0])
+        reg.for_member("m-1").record_failure(timeout=True)
+        assert reg.for_member("m-1").state == OPEN
+        clock[0] += 10.0  # 20s of cool-down left at export
+        state = reg.export_state()
+        assert abs(state["members"]["m-1"]["remaining_s"] - 20.0) < 1e-6
+
+        # Successor: 5s of downtime between snapshot and restore.
+        clock2 = [500.0]
+        reg2 = BreakerRegistry(config=cfg, clock=lambda: clock2[0])
+        state["wall"] -= 5.0  # pretend the export happened 5s ago
+        reg2.restore_state(state)
+        b = reg2.for_member("m-1")
+        assert b.state == OPEN
+        # First post-restart tick: still skipped, no free probe storm.
+        assert not b.allow(consume_probe=False)
+        # The probe resumes after the REMAINING cool-down (~15s), not a
+        # fresh 30s window...
+        clock2[0] += 16.0
+        assert b.allow()  # half-open probe admitted
+        # ...and not from zero either: at +1s it must still be closed off.
+        clock3 = [0.0]
+        reg3 = BreakerRegistry(config=cfg, clock=lambda: clock3[0])
+        reg3.restore_state({"wall": __import__("time").time(), "members": {
+            "m-1": {"state": "open", "remaining_s": 20.0, "consecutive": 1,
+                    "failures_total": 1, "opens_total": 1,
+                    "ewma_latency_s": None},
+        }})
+        clock3[0] += 1.0
+        assert not reg3.for_member("m-1").allow(consume_probe=False)
+
+    def test_half_open_restores_into_open_tail(self):
+        from kubeadmiral_tpu.transport.breaker import (
+            HALF_OPEN, OPEN, BreakerConfig, BreakerRegistry,
+        )
+
+        clock = [0.0]
+        cfg = BreakerConfig(open_seconds=10.0)
+        reg = BreakerRegistry(config=cfg, clock=lambda: clock[0])
+        reg.for_member("m-1").record_failure(timeout=True)
+        clock[0] += 11.0
+        assert reg.for_member("m-1").allow()  # consume the probe
+        assert reg.for_member("m-1").state == HALF_OPEN
+        state = reg.export_state()
+
+        reg2 = BreakerRegistry(config=cfg, clock=lambda: clock[0])
+        reg2.restore_state(state)
+        # The in-flight probe died with the old process: re-enter OPEN's
+        # tail (remaining 0 -> immediately probe-able, but never CLOSED
+        # for free).
+        assert reg2.for_member("m-1").state == OPEN
+
+
+class TestShutdownDrain:
+    def test_batch_sink_finalize_sheds_and_raises(self):
+        from kubeadmiral_tpu.federation.dispatch import BatchSink
+        from kubeadmiral_tpu.runtime.metrics import Metrics as M
+        from kubeadmiral_tpu.testing.fakekube import FakeKube
+        from kubeadmiral_tpu.transport.breaker import BreakerRegistry
+
+        metrics = M()
+        breakers = BreakerRegistry(metrics=metrics)
+        member = FakeKube("m")
+        sink = BatchSink(lambda _c: member, breakers=breakers)
+        sink.submit("c1", {"verb": "create", "resource": "v1/x",
+                           "object": {"metadata": {"name": "a"}}}, lambda r: None)
+        sink.submit("c1", {"verb": "create", "resource": "v1/x",
+                           "object": {"metadata": {"name": "b"}}}, lambda r: None)
+        shed = sink.finalize(deadline_s=1.0)
+        assert shed == 2
+        counters = metrics.snapshot()["counters"]
+        assert counters.get('member_shed_writes_total{cluster=c1}') == 2
+        with pytest.raises(RuntimeError):
+            sink.submit("c1", {"verb": "create"}, lambda r: None)
+        assert not [
+            t for t in threading.enumerate()
+            if t.name.startswith("dispatch-flush-")
+        ]
+
+    def test_finalize_all_sinks_covers_live_sinks(self):
+        from kubeadmiral_tpu.federation import dispatch as D
+        from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+        sink = D.BatchSink(lambda _c: FakeKube("m"))
+        sink.submit("c9", {"verb": "delete", "resource": "v1/x", "key": "a"},
+                    lambda r: None)
+        assert D.finalize_all_sinks(1.0) >= 1
+        assert sink._staged == {}
+
+    def test_immediate_sink_finalize_cancels_unstarted(self):
+        import time as _time
+        from concurrent.futures import ThreadPoolExecutor
+
+        from kubeadmiral_tpu.federation.dispatch import ImmediateSink
+
+        class SlowKube:
+            def batch(self, ops):
+                _time.sleep(0.5)
+                return [{"code": 200, "object": op.get("object", {})} for op in ops]
+
+        pool = ThreadPoolExecutor(max_workers=1)
+        sink = ImmediateSink(lambda _c: SlowKube(), pool=pool)
+        done = []
+        for i in range(4):
+            sink.submit("c1", {"verb": "create", "object": {}},
+                        lambda r: done.append(r))
+        shed = sink.finalize(deadline_s=0.7)
+        assert shed >= 1  # queued-behind writes cancelled
+        with pytest.raises(RuntimeError):
+            sink.submit("c1", {}, lambda r: None)
+        pool.shutdown(wait=False)
+
+    def test_streaming_drain_flushes_pending(self):
+        from kubeadmiral_tpu.scheduler.streaming import StreamingScheduler
+
+        units, clusters = small_world(n=64)
+        engine = SchedulerEngine(mesh=None)
+        stream = StreamingScheduler(engine, clusters, units, slab_age_ms=1e9)
+        stream.flush()
+        stream.offer(
+            dataclasses.replace(units[0], desired_replicas=99)
+        )
+        assert stream.pending() == 1
+        results = stream.drain(deadline_s=30.0)
+        assert results is not None
+        assert stream.pending() == 0
+        assert stream.drain(deadline_s=1.0) is None  # nothing pending
+
+    def test_leader_release_hands_off_immediately(self):
+        from kubeadmiral_tpu.runtime.leaderelection import LeaderElector
+        from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+        host = FakeKube("host")
+        a = LeaderElector(host, identity="a")
+        b = LeaderElector(host, identity="b")
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        assert a.release()
+        assert b.try_acquire_or_renew(), "standby must win without lease expiry"
+
+    def test_manager_shutdown_writes_final_snapshot(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KT_SNAPSHOT_DIR", str(tmp_path))
+        from kubeadmiral_tpu.runtime.manager import ControllerManager
+        from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+
+        fleet = ClusterFleet()
+        fleet.add_member("c1")
+        manager = ControllerManager(fleet)
+        assert manager.snapshots is not None
+        # Any converged tick persists via the post-tick hook...
+        manager.engine.schedule(*small_world(n=32, c=4))
+        # ...and shutdown() drains + writes a final generation.
+        summary = manager.shutdown(deadline_s=5.0)
+        assert summary["elapsed_s"] < 30
+        store = manager.snapshots.store
+        assert store.load_latest() is not None
+
+        # A successor manager over the same dir stages the restore.
+        m2 = ControllerManager(ClusterFleet())
+        assert m2.snapshots.restore() == "staged"
+        m2.engine.schedule(*small_world(n=32, c=4))
+        assert m2.engine.restore_info["result"] == "loaded"
+
+
+# -- subprocess kill matrix ------------------------------------------------
+def _driver_env(workdir, phase="", prewarm=False, aot=False):
+    env = os.environ.copy()
+    for k in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+        env.pop(k, None)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        KT_RESTART_DIR=str(workdir),
+        KT_RESTART_KILL_PHASE=phase,
+        KT_RESTART_OBJECTS="160",
+        KT_RESTART_CLUSTERS="10",
+        KT_RESTART_PREWARM="1" if prewarm else "0",
+        KT_AOT="1" if aot else "0",
+        KT_BREAKER_OPEN_S="300",
+        KT_COMPILE_CACHE_DIR=os.path.join(str(workdir), "xla"),
+        KT_FLIGHTREC="1",
+    )
+    env.pop("KT_SNAPSHOT_KILL", None)
+    return env
+
+
+def _run_driver(mode, workdir, phase="", expect_kill=False, artifact=None,
+                prewarm=False, aot=False):
+    env = _driver_env(workdir, phase=phase, prewarm=prewarm, aot=aot)
+    if artifact:
+        env["KT_RESTART_ARTIFACT"] = artifact
+    proc = subprocess.run(
+        [sys.executable, DRIVER, mode],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    if expect_kill:
+        assert proc.returncode == -9, (
+            f"victim exited {proc.returncode} (kill never fired)\n"
+            f"{proc.stdout}\n{proc.stderr}"
+        )
+    else:
+        assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    return proc
+
+
+@pytest.fixture(scope="module")
+def reference_artifact(tmp_path_factory):
+    workdir = tmp_path_factory.mktemp("restart-ref")
+    _run_driver("reference", workdir)
+    return json.load(open(os.path.join(workdir, "reference.json")))
+
+
+def _kill_matrix_round(tmp_path_factory, phase, reference):
+    workdir = tmp_path_factory.mktemp(f"restart-{phase}")
+    _run_driver("victim", workdir, phase=phase, expect_kill=True)
+    assert os.path.exists(os.path.join(workdir, "tick2.done"))
+    if phase not in ("dispatch-flush",):
+        assert not os.path.exists(os.path.join(workdir, "tick3.done"))
+    _run_driver("successor", workdir)
+    succ = json.load(open(os.path.join(workdir, "successor.json")))
+    assert succ["restore"] == "staged"
+    assert succ["restore_info"]["result"] == "loaded"
+    # Bit-identical convergence: placements AND flight-recorder reason
+    # counts match the uninterrupted run exactly.
+    assert succ["placements"] == reference["placements"]
+    assert succ["reason_counts"] == reference["reason_counts"]
+    # The pre-crash OPEN breaker survived the restart: the member stays
+    # short-circuited, no free probe storm.
+    assert succ["breaker_m001"] == "open"
+    assert succ["breaker_allows_m001"] is False
+    # Torn writes leave temp files the loader ignores; nothing valid
+    # was quarantined along the way.
+    assert succ["quarantined"] == []
+    return succ
+
+
+class TestKillMatrix:
+    def test_sigkill_mid_snapshot_write(self, tmp_path_factory, reference_artifact):
+        """The tier-1 representative: die with the snapshot payload
+        half-written; the successor loads the previous generation and
+        still converges bit-identically."""
+        succ = _kill_matrix_round(
+            tmp_path_factory, "snapshot-write", reference_artifact
+        )
+        # tick 3 never persisted: the successor resumed from tick 2 and
+        # re-decided the tick-3 churn rows through the sub-batch path.
+        assert succ["fetch_paths"]["subbatch"] >= 1 or (
+            succ["fetch_paths"]["noop"] >= 1
+        )
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "phase",
+        ["featurize", "dispatch", "fetch", "snapshot-rename", "dispatch-flush"],
+    )
+    def test_sigkill_phase(self, tmp_path_factory, reference_artifact, phase):
+        _kill_matrix_round(tmp_path_factory, phase, reference_artifact)
+
+
+class TestWarmBootAot:
+    @pytest.mark.slow
+    def test_second_warm_boot_covers_ladder_from_caches(self, tmp_path_factory):
+        """The persistent-cache assertion (satellite): on the SECOND
+        warm boot the AOT manifest serves every ladder program
+        (loaded, zero live traces) and every XLA compile is a
+        persistent-cache hit — zero misses — so silent cache-key drift
+        fails this test instead of only dimming a telemetry counter."""
+        workdir = tmp_path_factory.mktemp("restart-aot")
+        _run_driver("victim", workdir, phase="snapshot-write",
+                    expect_kill=True, prewarm=True, aot=True)
+        _run_driver("successor", workdir, artifact="succ1.json",
+                    prewarm=True, aot=True)
+        s1 = json.load(open(os.path.join(workdir, "succ1.json")))
+        assert s1["aot"]["loaded"] > 0, s1["aot"]
+        _run_driver("successor", workdir, artifact="succ2.json",
+                    prewarm=True, aot=True)
+        s2 = json.load(open(os.path.join(workdir, "succ2.json")))
+        assert s2["aot"]["loaded"] > 0
+        assert s2["aot"]["rejected"] == 0
+        counters = s2["counters"]
+        hits = counters.get('engine_persistent_cache_total{result=hit}', 0)
+        misses = counters.get('engine_persistent_cache_total{result=miss}', 0)
+        assert hits >= s2["aot"]["loaded"], counters
+        assert misses == 0, (
+            f"second warm boot recompiled {misses} program(s): "
+            f"persistent-cache key drift ({counters})"
+        )

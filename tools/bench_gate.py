@@ -335,6 +335,98 @@ def gate_churn(root: Path, tolerance: float) -> int:
     return 0 if ok else 1
 
 
+_RESTART_RE = re.compile(r"^BENCH_RESTART_r(\d+)\.json$")
+
+
+def gate_restart(root: Path, tolerance: float) -> int:
+    """Gate the restart-to-first-tick scenario artifacts
+    (BENCH_RESTART_r*.json, written by ``make bench-restart``): the
+    warm ``restart_to_first_tick_ms`` value is gated like a latency
+    (ceiling vs the best prior same-metric+platform round, plus a
+    250 ms absolute slack for timer jitter); snapshot size / write-ms
+    and the AOT program counts are carried informationally.  A warm
+    boot that silently stopped loading AOT programs or parity-failed
+    fails OUTRIGHT, prior round or not."""
+    rounds = []
+    for path in sorted(root.glob("BENCH_RESTART_r*.json")):
+        m = _RESTART_RE.match(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench-gate: {path.name}: unreadable ({e})", file=sys.stderr)
+            return 2
+        parsed = doc.get("parsed") or {}
+        if doc.get("rc", 0) != 0 or parsed.get("value") is None:
+            continue
+        detail = parsed.get("detail") or {}
+        rounds.append(
+            {
+                "round": int(m.group(1)),
+                "path": path.name,
+                "metric": parsed.get("metric", ""),
+                "platform": detail.get("platform") or "unknown",
+                "value": float(parsed["value"]),
+                "cold_boot_ms": detail.get("cold_boot_ms"),
+                "ratio": detail.get("warm_vs_cold_pct"),
+                "snapshot_bytes": detail.get("snapshot_bytes"),
+                "snapshot_write_ms": detail.get("snapshot_write_ms"),
+                "aot": detail.get("aot"),
+                "parity": detail.get("parity"),
+            }
+        )
+    if not rounds:
+        return 0
+    rounds.sort(key=lambda r: r["round"])
+    latest = rounds[-1]
+    ok = True
+    print(
+        f"bench-gate: restart {latest['path']} "
+        f"restart_to_first_tick_ms={latest['value']:.1f} "
+        f"(cold {latest['cold_boot_ms']}, {latest['ratio']}% of cold); "
+        f"snapshot {latest['snapshot_bytes']}B / "
+        f"{latest['snapshot_write_ms']}ms write, aot={latest['aot']} — "
+        f"snapshot/aot informational"
+    )
+    if latest.get("parity") is False:
+        print("bench-gate: RESTART PARITY FAILURE", file=sys.stderr)
+        ok = False
+    aot = latest.get("aot") or {}
+    if aot.get("loaded", 0) == 0 or aot.get("traced", 0) > 0:
+        print(
+            f"bench-gate: RESTART AOT REGRESSION: warm boot traced "
+            f"{aot.get('traced')} program(s), loaded {aot.get('loaded')} — "
+            f"the trace ladder is back on the failover path",
+            file=sys.stderr,
+        )
+        ok = False
+    priors = [
+        r for r in rounds[:-1]
+        if r["metric"] == latest["metric"] and r["platform"] == latest["platform"]
+    ]
+    if not priors:
+        print(
+            f"bench-gate: WARNING: {latest['path']} ({latest['metric']}) has "
+            f"no prior same-platform baseline — value not gated this round"
+        )
+        return 0 if ok else 1
+    best = min(r["value"] for r in priors)
+    ceil = best * (1.0 + tolerance) + 250.0
+    print(
+        f"bench-gate: restart_to_first_tick_ms={latest['value']:.1f} vs "
+        f"best prior {best:.1f} (ceiling {ceil:.1f})"
+    )
+    if latest["value"] > ceil:
+        print(
+            f"bench-gate: RESTART LATENCY REGRESSION: "
+            f"{latest['value']:.1f}ms > {ceil:.1f}ms",
+            file=sys.stderr,
+        )
+        ok = False
+    return 0 if ok else 1
+
+
 def report_e2e_chaos(root: Path) -> None:
     """Informational: surface the newest e2e artifact's degraded-fleet
     (chaos) numbers — tick-stall p99 and shed-write counts — next to
@@ -381,8 +473,9 @@ def main() -> int:
     args = parser.parse_args()
     rc = gate(load_rounds(args.root), args.tolerance)
     churn_rc = gate_churn(args.root, args.tolerance)
+    restart_rc = gate_restart(args.root, args.tolerance)
     report_e2e_chaos(args.root)
-    return rc or churn_rc
+    return rc or churn_rc or restart_rc
 
 
 if __name__ == "__main__":

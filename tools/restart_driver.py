@@ -1,0 +1,305 @@
+#!/usr/bin/env python
+"""Kill-matrix driver for the crash-recovery harness (tests/test_restart.py).
+
+One deterministic world, three processes:
+
+* ``victim``  — runs tick 1 (cold) and tick 2 (churn) with per-tick
+  durable snapshots, then starts tick 3 and SIGKILLs ITSELF at the
+  requested phase (``KT_RESTART_KILL_PHASE``): ``featurize``,
+  ``dispatch`` (mid device program), ``fetch`` (mid device->host read),
+  ``snapshot-write`` (mid payload write, torn temp file),
+  ``snapshot-rename`` (payload complete, rename not performed),
+  ``dispatch-flush`` (tick + snapshot complete, killed mid member
+  flush).  Self-SIGKILL at the phase makes the cut deterministic — no
+  parent timing race.
+* ``successor`` — fresh process over the same directories: restores the
+  newest valid snapshot, rebuilds the FINAL (tick 3) world from the
+  shared seed, runs one tick to convergence, and writes an artifact
+  with its placements, flight-recorder reason counts, restore outcome,
+  AOT stats and persistent-cache counters.
+* ``reference`` — fresh process, no snapshots, runs ticks 1..3
+  uninterrupted and writes the same artifact shape.
+
+The harness asserts successor.placements == reference.placements and
+successor.reason_counts == reference.reason_counts, bit-identical —
+whatever phase the victim died in.
+
+Env: ``KT_RESTART_DIR`` (workdir; snapshots under <dir>/snapshots,
+artifacts as JSON), ``KT_RESTART_OBJECTS``/``KT_RESTART_CLUSTERS``
+(world shape), ``KT_RESTART_PREWARM=1`` (run the prewarm ladder —
+exports/loads AOT programs when KT_AOT is on).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+
+SEED = 20260804
+
+
+def build_world(n: int, c: int):
+    from kubeadmiral_tpu.models import types as T
+
+    rng = np.random.default_rng(SEED)
+    clusters = [
+        T.ClusterState(
+            name=f"m-{j:03d}",
+            labels={"region": ("us", "eu", "ap")[j % 3], "tier": str(j % 2)},
+            taints=(T.Taint("dedicated", "batch", "NoSchedule"),)
+            if j % 7 == 0
+            else (),
+            allocatable=T.parse_resources({"cpu": "64", "memory": "256Gi"}),
+            available=T.parse_resources(
+                {"cpu": f"{int(rng.integers(8, 60))}", "memory": "128Gi"}
+            ),
+            api_resources=frozenset({"apps/v1/Deployment"}),
+        )
+        for j in range(c)
+    ]
+    units = [
+        T.SchedulingUnit(
+            gvk="apps/v1/Deployment",
+            namespace=f"ns-{i % 7}",
+            name=f"w-{i:05d}",
+            scheduling_mode=T.MODE_DIVIDE if i % 4 else "Duplicate",
+            desired_replicas=int(rng.integers(1, 60)) if i % 4 else None,
+            resource_request=T.parse_resources(
+                {"cpu": f"{int(rng.integers(0, 6)) * 250}m"}
+            ),
+            tolerations=(T.Toleration(key="dedicated", operator="Exists"),)
+            if i % 3 == 0
+            else (),
+            max_clusters=int(rng.integers(1, 5)) if i % 5 == 0 else None,
+        )
+        for i in range(n)
+    ]
+    return units, clusters
+
+
+def churn(units, round_no: int):
+    """Deterministic ~4% churn per round (same function in every
+    process, so victim / successor / reference worlds line up)."""
+    rng = np.random.default_rng(SEED + round_no)
+    out = list(units)
+    for i in rng.integers(0, len(units), max(1, len(units) // 25)):
+        su = units[int(i)]
+        out[int(i)] = dataclasses.replace(
+            su,
+            desired_replicas=(su.desired_replicas or 1) + int(rng.integers(1, 9)),
+        )
+    return out
+
+
+def world_at(tick: int, n: int, c: int):
+    units, clusters = build_world(n, c)
+    for r in range(1, tick):
+        units = churn(units, r)
+    return units, clusters
+
+
+def make_stack(workdir: str):
+    from kubeadmiral_tpu.runtime.flightrec import get_default
+    from kubeadmiral_tpu.runtime.metrics import Metrics
+    from kubeadmiral_tpu.runtime.snapshot import SnapshotManager, SnapshotStore
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+    from kubeadmiral_tpu.transport.breaker import BreakerRegistry
+
+    metrics = Metrics()
+    engine = SchedulerEngine(mesh=None, metrics=metrics)
+    breakers = BreakerRegistry(metrics=metrics)
+    store = SnapshotStore(os.path.join(workdir, "snapshots"), metrics=metrics)
+    mgr = SnapshotManager(
+        engine, store, every=1, breakers=breakers, flightrec=get_default()
+    )
+    return engine, metrics, breakers, store, mgr
+
+
+def artifact(engine, metrics, results, units, extra: dict) -> dict:
+    from kubeadmiral_tpu.runtime.flightrec import get_default
+
+    placements = {
+        u.key: {
+            cl: (None if reps is None else int(reps))
+            for cl, reps in sorted(r.clusters.items())
+        }
+        for u, r in zip(units, results)
+    }
+    rec = get_default()
+    reason_counts = {}
+    for u in units:
+        record = rec.lookup(u.key)
+        if record is not None:
+            reason_counts[u.key] = [int(x) for x in record.reason_counts]
+    snap = metrics.snapshot()
+    counters = {
+        k: v
+        for k, v in snap["counters"].items()
+        if k.startswith(("engine_persistent_cache_total", "engine_aot_programs_total",
+                         "engine_snapshot_total"))
+    }
+    return {
+        "placements": placements,
+        "reason_counts": reason_counts,
+        "counters": counters,
+        "aot": dict(engine._aot.stats),
+        **extra,
+    }
+
+
+def install_kill(engine, phase: str) -> None:
+    def die(*_a, **_k):
+        os.kill(os.getpid(), 9)
+
+    if phase == "featurize":
+        engine._featurize_chunk = die
+    elif phase == "dispatch":
+        # Kill with the program call in flight: the tick was dispatched
+        # but its results never observed.
+        tick_c, tick_d = engine._tick_compact, engine._tick
+
+        def kill_after_dispatch_c(*a):
+            tick_c(*a)
+            os.kill(os.getpid(), 9)
+
+        def kill_after_dispatch_d(*a):
+            tick_d(*a)
+            os.kill(os.getpid(), 9)
+
+        engine._tick_compact = kill_after_dispatch_c
+        engine._tick = kill_after_dispatch_d
+    elif phase == "fetch":
+        engine._read_np = die
+    elif phase == "snapshot-write":
+        os.environ["KT_SNAPSHOT_KILL"] = "mid-write"
+    elif phase == "snapshot-rename":
+        os.environ["KT_SNAPSHOT_KILL"] = "pre-rename"
+    elif phase == "dispatch-flush":
+        pass  # installed at the sink below
+    else:
+        raise SystemExit(f"unknown kill phase {phase!r}")
+
+
+def flush_placements(results, units, kill: bool) -> None:
+    """A member-flush stand-in: stage one write per scheduled object
+    into a BatchSink over an in-process member and flush; with ``kill``
+    the member client SIGKILLs the process mid-batch — the
+    ``dispatch-flush`` phase of the matrix."""
+    from kubeadmiral_tpu.federation.dispatch import BatchSink
+    from kubeadmiral_tpu.testing.fakekube import FakeKube
+
+    member = FakeKube("member-durable")
+
+    class KillingKube:
+        def __init__(self, inner, after: int):
+            self._inner = inner
+            self._after = after
+            self._seen = 0
+
+        def batch(self, ops):
+            self._seen += len(ops)
+            if kill and self._seen >= self._after:
+                os.kill(os.getpid(), 9)
+            return self._inner.batch(ops)
+
+    client = KillingKube(member, after=max(1, len(units) // 2))
+    sink = BatchSink(lambda _c: client)
+    for u, r in zip(units, results):
+        if not r.clusters:
+            continue
+        sink.submit(
+            "m-000",
+            {
+                "verb": "create",
+                "resource": "v1/configmaps",
+                "object": {
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"namespace": u.namespace, "name": u.name},
+                    "data": {k: str(v) for k, v in sorted(r.clusters.items())},
+                },
+            },
+            lambda _res: None,
+        )
+    sink.flush()
+
+
+def main() -> int:
+    mode = sys.argv[1]
+    workdir = os.environ["KT_RESTART_DIR"]
+    n = int(os.environ.get("KT_RESTART_OBJECTS", "192"))
+    c = int(os.environ.get("KT_RESTART_CLUSTERS", "10"))
+    prewarm = os.environ.get("KT_RESTART_PREWARM") == "1"
+    os.makedirs(workdir, exist_ok=True)
+
+    engine, metrics, breakers, store, mgr = make_stack(workdir)
+    if prewarm:
+        engine.prewarm(n, c, wait=True)
+
+    if mode == "victim":
+        phase = os.environ.get("KT_RESTART_KILL_PHASE", "")
+        units, clusters = world_at(1, n, c)
+        engine.schedule(units, clusters)
+        open(os.path.join(workdir, "tick1.done"), "w").write("1")
+        units = churn(units, 1)
+        engine.schedule(units, clusters)
+        open(os.path.join(workdir, "tick2.done"), "w").write("1")
+        # A breaker opened pre-crash: the successor must keep skipping
+        # this member instead of probing it fresh.
+        breakers.for_member("m-001").record_failure(timeout=True)
+        mgr.snapshot()  # re-persist with the open breaker riding along
+        if phase:
+            install_kill(engine, phase)
+        units = churn(units, 2)
+        results = engine.schedule(units, clusters)
+        open(os.path.join(workdir, "tick3.done"), "w").write("1")
+        flush_placements(results, units, kill=(phase == "dispatch-flush"))
+        # Reaching here means the kill never fired — the harness treats
+        # a 0 exit from a victim as a matrix failure.
+        return 0
+
+    if mode == "successor":
+        restore_result = mgr.restore()
+        units, clusters = world_at(3, n, c)
+        results = engine.schedule(units, clusters)
+        doc = artifact(
+            engine, metrics, results, units,
+            {
+                "restore": restore_result,
+                "restore_info": engine.restore_info,
+                "breaker_m001": breakers.for_member("m-001").state,
+                "breaker_allows_m001": breakers.allow(
+                    "m-001", consume_probe=False
+                ),
+                "fetch_paths": dict(engine.fetch_stats),
+                "quarantined": sorted(
+                    f for f in os.listdir(os.path.join(workdir, "snapshots"))
+                    if f.endswith(".quarantined")
+                ),
+            },
+        )
+        out = os.environ.get("KT_RESTART_ARTIFACT", "successor.json")
+        with open(os.path.join(workdir, out), "w") as fh:
+            json.dump(doc, fh)
+        return 0
+
+    if mode == "reference":
+        units, clusters = world_at(1, n, c)
+        engine.schedule(units, clusters)
+        units = churn(units, 1)
+        engine.schedule(units, clusters)
+        units = churn(units, 2)
+        results = engine.schedule(units, clusters)
+        doc = artifact(engine, metrics, results, units, {"restore": "none"})
+        with open(os.path.join(workdir, "reference.json"), "w") as fh:
+            json.dump(doc, fh)
+        return 0
+
+    raise SystemExit(f"unknown mode {mode!r}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
