@@ -241,11 +241,15 @@ def time_batched(rng, units, clusters, followers):
     # Cold tick: featurizes from scratch, uploads everything, fetches
     # everything — against prewarmed programs.
     dispatches0 = engine.dispatches_total
+    feat_rows0 = dict(engine.featurize_rows)
     t_cold = time.perf_counter()
     engine.schedule(units, clusters, follower_index=fidx)
     cold_ms = (time.perf_counter() - t_cold) * 1e3
     cold_dispatches = engine.dispatches_total - dispatches0
     cold_featurize_ms = round(engine.timings["featurize"] * 1e3, 1)
+    cold_feat_rows = {
+        k: engine.featurize_rows[k] - feat_rows0[k] for k in feat_rows0
+    }
     # One churned tick outside the timing loop (first sub-batch shapes).
     units = churn(rng, units)
     engine.schedule(units, clusters, follower_index=fidx)
@@ -261,6 +265,7 @@ def time_batched(rng, units, clusters, followers):
     detail = {"featurize": 0.0, "device": 0.0, "fetch": 0.0, "decode": 0.0}
     fetch_bytes0 = engine.fetch_bytes_total
     overflow_t0 = engine.overflow_rows_total
+    feat_rows0 = dict(engine.featurize_rows)
     # Optional jax.profiler capture around the timed ticks
     # (KT_PROFILE_TICKS=N, artifact under KT_PROFILE_DIR): what
     # tpu_capture.py uses to grab one on-chip trace per window.
@@ -293,6 +298,10 @@ def time_batched(rng, units, clusters, followers):
     dt = (time.perf_counter() - t0) / TICKS
     tick_fetch_bytes = (engine.fetch_bytes_total - fetch_bytes0) / TICKS
     tick_overflow_rows = (engine.overflow_rows_total - overflow_t0) / TICKS
+    steady_feat_rows = {
+        k: round((engine.featurize_rows[k] - feat_rows0[k]) / TICKS, 1)
+        for k in feat_rows0
+    }
     placed = sum(1 for r in results if r.clusters)
 
     # Drift tick: one cluster's resources changed — every row must be
@@ -307,9 +316,13 @@ def time_batched(rng, units, clusters, followers):
     drift_dispatches0 = engine.dispatches_total
     drift_upload0 = dict(engine.upload_bytes)
     drift_overflow0 = engine.overflow_rows_total
+    drift_feat0 = dict(engine.featurize_rows)
     t_drift = time.perf_counter()
     engine.schedule(units, drifted, follower_index=fidx)
     drift_ms = (time.perf_counter() - t_drift) * 1e3
+    drift_feat_rows = {
+        k: engine.featurize_rows[k] - drift_feat0[k] for k in drift_feat0
+    }
     drift_stage = {k: round(v * 1e3, 1) for k, v in engine.timings.items()}
     drift_dispatches = engine.dispatches_total - drift_dispatches0
     drift_upload = {
@@ -389,6 +402,23 @@ def time_batched(rng, units, clusters, followers):
     detail["cold_tick_ms"] = round(cold_ms, 1)
     detail["prewarm_s"] = round(prewarm_s, 1)
     detail["featurize_cold_ms"] = cold_featurize_ms
+    # Per-phase featurization attribution (ISSUE 10): featurize_ms +
+    # rows featurized {full|delta} per phase, so the 2.8s c5 full
+    # rebuild can never silently return to the steady/drift path
+    # (counters prove full rebuilds only on cold/topology change;
+    # tools/bench_gate.py gates the drift/churn featurize_ms).
+    detail["featurize_attr"] = {
+        "cold": {"ms": cold_featurize_ms, "rows": cold_feat_rows},
+        "steady": {
+            # detail["featurize"] is already the per-tick average ms.
+            "ms": detail["featurize"],
+            "rows": steady_feat_rows,
+        },
+        "drift": {
+            "ms": drift_stage.get("featurize", 0.0),
+            "rows": drift_feat_rows,
+        },
+    }
     detail["noop_tick_ms"] = round(noop_ms, 1)
     # Fetch wire telemetry (ISSUE 3): the per-timed-tick transfer volume
     # the packed export exists to shrink, plus the format and the
@@ -517,6 +547,7 @@ def run_churn_scenario() -> None:
     drifts = 0
     seq = 0
     overflow0 = engine.overflow_rows_total
+    feat_rows0 = dict(engine.featurize_rows)
     stage_totals: dict[str, float] = {}
     lat0 = len(stream.latencies)
     last_flushes = stream.flushes
@@ -597,6 +628,19 @@ def run_churn_scenario() -> None:
         "flush_triggers": dict(stream.flush_stats),
         "stage_totals_ms": {
             k: round(v * 1e3, 1) for k, v in stage_totals.items()
+        },
+        # Featurization attribution (ISSUE 10): per-flush featurize cost
+        # (GATED by tools/bench_gate.py once a prior round carries it)
+        # and the rows-featurized split — a sustained-churn run must
+        # move delta rows only (full rows here mean the O(changed)
+        # contract regressed mid-stream).
+        "featurize_per_flush_ms": round(
+            stage_totals.get("featurize", 0.0) * 1e3 / flushes, 2
+        )
+        if flushes
+        else None,
+        "featurize_rows": {
+            k: engine.featurize_rows[k] - feat_rows0[k] for k in feat_rows0
         },
         "drift_gate": dict(engine.drift_stats),
         "fetch_overflow_rows": engine.overflow_rows_total - overflow0,

@@ -137,7 +137,40 @@ class TickOutputs(NamedTuple):
                           # plane the flight recorder serves
 
 
-def expand_compact(ci) -> TickInputs:
+def fnv_tiebreak_plane(key_bytes, key_len, name_hash_state) -> jax.Array:
+    """The planner tie-break plane: continue each cluster name's FNV-1
+    state over the object key's bytes (h = h*prime ^ byte, uint32
+    wraparound), then map to order-preserving int32 (hashing.py
+    semantics).  O(B*C*L) — the single most expensive part of
+    expand_compact, and the only per-(object, cluster) input that is
+    STABLE across ticks for unchanged rows: the engine precomputes it
+    into a device-resident per-chunk plane (patched row-wise on churn)
+    so the drift survivor kernels never re-run the byte scan."""
+    b = key_bytes.shape[0]
+    c = name_hash_state.shape[0]
+    prime = jnp.uint32(16777619)
+    state0 = jnp.broadcast_to(
+        jnp.asarray(name_hash_state), (b, c)
+    ).astype(jnp.uint32)
+    key_cols = jnp.asarray(key_bytes).T  # [L, B] — scanned xs
+    key_len = jnp.asarray(key_len)
+    n_bytes = key_cols.shape[0]
+
+    def fnv_step(state, xs):
+        byte, j = xs
+        upd = (state * prime) ^ byte.astype(jnp.uint32)[:, None]
+        keep = (j < key_len)[:, None]
+        return jnp.where(keep, upd, state), None
+
+    state, _ = jax.lax.scan(
+        fnv_step, state0, (key_cols, jnp.arange(n_bytes))
+    )
+    return jax.lax.bitcast_convert_type(
+        state ^ jnp.uint32(0x80000000), jnp.int32
+    )
+
+
+def expand_compact(ci, tiebreak=None) -> TickInputs:
     """Device-side expansion of CompactInputs into the dense planes the
     fused tick consumes: vocabulary-table gathers, sparse policy
     scatters, and the planner tie-break FNV-1 hash — all in HBM, where
@@ -146,7 +179,13 @@ def expand_compact(ci) -> TickInputs:
 
     Bit-exact with scheduler/featurize.featurize: the tables are built
     by the same host matching code, and the FNV continuation reproduces
-    utils/hashing.fnv32_extend + uint32_to_sortable_int32 exactly."""
+    utils/hashing.fnv32_extend + uint32_to_sortable_int32 exactly.
+
+    ``tiebreak`` (i32[B, C]) short-circuits the FNV byte scan with a
+    precomputed plane — the engine's drift survivor kernels gather rows
+    from a per-chunk device-resident plane built once per upload and
+    patched incrementally, so the scan's O(B*C*L) cost stays off the
+    per-drift floor."""
     b = ci.gvk_id.shape[0]
     c = ci.cluster_valid.shape[0]
     _note_trace("expand_compact", b, c)
@@ -184,29 +223,10 @@ def expand_compact(ci) -> TickInputs:
         jnp.int32,
     )
 
-    # Planner tie-break: continue each cluster name's FNV-1 state over
-    # the object key's bytes (h = h*prime ^ byte, uint32 wraparound),
-    # then map to order-preserving int32 (hashing.py semantics).
-    prime = jnp.uint32(16777619)
-    state0 = jnp.broadcast_to(
-        jnp.asarray(ci.name_hash_state), (b, c)
-    ).astype(jnp.uint32)
-    key_cols = jnp.asarray(ci.key_bytes).T  # [L, B] — scanned xs
-    key_len = jnp.asarray(ci.key_len)
-    n_bytes = key_cols.shape[0]
-
-    def fnv_step(state, xs):
-        byte, j = xs
-        upd = (state * prime) ^ byte.astype(jnp.uint32)[:, None]
-        keep = (j < key_len)[:, None]
-        return jnp.where(keep, upd, state), None
-
-    state, _ = jax.lax.scan(
-        fnv_step, state0, (key_cols, jnp.arange(n_bytes))
-    )
-    tiebreak = jax.lax.bitcast_convert_type(
-        state ^ jnp.uint32(0x80000000), jnp.int32
-    )
+    if tiebreak is None:
+        tiebreak = fnv_tiebreak_plane(
+            ci.key_bytes, ci.key_len, ci.name_hash_state
+        )
 
     return TickInputs(
         filter_enabled=ci.filter_enabled,
@@ -458,88 +478,59 @@ def _finalize(
 _CERT_INF = np.int64(1) << 62
 
 
-def schedule_tick_narrow(
-    inp: TickInputs, m: int, rows_only=None
-) -> tuple[TickOutputs, jax.Array]:
-    """Two-phase narrow solve; returns (outputs, cert i8[B]).
+def _select_comp(totals, feasible, c, iota, i32_keys):
+    """The select stage's collision-free composite key ((-total, index)
+    ascending) for the narrow candidate sort and its certificate.
 
-    ``m`` is a static candidate width (engine: KT_NARROW_M-floored pow2
-    of the chunk's finite maxClusters bound, capped at the cluster
-    bucket).  ``cert[b] == 1`` guarantees the row's outputs are
-    bit-identical to ``schedule_tick``; rows with 0 must be re-solved
-    dense (the engine's fallback sub-batch).  ``rows_only`` (a mesh
-    NamedSharding) constrains the per-row top-k/gather sources to
-    rows-only layout — like the pack sort, GSPMD must not run them on a
-    sharded cluster axis."""
-    b, c = inp.api_ok.shape[0], inp.cluster_valid.shape[0]
-    m = min(m, c)
-    _note_trace("schedule_tick_narrow", b, c)
-    feasible, reasons, totals = _phase1(inp)
-
-    def cs(x):
-        if rows_only is None:
-            return x
-        return jax.lax.with_sharding_constraint(x, rows_only)
-
-    feasible = cs(feasible)
-    totals = cs(totals)
-    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
-    iota = lax.broadcasted_iota(jnp.int32, (b, c), 1)
-
-    def take(plane):
-        return jnp.take_along_axis(cs(plane), cand_s, axis=-1)
-
-    # --- select resolution ------------------------------------------------
-    nfeas = jnp.sum(feasible, axis=-1, dtype=jnp.int32)
-    k_eff = jnp.where(
-        inp.max_clusters < 0, 0, jnp.minimum(inp.max_clusters, jnp.int32(c))
-    )
-    # The cut cannot engage: selection is the feasible set, no sort.
-    kinf = k_eff >= nfeas
-
+    Returns (comp, key_ok bool[B], cert_inf): with ``i32_keys`` (and a
+    cluster axis narrow enough to leave >= 12 value bits) the key packs
+    into int32 — on CPU the [B, C] single-key sort is the narrow
+    kernel's floor, and an i32 sort moves half the bytes of the i64
+    one.  The demotion is CERT-GUARDED, not assumed: rows whose
+    feasible totals overflow the narrowed value field (webhook scores
+    can reach int32max/2) get ``key_ok`` False and must take the dense
+    fallback — the same pattern as the quantized planner key."""
+    if i32_keys:
+        cbits = max(1, (c - 1).bit_length())
+        if cbits <= 18:
+            lim = np.int64(1) << (30 - cbits)
+            t64 = totals.astype(jnp.int64)
+            inrange = (t64 < lim) & (t64 > -lim)
+            key_ok = ~jnp.any(feasible & ~inrange, axis=-1)
+            key1 = jnp.where(
+                feasible & inrange,
+                -totals.astype(jnp.int32),
+                jnp.int32(lim),
+            )
+            comp = (key1 << cbits) | iota
+            return comp, key_ok, jnp.int32(np.iinfo(np.int32).max)
     key1 = jnp.where(
         feasible, -totals.astype(jnp.int32), jnp.iinfo(jnp.int32).max
     )
-    # Candidate selection is a SINGLE-key sort of the collision-free
-    # composite (key1 asc, index asc) packed into int64 — not lax.top_k:
-    # XLA lowers top_k's index payload to a variadic sort, which on CPU
-    # is a row-serial comparator loop ~6x slower than the packed
-    # single-key form (36.0 -> 6.5ms at [256, 512], m=128).  The first
-    # m sorted values decode to exactly top_k's indices (% c), ties
-    # preferring the lower index, same as top_k.
-    comp_sel = key1.astype(jnp.int64) * c + iota
-    cand_s = (lax.sort(cs(comp_sel), dimension=-1)[:, :m] % c).astype(
-        jnp.int32
-    )
-    cand_s = jnp.sort(cand_s, axis=-1)  # ascending: narrow slot order
-    #                                     preserves the dense index order
-    fea_s = take(feasible)
-    sel_n = select_topk(take(totals), fea_s, inp.max_clusters)
-    sel_scatter = (
-        jnp.zeros((b, c), bool).at[rows, cand_s].set(sel_n)
-    )
-    selected = jnp.where(kinf[:, None], feasible, sel_scatter)
+    comp = key1.astype(jnp.int64) * c + iota
+    return comp, jnp.ones(totals.shape[0], bool), _CERT_INF
 
-    # Select certificate (comp_sel is collision-free): every feasible
-    # non-candidate must rank strictly after every selected column, and
-    # the narrow cut must have had enough feasible candidates to fill k
-    # (or seen every feasible column).
-    cand_mask = jnp.zeros((b, c), bool).at[rows, cand_s].set(True)
-    out_feas = feasible & ~cand_mask
-    best_out = jnp.min(
-        jnp.where(out_feas, comp_sel, _CERT_INF), axis=-1
-    )
-    worst_sel = jnp.max(
-        jnp.where(sel_n, jnp.take_along_axis(comp_sel, cand_s, -1), -_CERT_INF),
-        axis=-1,
-    )
-    nf_cand = jnp.sum(fea_s, axis=-1, dtype=jnp.int32)
-    cert_sel = kinf | (
-        ((nf_cand >= k_eff) | (nfeas == nf_cand)) & (best_out > worst_sel)
-    )
 
-    # --- planner candidates: top-M members in processing order ------------
-    weights = _planner_weights(inp, selected)
+def _decode_comp(sorted_comp, c, i32_keys):
+    """Low-bits decode of a sorted composite back to column indices."""
+    if i32_keys:
+        cbits = max(1, (c - 1).bit_length())
+        if cbits <= 18:
+            return (sorted_comp & ((1 << cbits) - 1)).astype(jnp.int32)
+    return (sorted_comp % c).astype(jnp.int32)
+
+
+def _plan_topm(inp: TickInputs, selected, weights, m: int, cs):
+    """Planner over the top-M member slots in ITS OWN processing order —
+    the narrow planner leg, shared by the narrow tick, the score-only
+    solve and the selection-known drift replan.  Returns
+    (divide_replicas i64[B, C], cert bool[B]); cert True iff
+    plan_batch_narrow's phantom-tail certificate held and no selected
+    special column was left outside the slots."""
+    b, c = selected.shape
+    m = min(m, c)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    iota = lax.broadcasted_iota(jnp.int32, (b, c), 1)
     special = (
         (inp.min_replicas > 0)
         | (inp.max_replicas != INT32_INF)
@@ -626,13 +617,267 @@ def schedule_tick_narrow(
     divide_replicas = (
         jnp.zeros((b, c), jnp.int64).at[rows, cand_p].set(divide_n)
     )
+    return divide_replicas, pcert & ~spec_out
+
+
+def _narrow_solve(
+    inp: TickInputs, feasible, reasons, totals, m: int, rows_only,
+    i32_keys: bool,
+) -> tuple[TickOutputs, jax.Array]:
+    """The select + planner back half of the narrow solve, given a
+    phase-1 triple (from ``_phase1`` for the narrow tick, or from
+    ``_phase1_from_stored`` for the drift score-only path)."""
+    b, c = inp.api_ok.shape[0], inp.cluster_valid.shape[0]
+    m = min(m, c)
+
+    def cs(x):
+        if rows_only is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, rows_only)
+
+    feasible = cs(feasible)
+    totals = cs(totals)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    iota = lax.broadcasted_iota(jnp.int32, (b, c), 1)
+
+    def take(plane):
+        return jnp.take_along_axis(cs(plane), cand_s, axis=-1)
+
+    # --- select resolution ------------------------------------------------
+    nfeas = jnp.sum(feasible, axis=-1, dtype=jnp.int32)
+    k_eff = jnp.where(
+        inp.max_clusters < 0, 0, jnp.minimum(inp.max_clusters, jnp.int32(c))
+    )
+    # The cut cannot engage: selection is the feasible set, no sort.
+    kinf = k_eff >= nfeas
+
+    # Candidate selection is a SINGLE-key sort of the collision-free
+    # composite (key1 asc, index asc) — not lax.top_k: XLA lowers
+    # top_k's index payload to a variadic sort, which on CPU is a
+    # row-serial comparator loop ~6x slower than the packed single-key
+    # form (36.0 -> 6.5ms at [256, 512], m=128).  The first m sorted
+    # values decode to exactly top_k's indices, ties preferring the
+    # lower index, same as top_k.  _select_comp narrows the key to i32
+    # when the range analysis allows (cert-guarded, i64 fallback).
+    comp_sel, key_ok, cert_inf = _select_comp(
+        totals, feasible, c, iota, i32_keys
+    )
+    cand_s = _decode_comp(
+        lax.sort(cs(comp_sel), dimension=-1)[:, :m], c, i32_keys
+    )
+    cand_s = jnp.sort(cand_s, axis=-1)  # ascending: narrow slot order
+    #                                     preserves the dense index order
+    fea_s = take(feasible)
+    sel_n = select_topk(take(totals), fea_s, inp.max_clusters)
+    sel_scatter = (
+        jnp.zeros((b, c), bool).at[rows, cand_s].set(sel_n)
+    )
+    selected = jnp.where(kinf[:, None], feasible, sel_scatter)
+
+    # Select certificate (comp_sel is collision-free when key_ok): every
+    # feasible non-candidate must rank strictly after every selected
+    # column, and the narrow cut must have had enough feasible
+    # candidates to fill k (or seen every feasible column).
+    cand_mask = jnp.zeros((b, c), bool).at[rows, cand_s].set(True)
+    out_feas = feasible & ~cand_mask
+    best_out = jnp.min(
+        jnp.where(out_feas, comp_sel, cert_inf), axis=-1
+    )
+    worst_sel = jnp.max(
+        jnp.where(sel_n, jnp.take_along_axis(comp_sel, cand_s, -1), -cert_inf),
+        axis=-1,
+    )
+    nf_cand = jnp.sum(fea_s, axis=-1, dtype=jnp.int32)
+    cert_sel = kinf | (
+        key_ok
+        & ((nf_cand >= k_eff) | (nfeas == nf_cand))
+        & (best_out > worst_sel)
+    )
+
+    # --- planner candidates: top-M members in processing order ------------
+    weights = _planner_weights(inp, selected)
+    divide_replicas, plan_cert = _plan_topm(inp, selected, weights, m, cs)
 
     # No sticky shortcut here: sticky placements bypass the solve, but
     # their REASONS keep the would-be pipeline's zero-replica bits
     # (explain_one's "context" contract), so sticky rows certify under
     # the same select+planner conditions as everyone else.
-    cert = cert_sel & (~inp.mode_divide | (pcert & ~spec_out))
+    cert = cert_sel & (~inp.mode_divide | plan_cert)
     out = _finalize(inp, feasible, reasons, totals, selected, divide_replicas)
+    return out, cert.astype(jnp.int8)
+
+
+def schedule_tick_narrow(
+    inp: TickInputs, m: int, rows_only=None, i32_keys: bool = False
+) -> tuple[TickOutputs, jax.Array]:
+    """Two-phase narrow solve; returns (outputs, cert i8[B]).
+
+    ``m`` is a static candidate width (engine: KT_NARROW_M-floored pow2
+    of the chunk's finite maxClusters bound, capped at the cluster
+    bucket).  ``cert[b] == 1`` guarantees the row's outputs are
+    bit-identical to ``schedule_tick``; rows with 0 must be re-solved
+    dense (the engine's fallback sub-batch).  ``rows_only`` (a mesh
+    NamedSharding) constrains the per-row top-k/gather sources to
+    rows-only layout — like the pack sort, GSPMD must not run them on a
+    sharded cluster axis.  ``i32_keys`` (KT_PHASE1_I32) demotes the
+    select candidate composite to int32 where the key range analysis
+    allows — cert-guarded per row, i64 semantics otherwise."""
+    b, c = inp.api_ok.shape[0], inp.cluster_valid.shape[0]
+    _note_trace("schedule_tick_narrow", b, c)
+    feasible, reasons, totals = _phase1(inp)
+    return _narrow_solve(
+        inp, feasible, reasons, totals, m, rows_only, i32_keys
+    )
+
+
+# -- stored-plane phase 1 (drift survivors) -------------------------------
+# A capacity drift cannot move any topology-derived filter result (api/
+# taint/placement/affinity/webhook/validity): of the filter stage, ONLY
+# resources_fit reads the cluster resource planes.  For rows whose
+# cached reason plane is trustworthy (clean cache hit, same topology,
+# no stale-out marking — the engine's drift path enforces all three),
+# phase 1 can therefore be reconstructed WITHOUT re-running the filter
+# gathers or the expand FNV scan:
+#
+# * non-fit filter verdicts come from the stored reason bits (exact:
+#   a selected column carries mask 0 but was feasible, so every filter
+#   passed; a rejected column's topology bits cannot have drifted);
+# * the ONE capacity-derived bit (resources_fit — which the skip path
+#   is allowed to leave stale on infeasible columns) is recomputed
+#   dense against the new cluster planes;
+# * the score plane is recomputed in full over the NEW feasibility
+#   (fit flips shift the normalization maxima, so stored totals are
+#   unusable for these rows — this is the "score-only phase 1": the
+#   score half runs, the filter half is table lookups on stored bits).
+#
+# Sticky-active rows are the one soundness exception (their current
+# columns carry mask 0 regardless of filter verdicts) — both consumers
+# fail the certificate for them, and the engine's gate never routes
+# sticky rows to these kernels in the first place.
+
+_NONFIT_BLOCK = np.int32(RSN.FILTER_REASON_MASK & ~RSN.REASON_RESOURCES_FIT)
+
+
+def _stored_filters(inp: TickInputs, reasons_rows):
+    """(feasible, base_reasons) for drift survivor rows, from the
+    stored reason plane plus a dense resources_fit recompute — no
+    filter-table gathers, no reason-bit assembly beyond the fit bit."""
+    fit_ok = F.resources_fit(inp.request, inp.alloc, inp.used)
+    fit_enabled = inp.filter_enabled[:, F.F_RESOURCES_FIT, None]
+    topo_ok = (reasons_rows & _NONFIT_BLOCK) == 0
+    feasible = (
+        topo_ok
+        & (~fit_enabled | fit_ok)
+        & inp.cluster_valid[None, :]
+        & inp.webhook_ok
+    )
+    fit_bit = jnp.where(
+        fit_enabled & ~fit_ok, jnp.int32(RSN.REASON_RESOURCES_FIT), 0
+    )
+    base_reasons = (
+        reasons_rows
+        & ~jnp.int32(RSN.SELECT_REASON_MASK | RSN.REASON_RESOURCES_FIT)
+    ) | fit_bit
+    return feasible, base_reasons
+
+
+def _phase1_from_stored(inp: TickInputs, reasons_rows):
+    """(feasible, base_reasons, totals): _stored_filters plus the full
+    score recompute (the "score-only phase 1")."""
+    feasible, base_reasons = _stored_filters(inp, reasons_rows)
+    totals = S.total_scores(
+        inp.score_enabled,
+        feasible,
+        inp.request,
+        inp.alloc,
+        inp.used,
+        inp.taint_counts,
+        inp.affinity_scores,
+    )
+    totals = totals + jnp.where(feasible, inp.webhook_scores, 0)
+    return feasible, base_reasons, totals
+
+
+def drift_scoreonly(
+    inp: TickInputs,   # gathered survivor rows [n, C] (expanded)
+    reasons_rows,      # i32[n, C] previous reason plane rows
+    m: int,
+    rows_only=None,
+    i32_keys: bool = False,
+) -> tuple[TickOutputs, jax.Array]:
+    """Score-only re-solve of fit-flip survivors whose top-K cut may
+    engage: phase 1 reconstructed from stored planes (see the module
+    comment above), then the UNCHANGED narrow select/planner machinery.
+    Returns (outputs [n, C], cert i8[n]); cert semantics match
+    ``schedule_tick_narrow`` plus a fail-closed arm for sticky-active
+    rows (whose stored reasons cannot reconstruct feasibility)."""
+    n, c = inp.api_ok.shape[0], inp.cluster_valid.shape[0]
+    _note_trace("drift_scoreonly", n, c)
+    feasible, base_reasons, totals = _phase1_from_stored(inp, reasons_rows)
+    out, cert = _narrow_solve(
+        inp, feasible, base_reasons, totals, m, rows_only, i32_keys
+    )
+    sticky_active = inp.sticky & jnp.any(inp.current_mask, axis=-1)
+    return out, (cert.astype(bool) & ~sticky_active).astype(jnp.int8)
+
+
+def drift_replan(
+    inp: TickInputs,   # gathered survivor rows [n, C] (expanded)
+    reasons_rows,      # i32[n, C] previous reason plane rows
+    scores_rows,       # i32[n, C] stored score plane rows (NOT recomputed)
+    m: int,
+) -> tuple[TickOutputs, jax.Array]:
+    """Selection-known replan of kinf fit-flip survivors: rows whose
+    top-K cut provably cannot engage (maxClusters unlimited, negative,
+    or >= the NEW feasible count) need NO select sort and NO scores —
+    the new selection IS the new feasible set, which ``_stored_filters``
+    reconstructs as prev_feas ± the fit-flipped columns.  Duplicate
+    rows are then done (no planner); Divide rows run the top-M
+    processing-order planner leg only.  The kernel runs ONE full-width
+    sort (the planner candidate key) where the narrow slab runs three,
+    plus the FNV scan and the five score plugins it also skips.
+
+    The score INTROSPECTION plane is the one thing that goes stale:
+    outputs carry ``scores_rows`` unrecomputed, so a replan row's
+    /debug/explain scores and recorded top-k reflect the last solved
+    score plane (the same fresh-as-of-last-solve contract the gate's
+    skip path already has).  That staleness is provably decision-free:
+    replan rows are host-kinf (maxClusters unlimited/negative), so the
+    gate's rank refinement, the resolve path and the select cut never
+    consult their stored scores.  Placements, replicas and reason
+    planes are EXACT.
+
+    Returns (outputs [n, C], cert i8[n]).  cert == 1 guarantees
+    placement/replica/reason outputs bit-identical to a dense re-solve;
+    rows with 0 (cut would engage, sticky, planner cert failure) take
+    the slab path."""
+    n, c = inp.api_ok.shape[0], inp.cluster_valid.shape[0]
+    _note_trace("drift_replan", n, c)
+    feasible, base_reasons = _stored_filters(inp, reasons_rows)
+    totals = scores_rows
+
+    nfeas = jnp.sum(feasible, axis=-1, dtype=jnp.int32)
+    k_eff = jnp.where(
+        inp.max_clusters < 0, 0, jnp.minimum(inp.max_clusters, jnp.int32(c))
+    )
+    kinf = (inp.max_clusters == INT32_INF) | (k_eff >= nfeas)
+    # Negative maxClusters selects nothing; otherwise the cut cannot
+    # engage and selection equals the new feasible set.
+    selected = feasible & (inp.max_clusters >= 0)[:, None]
+    sticky_active = inp.sticky & jnp.any(inp.current_mask, axis=-1)
+
+    weights = _planner_weights(inp, selected)
+    divide_replicas, plan_cert = _plan_topm(
+        inp, selected, weights, m, lambda x: x
+    )
+    cert = (
+        (kinf | (inp.max_clusters < 0))
+        & ~sticky_active
+        & (~inp.mode_divide | plan_cert)
+    )
+    out = _finalize(
+        inp, feasible, base_reasons, totals, selected, divide_replicas
+    )
     return out, cert.astype(jnp.int8)
 
 
@@ -1010,17 +1255,24 @@ def drift_wcheck(
     cpu_avail_old,
     cpu_alloc_new,
     cpu_avail_new,
+    compute_dtype=jnp.int64,
 ):
     """Dynamic-weight equality check for gate-classified wcheck rows.
 
     Those rows' selection provably equals their feasible set (see the
     gate's exactness argument, step 3/4), so comparing dynamic weights
     over prev_feas decides replica equality exactly.  Returns i8[K]:
-    1 where the weights differ (row must recompute)."""
+    1 where the weights differ (row must recompute).
+    ``compute_dtype=jnp.int32`` demotes the weight arithmetic behind
+    the engine's host-side range guard (see ops.weights)."""
     _note_trace("drift_wcheck", rows_idx.shape[0], prev_feas.shape[1])
     sel = prev_feas[rows_idx] != 0
-    w_old = dynamic_weights(sel, cpu_alloc_old, cpu_avail_old)
-    w_new = dynamic_weights(sel, cpu_alloc_new, cpu_avail_new)
+    w_old = dynamic_weights(
+        sel, cpu_alloc_old, cpu_avail_old, compute_dtype=compute_dtype
+    )
+    w_new = dynamic_weights(
+        sel, cpu_alloc_new, cpu_avail_new, compute_dtype=compute_dtype
+    )
     return (w_old != w_new).any(axis=-1).astype(jnp.int8)
 
 
@@ -1244,7 +1496,20 @@ def pack_rows(selected, replicas, counted, scores, reasons, k: int) -> PackedRow
     # Selected clusters sort to the front by (-score, index); unselected
     # sink past them (scores are bounded far below int32 max).
     key1 = jnp.where(selb, -scores.astype(jnp.int32), jnp.iinfo(jnp.int32).max)
-    _, order = lax.sort((key1, iota), dimension=-1, num_keys=2)
+    if jax.default_backend() == "tpu":
+        # int64 is emulated on TPU; the 2-key int32 comparator is the
+        # cheaper form there (the select_topk encoding rule).
+        _, order = lax.sort((key1, iota), dimension=-1, num_keys=2)
+    else:
+        # XLA:CPU lowers the index payload of a variadic sort to a
+        # row-serial comparator loop; the collision-free int64
+        # composite single-key sort is ~5x faster at slab shapes (the
+        # PR-5 select-sort lesson, applied to the pack — at c5 the pack
+        # was 13.4s of the 51s drift device time).  Floor-mod keeps the
+        # decode exact for negative keys (comp = key1*c + iota with
+        # 0 <= iota < c).
+        comp = key1.astype(jnp.int64) * c + iota
+        order = (lax.sort(comp, dimension=-1) % c).astype(jnp.int32)
     order = order[..., :k]
     valid = jnp.take_along_axis(selb, order, axis=-1)
     gidx = jnp.where(valid, order, 0)

@@ -154,7 +154,13 @@ def total_scores(
     taint_counts,    # i64[B, C] intolerable PreferNoSchedule taints
     affinity_scores, # i64[B, C] preferred-term weight sums
 ):
-    """Sum of enabled, normalized plugin scores; 0 on infeasible clusters."""
+    """Sum of enabled, normalized plugin scores; 0 on infeasible clusters.
+
+    All five plugins compute unconditionally and the enablement mask
+    selects — a lax.cond per plugin was tried (ISSUE 10) and REGRESSED
+    the big shapes ~2x: the conditional regions block XLA's fusion of
+    the plugin math into one [B, C] pass and materialize full int64
+    planes per branch, costing more than the skipped arithmetic saved."""
     taint = normalize(taint_counts, feasible, reverse=True)
     affinity = normalize(affinity_scores, feasible, reverse=False)
     plugin_scores = (

@@ -36,7 +36,7 @@ def _round_half_div(num, den):
     return _floordiv_smallq(2 * num + den, 2 * den)
 
 
-def dynamic_weights(selected, cpu_alloc, cpu_avail):
+def dynamic_weights(selected, cpu_alloc, cpu_avail, compute_dtype=jnp.int64):
     """selected bool[B,C]; cpu_alloc/cpu_avail i64[C] -> i32[B,C] weights.
 
     Weights are zero outside the selection mask.
@@ -47,9 +47,21 @@ def dynamic_weights(selected, cpu_alloc, cpu_avail):
     no sorts) and gathers them into the [B, M] planner slots, and the
     residual's first-max tie-break (index order) survives the gather
     because candidate slots preserve ascending column order.
-    """
+
+    ``compute_dtype=jnp.int32`` demotes the arithmetic (identical
+    values when no intermediate overflows — all rounding is the exact
+    integer form below).  Callers must have proven the range
+    host-side: the worst intermediate is ``2*max_cpu*(1400 + C)``
+    (the x1.4 supply-limit round over the allocatable sum), so the
+    demotion is safe iff that stays under 2**31.  The engine's drift
+    weight-check applies it behind exactly that guard — on CPU the
+    [rows, C] i64 passes were ~half the wcheck kernel's time."""
     sel = selected
-    n = jnp.maximum(jnp.sum(sel, axis=-1, keepdims=True), 1).astype(jnp.int64)
+    cpu_alloc = cpu_alloc.astype(compute_dtype)
+    cpu_avail = cpu_avail.astype(compute_dtype)
+    n = jnp.maximum(jnp.sum(sel, axis=-1, keepdims=True), 1).astype(
+        compute_dtype
+    )
 
     # CalcWeightLimit: allocatable-CPU share * 1000 * 1.4 (rsp.go:183-213).
     alloc = jnp.where(sel, cpu_alloc[None, :], 0)

@@ -67,6 +67,9 @@ PROGRAM_KINDS = (
     "gate",            # drift gate (row classification from cached planes)
     "wcheck",          # drift dynamic-weight comparison
     "resolve",         # sort-free drift survivor resolve
+    "replan",          # selection-known replan of kinf fit-flip survivors
+    "scoreonly",       # score-only narrow solve of finite-K fit-flip rows
+    "tiebreak",        # precomputed planner tie-break plane (full/patch)
     "gather",          # delta-row plane gathers (dense wire)
     "pack",            # packed-export wire compaction (gather/full)
     "overflow",        # K-overflow bit-packed row re-fetch gather

@@ -107,9 +107,20 @@ CATALOG: dict[str, MetricSpec] = {
         "skip = provably identical outputs, wcheck = dynamic-weight "
         "comparison rows, wcheck_changed = weight comparisons that "
         "found a difference, resolve = survivors settled by the "
-        "sort-free drift-resolve program, resolve_fallback = resolve "
-        "rows whose certificate failed (slab re-solve), recompute = "
-        "rows re-scheduled through the sub-batch slabs."),
+        "sort-free drift-resolve program, replan = kinf fit-flip "
+        "survivors settled by the selection-known replan (no select "
+        "sort), score_only = finite-K fit-flip survivors settled by "
+        "the stored-plane score-only narrow solve, *_fallback = rows "
+        "of those paths whose certificate failed (slab re-solve), "
+        "recompute = rows re-scheduled through the sub-batch slabs."),
+    "engine_featurize_rows_total": MetricSpec(
+        "counter", "rows", ("path",),
+        "Rows featurized per path: full = whole-chunk rebuilds (cold "
+        "boot, topology change, vocabulary overflow, webhook ticks, "
+        "snapshot restore), delta = row-wise patches of cached chunks. "
+        "A steady/churn tick must move delta rows only — full rows "
+        "outside cold/topology transitions mean the O(changed-rows) "
+        "featurization contract regressed (KT_DELTA_FEAT)."),
     "engine_gate_inflight": MetricSpec(
         "gauge", "gates", (),
         "Drift-gate programs currently in flight on the device (set at "

@@ -48,9 +48,12 @@ from kubeadmiral_tpu.ops.pipeline import (
     TickOutputs,
     drift_gate_compact,
     drift_gate_dense,
+    drift_replan,
     drift_resolve,
+    drift_scoreonly,
     drift_wcheck,
     expand_compact,
+    fnv_tiebreak_plane,
     pack_wire,
     schedule_tick,
     schedule_tick_narrow,
@@ -312,6 +315,16 @@ class _CachedChunk:
     # were merged host-side by the sub-batch pass): the next delta fetch
     # force-gathers them, everything else still rides the device diff.
     stale_out_rows: Optional[list] = None
+    # Device-resident planner tie-break plane (i32[B_pad, C_pad], compact
+    # format only): precomputed once per per-object upload and patched
+    # row-wise on churn, so the drift survivor kernels (resolve / replan
+    # / score-only) never re-run expand_compact's FNV byte scan.
+    tiebreak_dev: Optional[object] = None
+    # Entry was rebuilt from a durable snapshot and has not yet had a
+    # full identity/signature walk: the delta-featurization dirty-row
+    # hint must not skip rows for it (every row still needs snapshot-
+    # signature verification).
+    restored: bool = False
     # Adaptive packed-export K hint: pow2 over the chunk's observed
     # nsel distribution (see SchedulerEngine._observe_nsel); 0 = no
     # observation yet, use the static maxClusters bound.
@@ -604,6 +617,8 @@ class SchedulerEngine:
         self.drift_stats = {
             "gated": 0, "skip": 0, "wcheck": 0, "wcheck_changed": 0,
             "recompute": 0, "resolve": 0, "resolve_fallback": 0,
+            "replan": 0, "replan_fallback": 0,
+            "score_only": 0, "score_only_fallback": 0,
             "fallback": 0,
         }
         # Sort-free drift resolve (KT_DRIFT_RESOLVE=0 opts out): gate
@@ -612,6 +627,36 @@ class SchedulerEngine:
         self.drift_resolve = os.environ.get(
             "KT_DRIFT_RESOLVE", "1"
         ) not in ("0", "false", "no")
+        # Selection-known replan + score-only phase 1 (KT_REPLAN=0 opts
+        # out): fit-flip gate survivors re-solve from stored reason
+        # planes — kinf rows through the sort-free drift_replan kernel,
+        # finite-K rows through the score-only narrow solve — instead of
+        # riding full phase-1 slabs.  Cert failures drop to the slab
+        # path (counted replan_fallback / score_only_fallback).
+        self.replan = os.environ.get("KT_REPLAN", "1") not in (
+            "0", "false", "no",
+        )
+        # i32 phase-1 arithmetic (KT_PHASE1_I32=0 opts out): demote the
+        # narrow select composite keys (per-row cert-guarded) and the
+        # drift weight-check arithmetic (host range-guarded) from int64
+        # — on CPU the i64 forms are ~2x the bytes through the sort and
+        # reduction floors.
+        self.phase1_i32 = os.environ.get("KT_PHASE1_I32", "1") not in (
+            "0", "false", "no",
+        )
+        # Delta featurization (KT_DELTA_FEAT=0 opts out): row-wise
+        # featurize patches + the streaming dirty-row hint.  Off forces
+        # every changed chunk through the full featurizer (ops escape
+        # hatch; correctness is identical either way).
+        self.delta_feat = os.environ.get("KT_DELTA_FEAT", "1") not in (
+            "0", "false", "no",
+        )
+        # Rows featurized per path: "full" = whole-chunk (cold boot,
+        # topology change, vocab overflow, webhook ticks, restore),
+        # "delta" = row-wise patches.  engine_featurize_rows_total
+        # mirrors these as counters; bench.py attributes them per phase
+        # so a silent return of the full [B, C] rebuild is visible.
+        self.featurize_rows = {"full": 0, "delta": 0}
         # Raw device-dispatch count (the number bench.py reports for the
         # cold/drift dispatch-count acceptance): every tick/gather/pack/
         # gate program launch increments it.
@@ -884,11 +929,15 @@ class SchedulerEngine:
         # hetero-height slabs); jax traces one variant per shape tuple.
         self._concat = jax.jit(lambda *xs: jnp.concatenate(xs))
         # Per-shape program caches for the drift gate, its dynamic-
-        # weight check, the sort-free survivor resolve, and the
-        # prev-plane scatter repair.
+        # weight check, the sort-free survivor resolve, the fit-flip
+        # replan / score-only solves, the precomputed tie-break plane,
+        # and the prev-plane scatter repair.
         self._gate_programs: dict[tuple, object] = {}
         self._wcheck_program_cache: dict[tuple, object] = {}
         self._resolve_programs: dict[tuple, object] = {}
+        self._replan_programs: dict[tuple, object] = {}
+        self._scoreonly_programs: dict[tuple, object] = {}
+        self._tb_program_cache: dict[str, object] = {}
         self._repair_program_cache: dict[tuple, object] = {}
         # Narrow-solve programs: the (fmt, M) tick variants, the dense
         # row re-solve for uncertified rows, and the 4-plane scatter
@@ -1103,10 +1152,14 @@ class SchedulerEngine:
         rows_only = self._rows_only_sharding
         donate = (1,) if self.donate else ()
 
+        i32_keys = self.phase1_i32
+
         def impl(inp, prev, _m=m, _fmt=fmt):
             if _fmt == "compact":
                 inp = expand_compact(inp)
-            out, cert = schedule_tick_narrow(inp, _m, rows_only=rows_only)
+            out, cert = schedule_tick_narrow(
+                inp, _m, rows_only=rows_only, i32_keys=i32_keys
+            )
             return out, _diff_bits(out, prev), cert
 
         if self.mesh is None:
@@ -1564,14 +1617,26 @@ class SchedulerEngine:
         return featurize(chunk, clusters, view=view).inputs, "dense"
 
     def _featurize_chunk(
-        self, idx: int, chunk, clusters, view: ClusterView, webhook_eval, vocab
+        self, idx: int, chunk, clusters, view: ClusterView, webhook_eval,
+        vocab, dirty: Optional[list] = None,
     ) -> tuple[object, str, Optional[_CachedChunk], str]:
         """Returns (inputs, status, cache entry, fmt); status is one of
         "hit" (rows unchanged), "patch" (few rows re-featurized),
-        "miss" (full featurize), "nocache" (caching not applicable)."""
+        "miss" (full featurize), "nocache" (caching not applicable).
+
+        ``dirty`` (LOCAL chunk row indices) is the delta-featurization
+        hint: the caller asserts every row OUTSIDE it is the identical
+        object handed to the previous schedule() call (the streaming
+        scheduler owns the canonical list, so it knows exactly which
+        rows its events touched) — the identity/signature walk then
+        visits only the hinted rows instead of the whole chunk.
+        Ignored for snapshot-restored entries (every row still needs
+        its signature verified against the snapshot) and under
+        KT_DELTA_FEAT=0."""
         if webhook_eval is not None:
             # Webhook planes are per-tick HTTP results; never cached.
             fb = featurize(chunk, clusters, view=view, webhook_eval=webhook_eval)
+            self.featurize_rows["full"] += len(chunk)
             return fb.inputs, "nocache", None, "dense"
 
         topo_fp = self._topo_fingerprint(view)
@@ -1588,12 +1653,22 @@ class SchedulerEngine:
             # Identity fast-path, per ROW: identical objects mean
             # identical rows without computing signatures (SchedulingUnit
             # is immutable), so a 1%-churn tick signature-checks only the
-            # replaced objects — not the whole chunk.
-            changed = [
-                i
-                for i, (a, b) in enumerate(zip(chunk, cached.units))
-                if a is not b and featurize_signature(a) != cached.sigs[i]
-            ]
+            # replaced objects — not the whole chunk; with a dirty-row
+            # hint, only the hinted rows.
+            if dirty is not None and self.delta_feat and not cached.restored:
+                changed = [
+                    i
+                    for i in dirty
+                    if chunk[i] is not cached.units[i]
+                    and featurize_signature(chunk[i]) != cached.sigs[i]
+                ]
+            else:
+                changed = [
+                    i
+                    for i, (a, b) in enumerate(zip(chunk, cached.units))
+                    if a is not b and featurize_signature(a) != cached.sigs[i]
+                ]
+                cached.restored = False
             refreshed = cached.inputs._replace(
                 alloc=view.alloc,
                 used=view.used,
@@ -1605,7 +1680,7 @@ class SchedulerEngine:
                 cached.units = list(chunk)
                 self.cache_stats["hit"] += 1
                 return refreshed, "hit", cached, cached.fmt
-            if len(changed) <= max(1, len(chunk) // 4):
+            if self.delta_feat and len(changed) <= max(1, len(chunk) // 4):
                 sub = self._featurize_rows(
                     [chunk[i] for i in changed], clusters, view, vocab, cached
                 )
@@ -1622,10 +1697,12 @@ class SchedulerEngine:
                     # changed rows enable the sub-batch fast path.
                     cached.last_patch = (changed, sub)
                     self.cache_stats["patch"] += 1
+                    self.featurize_rows["delta"] += len(changed)
                     return refreshed, "patch", cached, cached.fmt
 
         inputs, fmt = self._featurize_full(chunk, clusters, view, vocab)
         self.cache_stats["miss"] += 1
+        self.featurize_rows["full"] += len(chunk)
         if cached is not None:
             self._cache_used -= cached.nbytes
             del self._chunk_cache[idx]
@@ -1699,6 +1776,7 @@ class SchedulerEngine:
         webhook_eval=None,
         want_scores: bool = False,
         follower_index=None,
+        dirty_rows=None,
     ) -> list[ScheduleResult]:
         """``want_scores`` additionally decodes per-cluster score dicts
         (only webhook select plugins consume them).  Scores ride the
@@ -1707,7 +1785,15 @@ class SchedulerEngine:
 
         ``follower_index`` (an :class:`ops.follower.FollowerIndex`)
         applies follower-scheduling unions over the returned rows
-        incrementally, driven by this tick's changed-row set."""
+        incrementally, driven by this tick's changed-row set.
+
+        ``dirty_rows`` (GLOBAL row indices) is the delta-featurization
+        hint: callers that know exactly which rows changed since their
+        previous schedule() call over this unit list (the streaming
+        scheduler's event log) pass them so the featurizer's
+        identity/signature walk is O(changed), not O(world).  Rows
+        outside the hint MUST be the identical unit objects of that
+        previous call — the contract is the caller's to keep."""
         if not units:
             self.last_changed = []
             return []
@@ -1721,6 +1807,7 @@ class SchedulerEngine:
             upload0 = dict(self.upload_bytes)
             drift0 = dict(self.drift_stats)
             narrow0 = dict(self.narrow_stats)
+            feat0 = dict(self.featurize_rows)
             # Arm the flight recorder for this tick: record sites (the
             # fetch/decode helpers) consume _tick_rec; ticks riding the
             # noop/skip fast paths record nothing and the previous
@@ -1748,6 +1835,7 @@ class SchedulerEngine:
                     results = self._schedule_impl(
                         units, clusters, view=view, webhook_eval=webhook_eval,
                         want_scores=want_scores, follower_index=follower_index,
+                        dirty_rows=dirty_rows,
                     )
             finally:
                 if rec is not None:
@@ -1756,7 +1844,7 @@ class SchedulerEngine:
             wall = time.perf_counter() - t_start
             self._emit_tick_metrics(
                 len(units), wall, cache0, fetch0,
-                bytes0, overflow0, upload0, drift0, narrow0,
+                bytes0, overflow0, upload0, drift0, narrow0, feat0,
             )
             if self.post_tick is not None:
                 # Durable-snapshot hook (runtime/snapshot.py): runs
@@ -1785,7 +1873,7 @@ class SchedulerEngine:
         self, n_units: int, wall: float, cache0: dict, fetch0: dict,
         bytes0: int = 0, overflow0: int = 0,
         upload0: Optional[dict] = None, drift0: Optional[dict] = None,
-        narrow0: Optional[dict] = None,
+        narrow0: Optional[dict] = None, feat0: Optional[dict] = None,
     ) -> None:
         """Per-tick telemetry: stage-latency histograms, cache/fetch path
         counters (as deltas of the raw dict stats over this call), true
@@ -1819,11 +1907,16 @@ class SchedulerEngine:
                 m.counter("engine_upload_bytes_total", delta, plane=plane)
         for kind in (
             "skip", "wcheck", "wcheck_changed", "recompute", "resolve",
-            "resolve_fallback",
+            "resolve_fallback", "replan", "replan_fallback",
+            "score_only", "score_only_fallback",
         ):
             delta = self.drift_stats[kind] - (drift0 or {}).get(kind, 0)
             if delta:
                 m.counter("engine_drift_rows_total", delta, kind=kind)
+        for path, value in self.featurize_rows.items():
+            delta = value - (feat0 or {}).get(path, 0)
+            if delta:
+                m.counter("engine_featurize_rows_total", delta, path=path)
         for key, path in (("rows", "narrow"), ("fallback", "fallback")):
             delta = self.narrow_stats[key] - (narrow0 or {}).get(key, 0)
             if delta:
@@ -2037,6 +2130,7 @@ class SchedulerEngine:
             if tuple(cs["sel"].shape) != (b_pad, c_bucket):
                 continue
             inputs, fmt = self._featurize_full(chunk, clusters, view, vocab)
+            self.featurize_rows["full"] += len(chunk)
             if fmt != cs["fmt"]:
                 continue
             host_bytes = sum(
@@ -2091,6 +2185,10 @@ class SchedulerEngine:
                 else jax.device_put(per_object)
             )
             entry.padded_shape = shape
+            entry.restored = True
+            # No tie-break plane build here: a fresh resume must stay
+            # ZERO dispatches (the no-op replay guarantee); a stale
+            # resume's first drift builds it lazily (_tiebreak_plane).
             grid = self._grid_sharding
 
             def put(arr, dtype):
@@ -2139,6 +2237,7 @@ class SchedulerEngine:
         webhook_eval=None,
         want_scores: bool = False,
         follower_index=None,
+        dirty_rows=None,
     ) -> list[ScheduleResult]:
         units_arg = units
         units = list(units)
@@ -2236,14 +2335,25 @@ class SchedulerEngine:
             if webhook_eval is None
             else None
         )
+        dirty_sorted = (
+            np.asarray(sorted(dirty_rows), dtype=np.int64)
+            if dirty_rows is not None
+            else None
+        )
         for chunk_idx, start in enumerate(range(0, len(units), eff_chunk)):
             chunk = units[start : start + eff_chunk]
+            dirty_chunk = None
+            if dirty_sorted is not None:
+                lo = np.searchsorted(dirty_sorted, start)
+                hi = np.searchsorted(dirty_sorted, start + len(chunk))
+                dirty_chunk = (dirty_sorted[lo:hi] - start).tolist()
             t0 = time.perf_counter()
             with trace.span(
                 "engine.featurize", chunk=chunk_idx, rows=len(chunk)
             ) as f_span:
                 inputs, status, entry, fmt = self._featurize_chunk(
-                    chunk_idx, chunk, clusters, view, webhook_eval, vocab
+                    chunk_idx, chunk, clusters, view, webhook_eval, vocab,
+                    dirty=dirty_chunk,
                 )
                 f_span.set(status=status, fmt=fmt)
             patch_info = None
@@ -3091,7 +3201,15 @@ class SchedulerEngine:
             if cols.size == 0:
                 info = {"empty": True}
             elif cols.size <= max(8, c // 4):
-                nb = _pow2_bucket(cols.size, 8, 1 << 30)
+                # Delta-axis bucket: EXACT 1 for the single-column case
+                # (the dominant live drift — one member's capacity
+                # moved), pow2 floored at 8 otherwise.  The gate's
+                # rank-count refinement and the resolve's entrant loop
+                # are O(D) fused [rows, C] passes over the PADDED delta
+                # axis, so an 8-slot pad on a 1-column drift was 8x the
+                # compare work for nothing (prewarm covers both the
+                # 1- and 8-slot program shapes).
+                nb = 1 if cols.size == 1 else _pow2_bucket(cols.size, 8, 1 << 30)
                 # Padded slots carry an out-of-range index: gathers are
                 # clamped-and-masked, the score write-back drops them.
                 didx = np.full(nb, 1 << 30, np.int32)
@@ -3121,12 +3239,18 @@ class SchedulerEngine:
     def _gate_program(self, fmt: str):
         """Jitted drift gate per format (jax re-traces per shape; the
         gate is a cheap filter-slice program, so the trace cost is
-        negligible next to the tick programs it replaces)."""
+        negligible next to the tick programs it replaces).  The stored
+        score plane is DONATED: the gate's changed-column refresh then
+        scatters in place instead of copying the whole [B, C] plane
+        (~84 MB per c5 chunk — measured as half the gate's device
+        time); the engine swaps the refreshed plane into prev_out right
+        after the mask read, so the donated buffer is dead by design."""
         fn = self._gate_programs.get(fmt)
         if fn is not None:
             return fn
         if fmt == "compact":
             cur_absent = Cmp.CUR_ABSENT
+            donate = (3,) if self.donate else ()
 
             def impl(per_object, tables, prev_feas, prev_scores, ao, uo,
                      an, un, didx, dvalid, dcpu, fin_idx):
@@ -3147,11 +3271,13 @@ class SchedulerEngine:
                         rep, rep, rep, rep, rep, rep, rep, rep,
                     ),
                     out_shardings=(rep, grid),
+                    donate_argnums=donate,
                 )
             else:
-                fn = jax.jit(impl)
+                fn = jax.jit(impl, donate_argnums=donate)
         else:
             impl = drift_gate_dense
+            donate = (2,) if self.donate else ()
             if self._grid_sharding is not None:
                 rep = self._replicated
                 grid = self._grid_sharding
@@ -3163,22 +3289,31 @@ class SchedulerEngine:
                         rep, rep, rep, rep, rep, rep, rep, rep,
                     ),
                     out_shardings=(rep, grid),
+                    donate_argnums=donate,
                 )
             else:
-                fn = jax.jit(impl)
+                fn = jax.jit(impl, donate_argnums=donate)
         fn = self._aot.wrap(f"gate:{fmt}", fn)
         fn = self._obs_wrap("gate", fn)
         self._gate_programs[fmt] = fn
         return fn
 
-    def _wcheck_program(self):
-        fn = self._wcheck_program_cache.get("wcheck")
+    def _wcheck_program(self, i32: bool = False):
+        key = ("wcheck", i32)
+        fn = self._wcheck_program_cache.get(key)
         if fn is None:
+            dtype = jnp.int32 if i32 else jnp.int64
+
+            def impl(prev_feas, rows_idx, ao, vo, an, vn, _d=dtype):
+                return drift_wcheck(
+                    prev_feas, rows_idx, ao, vo, an, vn, compute_dtype=_d
+                )
+
             if self._grid_sharding is not None:
                 rep = self._replicated
                 cl = self._cluster_shardings
                 fn = jax.jit(
-                    drift_wcheck,
+                    impl,
                     in_shardings=(
                         self._grid_sharding, rep,
                         cl["cpu_alloc"], cl["cpu_avail"],
@@ -3187,11 +3322,29 @@ class SchedulerEngine:
                     out_shardings=rep,
                 )
             else:
-                fn = jax.jit(drift_wcheck)
-            fn = self._aot.wrap("wcheck", fn)
+                fn = jax.jit(impl)
+            fn = self._aot.wrap(f"wcheck:{'i32' if i32 else 'i64'}", fn)
             fn = self._obs_wrap("wcheck", fn)
-            self._wcheck_program_cache["wcheck"] = fn
+            self._wcheck_program_cache[key] = fn
         return fn
+
+    def _wcheck_i32_ok(self, old_view, view, c_bucket: int) -> bool:
+        """Host range guard for the i32 weight-check demotion: the worst
+        intermediate in ops.weights.dynamic_weights is
+        ``2*max_cpu*(SUPPLY_LIMIT_NUM + C)`` (the x1.4 supply-limit
+        round over the allocatable sum), so i32 is exact iff that stays
+        under 2**31 for BOTH cpu plane generations."""
+        if not self.phase1_i32:
+            return False
+        from kubeadmiral_tpu.ops.weights import SUPPLY_LIMIT_NUM
+
+        mx = 0
+        for v in (old_view, view):
+            for plane in (v.cpu_alloc, v.cpu_avail):
+                arr = np.asarray(plane)
+                if arr.size:
+                    mx = max(mx, int(np.abs(arr).max()))
+        return 2 * mx * (SUPPLY_LIMIT_NUM + c_bucket) < 2**31
 
     def _fin_rows(self, entry, b_pad: int) -> np.ndarray:
         """The chunk's finite-maxClusters row indices, padded with
@@ -3210,13 +3363,18 @@ class SchedulerEngine:
         idx[: fin.size] = fin
         return idx
 
-    def _repair_stale_inputs(self, entry, fmt: str, c_bucket: int) -> None:
+    def _repair_stale_inputs(
+        self, entry, fmt: str, c_bucket: int, vocab=None
+    ) -> None:
         """Scatter just the stale rows' host inputs into the cached
         device per-object tensors (width-aligned to the cached padded
         shape).  Row-sliced, never a whole-chunk pad, and scattered in
         FIXED 128-row groups — one prewarmable patch-program shape, so
         neither a drift tick nor a churn tick can stall on a scatter
-        trace whatever the churned-row count."""
+        trace whatever the churned-row count.  The precomputed
+        tie-break plane rides the same groups (its FNV rows recompute
+        on device from the patched key bytes), so churn never forces a
+        whole-chunk rescan before the next drift."""
         stale = entry.stale_rows
         if not stale or entry.device_per_object is None:
             return
@@ -3240,6 +3398,18 @@ class SchedulerEngine:
         dst_all = np.full(idx.shape[0], b_pad, np.int32)  # pad scatters drop
         dst_all[:n] = stale
         dev = entry.device_per_object
+        tb = entry.tiebreak_dev
+        tb_ok = (
+            fmt == "compact"
+            and vocab is not None
+            and tb is not None
+            and tb.shape == (b_pad, c_bucket)
+        )
+        state_dev = (
+            self._tables_device(vocab, c_bucket)["name_hash_state"]
+            if tb_ok
+            else None
+        )
         for g in range(0, idx.shape[0], 128):
             rows = {
                 name: np.ascontiguousarray(arr[g : g + 128])
@@ -3248,8 +3418,16 @@ class SchedulerEngine:
             self.upload_bytes["object"] += sum(
                 a.nbytes for a in rows.values()
             )
-            dev = patch(dev, rows, dst_all[g : g + 128])
+            dst = dst_all[g : g + 128]
+            dev = patch(dev, rows, dst)
+            if tb_ok:
+                self.dispatches_total += 1
+                tb = self._tb_program("patch")(
+                    tb, rows["key_bytes"], rows["key_len"], state_dev, dst
+                )
         entry.device_per_object = dev
+        if fmt == "compact":
+            entry.tiebreak_dev = tb if tb_ok else None
         entry.stale_rows = None
 
     def _dispatch_drift_gate(
@@ -3269,7 +3447,7 @@ class SchedulerEngine:
             # forced into the recompute set at the next drift — at
             # bench churn rates that was ~30% of all drift recompute
             # work, none of it reflecting a real decision change.
-            self._repair_stale_inputs(entry, fmt, c_bucket)
+            self._repair_stale_inputs(entry, fmt, c_bucket, vocab=vocab)
         self.dispatches_total += 1
         slices = (
             info["alloc_old_d"], info["used_old_d"],
@@ -3294,6 +3472,82 @@ class SchedulerEngine:
             info["didx"], info["dvalid"], info["dcpu"], fin_idx,
         )
 
+    def _tb_program(self, kind: str):
+        """Jitted tie-break plane builders (compact format only): "full"
+        computes a chunk's whole [B, C] plane from its key bytes (one
+        FNV byte scan, enqueued asynchronously at per-object upload
+        time — cold/miss paths, where it amortizes); "patch" recomputes
+        fixed 128-row groups and scatters them in place (donated), so
+        churned rows keep the plane fresh without a whole-chunk rescan.
+        The drift survivor kernels then pass the plane into
+        expand_compact and never pay the scan on the drift floor."""
+        fn = self._tb_program_cache.get(kind)
+        if fn is not None:
+            return fn
+        if kind == "full":
+
+            def impl(key_bytes, key_len, state):
+                return fnv_tiebreak_plane(key_bytes, key_len, state)
+
+            if self._grid_sharding is not None:
+                po = self._per_object_shardings_compact
+                fn = jax.jit(
+                    impl,
+                    in_shardings=(
+                        po["key_bytes"], po["key_len"],
+                        self._table_shardings["name_hash_state"],
+                    ),
+                    out_shardings=self._grid_sharding,
+                )
+            else:
+                fn = jax.jit(impl)
+        else:
+
+            def impl(plane, key_bytes_rows, key_len_rows, state, dst):
+                rows_tb = fnv_tiebreak_plane(
+                    key_bytes_rows, key_len_rows, state
+                )
+                return plane.at[dst].set(rows_tb, mode="drop")
+
+            donate = (0,) if self.donate else ()
+            if self._grid_sharding is not None:
+                rep = self._replicated
+                fn = jax.jit(
+                    impl,
+                    in_shardings=(
+                        self._grid_sharding, rep, rep,
+                        self._table_shardings["name_hash_state"], rep,
+                    ),
+                    out_shardings=self._grid_sharding,
+                    donate_argnums=donate,
+                )
+            else:
+                fn = jax.jit(impl, donate_argnums=donate)
+        fn = self._aot.wrap(f"tiebreak:{kind}", fn)
+        fn = self._obs_wrap("tiebreak", fn)
+        self._tb_program_cache[kind] = fn
+        return fn
+
+    def _tiebreak_plane(self, entry, fmt: str, vocab, c_bucket: int):
+        """The chunk's device-resident tie-break plane (compact format),
+        computed lazily when the upload-time build was skipped or the
+        padded shape moved."""
+        if fmt != "compact" or entry.device_per_object is None:
+            return None
+        b_pad = entry.padded_shape[0]
+        tb = entry.tiebreak_dev
+        if tb is not None and tb.shape == (b_pad, c_bucket):
+            return tb
+        tables = self._tables_device(vocab, c_bucket)
+        self.dispatches_total += 1
+        tb = self._tb_program("full")(
+            entry.device_per_object["key_bytes"],
+            entry.device_per_object["key_len"],
+            tables["name_hash_state"],
+        )
+        entry.tiebreak_dev = tb
+        return tb
+
     def _resolve_program(self, fmt: str, m: int):
         """Jitted sort-free drift resolve per (format, M): gather the
         survivor rows' cached device inputs plus the stored prev planes,
@@ -3317,12 +3571,13 @@ class SchedulerEngine:
         grid = self._grid_sharding
 
         def impl(device_in, idx, prev_feas, prev_scores, prev_reasons,
-                 ao, uo, an, un, didx, dvalid, _fmt=fmt, _m=m):
+                 ao, uo, an, un, didx, dvalid, tb=None, _fmt=fmt, _m=m):
             rows = {name: getattr(device_in, name)[idx] for name in per_object}
             sub = device_in._replace(**rows)
             feas_r = prev_feas[idx]
             sco_r = prev_scores[idx]
             rsn_r = prev_reasons[idx]
+            tb_r = tb[idx] if tb is not None else None
             if replicated is not None:
                 sub = type(sub)(
                     *(
@@ -3334,10 +3589,27 @@ class SchedulerEngine:
                     jax.lax.with_sharding_constraint(x, replicated)
                     for x in (feas_r, sco_r, rsn_r)
                 )
-            inp = expand_compact(sub) if _fmt == "compact" else sub
+                if tb_r is not None:
+                    tb_r = jax.lax.with_sharding_constraint(tb_r, replicated)
+            inp = (
+                expand_compact(sub, tiebreak=tb_r)
+                if _fmt == "compact"
+                else sub
+            )
             out, cert = drift_resolve(
                 inp, feas_r, sco_r, rsn_r, ao, uo, an, un, didx, dvalid, _m
             )
+            # Fused wire pack (K = narrow M, stable + prewarm-known):
+            # packing inside the kernel saves re-reading the five
+            # [rows, C] output planes in a separate dispatch — at c5
+            # the standalone packs were ~3s of the drift device time.
+            k = min(_m, out.selected.shape[1])
+            wire = pack_wire(
+                out.selected, out.replicas, out.counted, out.scores,
+                out.reasons, k,
+            )
+            if replicated is not None:
+                wire = jax.lax.with_sharding_constraint(wire, replicated)
             if grid is not None:
                 out = TickOutputs(
                     *(
@@ -3345,42 +3617,60 @@ class SchedulerEngine:
                         for x in out
                     )
                 )
-            return out, cert
+            return out, cert, wire
 
         fn = self._aot.wrap(f"resolve:{fmt}:m{m}", jax.jit(impl))
         fn = self._obs_wrap("resolve", fn)
         self._resolve_programs[key] = fn
         return fn
 
+    # Prewarm-known survivor row-group sizes (resolve / replan /
+    # score-only / wcheck): greedy 256s then a 128/64 tail.  Fixed
+    # sizes bound the padding waste (at c5 the ~130-survivors-per-chunk
+    # case padded a 1024-row ladder rung — 8x the [rows, C] math)
+    # without free-pow2 trace risk mid-drift.
+    @staticmethod
+    def _survivor_groups(rows: list) -> list[tuple[list, int]]:
+        out = []
+        i, n = 0, len(rows)
+        while i < n:
+            rem = n - i
+            # Greedy minimal-padding decomposition over {256, 128, 64}:
+            # e.g. 140 rows -> 128 + 64 (192 padded), never one 256.
+            size = 256 if rem > 192 else (128 if rem > 64 else 64)
+            out.append((rows[i : i + size], size))
+            i += size
+        return out
+
     def _dispatch_drift_resolve(
         self, pi: int, entry, n: int, fmt: str, b_pad: int, pack_k: int,
         info: dict, mask: np.ndarray, rec: set, forced: set, cluster_dev,
         vocab, c_bucket: int,
-    ) -> Optional[dict]:
+    ) -> list[dict]:
         """Dispatch the sort-free resolve for one gated chunk's eligible
         survivors (recompute rows without a fit flip, prev planes
-        intact), or None when the chunk cannot take it — narrow
-        disabled, dense fetch format, wide delta, or no eligible rows.
-        The program (and its wire pack) goes into the device queue
+        intact), or [] when the chunk cannot take it — narrow disabled,
+        dense fetch format, wide delta, or no eligible rows.  The
+        programs (and their wire packs) go into the device queue
         immediately, overlapping later chunks' gate compute; results are
         drained batched by _drain_drift_resolve."""
         if not self.drift_resolve or self.fetch_format != "packed":
-            return None
+            return []
         if (
             entry.prev_reasons is None
             or entry.device_per_object is None
             or entry.prev_reasons.shape != entry.prev_feas.shape
         ):
-            return None
+            return []
         if info["didx"].shape[0] > DRIFT_REFINE_MAX_COLS:
-            return None
+            return []
         m = self._narrow_m(entry.inputs, c_bucket)
         if m is None:
-            return None
+            return []
         fitflip = set(np.nonzero(mask & DRIFT_FITFLIP)[0].tolist())
         rows = sorted(rec - fitflip - forced)
         if not rows:
-            return None
+            return []
         # Resolve rows are all finite-K (kinf rows never reach the
         # refined recompute set), so the narrow candidate width M —
         # a pow2 at or above the finite maxClusters bound by
@@ -3389,49 +3679,185 @@ class SchedulerEngine:
         # known to prewarm, so the wire pack program never traces
         # mid-drift.
         pack_k = min(m, c_bucket)
-        # Row-bucket ladder {64, 256, b_pad/4, b_pad}: the resolve
-        # program traces per idx shape, so the prewarm ladder must
-        # cover every shape a live drift can hit, and the resolve's
-        # per-row [kb, C] scans must not pay 4x padding waste for the
-        # common few-hundred-survivors chunk.
-        cap = max(64, b_pad // 4)
-        kb = b_pad
-        for rung in (64, 256, cap):
-            if len(rows) <= rung:
-                kb = rung
-                break
-        idx = np.full(kb, b_pad, np.int32)
-        idx[: len(rows)] = rows
         if fmt == "compact":
             device_in = CompactInputs(
                 **entry.device_per_object,
                 **self._tables_device(vocab, c_bucket),
                 **cluster_dev,
             )
+            tb = self._tiebreak_plane(entry, fmt, vocab, c_bucket)
         else:
             device_in = TickInputs(**entry.device_per_object, **cluster_dev)
-        self.dispatches_total += 1
-        out, cert = self._resolve_program(fmt, m)(
-            device_in, idx, entry.prev_feas, entry.prev_out[3],
-            entry.prev_reasons,
-            info["alloc_old_d"], info["used_old_d"],
-            info["alloc_new_d"], info["used_new_d"],
-            info["didx"], info["dvalid"],
-        )
-        # The packed wire for every resolve slot ships now too
-        # (uncertified slots are simply never decoded), so the whole
-        # survivor settle overlaps the remaining gates in the device
-        # queue.  Separate (cheap, per-K) pack program — see
-        # _resolve_program on why the pack is not fused.
-        self.dispatches_total += 1
-        wire = self._pack_program("gather", pack_k)(
-            out.selected, out.replicas, out.counted, out.scores,
-            out.reasons, np.arange(kb, dtype=np.int32),
-        )
-        return {
-            "pi": pi, "entry": entry, "rows": rows, "out": out,
-            "cert": cert, "wire": wire, "pack_k": pack_k, "fmt": fmt,
+            tb = None
+        jobs: list[dict] = []
+        prog = self._resolve_program(fmt, m)
+        for seg, kb in self._survivor_groups(rows):
+            idx = np.full(kb, b_pad, np.int32)
+            idx[: len(seg)] = seg
+            self.dispatches_total += 1
+            args = (
+                device_in, idx, entry.prev_feas, entry.prev_out[3],
+                entry.prev_reasons,
+                info["alloc_old_d"], info["used_old_d"],
+                info["alloc_new_d"], info["used_new_d"],
+                info["didx"], info["dvalid"],
+            )
+            if tb is not None:
+                args = args + (tb,)
+            # The packed wire for every resolve slot ships fused inside
+            # the program (uncertified slots are simply never decoded),
+            # so the whole survivor settle overlaps the remaining gates
+            # in the device queue.
+            out, cert, wire = prog(*args)
+            jobs.append({
+                "pi": pi, "entry": entry, "rows": seg, "out": out,
+                "cert": cert, "wire": wire, "pack_k": pack_k, "fmt": fmt,
+                "kind": "resolve",
+            })
+        return jobs
+
+    def _replan_program(self, fmt: str, m: int, scored: bool):
+        """Jitted fit-flip survivor solve per (format, M, path): gather
+        the survivor rows' cached device inputs plus the stored reason
+        plane, expand (compact — with the precomputed tie-break plane,
+        never the FNV scan) and run ops.pipeline.drift_replan
+        (``scored=False``: sort-free selection-known replan for kinf
+        rows) or drift_scoreonly (``scored=True``: stored-plane phase 1
+        + the narrow select/planner for finite-K rows).  Mesh handling
+        mirrors _resolve_program: the gathered sub-problem replicates,
+        outputs constrain back to the grid for the in-place repair."""
+        key = (fmt, m, scored)
+        cache = self._scoreonly_programs if scored else self._replan_programs
+        fn = cache.get(key)
+        if fn is not None:
+            return fn
+        per_object = tuple(self._per_object_fields(fmt))
+        replicated = self._replicated
+        grid = self._grid_sharding
+        i32_keys = self.phase1_i32
+
+        def impl(device_in, idx, prev_reasons, prev_scores, tb=None,
+                 _fmt=fmt, _m=m, _scored=scored):
+            rows = {name: getattr(device_in, name)[idx] for name in per_object}
+            sub = device_in._replace(**rows)
+            rsn_r = prev_reasons[idx]
+            sco_r = prev_scores[idx]
+            tb_r = tb[idx] if tb is not None else None
+            if replicated is not None:
+                sub = type(sub)(
+                    *(
+                        jax.lax.with_sharding_constraint(x, replicated)
+                        for x in sub
+                    )
+                )
+                rsn_r = jax.lax.with_sharding_constraint(rsn_r, replicated)
+                sco_r = jax.lax.with_sharding_constraint(sco_r, replicated)
+                if tb_r is not None:
+                    tb_r = jax.lax.with_sharding_constraint(tb_r, replicated)
+            inp = (
+                expand_compact(sub, tiebreak=tb_r)
+                if _fmt == "compact"
+                else sub
+            )
+            if _scored:
+                out, cert = drift_scoreonly(
+                    inp, rsn_r, _m, i32_keys=i32_keys
+                )
+            else:
+                out, cert = drift_replan(inp, rsn_r, sco_r, _m)
+            # Fused wire pack — see _resolve_program.
+            k = min(_m, out.selected.shape[1])
+            wire = pack_wire(
+                out.selected, out.replicas, out.counted, out.scores,
+                out.reasons, k,
+            )
+            if replicated is not None:
+                wire = jax.lax.with_sharding_constraint(wire, replicated)
+            if grid is not None:
+                out = TickOutputs(
+                    *(
+                        jax.lax.with_sharding_constraint(x, grid)
+                        for x in out
+                    )
+                )
+            return out, cert, wire
+
+        name = "scoreonly" if scored else "replan"
+        fn = self._aot.wrap(f"{name}:{fmt}:m{m}", jax.jit(impl))
+        fn = self._obs_wrap(name, fn)
+        cache[key] = fn
+        return fn
+
+    def _dispatch_drift_replans(
+        self, pi: int, entry, n: int, fmt: str, b_pad: int,
+        mask: np.ndarray, rec: set, forced: set, cluster_dev, vocab,
+        c_bucket: int,
+    ) -> list[dict]:
+        """Dispatch the fit-flip survivor solves for one gated chunk:
+        host-kinf rows (maxClusters unlimited or negative — the top-K
+        cut provably cannot engage) through the sort-free replan,
+        finite-maxClusters rows through the score-only narrow solve, in
+        fixed 256-row groups.  Returns the dispatched jobs ([] when the
+        chunk cannot take the path — replan disabled, dense fetch
+        format, narrow disabled, or no eligible rows); cert failures
+        stay in the recompute set and take the slab path."""
+        if not self.replan or self.fetch_format != "packed":
+            return []
+        if (
+            entry.prev_reasons is None
+            or entry.device_per_object is None
+            or entry.prev_feas is None
+            or entry.prev_reasons.shape != entry.prev_feas.shape
+        ):
+            return []
+        m = self._narrow_m(entry.inputs, c_bucket)
+        if m is None:
+            return []
+        fitflip = set(np.nonzero(mask & DRIFT_FITFLIP)[0].tolist())
+        rows = sorted((rec & fitflip) - forced)
+        if not rows:
+            return []
+        mc = np.asarray(entry.inputs.max_clusters)
+        kinf_host = (mc == INT32_INF) | (mc < 0)
+        by_path = {
+            False: [r for r in rows if kinf_host[r]],
+            True: [r for r in rows if not kinf_host[r]],
         }
+        # Same wire-pack K policy as the resolve: narrow M is stable
+        # across drift ticks and prewarm-known, unlike the adaptive
+        # hint (K-overflow rows ride the existing bit-packed re-fetch).
+        pack_k = min(m, c_bucket)
+        if fmt == "compact":
+            device_in = CompactInputs(
+                **entry.device_per_object,
+                **self._tables_device(vocab, c_bucket),
+                **cluster_dev,
+            )
+            tb = self._tiebreak_plane(entry, fmt, vocab, c_bucket)
+        else:
+            device_in = TickInputs(**entry.device_per_object, **cluster_dev)
+            tb = None
+        jobs: list[dict] = []
+        for scored, path_rows in by_path.items():
+            if not path_rows:
+                continue
+            prog = self._replan_program(fmt, m, scored)
+            for seg, g in self._survivor_groups(path_rows):
+                idx = np.full(g, b_pad, np.int32)
+                idx[: len(seg)] = seg
+                self.dispatches_total += 1
+                args = (device_in, idx, entry.prev_reasons,
+                        entry.prev_out[3])
+                if tb is not None:
+                    args = args + (tb,)
+                out, cert, wire = prog(*args)
+                jobs.append({
+                    "pi": pi, "entry": entry, "rows": seg, "out": out,
+                    "cert": cert, "wire": wire, "pack_k": pack_k,
+                    "fmt": fmt,
+                    "kind": "score_only" if scored else "replan",
+                })
+        return jobs
 
     def _repair_entry_rows(self, entry, out, src_pos, dst_rows) -> bool:
         """Scatter resolve-output rows back into the chunk's cached prev
@@ -3504,11 +3930,12 @@ class SchedulerEngine:
             entry, rows, out, k = (
                 job["entry"], job["rows"], job["out"], job["pack_k"]
             )
+            kind = job.get("kind", "resolve")
             nr = len(rows)
             cert = cert_np[i][:nr]
             ok_pos = np.nonzero(cert != 0)[0]
-            self.drift_stats["resolve"] += int(ok_pos.size)
-            self.drift_stats["resolve_fallback"] += int(nr - ok_pos.size)
+            self.drift_stats[kind] += int(ok_pos.size)
+            self.drift_stats[kind + "_fallback"] += int(nr - ok_pos.size)
             handled = {rows[p] for p in ok_pos.tolist()}
             plans[job["pi"]][3] -= handled
             if not ok_pos.size:
@@ -3536,7 +3963,7 @@ class SchedulerEngine:
             entry.prev_results = merged
             self._record_packed(
                 entry, res_rows, results, packed, over_pos, over_dense,
-                view, program=f"{job['fmt']}:resolve",
+                view, program=f"{job['fmt']}:{kind}",
             )
             if not self._repair_entry_rows(entry, out, ok_pos, res_rows):
                 entry.stale_out_rows = sorted(
@@ -3568,7 +3995,6 @@ class SchedulerEngine:
         wcheck_jobs: list[tuple] = []  # (plan index, wcheck rows, dev)
         plan_resolved: dict[int, list] = {}  # plan index -> merged rows
         newc = self._cluster_planes_device(view, c_bucket)
-        wfn = self._wcheck_program()
         for i, (slot, entry, n, devs, fmt, b_pad, pack_k, info) in enumerate(
             items
         ):
@@ -3604,36 +4030,48 @@ class SchedulerEngine:
             plans.append([slot, entry, n, rec, fmt, b_pad, pack_k])
             if wrows.size:
                 # Dispatch the weight check NOW; its result is read in
-                # the batched drain below.  Row shapes come from the
-                # same {64, b_pad/4, b_pad} ladder as the resolve/gate
-                # programs (prewarmed) — a free pow2 bucket would trace
-                # a fresh wcheck program mid-drift.
+                # the batched drain below.  Rows go in FIXED 64- or
+                # 256-row groups (one prewarmed program shape each, no
+                # pow2-ladder padding waste — at c5 the ~270-rows-per-
+                # chunk case padded a 1024-row rung, 4x the [rows, C]
+                # weight math for nothing), with the i32 arithmetic
+                # demotion behind the host range guard.
                 self.drift_stats["wcheck"] += int(wrows.size)
-                cap = max(64, b_pad // 4)
-                kb = (
-                    64 if wrows.size <= 64
-                    else (cap if wrows.size <= cap else b_pad)
-                )
-                ridx = np.zeros(kb, np.int32)
-                ridx[: wrows.size] = wrows
+                w_i32 = self._wcheck_i32_ok(entry.prev_view, view, c_bucket)
+                wfn = self._wcheck_program(w_i32)
                 oldc = self._wcheck_cpu_device(entry.prev_view, c_bucket)
-                self.dispatches_total += 1
-                wcheck_jobs.append(
-                    (len(plans) - 1, wrows, wfn(
-                        entry.prev_feas, ridx,
-                        oldc["cpu_alloc"], oldc["cpu_avail"],
-                        newc["cpu_alloc"], newc["cpu_avail"],
-                    ))
-                )
+                for seg_list, kb in self._survivor_groups(
+                    wrows.tolist()
+                ):
+                    seg = np.asarray(seg_list, dtype=wrows.dtype)
+                    ridx = np.zeros(kb, np.int32)
+                    ridx[: seg.size] = seg
+                    self.dispatches_total += 1
+                    wcheck_jobs.append(
+                        (len(plans) - 1, seg, wfn(
+                            entry.prev_feas, ridx,
+                            oldc["cpu_alloc"], oldc["cpu_avail"],
+                            newc["cpu_alloc"], newc["cpu_avail"],
+                        ))
+                    )
             # Sort-free resolve of the eligible survivors (recompute
             # rows without a fit flip): dispatched immediately, so the
-            # resolve program overlaps the remaining gates' compute.
-            job = self._dispatch_drift_resolve(
-                len(plans) - 1, entry, n, fmt, b_pad, pack_k, info,
-                mask, rec, forced, newc, vocab, c_bucket,
+            # resolve programs overlap the remaining gates' compute.
+            resolve_jobs.extend(
+                self._dispatch_drift_resolve(
+                    len(plans) - 1, entry, n, fmt, b_pad, pack_k, info,
+                    mask, rec, forced, newc, vocab, c_bucket,
+                )
             )
-            if job is not None:
-                resolve_jobs.append(job)
+            # Fit-flip survivors: selection-known replan (kinf) and
+            # score-only narrow solve (finite-K) from stored planes —
+            # dispatched now too, overlapping the remaining gates.
+            resolve_jobs.extend(
+                self._dispatch_drift_replans(
+                    len(plans) - 1, entry, n, fmt, b_pad, mask, rec,
+                    forced, newc, vocab, c_bucket,
+                )
+            )
             timings["decode"] += time.perf_counter() - t0
 
         if resolve_jobs:
@@ -3792,7 +4230,7 @@ class SchedulerEngine:
                 # Scatter-repair the rows churned since the last upload:
                 # K rows over the link instead of the whole chunk, in
                 # the shape-stable 128-row patch groups.
-                self._repair_stale_inputs(entry, fmt, c_pad)
+                self._repair_stale_inputs(entry, fmt, c_pad, vocab=vocab)
             per_object = entry.device_per_object
         else:
             self.upload_bytes["object"] += sum(
@@ -3806,6 +4244,19 @@ class SchedulerEngine:
                 entry.device_per_object = per_object
                 entry.padded_shape = shape
                 entry.stale_rows = None
+                entry.tiebreak_dev = None
+                if fmt == "compact" and vocab is not None:
+                    # Precompute the tie-break plane off the fresh
+                    # upload (async; amortizes into the cold/miss path
+                    # so drift survivor kernels skip the FNV scan).
+                    self.dispatches_total += 1
+                    entry.tiebreak_dev = self._tb_program("full")(
+                        per_object["key_bytes"],
+                        per_object["key_len"],
+                        self._tables_device(vocab, c_pad)[
+                            "name_hash_state"
+                        ],
+                    )
         if fmt == "compact":
             return CompactInputs(
                 **per_object,
@@ -4798,27 +5249,39 @@ class SchedulerEngine:
                 name: np.asarray(getattr(padded, name))
                 for name in Cmp.PER_OBJECT_FIELDS
             }
-            didx8 = np.full(8, 1 << 30, np.int32)
-            dflag8 = np.zeros(8, bool)
-            slice8 = np.zeros(
-                (8,) + np.asarray(padded.alloc).shape[1:],
-                np.asarray(padded.alloc).dtype,
-            )
+            # Delta-axis shapes a live drift can produce: 1 (the
+            # dominant single-member capacity drift — exact-size, no
+            # 8-slot padding waste in the gate/resolve D loops) and the
+            # 8-slot pow2 floor for multi-column drifts.
+            delta_shapes = {}
+            for nb in (1, 8):
+                delta_shapes[nb] = (
+                    np.full(nb, 1 << 30, np.int32),
+                    np.zeros(nb, bool),
+                    np.zeros(
+                        (nb,) + np.asarray(padded.alloc).shape[1:],
+                        np.asarray(padded.alloc).dtype,
+                    ),
+                )
+            didx8, dflag8, slice8 = delta_shapes[8]
             # Both rungs of the gate's fin-row ladder (see
-            # _fin_rows): a drift tick must never stall on a
-            # gate compile, whatever the finite-K row fraction.
+            # _fin_rows), at both delta shapes: a drift tick must
+            # never stall on a gate compile, whatever the finite-K
+            # row fraction or changed-column count.
             for fin_n in sorted({max(64, b_pad // 4), b_pad}):
                 fin_pad = np.full(fin_n, 1 << 30, np.int32)
-                jax.block_until_ready(
-                    self._gate_program("compact")(
-                        per_object,
-                        Cmp.pad_tables(vocab.tables(), c_bucket),
-                        np.zeros(shape, np.int8),
-                        np.zeros(shape, np.int32),
-                        slice8, slice8, slice8, slice8,
-                        didx8, dflag8, dflag8, fin_pad,
+                for nb in (1, 8):
+                    didx, dflag, dslice = delta_shapes[nb]
+                    jax.block_until_ready(
+                        self._gate_program("compact")(
+                            per_object,
+                            Cmp.pad_tables(vocab.tables(), c_bucket),
+                            np.zeros(shape, np.int8),
+                            np.zeros(shape, np.int32),
+                            dslice, dslice, dslice, dslice,
+                            didx, dflag, dflag, fin_pad,
+                        )
                     )
-                )
             # The 128-row input-patch group (stale-row repair):
             # every churn/drift scatter-repair uses exactly this
             # shape (see _repair_stale_inputs).
@@ -4835,11 +5298,29 @@ class SchedulerEngine:
                     np.full(128, b_pad, np.int32),
                 )["total"]
             )
+            # The precomputed tie-break plane (full build + 128-row
+            # patch groups): survivor kernels consume it, uploads
+            # build it, churn repairs it — all prewarm-known.
+            tb_warm = self._tb_program("full")(
+                per_object["key_bytes"], per_object["key_len"],
+                np.asarray(padded.name_hash_state),
+            )
+            # The patch warm DONATES its plane argument — thread the
+            # returned (repaired-in-place) plane forward so the
+            # survivor-kernel warms below don't touch a dead buffer.
+            tb_warm = self._tb_program("patch")(
+                tb_warm,
+                np.ascontiguousarray(per_object["key_bytes"][:1].repeat(128, 0)),
+                np.zeros(128, np.int32),
+                np.asarray(padded.name_hash_state),
+                np.full(128, b_pad, np.int32),
+            )
+            jax.block_until_ready(tb_warm)
             if narrow_m is not None and self.drift_resolve:
                 # The sort-free drift resolve (+ its wire pack)
                 # is the FIRST capacity-drift tick's survivor
-                # path — warm its row-bucket ladder so live
-                # drifts never stall on its trace.
+                # path — warm its row-bucket ladder at both delta
+                # shapes so live drifts never stall on its trace.
                 device_in_warm = padded._replace(
                     **Cmp.pad_tables(vocab.tables(), c_bucket)
                 )
@@ -4851,39 +5332,54 @@ class SchedulerEngine:
                     if self.fetch_format == "packed"
                     else 0
                 )
-                for kb in sorted({64, 256, max(64, b_pad // 4)}):
+                for kb in (64, 128, 256):
                     ridx = np.full(kb, b_pad, np.int32)
-                    r_out, r_cert = self._resolve_program(
-                        "compact", narrow_m
-                    )(
-                        device_in_warm, ridx,
-                        np.zeros(shape, np.int8),
-                        np.zeros(shape, np.int32),
-                        np.zeros(shape, np.int32),
-                        slice8, slice8, slice8, slice8,
-                        didx8, dflag8,
-                    )
-                    jax.block_until_ready(r_cert)
-                    if pk:
-                        jax.block_until_ready(
-                            self._pack_program("gather", pk)(
-                                r_out.selected, r_out.replicas,
-                                r_out.counted, r_out.scores,
-                                r_out.reasons,
-                                np.arange(kb, dtype=np.int32),
-                            )
+                    for nb in (1, 8):
+                        didx, dflag, dslice = delta_shapes[nb]
+                        r_out, r_cert, r_wire = self._resolve_program(
+                            "compact", narrow_m
+                        )(
+                            device_in_warm, ridx,
+                            np.zeros(shape, np.int8),
+                            np.zeros(shape, np.int32),
+                            np.zeros(shape, np.int32),
+                            dslice, dslice, dslice, dslice,
+                            didx, dflag, tb_warm,
                         )
-            for wn in sorted({64, max(64, b_pad // 4), b_pad}):
-                jax.block_until_ready(
-                    self._wcheck_program()(
-                        np.zeros(shape, np.int8),
-                        np.zeros(wn, np.int32),
-                        np.asarray(padded.cpu_alloc),
-                        np.asarray(padded.cpu_avail),
-                        np.asarray(padded.cpu_alloc),
-                        np.asarray(padded.cpu_avail),
-                    )
+                        jax.block_until_ready(r_wire)
+            if narrow_m is not None and self.replan:
+                # Fit-flip survivor solves (selection-known replan +
+                # score-only narrow) run in fixed 256-row groups — one
+                # shape each per (format, M) — plus their wire pack.
+                device_in_warm = padded._replace(
+                    **Cmp.pad_tables(vocab.tables(), c_bucket)
                 )
+                for scored in (False, True):
+                    for g in (64, 128, 256):
+                        gidx = np.full(g, b_pad, np.int32)
+                        rp_out, rp_cert, rp_wire = self._replan_program(
+                            "compact", narrow_m, scored
+                        )(
+                            device_in_warm, gidx,
+                            np.zeros(shape, np.int32),
+                            np.zeros(shape, np.int32), tb_warm,
+                        )
+                        jax.block_until_ready(rp_wire)
+            # Weight-check groups in both arithmetic widths — the i32
+            # demotion is view-dependent, so a live drift may dispatch
+            # either.
+            for wn in (64, 128, 256):
+                for w_i32 in (False, True):
+                    jax.block_until_ready(
+                        self._wcheck_program(w_i32)(
+                            np.zeros(shape, np.int8),
+                            np.zeros(wn, np.int32),
+                            np.asarray(padded.cpu_alloc),
+                            np.asarray(padded.cpu_avail),
+                            np.asarray(padded.cpu_alloc),
+                            np.asarray(padded.cpu_avail),
+                        )
+                    )
             outs[b_pad] = out
             log.info("prewarmed tick program %s", shape)
         # Sub-batch write-back repair: full-chunk planes get

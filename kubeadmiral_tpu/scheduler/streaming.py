@@ -151,6 +151,12 @@ class StreamingScheduler:
         # shows one connected event -> placement-written timeline.
         self._flush_seq = 0
         self.last_flush_id = 0
+        # Delta-featurization hint plumbing: the engine tick counter as
+        # of OUR last flush — the dirty-row hint is only sound when no
+        # other caller ticked the engine in between (their world would
+        # have replaced the cached unit rows the hint promises are
+        # unchanged).
+        self._last_engine_tick: Optional[int] = None
         # Bounded recent event->placement-visible latencies (seconds).
         self.latencies: deque[float] = deque(maxlen=200_000)
 
@@ -266,6 +272,8 @@ class StreamingScheduler:
                 self._pending.clear()
                 self.metrics.store("engine_stream_slab_depth", 0)
                 had_capacity = False
+                world0 = len(self._units)
+                dirty: set[int] = set()
                 for ev in drained:
                     if ev.kind == "capacity":
                         self._clusters = list(ev.payload)
@@ -276,6 +284,7 @@ class StreamingScheduler:
                         if row is not None:
                             self._units[row] = make_placeholder(row)
                             self._free.append(row)
+                            dirty.add(row)
                         continue
                     unit = ev.payload
                     row = self._row_of.get(unit.key)
@@ -285,14 +294,32 @@ class StreamingScheduler:
                         row = self._free.pop()
                         self._row_of[unit.key] = row
                     self._units[row] = unit
+                    dirty.add(row)
                 # Fresh list: the engine's no-op gate treats the container
                 # as immutable (content-identity replays still work).
                 units = list(self._units)
                 clusters = self._clusters
+                # Every pre-grown placeholder row past the previous
+                # world length is new to the engine too.
+                if len(self._units) > world0:
+                    dirty.update(range(world0, len(self._units)))
             t_engine = self.clock()
-            results = self.engine.schedule(
-                units, clusters, follower_index=self.follower_index
+            # The event log knows EXACTLY which rows moved — hand the
+            # engine that set so its featurize identity walk is
+            # O(changed), not O(world).  Sound only when this scheduler
+            # was also the engine's previous caller (tick counter
+            # unchanged since our last flush); anything else falls back
+            # to the full walk.
+            dirty_rows = (
+                sorted(dirty)
+                if self._last_engine_tick == self.engine.tick_seq
+                else None
             )
+            results = self.engine.schedule(
+                units, clusters, follower_index=self.follower_index,
+                dirty_rows=dirty_rows,
+            )
+            self._last_engine_tick = self.engine.tick_seq
             now = self.clock()
             tick_id = getattr(self.engine, "last_tick_id", 0)
             # Correlate the flush with the engine tick it produced: the

@@ -1,0 +1,137 @@
+"""AOT + ledger coverage guard (ISSUE 10 satellite).
+
+Every jitted program the engine can dispatch must route through BOTH
+``AotStore.wrap`` (so warm-boot failover can preload it instead of
+re-tracing) and ``SchedulerEngine._obs_wrap`` (so the dispatch ledger
+attributes its device time).  A builder that skips either silently
+escapes restart failover or /debug/waterfall — the replan/score-only/
+tiebreak kernels of this PR are exactly the kind of addition that could
+slip through.
+
+Two teeth:
+
+* a SOURCE enumeration: every ``jax.jit(`` call site inside
+  ``scheduler/engine.py`` must live in a method on the expected list —
+  adding a new builder without extending this test fails it;
+* a RUNTIME check: each builder's product carries the AOT wrapper
+  inside the ledger wrapper (single-device engines; meshes construct
+  the store disabled by design and are excluded from the AOT contract).
+"""
+
+import re
+
+import pytest
+
+from kubeadmiral_tpu.scheduler import aot as aot_mod
+from kubeadmiral_tpu.scheduler import engine as engine_mod
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+# Methods (or module functions) of scheduler/engine.py that may contain
+# jax.jit call sites.  Every one is exercised by the runtime half below;
+# a NEW jit site must be added here AND covered there.
+EXPECTED_JIT_SITES = {
+    "_build_programs",       # tick/tick_compact/gathers/overflow/patch/stack
+    "_zeros_for",            # zero prev-plane builders
+    "_narrow_program",
+    "_fallback_program",
+    "_cert_repair_program",
+    "_pack_program",
+    "_gate_program",
+    "_wcheck_program",
+    "_resolve_program",
+    "_replan_program",       # replan + score-only variants
+    "_tb_program",           # tiebreak plane full/patch builders
+    "_repair_program",
+    "_prewarm_ladder",       # the transient prewarm-only repair chain seed
+}
+
+
+def test_source_enumerates_every_jit_site():
+    src = open(engine_mod.__file__).read()
+    # Walk jit call sites back to their enclosing def.
+    sites = set()
+    defs = [
+        (m.start(), m.group(1))
+        for m in re.finditer(r"\n    def (\w+)\(", src)
+    ]
+    for m in re.finditer(r"jax\.jit\(", src):
+        owner = None
+        for pos, name in defs:
+            if pos < m.start():
+                owner = name
+            else:
+                break
+        assert owner is not None, "jax.jit outside any method"
+        sites.add(owner)
+    assert sites == EXPECTED_JIT_SITES, (
+        "engine jit call sites changed; update EXPECTED_JIT_SITES and "
+        "extend the runtime coverage below",
+        sites ^ EXPECTED_JIT_SITES,
+    )
+
+
+def _is_aot_wrapped(fn) -> bool:
+    return isinstance(fn, aot_mod._AotProgram)
+
+
+def _obs_target(fn):
+    """The fn captured by an _obs_wrap closure (None if not obs-wrapped)."""
+    closure = getattr(fn, "__closure__", None)
+    if not closure or getattr(fn, "__name__", "") != "observed":
+        return None
+    for cell in closure:
+        try:
+            value = cell.cell_contents
+        except ValueError:
+            continue
+        if callable(value) and not hasattr(value, "observe"):
+            return value
+    return None
+
+
+def _assert_covered(fn, what):
+    inner = _obs_target(fn)
+    assert inner is not None, f"{what}: not routed through _obs_wrap"
+    assert _is_aot_wrapped(inner), f"{what}: not routed through aot.wrap"
+
+
+def test_every_builder_routes_through_aot_and_ledger(tmp_path, monkeypatch):
+    monkeypatch.setenv("KT_AOT", "1")
+    monkeypatch.setenv("KT_COMPILE_CACHE_DIR", str(tmp_path))
+    eng = SchedulerEngine(chunk_size=64, min_bucket=16,
+                          min_cluster_bucket=8, mesh=None)
+    assert eng._aot.enabled, "AOT store must be enabled for this guard"
+
+    # Shared programs assigned in _build_programs + _instrument_programs.
+    for name in (
+        "_tick", "_tick_compact", "_gather", "_gather3", "_gather5",
+        "_gather_over3", "_gather_over4", "_patch", "_patch_compact",
+    ):
+        _assert_covered(getattr(eng, name), name)
+
+    # Per-key builder caches: one representative key each.
+    builders = [
+        ("_narrow_program", eng._narrow_program("compact", 16)),
+        ("_narrow_program/dense", eng._narrow_program("dense", 16)),
+        ("_fallback_program", eng._fallback_program("compact")),
+        ("_cert_repair_program", eng._cert_repair_program()),
+        ("_pack_program/full", eng._pack_program("full", 16)),
+        ("_pack_program/gather", eng._pack_program("gather", 16)),
+        ("_gate_program/compact", eng._gate_program("compact")),
+        ("_gate_program/dense", eng._gate_program("dense")),
+        ("_wcheck_program/i64", eng._wcheck_program(False)),
+        ("_wcheck_program/i32", eng._wcheck_program(True)),
+        ("_resolve_program", eng._resolve_program("compact", 16)),
+        ("_replan_program", eng._replan_program("compact", 16, False)),
+        ("_scoreonly_program", eng._replan_program("compact", 16, True)),
+        ("_tb_program/full", eng._tb_program("full")),
+        ("_tb_program/patch", eng._tb_program("patch")),
+        ("_repair_program", eng._repair_program()),
+    ]
+    for what, fn in builders:
+        _assert_covered(fn, what)
+
+    # The zeros builders cache obs-wrapped aot programs too.
+    eng._zeros_for((16, 8))
+    fn = eng._zero_fns[(16, 8)]
+    _assert_covered(fn, "_zeros_for")

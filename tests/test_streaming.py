@@ -230,6 +230,11 @@ class TestStreamingInterleaveDifferential:
             # re-decided (skipped rows keep their prior records, by
             # design): placements, per-reason rejection counts,
             # feasible counts, and the recorded top-k — bit-identical.
+            # Exception (ISSUE 10): rows settled by the selection-known
+            # replan carry top-k from the LAST SOLVED score plane (the
+            # kernel skips the score recompute by design — staleness is
+            # provably decision-free for those kinf rows), so only
+            # their top-k comparison is skipped.
             for row in (changed or []):
                 u = stream.units[row]
                 if is_placeholder(u):
@@ -242,6 +247,8 @@ class TestStreamingInterleaveDifferential:
                     u.key, a.reason_counts, b.reason_counts,
                 )
                 assert a.feasible_n == b.feasible_n, u.key
+                if a.program.endswith(":replan"):
+                    continue
                 assert np.array_equal(a.topk_idx, b.topk_idx), u.key
                 assert np.array_equal(a.topk_scores, b.topk_scores), u.key
         # The log must actually have exercised the paths under test.

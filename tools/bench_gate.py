@@ -87,8 +87,21 @@ def load_rounds(root: Path) -> list[dict]:
                 # informational; per-program gating can follow once a
                 # few rounds carry it.
                 "device_attr": detail.get("device_attr"),
+                # GATED since ISSUE 10 (the replan/score-only work's
+                # acceptance): the full drift-tick latency, with the
+                # same absolute slack as gate_wait.
                 "drift_tick_ms": (detail.get("stage_ms") or {}).get(
                     "drift_tick_ms"
+                ),
+                # GATED (ISSUE 10): the drift tick's featurize stage —
+                # a silent return of full [B, C] re-featurization on
+                # the drift path must fail here.
+                "drift_featurize_ms": (
+                    (detail.get("stage_ms") or {}).get("drift_stage_ms") or {}
+                ).get("featurize"),
+                # Informational: per-phase featurize_ms + rows split.
+                "featurize_attr": (detail.get("stage_ms") or {}).get(
+                    "featurize_attr"
                 ),
                 # Gated like tick_ms (lower is better): the heavy XLA
                 # stages of the steady tick and of the drift recompute.
@@ -160,15 +173,15 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             f"drift={latest.get('drift_overflow')} — adaptive-K watch, "
             f"informational"
         )
-    if latest.get("drift_tick_ms") is not None:
-        prior_drift = [
-            r["drift_tick_ms"] for r in priors
-            if r.get("drift_tick_ms") is not None
-        ]
-        note = f" (best prior {min(prior_drift):.1f})" if prior_drift else ""
+    if latest.get("featurize_attr"):
+        fa = latest["featurize_attr"]
         print(
-            f"bench-gate: drift_tick_ms={latest['drift_tick_ms']:.1f}{note} "
-            f"— informational, not gated"
+            "bench-gate: featurize_attr "
+            + " ".join(
+                f"{phase}={spec.get('ms')}ms/rows={spec.get('rows')}"
+                for phase, spec in fa.items()
+            )
+            + " — cold/steady informational (drift gated below)"
         )
     if latest["value"] < floor:
         print(
@@ -211,6 +224,8 @@ def gate(rounds: list[dict], tolerance: float) -> int:
         ("device_ms", "stage_ms.device"),
         ("drift_device_ms", "drift_stage_ms.device"),
         ("drift_gate_wait_ms", "drift_stage_ms.gate_wait"),
+        ("drift_tick_ms", "drift_tick_ms"),
+        ("drift_featurize_ms", "drift_stage_ms.featurize"),
     ):
         prior_vals = [r.get(key) for r in priors if r.get(key) is not None]
         if latest.get(key) is None:
@@ -227,11 +242,13 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             continue
         best = min(prior_vals)
         ceil = best * (1.0 + tolerance)
-        if key == "drift_gate_wait_ms":
-            # gate_wait sits near zero once the gates pipeline; a pure
-            # percentage ceiling over a ~25ms best would fail on timer
-            # jitter.  The absolute slack still catches the regression
-            # class this gate exists for (60.4s at r08) by 2+ orders of
+        if key in ("drift_gate_wait_ms", "drift_tick_ms", "drift_featurize_ms"):
+            # These sit near zero (gate_wait) or in the hundreds of ms
+            # at small configs once the survivor paths land; a pure
+            # percentage ceiling would fail on timer jitter.  The
+            # absolute slack still catches the regression classes the
+            # gates exist for (60.4s gate_wait at r08, 50.4s drift tick
+            # at r09, multi-second full re-featurizes) by 1-2 orders of
             # magnitude.
             ceil += 250.0
         print(
@@ -255,9 +272,11 @@ _CHURN_RE = re.compile(r"^BENCH_CHURN_r(\d+)\.json$")
 def gate_churn(root: Path, tolerance: float) -> int:
     """Gate the sustained-churn scenario artifacts (BENCH_CHURN_r*.json,
     written by ``make bench-churn``): sustained objects-revalidated/s is
-    gated like the main throughput metric, and event->placement latency
-    p99 is gated once a comparable prior round carries it (informational
-    on first landing)."""
+    gated like the main throughput metric; event->placement latency p99
+    is GATED (promoted from the PR-7 first-landing informational state:
+    best-prior ceiling + the gate_wait-style absolute slack), as is the
+    per-flush featurize cost (informational only until a prior round
+    carries it)."""
     rounds = []
     for path in sorted(root.glob("BENCH_CHURN_r*.json")):
         m = _CHURN_RE.match(path.name)
@@ -280,6 +299,8 @@ def gate_churn(root: Path, tolerance: float) -> int:
                 "platform": detail.get("platform") or "unknown",
                 "value": float(parsed["value"]),
                 "p99": detail.get("latency_ms_p99"),
+                "featurize": detail.get("featurize_per_flush_ms"),
+                "featurize_rows": detail.get("featurize_rows"),
             }
         )
     if not rounds:
@@ -312,26 +333,34 @@ def gate_churn(root: Path, tolerance: float) -> int:
             file=sys.stderr,
         )
         ok = False
-    prior_p99 = [r["p99"] for r in priors if r.get("p99") is not None]
-    if latest.get("p99") is not None:
-        if prior_p99:
-            ceil = min(prior_p99) * (1.0 + tolerance) + 250.0
+    for key, label in (("p99", "latency_ms_p99"),
+                       ("featurize", "featurize_per_flush_ms")):
+        prior_vals = [r[key] for r in priors if r.get(key) is not None]
+        if latest.get(key) is None:
+            continue
+        if prior_vals:
+            ceil = min(prior_vals) * (1.0 + tolerance) + 250.0
             print(
-                f"bench-gate: churn latency_ms_p99={latest['p99']:.1f} vs "
-                f"best prior {min(prior_p99):.1f} (ceiling {ceil:.1f})"
+                f"bench-gate: churn {label}={latest[key]:.1f} vs "
+                f"best prior {min(prior_vals):.1f} (ceiling {ceil:.1f})"
             )
-            if latest["p99"] > ceil:
+            if latest[key] > ceil:
                 print(
-                    f"bench-gate: CHURN LATENCY REGRESSION: p99 "
-                    f"{latest['p99']:.1f}ms > {ceil:.1f}ms",
+                    f"bench-gate: CHURN LATENCY REGRESSION: {label} "
+                    f"{latest[key]:.1f}ms > {ceil:.1f}ms",
                     file=sys.stderr,
                 )
                 ok = False
         else:
             print(
-                f"bench-gate: churn latency_ms_p99={latest['p99']:.1f} — "
+                f"bench-gate: churn {label}={latest[key]:.1f} — "
                 f"informational (first round carrying it)"
             )
+    if latest.get("featurize_rows") is not None:
+        print(
+            f"bench-gate: churn featurize_rows={latest['featurize_rows']} "
+            f"— delta-only expected mid-stream, informational"
+        )
     return 0 if ok else 1
 
 
