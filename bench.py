@@ -397,6 +397,21 @@ def time_batched(rng, units, clusters, followers):
     detail["drift_dispatches"] = drift_dispatches
     detail["drift_upload_bytes"] = drift_upload
     detail["drift_gate"] = dict(engine.drift_stats)
+    # ISSUE 11: unified-survivor shape accounting (padding_ratio is the
+    # number the one-stream dispatch exists to push toward 1.0) + the
+    # per-phase stale-repair split (drift must stay 0 under eager
+    # churn-tick repair).
+    detail["survivor_kernel"] = {
+        "rows": engine.survivor_stats["rows"],
+        "groups": engine.survivor_stats["groups"],
+        "padding_ratio": round(
+            engine.survivor_stats["padded_rows"]
+            / max(1, engine.survivor_stats["rows"]),
+            3,
+        ),
+        "fallback_rows": engine.survivor_stats["fallback_rows"],
+    }
+    detail["stale_repair_rows"] = dict(engine.stale_repair_rows)
     detail["cold_dispatches"] = cold_dispatches
     detail["upload_bytes"] = dict(engine.upload_bytes)
     detail["cold_tick_ms"] = round(cold_ms, 1)
@@ -643,6 +658,20 @@ def run_churn_scenario() -> None:
             k: engine.featurize_rows[k] - feat_rows0[k] for k in feat_rows0
         },
         "drift_gate": dict(engine.drift_stats),
+        # ISSUE 11: the unified-kernel shape block carried in every
+        # BENCH_CHURN artifact (bench-gate surfaces it), plus the
+        # stale-repair phase split proving drift ticks see zero.
+        "survivor_kernel": {
+            "rows": engine.survivor_stats["rows"],
+            "groups": engine.survivor_stats["groups"],
+            "padding_ratio": round(
+                engine.survivor_stats["padded_rows"]
+                / max(1, engine.survivor_stats["rows"]),
+                3,
+            ),
+            "fallback_rows": engine.survivor_stats["fallback_rows"],
+        },
+        "stale_repair_rows": dict(engine.stale_repair_rows),
         "fetch_overflow_rows": engine.overflow_rows_total - overflow0,
         "narrow": {
             "enabled": engine.narrow,
@@ -668,6 +697,30 @@ def run_churn_scenario() -> None:
         file=sys.stderr,
     )
     _save_churn_artifact(result)
+
+
+def _memory_sample() -> dict:
+    """Process peak RSS + live device-buffer bytes at the call point —
+    the restart scenario samples both boots so the AOT no-donation
+    trade (preloaded programs keep un-donated prev buffers alive) is a
+    measured number per round, not a docs note."""
+    import resource
+
+    import jax
+
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # ru_maxrss is KiB on Linux, bytes on macOS.
+    peak_mb = ru / 1024.0 if sys.platform != "darwin" else ru / (1024.0**2)
+    try:
+        dev_bytes = int(
+            sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+        )
+    except Exception:
+        dev_bytes = None
+    return {
+        "peak_rss_mb": round(peak_mb, 1),
+        "device_buffer_bytes": dev_bytes,
+    }
 
 
 def run_restart_scenario() -> None:
@@ -753,6 +806,11 @@ def run_restart_scenario() -> None:
             "aot": dict(engine._aot.stats),
             "parity": mism == 0,
             "parity_mismatches": mism,
+            # ROADMAP loose end (ISSUE 11): the AOT no-donation memory
+            # cost, measured at the fully-preloaded point (AOT-compiled
+            # programs drop prev-buffer donation, so a warm boot holds
+            # more live device state than a cold one).
+            **_memory_sample(),
         }))
         return
 
@@ -774,6 +832,7 @@ def run_restart_scenario() -> None:
     )
     snapshot_bytes = store.last_bytes
     snapshot_write_ms = round(store.last_write_s * 1e3, 1)
+    cold_mem = _memory_sample()
 
     env = dict(os.environ)
     env["KT_RESTART_WARM"] = "1"
@@ -810,6 +869,16 @@ def run_restart_scenario() -> None:
             "restore_info", "fetch_paths", "aot", "parity",
             "parity_mismatches",
         )},
+        # Warm-vs-cold memory cost of the AOT preload path (ROADMAP
+        # loose end; docs/operations.md § Restart & failover runbook).
+        "memory": {
+            "cold_peak_rss_mb": cold_mem["peak_rss_mb"],
+            "cold_device_buffer_bytes": cold_mem["device_buffer_bytes"],
+            "warm_peak_rss_mb": warm_doc.get("peak_rss_mb"),
+            "warm_device_buffer_bytes": warm_doc.get(
+                "device_buffer_bytes"
+            ),
+        },
     }
     result = {
         "metric": f"restart_to_first_tick_ms_{N_OBJECTS}x{N_CLUSTERS}",

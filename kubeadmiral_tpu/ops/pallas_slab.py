@@ -1,0 +1,198 @@
+"""Pallas TPU kernel for the narrow-slab hot loop's per-cell front.
+
+The narrow slab program's cost at [rows, C] is dominated by its
+per-cell work: the five filter masks, the five score plugins with their
+per-row normalizations, and the reason-bit assembly are O(B*C) integer
+math that XLA materializes as a dozen-plus separate [B, C] (mostly
+int64) passes — the ~0.45us/cell floor ROADMAP item 2 names, which
+every sub-batch path rides (churn slabs, drift survivors, certificate
+fallbacks).  This module hand-fuses that front into ONE VMEM-resident
+pass per row block (SNIPPETS [1]'s shard_map + Pallas pattern, minus
+the remote copies): each grid step holds a [bm, C] tile of every
+per-object plane plus the shared [C, R] cluster tensors in VMEM and
+emits feasibility, reason bits and normalized score totals without
+spilling an intermediate plane to HBM between plugin passes.
+
+Exactness: the kernel body calls the very same ops.filters / ops.scores
+jnp math the XLA ``_phase1`` runs — integer arithmetic end to end (the
+balanced-allocation score's rational form and ``_floordiv_smallq``'s
+estimate+correct division are backend-stable by design; ops/scores.py
+derives the error bounds).  Bit-identity is enforced three ways:
+
+* interpret-mode parity tests (tests/test_pallas_slab.py) assert the
+  triple equals ``_phase1(inp)`` bit-for-bit on randomized worlds,
+  including webhook planes and padded cluster columns;
+* the graft dryrun harness runs a pallas-vs-dense parity block
+  (``__graft_entry__.dryrun_multichip``);
+* downstream, nothing changes: the narrow solve's per-row certificates
+  and the dense fallback still guard the select/planner stages, so a
+  row the narrow solve cannot certify re-solves through the dense
+  (non-Pallas) program — placements stay bit-identical by construction
+  even if a backend ever disagreed on the fused front.
+
+Knob: ``KT_PALLAS=1`` opts in; the default is OFF everywhere — on
+non-TPU platforms the kernel only exists in interpreter mode (a parity
+harness, not a fast path), and the compiled Mosaic kernel awaits its
+first on-chip validation round (ROADMAP item 1) before it can default
+on for TPU.  Non-TPU backends always run the interpreter regardless of
+the knob, so tier-1 parity tests exercise the real kernel body.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from kubeadmiral_tpu.ops import filters as F
+from kubeadmiral_tpu.ops import reasons as RSN
+from kubeadmiral_tpu.ops import scores as S
+
+# Row-block height: 8 sublanes is the f32 VPU tile height and divides
+# every engine row bucket (pow2 >= 16); tiny test batches fall back to
+# the largest pow2 that divides B.
+_BLOCK_ROWS = 8
+
+
+def pallas_enabled() -> bool:
+    """The KT_PALLAS opt-in (default off — see the module docstring)."""
+    return os.environ.get("KT_PALLAS", "0") in ("1", "true", "yes")
+
+
+def _phase1_kernel(
+    # per-row blocks [bm, *]
+    filter_enabled_ref,  # i8[bm, 5]
+    score_enabled_ref,   # i8[bm, 5]
+    request_ref,         # i64[bm, R]
+    placement_has_ref,   # i8[bm, 1]
+    api_ref,             # i8[bm, C]
+    taint_new_ref,       # i8[bm, C]
+    taint_cur_ref,       # i8[bm, C]
+    selector_ref,        # i8[bm, C]
+    placement_ref,       # i8[bm, C]
+    current_ref,         # i8[bm, C]
+    webhook_ok_ref,      # i8[bm, C]
+    webhook_sco_ref,     # i64[bm, C]
+    taint_counts_ref,    # i64[bm, C]
+    affinity_ref,        # i64[bm, C]
+    # shared cluster planes (whole axis in every block)
+    alloc_ref,           # i64[C, R]
+    used_ref,            # i64[C, R]
+    cluster_valid_ref,   # i8[1, C]
+    # outputs [bm, C]
+    feas_ref,            # i8
+    rsn_ref,             # i32
+    tot_ref,             # i64
+):
+    """One fused pass over a [bm, C] tile: filters -> reason bits ->
+    score plugins -> normalization -> totals, all VMEM-resident.  The
+    body is ops.filters/ops.scores verbatim — the fusion is the kernel,
+    the math is the library's."""
+    fe = filter_enabled_ref[:] != 0
+    se = score_enabled_ref[:] != 0
+    request = request_ref[:]
+    placement_has = placement_has_ref[:][:, 0] != 0
+    api_ok = api_ref[:] != 0
+    taint_ok_new = taint_new_ref[:] != 0
+    taint_ok_cur = taint_cur_ref[:] != 0
+    selector_ok = selector_ref[:] != 0
+    placement_ok = placement_ref[:] != 0
+    current_mask = current_ref[:] != 0
+    webhook_ok = webhook_ok_ref[:] != 0
+    webhook_scores = webhook_sco_ref[:]
+    taint_counts = taint_counts_ref[:]
+    affinity_scores = affinity_ref[:]
+    alloc = alloc_ref[:]
+    used = used_ref[:]
+    cluster_valid = cluster_valid_ref[:][0] != 0
+
+    fit_ok = F.resources_fit(request, alloc, used)
+    feasible, reasons = F.combine_filters_explain(
+        fe, api_ok, taint_ok_new, taint_ok_cur, current_mask, fit_ok,
+        placement_has, placement_ok, selector_ok,
+    )
+    reasons = (
+        reasons
+        | jnp.where(~webhook_ok, jnp.int32(RSN.REASON_WEBHOOK_FILTER), 0)
+        | jnp.where(
+            ~cluster_valid[None, :], jnp.int32(RSN.REASON_CLUSTER_INVALID), 0
+        )
+    )
+    feasible = feasible & cluster_valid[None, :] & webhook_ok
+    totals = S.total_scores(
+        se, feasible, request, alloc, used, taint_counts, affinity_scores,
+    )
+    totals = totals + jnp.where(feasible, webhook_scores, 0)
+    feas_ref[:] = feasible.astype(jnp.int8)
+    rsn_ref[:] = reasons.astype(jnp.int32)
+    tot_ref[:] = totals.astype(jnp.int64)
+
+
+def _block_rows(b: int) -> int:
+    bm = _BLOCK_ROWS
+    while bm > 1 and b % bm:
+        bm //= 2
+    return bm
+
+
+def phase1_slab(inp, interpret: bool | None = None):
+    """The fused Pallas phase 1 over expanded TickInputs planes.
+
+    Returns (feasible bool[B, C], reasons i32[B, C], totals i64[B, C])
+    — the exact triple ``ops.pipeline._phase1`` computes, consumable by
+    ``schedule_tick_narrow(..., phase1=...)``.  Traceable under jit
+    (the engine's narrow program wraps it); ``interpret`` defaults to
+    True off-TPU so the kernel body runs everywhere tier-1 runs."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, c = inp.api_ok.shape
+    r = inp.request.shape[1]
+    bm = _block_rows(b)
+
+    def row(x):
+        return pl.BlockSpec((bm, x), lambda i: (i, 0))
+
+    def shared(shape):
+        return pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+
+    i8 = jnp.int8
+    args = (
+        inp.filter_enabled.astype(i8),
+        inp.score_enabled.astype(i8),
+        inp.request.astype(jnp.int64),
+        inp.placement_has.astype(i8).reshape(b, 1),
+        inp.api_ok.astype(i8),
+        inp.taint_ok_new.astype(i8),
+        inp.taint_ok_cur.astype(i8),
+        inp.selector_ok.astype(i8),
+        inp.placement_ok.astype(i8),
+        inp.current_mask.astype(i8),
+        inp.webhook_ok.astype(i8),
+        inp.webhook_scores.astype(jnp.int64),
+        inp.taint_counts.astype(jnp.int64),
+        inp.affinity_scores.astype(jnp.int64),
+        inp.alloc.astype(jnp.int64),
+        inp.used.astype(jnp.int64),
+        inp.cluster_valid.astype(i8).reshape(1, c),
+    )
+    in_specs = [
+        row(5), row(5), row(r), row(1),
+        row(c), row(c), row(c), row(c), row(c), row(c),
+        row(c), row(c), row(c), row(c),
+        shared((c, r)), shared((c, r)), shared((1, c)),
+    ]
+    feas8, reasons, totals = pl.pallas_call(
+        _phase1_kernel,
+        grid=(b // bm,),
+        in_specs=in_specs,
+        out_specs=(row(c), row(c), row(c)),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, c), jnp.int8),
+            jax.ShapeDtypeStruct((b, c), jnp.int32),
+            jax.ShapeDtypeStruct((b, c), jnp.int64),
+        ),
+        interpret=interpret,
+    )(*args)
+    return feas8 != 0, reasons, totals

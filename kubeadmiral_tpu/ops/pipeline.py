@@ -708,7 +708,8 @@ def _narrow_solve(
 
 
 def schedule_tick_narrow(
-    inp: TickInputs, m: int, rows_only=None, i32_keys: bool = False
+    inp: TickInputs, m: int, rows_only=None, i32_keys: bool = False,
+    phase1=None,
 ) -> tuple[TickOutputs, jax.Array]:
     """Two-phase narrow solve; returns (outputs, cert i8[B]).
 
@@ -721,10 +722,20 @@ def schedule_tick_narrow(
     rows-only layout — like the pack sort, GSPMD must not run them on a
     sharded cluster axis.  ``i32_keys`` (KT_PHASE1_I32) demotes the
     select candidate composite to int32 where the key range analysis
-    allows — cert-guarded per row, i64 semantics otherwise."""
+    allows — cert-guarded per row, i64 semantics otherwise.
+
+    ``phase1`` optionally supplies a precomputed (feasible bool[B, C],
+    reasons i32[B, C], totals i64[B, C]) triple — the KT_PALLAS slab
+    path computes it with the fused ops/pallas_slab.py kernel instead
+    of the XLA ``_phase1``; the supplied triple must be bit-identical
+    to ``_phase1(inp)`` (the Pallas kernel runs the very same integer
+    plugin math, enforced by interpret-mode parity tests), so the
+    select/planner certificates and outputs are unchanged."""
     b, c = inp.api_ok.shape[0], inp.cluster_valid.shape[0]
     _note_trace("schedule_tick_narrow", b, c)
-    feasible, reasons, totals = _phase1(inp)
+    feasible, reasons, totals = (
+        _phase1(inp) if phase1 is None else phase1
+    )
     return _narrow_solve(
         inp, feasible, reasons, totals, m, rows_only, i32_keys
     )
@@ -881,6 +892,60 @@ def drift_replan(
     return out, cert.astype(jnp.int8)
 
 
+def drift_survivor(
+    inp: TickInputs,   # gathered survivor rows [n, C] (expanded)
+    reasons_rows,      # i32[n, C] previous reason plane rows
+    m: int,
+    rows_only=None,
+    i32_keys: bool = False,
+) -> tuple[TickOutputs, jax.Array]:
+    """The UNIFIED drift-survivor kernel: ONE program for every gate
+    survivor, whatever its classification (the ISSUE 11 tentpole).
+
+    PR 10 ran three separate survivor streams per gated chunk —
+    ``drift_resolve`` (recompute rows without a fit flip),
+    ``drift_replan`` (kinf fit-flip rows) and ``drift_scoreonly``
+    (finite-K fit-flip rows) — each greedy-grouped independently, so a
+    chunk with 90+50+40 survivors padded three {256,128,64} ladders
+    (~1.6x the [rows, C] math) and paid three dispatch chains.  The
+    score-only solve provably SUBSUMES both others:
+
+    * its stored-filter phase 1 (``_phase1_from_stored``) reconstructs
+      feasibility exactly for every trustworthy-reasons row — for
+      no-fit-flip rows the dense resources_fit recompute reproduces the
+      stored plane bit-for-bit (fit did not move), for fit-flip rows it
+      IS the new feasibility;
+    * its full score recompute equals the gate-refreshed stored totals
+      where no fit flipped (the gate's exactness argument, step 2) and
+      is the only correct choice where one did — so unified rows carry
+      EXACT fresh score planes, strictly better than replan's
+      fresh-as-of-last-solve staleness;
+    * the narrow select handles kinf rows sort-cheaply (selection = the
+      feasible set; ``kinf`` arm) and finite-K rows by the certified
+      candidate sort, so the replan/resolve specializations buy no
+      extra exactness — only the padding and dispatches they cost.
+
+    The engine routes ALL survivors of a chunk through this kernel in
+    one greedy-grouped stream, carrying a host-side per-row mode vector
+    (resolve / replan / score_only) for attribution only — the math is
+    mode-blind by design.  Unlike ``drift_resolve`` it consults no
+    delta-column info, so wide drifts (D > DRIFT_REFINE_MAX_COLS) ride
+    it too.  Cert semantics match ``drift_scoreonly`` exactly (narrow
+    select/planner certificates, fail-closed sticky arm); failures drop
+    to the slab path bit-identically by construction.
+    KT_SURVIVOR_UNIFIED=0 reverts to the three-stream dispatch.
+
+    Returns (outputs [n, C], cert i8[n])."""
+    n, c = inp.api_ok.shape[0], inp.cluster_valid.shape[0]
+    _note_trace("drift_survivor", n, c)
+    feasible, base_reasons, totals = _phase1_from_stored(inp, reasons_rows)
+    out, cert = _narrow_solve(
+        inp, feasible, base_reasons, totals, m, rows_only, i32_keys
+    )
+    sticky_active = inp.sticky & jnp.any(inp.current_mask, axis=-1)
+    return out, (cert.astype(bool) & ~sticky_active).astype(jnp.int8)
+
+
 # -- drift gate -----------------------------------------------------------
 # A cluster-capacity drift tick must revalidate every row, but the rows
 # whose DECISION can actually move are a function of which cluster
@@ -988,6 +1053,13 @@ def _drift_classify(
     #                 pad: out of range).  Only those rows can have an
     #                 engaged top-K cut, so the rank-count refinement
     #                 runs on this gathered subset instead of all B rows.
+    nfeas,          # i32[B] CACHED per-row feasible-column counts.  The
+    #                 r11 gate derived this with a full [B, C] pf.sum
+    #                 pass on EVERY drift tick (~4.9s of c5 gate device
+    #                 time); the engine now maintains the count alongside
+    #                 prev_feas — written at every prev-plane store and
+    #                 patched by every row repair — so the gate reads a
+    #                 [B] vector instead of reducing a [B, C] plane.
 ):
     """Shared tail of the dense/compact drift gates.
 
@@ -996,12 +1068,10 @@ def _drift_classify(
     changed columns' values refreshed — so skipped rows' cached state
     stays exact across consecutive drift ticks."""
     b, c = prev_feas.shape
-    pf = prev_feas != 0
     pf_d = prev_feas_d != 0
     valid = delta_valid[None, :]
     fitflip = ((fea_new_d != pf_d) & valid).any(axis=1)
     dcpu_any = (pf_d & (delta_cpu & delta_valid)[None, :]).any(axis=1)
-    nfeas = pf.sum(axis=1, dtype=jnp.int32)
     # Selection equals the feasible set when the top-K cut cannot engage
     # (unlimited, K >= nfeas, or negative K = empty selection).
     kinf = (
@@ -1040,7 +1110,7 @@ def _drift_classify(
             delta_valid, mode="drop"
         )
         ridx = jnp.clip(fin_idx, 0, b - 1)
-        pf_g = pf[ridx]                            # [Nf, C]
+        pf_g = prev_feas[ridx] != 0                # [Nf, C]
         pf_d_g = pf_d[ridx]                        # [Nf, D]
         iota64 = lax.broadcasted_iota(jnp.int64, pf_g.shape, 1)
         comp = (-prev_scores[ridx].astype(jnp.int64)) * c + iota64
@@ -1127,6 +1197,7 @@ def drift_gate_dense(
     delta_valid,
     delta_cpu,
     fin_idx,
+    nfeas,
 ):
     """Drift gate over dense cached per-object planes.
 
@@ -1136,7 +1207,10 @@ def drift_gate_dense(
     (i64[D, R]); ``delta_idx`` i32[D] names the changed columns (padded
     entries carry an out-of-range index and ``delta_valid`` False);
     ``fin_idx`` i32[Nf] the rows with a finite maxClusters (the only
-    rows the rank-count refinement must visit; pad out of range).
+    rows the rank-count refinement must visit; pad out of range);
+    ``nfeas`` i32[B] the engine's cached per-row feasible counts
+    (maintained alongside prev_feas — kills the gate's [B, C] pf.sum
+    pass, see _drift_classify).
     Returns (i8[B] mask, i32[B, C] refreshed score plane)."""
     b = per_object["total"].shape[0]
     _note_trace("drift_gate", b, prev_feas.shape[1])
@@ -1175,6 +1249,7 @@ def drift_gate_dense(
         per_object["weights_given"],
         sticky_active,
         fin_idx,
+        nfeas,
     )
 
 
@@ -1191,6 +1266,7 @@ def drift_gate_compact(
     delta_valid,
     delta_cpu,
     fin_idx,
+    nfeas,
     cur_absent,
 ):
     """Compact-format drift gate: the changed columns' filter masks are
@@ -1245,6 +1321,7 @@ def drift_gate_compact(
         per_object["weights_given"],
         sticky_active,
         fin_idx,
+        nfeas,
     )
 
 

@@ -69,6 +69,10 @@ PROGRAM_KINDS = (
     "resolve",         # sort-free drift survivor resolve
     "replan",          # selection-known replan of kinf fit-flip survivors
     "scoreonly",       # score-only narrow solve of finite-K fit-flip rows
+    "survivor",        # UNIFIED drift-survivor kernel (subsumes the three
+    #                    above; KT_SURVIVOR_UNIFIED)
+    "nfeas",           # cached per-row feasible-count reduce (store-site
+    #                    companion of prev_feas; kills the gate's pf.sum)
     "tiebreak",        # precomputed planner tie-break plane (full/patch)
     "gather",          # delta-row plane gathers (dense wire)
     "pack",            # packed-export wire compaction (gather/full)

@@ -106,13 +106,25 @@ CATALOG: dict[str, MetricSpec] = {
         "Drift-gate row classification on cluster-capacity drift ticks: "
         "skip = provably identical outputs, wcheck = dynamic-weight "
         "comparison rows, wcheck_changed = weight comparisons that "
-        "found a difference, resolve = survivors settled by the "
-        "sort-free drift-resolve program, replan = kinf fit-flip "
-        "survivors settled by the selection-known replan (no select "
-        "sort), score_only = finite-K fit-flip survivors settled by "
-        "the stored-plane score-only narrow solve, *_fallback = rows "
-        "of those paths whose certificate failed (slab re-solve), "
-        "recompute = rows re-scheduled through the sub-batch slabs."),
+        "found a difference, unified = survivors settled by the ONE "
+        "unified survivor kernel (the default path — subsumes the "
+        "resolve/replan/score_only specializations, KT_SURVIVOR_"
+        "UNIFIED), resolve = survivors settled by the sort-free "
+        "drift-resolve program, replan = kinf fit-flip survivors "
+        "settled by the selection-known replan (no select sort), "
+        "score_only = finite-K fit-flip survivors settled by the "
+        "stored-plane score-only narrow solve (the latter three engage "
+        "only under KT_SURVIVOR_UNIFIED=0), *_fallback = rows of those "
+        "paths whose certificate failed (slab re-solve), recompute = "
+        "rows re-scheduled through the sub-batch slabs."),
+    "engine_stale_rows_total": MetricSpec(
+        "counter", "rows", ("phase",),
+        "Stale device-input rows scatter-repaired, by phase: churn = "
+        "repaired EAGERLY inside the tick that made them stale (the "
+        "default), drift = repaired on a drift gate's critical path "
+        "(the backstop — must stay 0 under eager repair; nonzero means "
+        "a churn path left rows it could not reach eagerly), dispatch "
+        "= repaired at a full-dispatch upload."),
     "engine_featurize_rows_total": MetricSpec(
         "counter", "rows", ("path",),
         "Rows featurized per path: full = whole-chunk rebuilds (cold "

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.ops import pallas_slab as pallas_slab_mod
 from kubeadmiral_tpu.ops import pipeline as pipeline_mod
 from kubeadmiral_tpu.ops.pipeline import (
     DRIFT_FITFLIP,
@@ -51,6 +52,7 @@ from kubeadmiral_tpu.ops.pipeline import (
     drift_replan,
     drift_resolve,
     drift_scoreonly,
+    drift_survivor,
     drift_wcheck,
     expand_compact,
     fnv_tiebreak_plane,
@@ -295,6 +297,12 @@ class _CachedChunk:
     # reasons are provably unchanged without a fit flip, so the
     # sort-free resolve can emit exact reason planes too.
     prev_reasons: Optional[object] = None
+    # Cached per-row feasible-column counts (device i32[B]): maintained
+    # alongside prev_feas (stored by every prev-plane store, patched by
+    # every row repair, derived at restore) so the drift gate reads a
+    # [B] vector instead of running a [B, C] pf.sum pass per drift tick
+    # (~4.9s of c5 gate device time at r11).
+    prev_nfeas: Optional[object] = None
     prev_results: Optional[list] = None
     # Whether prev_results carry decoded score dicts — a want_scores
     # consumer can only ride the noop/delta/sub-batch fast paths when
@@ -318,8 +326,14 @@ class _CachedChunk:
     # Device-resident planner tie-break plane (i32[B_pad, C_pad], compact
     # format only): precomputed once per per-object upload and patched
     # row-wise on churn, so the drift survivor kernels (resolve / replan
-    # / score-only) never re-run expand_compact's FNV byte scan.
+    # / score-only / unified) never re-run expand_compact's FNV byte scan.
     tiebreak_dev: Optional[object] = None
+    # Rows whose tiebreak_dev rows are pending an FNV re-patch: the
+    # eager churn-tick input repair defers the (relatively expensive)
+    # tie-break FNV recompute off the steady path — _tiebreak_plane
+    # patches these lazily before any survivor kernel consumes the
+    # plane (the only consumer).
+    tb_stale_rows: Optional[list] = None
     # Entry was rebuilt from a durable snapshot and has not yet had a
     # full identity/signature walk: the delta-featurization dirty-row
     # hint must not skip rows for it (every row still needs snapshot-
@@ -619,6 +633,7 @@ class SchedulerEngine:
             "recompute": 0, "resolve": 0, "resolve_fallback": 0,
             "replan": 0, "replan_fallback": 0,
             "score_only": 0, "score_only_fallback": 0,
+            "unified": 0, "unified_fallback": 0,
             "fallback": 0,
         }
         # Sort-free drift resolve (KT_DRIFT_RESOLVE=0 opts out): gate
@@ -636,6 +651,38 @@ class SchedulerEngine:
         self.replan = os.environ.get("KT_REPLAN", "1") not in (
             "0", "false", "no",
         )
+        # Unified survivor kernel (KT_SURVIVOR_UNIFIED=0 reverts to the
+        # three-stream resolve/replan/score_only dispatch): EVERY drift-
+        # gate survivor of a chunk rides ONE greedy-grouped
+        # drift_survivor stream (ops/pipeline.py) — the score-only solve
+        # provably subsumes the other two, so the per-chunk cross-stream
+        # padding (~1.6x at c5) and two of three dispatch ladders
+        # disappear.  Per-row modes (resolve/replan/score_only) are kept
+        # host-side for attribution; cert failures still drop to the
+        # slab path bit-identically.
+        self.survivor_unified = os.environ.get(
+            "KT_SURVIVOR_UNIFIED", "1"
+        ) not in ("0", "false", "no")
+        # Unified-kernel shape accounting (bench detail.survivor_kernel):
+        # rows = survivors dispatched, groups = greedy row-groups,
+        # padded_rows = group-padded row total (padding_ratio =
+        # padded_rows/rows), fallback_rows = cert failures (slab).
+        self.survivor_stats = {
+            "rows": 0, "groups": 0, "padded_rows": 0, "fallback_rows": 0,
+        }
+        # Pallas slab front (KT_PALLAS=1 opts in, default off): the
+        # narrow programs compute phase 1 with the fused
+        # ops/pallas_slab.py kernel instead of the XLA pass —
+        # interpreter mode off-TPU (a parity harness, not a fast path),
+        # compiled Mosaic on TPU.  Meshed engines keep the XLA path
+        # (pallas_call under GSPMD needs shard_map; ROADMAP item 1).
+        self.pallas = pallas_slab_mod.pallas_enabled()
+        # Stale-input repair accounting per phase (engine_stale_rows_
+        # total): churn = rows repaired eagerly inside the tick that
+        # made them stale (the ISSUE 11 satellite), drift = rows a
+        # drift gate still had to repair first (must stay 0 with eager
+        # repair on), dispatch = repairs at full-dispatch upload.
+        self.stale_repair_rows = {"churn": 0, "drift": 0, "dispatch": 0}
         # i32 phase-1 arithmetic (KT_PHASE1_I32=0 opts out): demote the
         # narrow select composite keys (per-row cert-guarded) and the
         # drift weight-check arithmetic (host range-guarded) from int64
@@ -937,6 +984,8 @@ class SchedulerEngine:
         self._resolve_programs: dict[tuple, object] = {}
         self._replan_programs: dict[tuple, object] = {}
         self._scoreonly_programs: dict[tuple, object] = {}
+        self._survivor_programs: dict[tuple, object] = {}
+        self._nfeas_cache: dict[str, object] = {}
         self._tb_program_cache: dict[str, object] = {}
         self._repair_program_cache: dict[tuple, object] = {}
         # Narrow-solve programs: the (fmt, M) tick variants, the dense
@@ -1153,12 +1202,24 @@ class SchedulerEngine:
         donate = (1,) if self.donate else ()
 
         i32_keys = self.phase1_i32
+        # KT_PALLAS: the fused ops/pallas_slab.py kernel computes
+        # phase 1 in one VMEM-resident pass per row block (bit-identical
+        # to the XLA _phase1 — see the module's parity contract); the
+        # narrow select/planner + certificates are unchanged, so cert
+        # failures still re-solve through the dense (non-Pallas)
+        # fallback.  Meshed engines keep the XLA path (pallas_call under
+        # GSPMD needs shard_map — ROADMAP item 1's on-chip round).
+        use_pallas = self.pallas and self.mesh is None
 
         def impl(inp, prev, _m=m, _fmt=fmt):
             if _fmt == "compact":
                 inp = expand_compact(inp)
+            phase1 = (
+                pallas_slab_mod.phase1_slab(inp) if use_pallas else None
+            )
             out, cert = schedule_tick_narrow(
-                inp, _m, rows_only=rows_only, i32_keys=i32_keys
+                inp, _m, rows_only=rows_only, i32_keys=i32_keys,
+                phase1=phase1,
             )
             return out, _diff_bits(out, prev), cert
 
@@ -1181,7 +1242,10 @@ class SchedulerEngine:
                 out_shardings=(M.output_shardings(self.mesh), rows, rows),
                 donate_argnums=donate,
             )
-        fn = self._aot.wrap(f"tick_narrow:{fmt}:m{m}", fn)
+        # A Pallas narrow program must not be served by (or write into)
+        # a non-Pallas manifest entry: the AOT key carries the variant.
+        suffix = ":pl" if use_pallas else ""
+        fn = self._aot.wrap(f"tick_narrow:{fmt}:m{m}{suffix}", fn)
         fn = self._obs_wrap("tick_narrow", fn)
         self._narrow_programs[key] = fn
         return fn
@@ -1760,6 +1824,7 @@ class SchedulerEngine:
                 entry.prev_out = cached.prev_out
                 entry.prev_feas = cached.prev_feas
                 entry.prev_reasons = cached.prev_reasons
+                entry.prev_nfeas = cached.prev_nfeas
                 entry.prev_results = cached.prev_results
                 entry.prev_has_scores = cached.prev_has_scores
                 entry.stale_out_rows = cached.stale_out_rows
@@ -1808,6 +1873,7 @@ class SchedulerEngine:
             drift0 = dict(self.drift_stats)
             narrow0 = dict(self.narrow_stats)
             feat0 = dict(self.featurize_rows)
+            stale0 = dict(self.stale_repair_rows)
             # Arm the flight recorder for this tick: record sites (the
             # fetch/decode helpers) consume _tick_rec; ticks riding the
             # noop/skip fast paths record nothing and the previous
@@ -1845,6 +1911,7 @@ class SchedulerEngine:
             self._emit_tick_metrics(
                 len(units), wall, cache0, fetch0,
                 bytes0, overflow0, upload0, drift0, narrow0, feat0,
+                stale0,
             )
             if self.post_tick is not None:
                 # Durable-snapshot hook (runtime/snapshot.py): runs
@@ -1874,6 +1941,7 @@ class SchedulerEngine:
         bytes0: int = 0, overflow0: int = 0,
         upload0: Optional[dict] = None, drift0: Optional[dict] = None,
         narrow0: Optional[dict] = None, feat0: Optional[dict] = None,
+        stale0: Optional[dict] = None,
     ) -> None:
         """Per-tick telemetry: stage-latency histograms, cache/fetch path
         counters (as deltas of the raw dict stats over this call), true
@@ -1909,10 +1977,15 @@ class SchedulerEngine:
             "skip", "wcheck", "wcheck_changed", "recompute", "resolve",
             "resolve_fallback", "replan", "replan_fallback",
             "score_only", "score_only_fallback",
+            "unified", "unified_fallback",
         ):
             delta = self.drift_stats[kind] - (drift0 or {}).get(kind, 0)
             if delta:
                 m.counter("engine_drift_rows_total", delta, kind=kind)
+        for phase, value in self.stale_repair_rows.items():
+            delta = value - (stale0 or {}).get(phase, 0)
+            if delta:
+                m.counter("engine_stale_rows_total", delta, phase=phase)
         for path, value in self.featurize_rows.items():
             delta = value - (feat0 or {}).get(path, 0)
             if delta:
@@ -2205,6 +2278,12 @@ class SchedulerEngine:
             )
             entry.prev_feas = put(cs["feas"], np.int8)
             entry.prev_reasons = put(cs["rsn"], np.int32)
+            # The cached nfeas vector is DERIVED, not serialized: a
+            # host-side row sum at restore keeps the snapshot format
+            # stable and the zero-dispatch fresh-resume guarantee intact.
+            entry.prev_nfeas = jax.device_put(
+                (np.asarray(cs["feas"]) != 0).sum(axis=1).astype(np.int32)
+            )
             n = len(chunk)
             entry.prev_results = self._decode_rows(
                 np.asarray(sel)[:n], np.asarray(rep)[:n], np.asarray(cnt)[:n],
@@ -3013,6 +3092,7 @@ class SchedulerEngine:
 
         offset = 0
         t3 = time.perf_counter()
+        eager_repairs: list = []
         all_reasons = np.concatenate(rec_reasons) if rec_reasons else None
         all_scores = np.concatenate(rec_scores) if rec_scores else None
         all_counts = np.concatenate(rec_counts) if rec_counts else None
@@ -3048,13 +3128,20 @@ class SchedulerEngine:
             entry.prev_results = merged
             entry.prev_view = view
             if inputs_stale:
-                # The device INPUT copy is stale for the patched rows —
-                # record them for lazy scatter-repair (a later dispatch
-                # must not pay a full chunk re-upload).  Drift
-                # recomputes reuse unchanged inputs and skip this.
+                # The device INPUT copy is stale for the patched rows.
+                # Record them, then repair EAGERLY after this loop — in
+                # the same tick that created them (ISSUE 11 satellite) —
+                # so drift gates never pay the repair on their critical
+                # path and never see a gate-blind row (PR 7 measured
+                # ~30% of drift recompute as stale-row artifacts before
+                # the gate-time repair; this moves the scatter off the
+                # drift tick entirely).  Rows the eager pass cannot
+                # reach (no device copy) stay marked for the gate-time
+                # backstop.
                 entry.stale_rows = sorted(
                     set(entry.stale_rows or ()) | set(changed_rows)
                 )
+                eager_repairs.append(entry)
             # Device write-back: scatter the slab's fresh output planes
             # into the chunk's cached prev planes, so the prev state
             # stays exact row-for-row — later drift gates and delta
@@ -3072,28 +3159,57 @@ class SchedulerEngine:
             # fresh this tick and rows are immutable.
             chunk_results[slot] = merged
         timings["decode"] += time.perf_counter() - t3
+        if eager_repairs:
+            # Eager stale-input repair: scatter the churned rows' fresh
+            # host inputs (+ tie-break rows) into the cached device
+            # tensors NOW, attributed to this tick's featurize stage —
+            # engine_stale_rows_total{phase="churn"} counts them, and
+            # the drift-gate backstop (phase="drift") must stay at 0.
+            t4 = time.perf_counter()
+            for entry in eager_repairs:
+                self._repair_stale_inputs(
+                    entry, fmt, c_bucket, vocab=vocab, phase="churn",
+                    patch_tiebreak=False,
+                )
+            timings["featurize"] += time.perf_counter() - t4
 
     def _repair_program(self):
-        """Jitted 6-plane scatter: prev planes .at[dst].set(slab[src])
-        (dst padded out-of-range -> mode='drop').  The planes are
-        DONATED: XLA updates them in place instead of copying ~20MB of
-        [B, C] state per repaired chunk (the engine re-references the
-        returned planes; nothing else holds the old ones)."""
+        """Jitted 7-plane scatter: the six prev planes
+        .at[dst].set(slab[src]) (dst padded out-of-range -> mode='drop')
+        plus the cached nfeas vector, whose repaired rows are re-summed
+        from the slab's feasibility plane IN the same dispatch — the
+        cached count can never go stale across a repair.  The planes
+        are DONATED: XLA updates them in place instead of copying ~20MB
+        of [B, C] state per repaired chunk (the engine re-references
+        the returned planes; nothing else holds the old ones)."""
         fn = self._repair_program_cache.get("repair")
         if fn is None:
-            def impl(planes, slab, src, dst):
-                return tuple(
+            def impl(planes, slab, src, dst, nfeas):
+                out = tuple(
                     p.at[dst].set(s[src], mode="drop")
                     for p, s in zip(planes, slab)
                 )
+                # slab[4] is the slab's feasibility plane.  The nfeas
+                # vector argument is deliberately NOT donated: it is
+                # [B] i32 (copy cost ~nothing next to the ~20MB plane
+                # scatters), and chain-donating it proved hazardous —
+                # the tiny buffer also sits in the dispatch ledger's
+                # smallest-leaf watch set, and recycling it under an
+                # outstanding reference let a later allocation clobber
+                # the live vector (caught by the nfeas-consistency
+                # differential as an all-zero cached count).
+                nf_rows = jnp.sum(slab[4][src] != 0, axis=1, dtype=jnp.int32)
+                return out + (nfeas.at[dst].set(nf_rows, mode="drop"),)
 
             donate = (0,) if self.donate else ()
             if self._grid_sharding is not None:
                 grid, rep = self._grid_sharding, self._replicated
                 fn = jax.jit(
                     impl,
-                    in_shardings=((grid,) * 6, (grid,) * 6, rep, rep),
-                    out_shardings=(grid,) * 6,
+                    in_shardings=(
+                        (grid,) * 6, (grid,) * 6, rep, rep, rep,
+                    ),
+                    out_shardings=(grid,) * 6 + (rep,),
                     donate_argnums=donate,
                 )
             else:
@@ -3140,6 +3256,7 @@ class SchedulerEngine:
             if s >= len(slabs) or slabs[s][1].selected.shape[1] != c_pad:
                 return False
         planes = entry.prev_out + (entry.prev_feas, entry.prev_reasons)
+        nfeas = self._ensure_nfeas(entry)
         fn = self._repair_program()
         for s, (srcs, dsts) in segments.items():
             out = slabs[s][1]
@@ -3161,10 +3278,12 @@ class SchedulerEngine:
                 dseg = dsts[g : g + 128]
                 dst[: len(dseg)] = dseg
                 self.dispatches_total += 1
-                planes = fn(planes, slab_planes, src, dst)
+                out7 = fn(planes, slab_planes, src, dst, nfeas)
+                planes, nfeas = out7[:6], out7[6]
         entry.prev_out = planes[:4]
         entry.prev_feas = planes[4]
         entry.prev_reasons = planes[5]
+        entry.prev_nfeas = nfeas
         entry.stale_out_rows = (
             sorted(set(entry.stale_out_rows) - set(changed_rows))
             if entry.stale_out_rows
@@ -3236,6 +3355,48 @@ class SchedulerEngine:
         cache[key] = info
         return info
 
+    def _nfeas_program(self):
+        """Jitted feasible-count reduce: i8[B, C] prev_feas -> i32[B].
+        Dispatched once per prev-plane STORE (full dispatches, restore
+        misses) instead of once per drift GATE — the r11 gate re-derived
+        this count with a [B, C] pf.sum pass on every drift tick."""
+        fn = self._nfeas_cache.get("nfeas")
+        if fn is None:
+
+            def impl(feas):
+                return jnp.sum(feas != 0, axis=1, dtype=jnp.int32)
+
+            if self._grid_sharding is not None:
+                fn = jax.jit(
+                    impl,
+                    in_shardings=self._grid_sharding,
+                    out_shardings=self._replicated,
+                )
+            else:
+                fn = jax.jit(impl)
+            fn = self._aot.wrap("nfeas", fn)
+            fn = self._obs_wrap("nfeas", fn)
+            self._nfeas_cache["nfeas"] = fn
+        return fn
+
+    def _store_nfeas(self, entry, feas) -> None:
+        """Maintain the cached per-row feasible-count vector alongside a
+        fresh prev_feas store (one tiny async reduce, off the drift
+        tick's critical path)."""
+        self.dispatches_total += 1
+        entry.prev_nfeas = self._nfeas_program()(feas)
+
+    def _ensure_nfeas(self, entry):
+        """The chunk's cached nfeas vector, derived lazily when a store
+        site predates the cache (restored snapshots, revert knobs)."""
+        b_pad = entry.prev_feas.shape[0]
+        nf = entry.prev_nfeas
+        if nf is None or tuple(nf.shape) != (b_pad,):
+            self.dispatches_total += 1
+            nf = self._nfeas_program()(entry.prev_feas)
+            entry.prev_nfeas = nf
+        return nf
+
     def _gate_program(self, fmt: str):
         """Jitted drift gate per format (jax re-traces per shape; the
         gate is a cheap filter-slice program, so the trace cost is
@@ -3253,10 +3414,10 @@ class SchedulerEngine:
             donate = (3,) if self.donate else ()
 
             def impl(per_object, tables, prev_feas, prev_scores, ao, uo,
-                     an, un, didx, dvalid, dcpu, fin_idx):
+                     an, un, didx, dvalid, dcpu, fin_idx, nfeas):
                 return drift_gate_compact(
                     per_object, tables, prev_feas, prev_scores, ao, uo,
-                    an, un, didx, dvalid, dcpu, fin_idx, cur_absent,
+                    an, un, didx, dvalid, dcpu, fin_idx, nfeas, cur_absent,
                 )
 
             if self._grid_sharding is not None:
@@ -3268,7 +3429,7 @@ class SchedulerEngine:
                         self._per_object_shardings_compact,
                         self._table_shardings,
                         grid, grid,
-                        rep, rep, rep, rep, rep, rep, rep, rep,
+                        rep, rep, rep, rep, rep, rep, rep, rep, rep,
                     ),
                     out_shardings=(rep, grid),
                     donate_argnums=donate,
@@ -3286,7 +3447,7 @@ class SchedulerEngine:
                     in_shardings=(
                         self._per_object_shardings,
                         grid, grid,
-                        rep, rep, rep, rep, rep, rep, rep, rep,
+                        rep, rep, rep, rep, rep, rep, rep, rep, rep,
                     ),
                     out_shardings=(rep, grid),
                     donate_argnums=donate,
@@ -3364,7 +3525,8 @@ class SchedulerEngine:
         return idx
 
     def _repair_stale_inputs(
-        self, entry, fmt: str, c_bucket: int, vocab=None
+        self, entry, fmt: str, c_bucket: int, vocab=None,
+        phase: str = "dispatch", patch_tiebreak: bool = True,
     ) -> None:
         """Scatter just the stale rows' host inputs into the cached
         device per-object tensors (width-aligned to the cached padded
@@ -3374,10 +3536,26 @@ class SchedulerEngine:
         trace whatever the churned-row count.  The precomputed
         tie-break plane rides the same groups (its FNV rows recompute
         on device from the patched key bytes), so churn never forces a
-        whole-chunk rescan before the next drift."""
+        whole-chunk rescan before the next drift.
+
+        ``phase`` labels the engine_stale_rows_total counter: "churn"
+        (eager repair inside the tick that created the stale rows),
+        "drift" (gate-path backstop; must stay 0 under eager repair),
+        "dispatch" (full-dispatch upload path).
+
+        ``patch_tiebreak=False`` (the eager churn path) repairs the
+        per-object planes but DEFERS the tie-break FNV recompute: the
+        plane's only consumers are the drift survivor kernels, and the
+        FNV patch is ~10x the plain input scatter (measured ~12ms per
+        c3 steady tick when run eagerly) — the deferred rows are
+        recorded on the entry and flushed by _tiebreak_plane before any
+        survivor dispatch reads the plane."""
         stale = entry.stale_rows
         if not stale or entry.device_per_object is None:
             return
+        self.stale_repair_rows[phase] = (
+            self.stale_repair_rows.get(phase, 0) + len(stale)
+        )
         b_pad = entry.padded_shape[0]
         n = len(stale)
         idx = np.full(-(-n // 128) * 128, stale[0], np.int64)  # pad: valid row
@@ -3399,12 +3577,13 @@ class SchedulerEngine:
         dst_all[:n] = stale
         dev = entry.device_per_object
         tb = entry.tiebreak_dev
-        tb_ok = (
+        tb_live = (
             fmt == "compact"
             and vocab is not None
             and tb is not None
             and tb.shape == (b_pad, c_bucket)
         )
+        tb_ok = tb_live and patch_tiebreak
         state_dev = (
             self._tables_device(vocab, c_bucket)["name_hash_state"]
             if tb_ok
@@ -3427,7 +3606,17 @@ class SchedulerEngine:
                 )
         entry.device_per_object = dev
         if fmt == "compact":
-            entry.tiebreak_dev = tb if tb_ok else None
+            if tb_ok:
+                entry.tiebreak_dev = tb
+            elif tb_live:
+                # Deferred: keep the plane, mark the rows for the lazy
+                # FNV re-patch at first survivor use.
+                entry.tb_stale_rows = sorted(
+                    set(entry.tb_stale_rows or ()) | set(stale)
+                )
+            else:
+                entry.tiebreak_dev = None
+                entry.tb_stale_rows = None
         entry.stale_rows = None
 
     def _dispatch_drift_gate(
@@ -3442,12 +3631,14 @@ class SchedulerEngine:
         if entry.stale_rows:
             # Rows churned since the last full dispatch left stale
             # device INPUT copies — scatter-repair them now so the gate
-            # classifies them like everyone else.  Without this, every
-            # row churned during steady operation is gate-blind and
-            # forced into the recompute set at the next drift — at
-            # bench churn rates that was ~30% of all drift recompute
-            # work, none of it reflecting a real decision change.
-            self._repair_stale_inputs(entry, fmt, c_bucket, vocab=vocab)
+            # classifies them like everyone else.  With eager churn-tick
+            # repair on (the ISSUE 11 satellite) this arm never fires
+            # (engine_stale_rows_total{phase="drift"} stays 0); it is
+            # kept as the correctness backstop for paths that cannot
+            # repair eagerly (no device copy at churn time).
+            self._repair_stale_inputs(
+                entry, fmt, c_bucket, vocab=vocab, phase="drift"
+            )
         self.dispatches_total += 1
         slices = (
             info["alloc_old_d"], info["used_old_d"],
@@ -3455,6 +3646,7 @@ class SchedulerEngine:
         )
         self.upload_bytes["cluster"] += sum(a.nbytes for a in slices)
         fin_idx = self._fin_rows(entry, b_pad)
+        nfeas = self._ensure_nfeas(entry)
         if fmt == "compact":
             return gate(
                 entry.device_per_object,
@@ -3463,6 +3655,7 @@ class SchedulerEngine:
                 entry.prev_out[3],
                 *slices,
                 info["didx"], info["dvalid"], info["dcpu"], fin_idx,
+                nfeas,
             )
         return gate(
             entry.device_per_object,
@@ -3470,6 +3663,7 @@ class SchedulerEngine:
             entry.prev_out[3],
             *slices,
             info["didx"], info["dvalid"], info["dcpu"], fin_idx,
+            nfeas,
         )
 
     def _tb_program(self, kind: str):
@@ -3531,12 +3725,40 @@ class SchedulerEngine:
     def _tiebreak_plane(self, entry, fmt: str, vocab, c_bucket: int):
         """The chunk's device-resident tie-break plane (compact format),
         computed lazily when the upload-time build was skipped or the
-        padded shape moved."""
+        padded shape moved; rows whose FNV re-patch was deferred by the
+        eager churn-tick repair are flushed HERE, before any survivor
+        kernel reads the plane (its only consumer)."""
         if fmt != "compact" or entry.device_per_object is None:
             return None
         b_pad = entry.padded_shape[0]
         tb = entry.tiebreak_dev
         if tb is not None and tb.shape == (b_pad, c_bucket):
+            if entry.tb_stale_rows:
+                pend = [r for r in entry.tb_stale_rows]
+                l_pad = entry.padded_shape[3]
+                n = len(pend)
+                idx = np.full(-(-n // 128) * 128, pend[0], np.int64)
+                idx[:n] = pend
+                piece = self._slice_rows(entry, idx.tolist())
+                piece = Cmp.pad_axis1(piece, {"key_bytes": 0}, l_pad)
+                kb = np.asarray(piece.key_bytes)
+                kl = np.asarray(piece.key_len)
+                state_dev = self._tables_device(vocab, c_bucket)[
+                    "name_hash_state"
+                ]
+                dst_all = np.full(idx.shape[0], b_pad, np.int32)
+                dst_all[:n] = pend
+                for g in range(0, idx.shape[0], 128):
+                    self.dispatches_total += 1
+                    tb = self._tb_program("patch")(
+                        tb,
+                        np.ascontiguousarray(kb[g : g + 128]),
+                        np.ascontiguousarray(kl[g : g + 128]),
+                        state_dev,
+                        dst_all[g : g + 128],
+                    )
+                entry.tiebreak_dev = tb
+                entry.tb_stale_rows = None
             return tb
         tables = self._tables_device(vocab, c_bucket)
         self.dispatches_total += 1
@@ -3546,6 +3768,7 @@ class SchedulerEngine:
             tables["name_hash_state"],
         )
         entry.tiebreak_dev = tb
+        entry.tb_stale_rows = None
         return tb
 
     def _resolve_program(self, fmt: str, m: int):
@@ -3859,6 +4082,152 @@ class SchedulerEngine:
                 })
         return jobs
 
+    def _survivor_program(self, fmt: str, m: int):
+        """Jitted UNIFIED survivor solve per (format, M) — the ISSUE 11
+        tentpole: gather the survivor rows' cached device inputs plus
+        the stored reason plane, expand (compact — with the precomputed
+        tie-break plane, never the FNV scan) and run
+        ops.pipeline.drift_survivor, which subsumes the resolve /
+        replan / score-only specializations exactly (see its
+        docstring).  Needs NO stored score plane (scores recompute from
+        stored filters) and NO delta-column info (wide drifts ride it
+        too).  Mesh handling mirrors _resolve_program: the gathered
+        sub-problem replicates, outputs constrain back to the grid for
+        the in-place repair; the wire pack is fused at K = narrow M."""
+        key = (fmt, m)
+        fn = self._survivor_programs.get(key)
+        if fn is not None:
+            return fn
+        per_object = tuple(self._per_object_fields(fmt))
+        replicated = self._replicated
+        grid = self._grid_sharding
+        i32_keys = self.phase1_i32
+
+        def impl(device_in, idx, prev_reasons, tb=None, _fmt=fmt, _m=m):
+            rows = {name: getattr(device_in, name)[idx] for name in per_object}
+            sub = device_in._replace(**rows)
+            rsn_r = prev_reasons[idx]
+            tb_r = tb[idx] if tb is not None else None
+            if replicated is not None:
+                sub = type(sub)(
+                    *(
+                        jax.lax.with_sharding_constraint(x, replicated)
+                        for x in sub
+                    )
+                )
+                rsn_r = jax.lax.with_sharding_constraint(rsn_r, replicated)
+                if tb_r is not None:
+                    tb_r = jax.lax.with_sharding_constraint(tb_r, replicated)
+            inp = (
+                expand_compact(sub, tiebreak=tb_r)
+                if _fmt == "compact"
+                else sub
+            )
+            out, cert = drift_survivor(inp, rsn_r, _m, i32_keys=i32_keys)
+            # Fused wire pack — see _resolve_program.
+            k = min(_m, out.selected.shape[1])
+            wire = pack_wire(
+                out.selected, out.replicas, out.counted, out.scores,
+                out.reasons, k,
+            )
+            if replicated is not None:
+                wire = jax.lax.with_sharding_constraint(wire, replicated)
+            if grid is not None:
+                out = TickOutputs(
+                    *(
+                        jax.lax.with_sharding_constraint(x, grid)
+                        for x in out
+                    )
+                )
+            return out, cert, wire
+
+        fn = self._aot.wrap(f"survivor:{fmt}:m{m}", jax.jit(impl))
+        fn = self._obs_wrap("survivor", fn)
+        self._survivor_programs[key] = fn
+        return fn
+
+    def _dispatch_drift_survivors(
+        self, pi: int, entry, n: int, fmt: str, b_pad: int,
+        mask: np.ndarray, rec: set, forced: set, cluster_dev, vocab,
+        c_bucket: int,
+    ) -> list[dict]:
+        """Dispatch ONE unified survivor stream for a gated chunk: every
+        recompute-classified row (fit flip or not, kinf or finite-K)
+        rides the same greedy-grouped drift_survivor program, so the
+        chunk pays one {256,128,64} padding ladder instead of three.
+        The per-row mode vector (resolve/replan/score_only — what the
+        three-stream dispatch would have picked) is carried host-side
+        for attribution only.  Returns the dispatched jobs ([] when the
+        chunk cannot take the path); cert failures stay in the
+        recompute set and take the slab path."""
+        if not self.survivor_unified or self.fetch_format != "packed":
+            return []
+        if (
+            entry.prev_reasons is None
+            or entry.device_per_object is None
+            or entry.prev_feas is None
+            or entry.prev_reasons.shape != entry.prev_feas.shape
+        ):
+            return []
+        m = self._narrow_m(entry.inputs, c_bucket)
+        if m is None:
+            return []
+        rows = sorted(rec - forced)
+        if not rows:
+            return []
+        if mask is None:
+            # Second-wave dispatch: weight-changed wcheck rows (kinf,
+            # no fit flip — the gate already proved selection equals
+            # the feasible set; only their dynamic-weight planner run
+            # moves).  r11 sent these through full slabs.
+            modes = {r: "wcheck" for r in rows}
+        else:
+            fitflip = set(np.nonzero(mask & DRIFT_FITFLIP)[0].tolist())
+            mc = np.asarray(entry.inputs.max_clusters)
+            kinf_host = (mc == INT32_INF) | (mc < 0)
+            modes = {
+                r: (
+                    "resolve"
+                    if r not in fitflip
+                    else ("replan" if kinf_host[r] else "score_only")
+                )
+                for r in rows
+            }
+        # Same wire-pack K policy as the three-stream paths: narrow M is
+        # stable across drift ticks and prewarm-known (K-overflow rows
+        # ride the existing bit-packed re-fetch).
+        pack_k = min(m, c_bucket)
+        if fmt == "compact":
+            device_in = CompactInputs(
+                **entry.device_per_object,
+                **self._tables_device(vocab, c_bucket),
+                **cluster_dev,
+            )
+            tb = self._tiebreak_plane(entry, fmt, vocab, c_bucket)
+        else:
+            device_in = TickInputs(**entry.device_per_object, **cluster_dev)
+            tb = None
+        prog = self._survivor_program(fmt, m)
+        jobs: list[dict] = []
+        self.survivor_stats["rows"] += len(rows)
+        for seg, g in self._survivor_groups(rows):
+            idx = np.full(g, b_pad, np.int32)
+            idx[: len(seg)] = seg
+            self.dispatches_total += 1
+            self.survivor_stats["groups"] += 1
+            self.survivor_stats["padded_rows"] += g
+            args = (device_in, idx, entry.prev_reasons)
+            if tb is not None:
+                args = args + (tb,)
+            out, cert, wire = prog(*args)
+            jobs.append({
+                "pi": pi, "entry": entry, "rows": seg, "out": out,
+                "cert": cert, "wire": wire, "pack_k": pack_k, "fmt": fmt,
+                "kind": "unified",
+                "modes": [modes[r] for r in seg],
+            })
+        return jobs
+
     def _repair_entry_rows(self, entry, out, src_pos, dst_rows) -> bool:
         """Scatter resolve-output rows back into the chunk's cached prev
         planes in place (the 6-plane donated repair: selection planes +
@@ -3879,6 +4248,7 @@ class SchedulerEngine:
         ):
             return False
         planes = entry.prev_out + (entry.prev_feas, entry.prev_reasons)
+        nfeas = self._ensure_nfeas(entry)
         fn = self._repair_program()
         out_planes = (
             out.selected, out.replicas, out.counted, out.scores,
@@ -3894,10 +4264,12 @@ class SchedulerEngine:
             dseg = np.asarray(dst_rows[g : g + 128])
             dst[: dseg.size] = dseg
             self.dispatches_total += 1
-            planes = fn(planes, out_planes, src, dst)
+            out7 = fn(planes, out_planes, src, dst, nfeas)
+            planes, nfeas = out7[:6], out7[6]
         entry.prev_out = planes[:4]
         entry.prev_feas = planes[4]
         entry.prev_reasons = planes[5]
+        entry.prev_nfeas = nfeas
         return True
 
     def _drain_drift_resolve(
@@ -3936,6 +4308,8 @@ class SchedulerEngine:
             ok_pos = np.nonzero(cert != 0)[0]
             self.drift_stats[kind] += int(ok_pos.size)
             self.drift_stats[kind + "_fallback"] += int(nr - ok_pos.size)
+            if kind == "unified":
+                self.survivor_stats["fallback_rows"] += int(nr - ok_pos.size)
             handled = {rows[p] for p in ok_pos.tolist()}
             plans[job["pi"]][3] -= handled
             if not ok_pos.size:
@@ -4054,24 +4428,35 @@ class SchedulerEngine:
                             newc["cpu_alloc"], newc["cpu_avail"],
                         ))
                     )
-            # Sort-free resolve of the eligible survivors (recompute
-            # rows without a fit flip): dispatched immediately, so the
-            # resolve programs overlap the remaining gates' compute.
-            resolve_jobs.extend(
-                self._dispatch_drift_resolve(
-                    len(plans) - 1, entry, n, fmt, b_pad, pack_k, info,
-                    mask, rec, forced, newc, vocab, c_bucket,
+            if self.survivor_unified:
+                # ONE unified survivor stream per chunk (the ISSUE 11
+                # tentpole): every recompute row — fit flip or not —
+                # rides the same greedy-grouped drift_survivor program,
+                # dispatched immediately so it overlaps the remaining
+                # gates' compute.
+                resolve_jobs.extend(
+                    self._dispatch_drift_survivors(
+                        len(plans) - 1, entry, n, fmt, b_pad, mask, rec,
+                        forced, newc, vocab, c_bucket,
+                    )
                 )
-            )
-            # Fit-flip survivors: selection-known replan (kinf) and
-            # score-only narrow solve (finite-K) from stored planes —
-            # dispatched now too, overlapping the remaining gates.
-            resolve_jobs.extend(
-                self._dispatch_drift_replans(
-                    len(plans) - 1, entry, n, fmt, b_pad, mask, rec,
-                    forced, newc, vocab, c_bucket,
+            else:
+                # KT_SURVIVOR_UNIFIED=0 revert: the r11 three-stream
+                # dispatch (sort-free resolve for no-fit-flip rows,
+                # selection-known replan for kinf fit-flips, score-only
+                # narrow solve for finite-K fit-flips).
+                resolve_jobs.extend(
+                    self._dispatch_drift_resolve(
+                        len(plans) - 1, entry, n, fmt, b_pad, pack_k,
+                        info, mask, rec, forced, newc, vocab, c_bucket,
+                    )
                 )
-            )
+                resolve_jobs.extend(
+                    self._dispatch_drift_replans(
+                        len(plans) - 1, entry, n, fmt, b_pad, mask, rec,
+                        forced, newc, vocab, c_bucket,
+                    )
+                )
             timings["decode"] += time.perf_counter() - t0
 
         if resolve_jobs:
@@ -4094,14 +4479,48 @@ class SchedulerEngine:
                     )
                     for j, i in enumerate(members):
                         warr[i] = stacked[j]
+            changed_by_pi: dict[int, list] = {}
             for i, (pi, wrows, _dev) in enumerate(wcheck_jobs):
                 changed = wrows[warr[i][: wrows.size] != 0]
                 self.drift_stats["wcheck_changed"] += int(changed.size)
                 plans[pi][3] |= set(changed.tolist())
+                if changed.size:
+                    changed_by_pi.setdefault(pi, []).extend(
+                        changed.tolist()
+                    )
             timings["gate_wait"] = (
                 timings.get("gate_wait", 0.0) + time.perf_counter() - t0
             )
             timings["fetch"] += time.perf_counter() - t0
+            if self.survivor_unified and changed_by_pi:
+                # Weight-changed wcheck rows are unified-eligible too:
+                # kinf, no fit flip, trustworthy stored reasons — the
+                # kernel re-derives selection (= the feasible set) and
+                # re-runs the planner with fresh dynamic weights,
+                # cert-guarded like every survivor.  Dispatched ONLY
+                # when the chunk's changed set is small (one greedy
+                # group): that is the padding-waste regime the unified
+                # stream exists for (130 rows in a 1024-row slab = 8x
+                # the math); a LARGE changed set already packs a slab
+                # near-perfectly, and the slab's one-dispatch drain
+                # beats a multi-group survivor drain there (measured:
+                # c3's 1000-row wcheck drift was 555ms via one slab vs
+                # ~1050ms via 8 survivor groups).
+                wave2: list[dict] = []
+                for pi, rows_c in changed_by_pi.items():
+                    if len(rows_c) > 256:
+                        continue
+                    _slot, entry, n, _rec, fmt, b_pad, _pk = plans[pi]
+                    wave2.extend(
+                        self._dispatch_drift_survivors(
+                            pi, entry, n, fmt, b_pad, None, set(rows_c),
+                            set(), newc, vocab, c_bucket,
+                        )
+                    )
+                if wave2:
+                    self._drain_drift_resolve(
+                        wave2, plans, plan_resolved, view, timings,
+                    )
 
         t0 = time.perf_counter()
         fallback: list[tuple] = []
@@ -4245,6 +4664,7 @@ class SchedulerEngine:
                 entry.padded_shape = shape
                 entry.stale_rows = None
                 entry.tiebreak_dev = None
+                entry.tb_stale_rows = None
                 if fmt == "compact" and vocab is not None:
                     # Precompute the tie-break plane off the fresh
                     # upload (async; amortizes into the cold/miss path
@@ -4708,6 +5128,7 @@ class SchedulerEngine:
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
         entry.prev_feas = out.feasible
         entry.prev_reasons = out.reasons
+        self._store_nfeas(entry, out.feasible)
         entry.stale_out_rows = None
         entry.prev_view = view
 
@@ -4765,6 +5186,7 @@ class SchedulerEngine:
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
         entry.prev_feas = out.feasible
         entry.prev_reasons = out.reasons
+        self._store_nfeas(entry, out.feasible)
         entry.stale_out_rows = None
         entry.prev_results = merged
         entry.prev_view = view
@@ -4800,6 +5222,7 @@ class SchedulerEngine:
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
             entry.prev_feas = out.feasible
             entry.prev_reasons = out.reasons
+            self._store_nfeas(entry, out.feasible)
             entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
@@ -4928,6 +5351,7 @@ class SchedulerEngine:
         entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
         entry.prev_feas = out.feasible
         entry.prev_reasons = out.reasons
+        self._store_nfeas(entry, out.feasible)
         entry.stale_out_rows = None
         entry.prev_results = merged
         entry.prev_view = view
@@ -4954,6 +5378,7 @@ class SchedulerEngine:
             entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
             entry.prev_feas = out.feasible
             entry.prev_reasons = out.reasons
+            self._store_nfeas(entry, out.feasible)
             entry.stale_out_rows = None
             entry.prev_results = results
             entry.prev_has_scores = want_scores
@@ -5268,6 +5693,10 @@ class SchedulerEngine:
             # _fin_rows), at both delta shapes: a drift tick must
             # never stall on a gate compile, whatever the finite-K
             # row fraction or changed-column count.
+            # The cached-nfeas reduce (prev-plane store sites).
+            jax.block_until_ready(
+                self._nfeas_program()(np.zeros(shape, np.int8))
+            )
             for fin_n in sorted({max(64, b_pad // 4), b_pad}):
                 fin_pad = np.full(fin_n, 1 << 30, np.int32)
                 for nb in (1, 8):
@@ -5280,6 +5709,7 @@ class SchedulerEngine:
                             np.zeros(shape, np.int32),
                             dslice, dslice, dslice, dslice,
                             didx, dflag, dflag, fin_pad,
+                            np.zeros(b_pad, np.int32),
                         )
                     )
             # The 128-row input-patch group (stale-row repair):
@@ -5365,6 +5795,23 @@ class SchedulerEngine:
                             np.zeros(shape, np.int32), tb_warm,
                         )
                         jax.block_until_ready(rp_wire)
+            if narrow_m is not None and self.survivor_unified:
+                # The UNIFIED survivor kernel (the production drift
+                # survivor path): its greedy {256,128,64} groups plus
+                # the fused wire pack, so a live drift's single
+                # survivor stream never stalls on a trace.
+                device_in_warm = padded._replace(
+                    **Cmp.pad_tables(vocab.tables(), c_bucket)
+                )
+                for g in (64, 128, 256):
+                    gidx = np.full(g, b_pad, np.int32)
+                    sv_out, sv_cert, sv_wire = self._survivor_program(
+                        "compact", narrow_m
+                    )(
+                        device_in_warm, gidx,
+                        np.zeros(shape, np.int32), tb_warm,
+                    )
+                    jax.block_until_ready(sv_wire)
             # Weight-check groups in both arithmetic widths — the i32
             # demotion is view-dependent, so a live drift may dispatch
             # either.
@@ -5391,7 +5838,7 @@ class SchedulerEngine:
         # non-donated inputs) and threads each call's results.
         big = max(shapes)
         pshape = (big, c_bucket)
-        planes = jax.jit(
+        all_planes = jax.jit(
             lambda: (
                 jnp.zeros(pshape, jnp.int8),
                 jnp.zeros(pshape, jnp.int32),
@@ -5399,18 +5846,21 @@ class SchedulerEngine:
                 jnp.zeros(pshape, jnp.int32),
                 jnp.zeros(pshape, jnp.int8),
                 jnp.zeros(pshape, jnp.int32),
+                jnp.zeros(big, jnp.int32),  # cached nfeas vector
             )
         )()
+        planes, nfeas = all_planes[:6], all_planes[6]
         src128 = np.zeros(128, np.int32)
         dst128 = np.full(128, big, np.int32)  # out of range: no-op
         for b_pad in shapes:
             slab = outs[b_pad]
-            planes = self._repair_program()(
+            out7 = self._repair_program()(
                 planes,
                 (slab.selected, slab.replicas, slab.counted,
                  slab.scores, slab.feasible, slab.reasons),
-                src128, dst128,
+                src128, dst128, nfeas,
             )
+            planes, nfeas = out7[:6], out7[6]
             jax.block_until_ready(planes[0])
 
     def prewarm(

@@ -40,6 +40,8 @@ EXPECTED_JIT_SITES = {
     "_wcheck_program",
     "_resolve_program",
     "_replan_program",       # replan + score-only variants
+    "_survivor_program",     # unified survivor kernel (ISSUE 11)
+    "_nfeas_program",        # cached per-row feasible-count reduce
     "_tb_program",           # tiebreak plane full/patch builders
     "_repair_program",
     "_prewarm_ladder",       # the transient prewarm-only repair chain seed
@@ -124,6 +126,8 @@ def test_every_builder_routes_through_aot_and_ledger(tmp_path, monkeypatch):
         ("_resolve_program", eng._resolve_program("compact", 16)),
         ("_replan_program", eng._replan_program("compact", 16, False)),
         ("_scoreonly_program", eng._replan_program("compact", 16, True)),
+        ("_survivor_program", eng._survivor_program("compact", 16)),
+        ("_nfeas_program", eng._nfeas_program()),
         ("_tb_program/full", eng._tb_program("full")),
         ("_tb_program/patch", eng._tb_program("patch")),
         ("_repair_program", eng._repair_program()),

@@ -100,7 +100,15 @@ def _engine(**kw):
     kw.setdefault("min_bucket", 32)
     kw.setdefault("min_cluster_bucket", 8)
     kw.setdefault("narrow_m", 16)
-    return SchedulerEngine(**kw)
+    # This module exercises the PR-10 THREE-STREAM survivor paths
+    # (resolve / replan / score_only), kept alive behind
+    # KT_SURVIVOR_UNIFIED=0 as the documented revert; the unified
+    # kernel that replaced them as the default has its own suite
+    # (tests/test_survivor_unified.py).
+    unified = kw.pop("survivor_unified", False)
+    eng = SchedulerEngine(**kw)
+    eng.survivor_unified = unified
+    return eng
 
 
 class TestReplanScoreOnly:

@@ -1,0 +1,123 @@
+"""Pallas slab-front parity (ISSUE 11).
+
+ops/pallas_slab.py hand-fuses the narrow slab's per-cell phase-1 front
+(filters, reason bits, score plugins + normalizations) into one
+VMEM-resident Pallas pass per row block.  On CPU the kernel runs in
+interpreter mode — the SAME kernel body tier-1 can execute — and the
+contract is bit-identity with the XLA ``_phase1`` on every plane, so
+the narrow solve fed the Pallas triple reproduces the XLA narrow solve
+exactly (certificates included).  KT_PALLAS=1 routes the engine's
+narrow programs through it; KT_PALLAS=0 (the default) keeps the XLA
+path.
+"""
+
+import numpy as np
+import pytest
+
+from test_drift_replan import _fitflip_world, _quarter_cpu
+from test_engine_cache import results_equal
+from test_pipeline import random_problem, to_tick_inputs
+
+from kubeadmiral_tpu.ops import pallas_slab as ps
+from kubeadmiral_tpu.ops import pipeline as dev
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+PLANES = ("selected", "replicas", "counted", "feasible", "scores", "reasons")
+
+
+def _random_inputs(rng, b, c, webhook=False, invalid_cols=0):
+    names = [f"member-{j}" for j in range(c)]
+    problems = [random_problem(rng, c, f"ns/w-{i}", names) for i in range(b)]
+    inp = to_tick_inputs(problems, c)
+    if webhook:
+        inp = inp._replace(
+            webhook_ok=rng.random((b, c)) > 0.15,
+            webhook_scores=rng.integers(-50, 200, (b, c)).astype(np.int64),
+        )
+    if invalid_cols:
+        valid = np.ones(c, bool)
+        valid[-invalid_cols:] = False
+        inp = inp._replace(cluster_valid=valid)
+    return inp
+
+
+class TestPhase1Parity:
+    @pytest.mark.parametrize(
+        "b,c,webhook,invalid",
+        [
+            (16, 24, False, 0),
+            (32, 12, True, 3),    # webhook planes + padded columns
+            (13, 40, False, 0),   # odd B: block-rows fallback path
+            (8, 200, True, 7),    # wide-ish cluster axis
+        ],
+    )
+    def test_bit_identical_to_xla_phase1(self, b, c, webhook, invalid):
+        rng = np.random.default_rng(b * 1000 + c)
+        inp = _random_inputs(rng, b, c, webhook=webhook, invalid_cols=invalid)
+        f_ref, r_ref, t_ref = dev._phase1(inp)
+        f_pl, r_pl, t_pl = ps.phase1_slab(inp, interpret=True)
+        assert np.array_equal(np.asarray(f_ref), np.asarray(f_pl))
+        assert np.array_equal(np.asarray(r_ref), np.asarray(r_pl))
+        assert np.array_equal(np.asarray(t_ref), np.asarray(t_pl))
+
+    def test_narrow_solve_with_pallas_phase1_bit_identical(self):
+        rng = np.random.default_rng(42)
+        inp = _random_inputs(rng, 48, 32, webhook=True, invalid_cols=2)
+        out_x, cert_x = dev.schedule_tick_narrow(inp, 8)
+        out_p, cert_p = dev.schedule_tick_narrow(
+            inp, 8, phase1=ps.phase1_slab(inp, interpret=True)
+        )
+        for name, a, b in zip(out_x._fields, out_x, out_p):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), name
+        assert np.array_equal(np.asarray(cert_x), np.asarray(cert_p))
+
+    def test_i32_keys_path_unchanged(self):
+        rng = np.random.default_rng(7)
+        inp = _random_inputs(rng, 24, 20)
+        out_x, cert_x = dev.schedule_tick_narrow(inp, 8, i32_keys=True)
+        out_p, cert_p = dev.schedule_tick_narrow(
+            inp, 8, i32_keys=True, phase1=ps.phase1_slab(inp, interpret=True)
+        )
+        for name, a, b in zip(out_x._fields, out_x, out_p):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), name
+        assert np.array_equal(np.asarray(cert_x), np.asarray(cert_p))
+
+
+class TestEngineKnob:
+    def _engine(self, **kw):
+        kw.setdefault("chunk_size", 64)
+        kw.setdefault("min_bucket", 32)
+        kw.setdefault("min_cluster_bucket", 8)
+        kw.setdefault("narrow_m", 16)
+        return SchedulerEngine(**kw)
+
+    def test_kt_pallas_engine_bit_identical(self, monkeypatch):
+        """KT_PALLAS=1: cold + churn + fit-flip drift through the
+        Pallas-fronted narrow programs equals the default engine."""
+        units, clusters = _fitflip_world(b=64, c=20)
+        monkeypatch.setenv("KT_PALLAS", "1")
+        eng_p = self._engine()
+        assert eng_p.pallas
+        monkeypatch.setenv("KT_PALLAS", "0")
+        eng_x = self._engine()
+        assert not eng_x.pallas
+
+        got = eng_p.schedule(units, clusters)
+        want = eng_x.schedule(units, clusters)
+        results_equal(got, want)
+
+        import dataclasses
+
+        churned = list(units)
+        churned[5] = dataclasses.replace(units[5], desired_replicas=77)
+        got = eng_p.schedule(churned, clusters)
+        want = eng_x.schedule(churned, clusters)
+        results_equal(got, want)
+
+        drifted = _quarter_cpu(clusters, 3)
+        got = eng_p.schedule(churned, drifted)
+        want = eng_x.schedule(churned, drifted)
+        results_equal(got, want)
+
+    def test_kt_pallas_default_off(self):
+        assert not self._engine().pallas

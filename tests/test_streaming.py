@@ -299,6 +299,10 @@ class TestDriftResolvePath:
         units, clusters = self._world()
         engine = SchedulerEngine(chunk_size=128, min_bucket=32,
                                  min_cluster_bucket=8, narrow_m=16)
+        # This class exercises the PR-7 sort-free resolve, kept behind
+        # KT_SURVIVOR_UNIFIED=0 (the unified kernel owns the default
+        # path — tests/test_survivor_unified.py).
+        engine.survivor_unified = False
         engine.schedule(units, clusters)
         engine.schedule(list(units), clusters)
         # One column goes fully free: its resource scores jump to the
@@ -324,6 +328,7 @@ class TestDriftResolvePath:
         units, clusters = self._world(b=64, c=20)
         engine = SchedulerEngine(chunk_size=64, min_bucket=32,
                                  min_cluster_bucket=8, narrow_m=16)
+        engine.survivor_unified = False
         engine.schedule(units, clusters)
         engine.schedule(list(units), clusters)
         world = list(clusters)
@@ -354,6 +359,7 @@ class TestDriftResolvePath:
         engine = SchedulerEngine(chunk_size=64, min_bucket=32,
                                  min_cluster_bucket=8, narrow_m=16)
         engine.drift_resolve = False
+        engine.survivor_unified = False
         engine.schedule(units, clusters)
         engine.schedule(list(units), clusters)
         drifted = [

@@ -1,0 +1,325 @@
+"""Unified survivor kernel + cached nfeas + eager stale repair (ISSUE 11).
+
+The drift gate's survivors — whatever their classification (no-fit-flip
+"resolve" rows, kinf fit-flip "replan" rows, finite-K fit-flip
+"score_only" rows) — now ride ONE greedy-grouped ``drift_survivor``
+stream per chunk (``engine_drift_rows_total{kind="unified"}``), the
+gate reads a CACHED per-row feasible-count vector instead of running a
+[B, C] pf.sum pass, and stale device inputs are repaired inside the
+churn tick that creates them.  Contract (same as every survivor path
+before it): certified rows are bit-identical to a stop-the-world dense
+re-solve; cert failures drop to the slab path — counted, never
+silently wrong.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubeadmiral_tpu.models.types import (
+    ClusterState,
+    MODE_DIVIDE,
+    SchedulingUnit,
+    parse_resources,
+)
+from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+from test_drift_replan import (
+    _clusters,
+    _fitflip_world,
+    _quarter_cpu,
+    GVK,
+)
+from test_engine_cache import results_equal
+
+
+def _engine(**kw):
+    kw.setdefault("chunk_size", 128)
+    kw.setdefault("min_bucket", 32)
+    kw.setdefault("min_cluster_bucket", 8)
+    kw.setdefault("narrow_m", 16)
+    return SchedulerEngine(**kw)
+
+
+def _warm(eng, units, clusters):
+    eng.schedule(units, clusters)
+    eng.schedule(list(units), clusters)
+
+
+class TestUnifiedSurvivor:
+    def test_unified_replaces_all_three_streams(self):
+        """A fit-flip drift (the replan/score-only home turf) settles
+        every survivor through kind=unified — the three-stream kinds
+        stay at zero — bit-identical to a fresh dense engine, with
+        flight-recorder parity INCLUDING top-k on every row (the
+        unified kernel recomputes scores, so even would-be-replan rows
+        carry exact fresh score planes — strictly stronger than the
+        replan path's documented fresh-as-of-last-solve staleness)."""
+        units, clusters = _fitflip_world()
+        rec = FlightRecorder(max_ticks=2, max_bytes=1 << 26)
+        eng = _engine(flight_recorder=rec)
+        _warm(eng, units, clusters)
+        drifted = _quarter_cpu(clusters, 3)
+        got = eng.schedule(units, drifted)
+        changed = eng.last_changed
+        assert eng.drift_stats["gated"] >= 1, eng.drift_stats
+        assert eng.drift_stats["unified"] > 0, eng.drift_stats
+        for kind in ("resolve", "replan", "score_only"):
+            assert eng.drift_stats[kind] == 0, eng.drift_stats
+        assert eng.survivor_stats["rows"] > 0, eng.survivor_stats
+        assert eng.survivor_stats["groups"] > 0, eng.survivor_stats
+        assert (
+            eng.survivor_stats["padded_rows"]
+            >= eng.survivor_stats["rows"]
+        )
+
+        oracle_rec = FlightRecorder(max_ticks=2, max_bytes=1 << 26)
+        oracle = _engine(flight_recorder=oracle_rec)
+        oracle.survivor_unified = False
+        oracle.narrow = False
+        want = oracle.schedule(units, drifted)
+        results_equal(got, want)
+        assert changed, "drift re-decided no rows"
+        for row in changed:
+            u = units[row]
+            a = rec.lookup(u.key)
+            b = oracle_rec.lookup(u.key)
+            assert a is not None and b is not None, u.key
+            assert a.placements == b.placements, u.key
+            assert np.array_equal(a.reason_counts, b.reason_counts), u.key
+            assert a.feasible_n == b.feasible_n, u.key
+            # No replan exemption: unified rows' top-k is exact.
+            assert np.array_equal(a.topk_idx, b.topk_idx), u.key
+            assert np.array_equal(a.topk_scores, b.topk_scores), u.key
+
+    def test_mixed_modes_ride_one_stream(self):
+        """A drift that simultaneously flips fit at one member AND
+        moves finite-K score rankings at another mixes all three
+        would-be modes in the same chunk; every survivor still lands in
+        kind=unified (one group stream), outputs exact."""
+        units, clusters = _fitflip_world(b=96, c=24)
+        eng = _engine()
+        _warm(eng, units, clusters)
+        world = _quarter_cpu(clusters, 3)  # fit flips at member 3
+        world = [
+            dataclasses.replace(c, available=dict(c.allocatable))
+            if j == 7  # member 7 fully free: rankings move, fit doesn't
+            else c
+            for j, c in enumerate(world)
+        ]
+        got = eng.schedule(units, world)
+        assert eng.drift_stats["unified"] > 0, eng.drift_stats
+        for kind in ("resolve", "replan", "score_only"):
+            assert eng.drift_stats[kind] == 0, eng.drift_stats
+        want = _engine().schedule(units, world)
+        results_equal(got, want)
+
+    def test_wide_delta_rides_unified(self):
+        """Drifts wider than the gate's rank-refinement bound (D > 8
+        changed columns) made the old resolve path ineligible — its
+        candidate completeness is O(D).  The unified kernel consults no
+        delta info, so wide drifts settle through it too (exactly)."""
+        units, clusters = _fitflip_world(b=96, c=48)
+        eng = _engine()
+        _warm(eng, units, clusters)
+        world = [
+            dataclasses.replace(
+                c,
+                available={
+                    "cpu": max(1, int(c.available["cpu"] * 0.6)),
+                    "memory": c.available["memory"],
+                },
+            )
+            if j < 10  # 10 changed columns: > DRIFT_REFINE_MAX_COLS,
+            else c     # < C/4 (the mass-change bail)
+            for j, c in enumerate(clusters)
+        ]
+        got = eng.schedule(units, world)
+        assert eng.drift_stats["gated"] >= 1, eng.drift_stats
+        assert eng.drift_stats["unified"] > 0, eng.drift_stats
+        want = _engine().schedule(units, world)
+        results_equal(got, want)
+
+    def test_planner_spill_forces_unified_fallback_exactly(self):
+        """Adversarial: Divide rows whose weighted cascade touches more
+        members than the narrow slot budget — the phantom-tail cert
+        fails, rows drop to the slab path (kind=unified_fallback,
+        survivor_stats.fallback_rows), outputs still exact."""
+        c = 40
+        clusters = _clusters(c, cpu=256, avail_fn=lambda j: {
+            "cpu": "200", "memory": "400Gi",
+        })
+        units = [
+            SchedulingUnit(
+                gvk=GVK,
+                namespace="ns",
+                name=f"wide-{i:04d}",
+                scheduling_mode=MODE_DIVIDE,
+                desired_replicas=400,
+                resource_request=parse_resources({"cpu": f"{2 + i % 3}"}),
+            )
+            for i in range(48)
+        ]
+        eng = _engine(chunk_size=64)
+        _warm(eng, units, clusters)
+        drifted = _quarter_cpu(clusters, 1)
+        drifted[1] = dataclasses.replace(
+            drifted[1],
+            available=parse_resources({"cpu": "1", "memory": "400Gi"}),
+        )
+        got = eng.schedule(units, drifted)
+        assert eng.drift_stats["unified_fallback"] > 0, eng.drift_stats
+        assert eng.survivor_stats["fallback_rows"] > 0, eng.survivor_stats
+        want = _engine(chunk_size=64).schedule(units, drifted)
+        results_equal(got, want)
+
+    def test_kt_survivor_unified_off_reverts_to_three_streams(self):
+        units, clusters = _fitflip_world(b=64, c=20)
+        eng = _engine(chunk_size=64)
+        eng.survivor_unified = False
+        _warm(eng, units, clusters)
+        drifted = _quarter_cpu(clusters, 3)
+        got = eng.schedule(units, drifted)
+        assert eng.drift_stats["unified"] == 0
+        legacy = (
+            eng.drift_stats["replan"] + eng.drift_stats["score_only"]
+            + eng.drift_stats["resolve"]
+            + eng.drift_stats["replan_fallback"]
+            + eng.drift_stats["score_only_fallback"]
+        )
+        assert legacy > 0, eng.drift_stats
+        want = _engine(chunk_size=64).schedule(units, drifted)
+        results_equal(got, want)
+
+
+def _cached_nfeas_consistent(eng) -> None:
+    """Every cached chunk's nfeas vector must equal the row sum of its
+    feasibility plane — the invariant every store/patch site keeps."""
+    checked = 0
+    for entry in eng._chunk_cache.values():
+        if entry.prev_feas is None or entry.prev_nfeas is None:
+            continue
+        want = (np.asarray(entry.prev_feas) != 0).sum(axis=1)
+        got = np.asarray(entry.prev_nfeas)
+        assert np.array_equal(got, want.astype(np.int32)), (
+            got, want,
+        )
+        checked += 1
+    assert checked > 0, "no cached chunk carried an nfeas vector"
+
+
+class TestCachedNfeas:
+    def test_nfeas_stays_exact_across_churn_drift_chain(self):
+        """churn -> drift -> churn -> drift: the cached nfeas vector is
+        patched by the slab repair and the survivor repair, consumed by
+        every gate — the chain must stay consistent with prev_feas AND
+        keep classification exact (results match fresh engines)."""
+        rng = np.random.default_rng(5)
+        units, clusters = _fitflip_world(b=96, c=24)
+        eng = _engine()
+        _warm(eng, units, clusters)
+        _cached_nfeas_consistent(eng)
+        world = list(clusters)
+        cur_units = list(units)
+        for step in range(4):
+            if step % 2 == 0:
+                # Churn: replace a handful of rows (patch + slab path).
+                cur_units = list(cur_units)
+                for i in rng.integers(0, len(cur_units), 7):
+                    u = cur_units[int(i)]
+                    cur_units[int(i)] = dataclasses.replace(
+                        u,
+                        desired_replicas=int(rng.integers(1, 40)),
+                        resource_request=parse_resources(
+                            {"cpu": f"{1 + int(rng.integers(0, 6))}"}
+                        ),
+                    )
+                got = eng.schedule(cur_units, world)
+            else:
+                # Drift: quarter one member's cpu (fit flips).
+                world = _quarter_cpu(world, int(rng.integers(0, len(world))))
+                got = eng.schedule(cur_units, world)
+                assert eng.drift_stats["gated"] >= 1, eng.drift_stats
+            want = _engine().schedule(cur_units, world)
+            results_equal(got, want)
+            _cached_nfeas_consistent(eng)
+
+    def test_nfeas_snapshot_roundtrip(self):
+        """A restored snapshot derives nfeas host-side; the first drift
+        tick after restore gates off it exactly."""
+        import pickle
+
+        units, clusters = _fitflip_world(b=64, c=20)
+        eng = _engine(chunk_size=64)
+        _warm(eng, units, clusters)
+        snap = pickle.loads(pickle.dumps(eng.snapshot_state()))
+        assert snap is not None
+
+        e2 = _engine(chunk_size=64)
+        e2.stage_restore(snap, assume_fresh=True)
+        drifted = _quarter_cpu(clusters, 3)
+        got = e2.schedule(units, drifted)
+        assert e2.restore_info["result"].startswith("loaded"), e2.restore_info
+        assert e2.drift_stats["gated"] >= 1, e2.drift_stats
+        want = _engine(chunk_size=64).schedule(units, drifted)
+        results_equal(got, want)
+        _cached_nfeas_consistent(e2)
+
+    def test_missing_nfeas_rederives_lazily(self):
+        """Dropping the cached vector (e.g. a revert knob flip) must
+        not break the gate: _ensure_nfeas re-derives it."""
+        units, clusters = _fitflip_world(b=64, c=20)
+        eng = _engine(chunk_size=64)
+        _warm(eng, units, clusters)
+        for entry in eng._chunk_cache.values():
+            entry.prev_nfeas = None
+        drifted = _quarter_cpu(clusters, 3)
+        got = eng.schedule(units, drifted)
+        assert eng.drift_stats["gated"] >= 1, eng.drift_stats
+        want = _engine(chunk_size=64).schedule(units, drifted)
+        results_equal(got, want)
+        _cached_nfeas_consistent(eng)
+
+
+class TestEagerStaleRepair:
+    def test_churn_tick_repairs_its_own_stale_rows(self):
+        """A churn tick's sub-batch pass leaves NO stale device-input
+        rows behind: the eager repair runs in the same tick (counted
+        phase=churn) and the next drift gate sees zero (phase=drift
+        stays 0) — results exact throughout."""
+        units, clusters = _fitflip_world(b=96, c=24)
+        eng = _engine()
+        _warm(eng, units, clusters)
+        churned = list(units)
+        for i in (3, 17, 40, 66):
+            churned[i] = dataclasses.replace(
+                units[i], desired_replicas=(units[i].desired_replicas or 1) + 9
+            )
+        eng.schedule(churned, clusters)
+        assert eng.stale_repair_rows["churn"] > 0, eng.stale_repair_rows
+        for entry in eng._chunk_cache.values():
+            assert not entry.stale_rows, entry.stale_rows
+        drifted = _quarter_cpu(clusters, 3)
+        got = eng.schedule(churned, drifted)
+        assert eng.stale_repair_rows["drift"] == 0, eng.stale_repair_rows
+        assert eng.drift_stats["gated"] >= 1, eng.drift_stats
+        want = _engine().schedule(churned, drifted)
+        results_equal(got, want)
+
+    def test_stale_counter_emitted(self):
+        from kubeadmiral_tpu.runtime.metrics import Metrics
+
+        units, clusters = _fitflip_world(b=64, c=20)
+        m = Metrics()
+        eng = _engine(chunk_size=64, metrics=m)
+        _warm(eng, units, clusters)
+        churned = list(units)
+        churned[5] = dataclasses.replace(units[5], desired_replicas=99)
+        eng.schedule(churned, clusters)
+        snap = m.snapshot()
+        assert any(
+            k.startswith("engine_stale_rows_total") and "churn" in k
+            for k in snap["counters"]
+        ), [k for k in snap["counters"] if "stale" in k]

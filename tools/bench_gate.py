@@ -301,6 +301,12 @@ def gate_churn(root: Path, tolerance: float) -> int:
                 "p99": detail.get("latency_ms_p99"),
                 "featurize": detail.get("featurize_per_flush_ms"),
                 "featurize_rows": detail.get("featurize_rows"),
+                # ISSUE 11: the unified-kernel shape block + the
+                # stale-repair phase split (informational), and the
+                # marker that this round ran the unified-kernel code
+                # (the hard absolute gates key off its presence).
+                "survivor_kernel": detail.get("survivor_kernel"),
+                "stale_repair_rows": detail.get("stale_repair_rows"),
             }
         )
     if not rounds:
@@ -361,6 +367,56 @@ def gate_churn(root: Path, tolerance: float) -> int:
             f"bench-gate: churn featurize_rows={latest['featurize_rows']} "
             f"— delta-only expected mid-stream, informational"
         )
+    if latest.get("survivor_kernel") is not None:
+        sk = latest["survivor_kernel"]
+        print(
+            f"bench-gate: churn survivor_kernel rows={sk.get('rows')} "
+            f"groups={sk.get('groups')} "
+            f"padding_ratio={sk.get('padding_ratio')} "
+            f"fallback_rows={sk.get('fallback_rows')} "
+            f"stale_repair={latest.get('stale_repair_rows')} — "
+            f"informational"
+        )
+        # HARD absolute gates (ISSUE 11) — engaged only for rounds that
+        # carry the unified-kernel block (older artifacts predate the
+        # work and must not retro-fail).  The throughput floor is 3x the
+        # r03 baseline of 11031 obj/s at the bench-churn config; the
+        # p99 ceiling holds the r03 value + slack.  KT_CHURN_FLOOR /
+        # KT_CHURN_P99_CEIL_MS override (0 disables).
+        hard_floors = {"churn_objs_per_sec_4096x256": 3.0 * 11031.0}
+        hard_floor = float(
+            os.environ.get(
+                "KT_CHURN_FLOOR",
+                str(hard_floors.get(latest["metric"], 0.0)),
+            )
+        )
+        p99_ceil = float(os.environ.get("KT_CHURN_P99_CEIL_MS", "3000"))
+        if hard_floor > 0:
+            print(
+                f"bench-gate: churn HARD floor "
+                f"{latest['value']:.1f} >= {hard_floor:.1f} obj/s"
+            )
+            if latest["value"] < hard_floor:
+                print(
+                    f"bench-gate: CHURN HARD-FLOOR FAILURE: "
+                    f"{latest['value']:.1f} < {hard_floor:.1f} obj/s "
+                    f"(3x the r03 baseline; KT_CHURN_FLOOR overrides)",
+                    file=sys.stderr,
+                )
+                ok = False
+        if p99_ceil > 0 and latest.get("p99") is not None:
+            print(
+                f"bench-gate: churn HARD p99 ceiling "
+                f"{latest['p99']:.1f} <= {p99_ceil:.1f} ms"
+            )
+            if latest["p99"] > p99_ceil:
+                print(
+                    f"bench-gate: CHURN HARD-P99 FAILURE: "
+                    f"{latest['p99']:.1f}ms > {p99_ceil:.1f}ms "
+                    f"(KT_CHURN_P99_CEIL_MS overrides)",
+                    file=sys.stderr,
+                )
+                ok = False
     return 0 if ok else 1
 
 
@@ -403,6 +459,7 @@ def gate_restart(root: Path, tolerance: float) -> int:
                 "snapshot_write_ms": detail.get("snapshot_write_ms"),
                 "aot": detail.get("aot"),
                 "parity": detail.get("parity"),
+                "memory": detail.get("memory"),
             }
         )
     if not rounds:
@@ -418,6 +475,16 @@ def gate_restart(root: Path, tolerance: float) -> int:
         f"{latest['snapshot_write_ms']}ms write, aot={latest['aot']} — "
         f"snapshot/aot informational"
     )
+    if latest.get("memory"):
+        mem = latest["memory"]
+        print(
+            f"bench-gate: restart memory: warm peak RSS "
+            f"{mem.get('warm_peak_rss_mb')}MB vs cold "
+            f"{mem.get('cold_peak_rss_mb')}MB, device buffers "
+            f"{mem.get('warm_device_buffer_bytes')}B vs "
+            f"{mem.get('cold_device_buffer_bytes')}B — the AOT "
+            f"no-donation cost, informational"
+        )
     if latest.get("parity") is False:
         print("bench-gate: RESTART PARITY FAILURE", file=sys.stderr)
         ok = False
