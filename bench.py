@@ -414,6 +414,12 @@ def time_batched(rng, units, clusters, followers):
     detail["stale_repair_rows"] = dict(engine.stale_repair_rows)
     detail["cold_dispatches"] = cold_dispatches
     detail["upload_bytes"] = dict(engine.upload_bytes)
+    # c6 memory census, live half (ISSUE 12): the ACTUAL device bytes of
+    # the resident working set at this config, per plane family and per
+    # device — runtime/census.py projects the same inventory to 1M x 10k
+    # (bench --scenario census) and validates its model against numbers
+    # like these.
+    detail["resident_bytes"] = engine.resident_state_bytes()
     detail["cold_tick_ms"] = round(cold_ms, 1)
     detail["prewarm_s"] = round(prewarm_s, 1)
     detail["featurize_cold_ms"] = cold_featurize_ms
@@ -842,6 +848,13 @@ def run_restart_scenario() -> None:
         env=env, capture_output=True, text=True,
         timeout=int(os.environ.get("KT_RESTART_TIMEOUT_S", "3600")),
     )
+    # ROADMAP's "multi-chip failover needs its own AOT story": measure
+    # the N-device warm boot explicitly.  Exports pin topology, so the
+    # meshed engine runs AOT in live-trace-only mode — this number is
+    # the trace-ladder cost a multi-device replacement actually pays
+    # (XLA compiles still hit the ambient persistent cache), with the
+    # honest AOT stats (loaded=0, traced>0) alongside.
+    multidev = _multidev_restart_probe()
     if child.returncode != 0:
         raise SystemExit(
             f"warm-restart child failed rc={child.returncode}:\n"
@@ -869,6 +882,9 @@ def run_restart_scenario() -> None:
             "restore_info", "fetch_paths", "aot", "parity",
             "parity_mismatches",
         )},
+        # The measured N-device warm-boot cost (None only when probing
+        # was disabled via KT_RESTART_MULTIDEV=0).
+        "multidevice": multidev,
         # Warm-vs-cold memory cost of the AOT preload path (ROADMAP
         # loose end; docs/operations.md § Restart & failover runbook).
         "memory": {
@@ -894,6 +910,149 @@ def run_restart_scenario() -> None:
         file=sys.stderr,
     )
     _save_round_artifact(result, "BENCH_RESTART")
+
+
+def _multidev_restart_probe():
+    """Boot a meshed engine in a forced-N-device subprocess (the
+    ``--xla_force_host_platform_device_count`` mechanism the dryrun and
+    tier-1 multidevice tests use) and measure prewarm + first tick — the
+    restart story at N>1, where AOT is live-trace-only by design.
+    KT_RESTART_MULTIDEV picks N (default 4; 0/1 disables).  Probe
+    failures degrade to an error record, never fail the round."""
+    import re as _re
+    import subprocess
+
+    n = int(os.environ.get("KT_RESTART_MULTIDEV", "4") or 0)
+    if n <= 1:
+        return None
+    code = (
+        "import json, time\n"
+        "import numpy as np\n"
+        "t0 = time.perf_counter()\n"
+        "from kubeadmiral_tpu.scheduler.engine import SchedulerEngine\n"
+        "from kubeadmiral_tpu.runtime.census import _census_world\n"
+        "units, clusters = _census_world(np.random.default_rng(7), 2048, 128)\n"
+        "eng = SchedulerEngine()\n"
+        "assert eng.mesh is not None, 'expected an auto mesh'\n"
+        "t1 = time.perf_counter()\n"
+        "eng.prewarm(len(units), len(clusters), wait=True)\n"
+        "prewarm_s = time.perf_counter() - t1\n"
+        "t2 = time.perf_counter()\n"
+        "eng.schedule(units, clusters)\n"
+        "print(json.dumps({\n"
+        "    'device_count': int(eng.mesh.devices.size),\n"
+        "    'warm_boot_ms': round((time.perf_counter() - t0) * 1e3, 1),\n"
+        "    'prewarm_s': round(prewarm_s, 2),\n"
+        "    'first_tick_ms': round((time.perf_counter() - t2) * 1e3, 1),\n"
+        "    'pipeline_depth': eng.pipeline_depth,\n"
+        "    'aot': dict(eng._aot.stats),\n"
+        "}))\n"
+    )
+    env = dict(os.environ)
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        flags = f"{flags} {flag}".strip()
+    env["XLA_FLAGS"] = flags
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never claim the chip
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True,
+            timeout=int(os.environ.get("KT_RESTART_TIMEOUT_S", "3600")),
+        )
+        if proc.returncode != 0:
+            return {
+                "error": f"rc={proc.returncode}",
+                "stderr": proc.stderr[-500:],
+            }
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — probe must not fail the round
+        return {"error": str(e)}
+
+
+def run_census_scenario() -> None:
+    """--scenario census: the c6 memory census (ISSUE 12).
+
+    Three steps, one artifact (BENCH_CENSUS_r<n>.json):
+
+    1. **Validate** the analytic model against a LIVE engine at a small
+       shape (actual device buffer bytes vs projection — a model that
+       can't predict 8k x 256 has no business predicting 1M x 10k).
+    2. **Project** the resident-plane inventory at the census shape
+       (KT_CENSUS_OBJECTS x KT_CENSUS_CLUSTERS, default 1M x 10k) on
+       KT_CENSUS_DEVICES devices (default 4).
+    3. **Decide** compress-or-shard against KT_HBM_BUDGET_GB: the
+       resolved configuration (f16 score plane engaged and/or the
+       minimum objects-axis device count) must be under budget —
+       tools/bench_gate.py FAILS the round when it is not, and the
+       validation error exceeds tolerance fails too."""
+    import jax
+
+    from kubeadmiral_tpu.bench_support import bench_platform_detail
+    from kubeadmiral_tpu.runtime import census
+
+    b = int(os.environ.get("KT_CENSUS_OBJECTS", "1000000"))
+    c = int(os.environ.get("KT_CENSUS_CLUSTERS", "10000"))
+    n_dev = int(os.environ.get("KT_CENSUS_DEVICES", "4"))
+    budget = census.hbm_budget_bytes()
+    t0 = time.perf_counter()
+    validation = census.validate(
+        int(os.environ.get("KT_CENSUS_VALIDATE_OBJECTS", "8192")),
+        int(os.environ.get("KT_CENSUS_VALIDATE_CLUSTERS", "256")),
+    )
+    decision = census.decide(b, c, n_dev, budget)
+    # The resolved configuration: what the census tells the operator to
+    # RUN — compression engaged unless everything fits as-is, device
+    # count raised to the minimum that fits when sharding is the verdict.
+    resolved = census.project(
+        b, c, decision["min_devices"],
+        score_f16=decision["verdict"] != "fits",
+    )
+    resolved_over = resolved["per_device"] > budget
+    value = resolved["per_device"]
+    detail = {
+        "scenario": "census",
+        **bench_platform_detail(),
+        "census_shape": f"{b}x{c}",
+        "requested_devices": n_dev,
+        "budget_gb": round(budget / (1 << 30), 2),
+        "decision": {
+            k: decision[k]
+            for k in (
+                "verdict", "per_device_i32", "per_device_f16",
+                "min_devices", "reasons_i16_would_save",
+            )
+        },
+        "resolved": resolved,
+        "over_budget": bool(resolved_over),
+        "validation": validation,
+        "census_wall_s": round(time.perf_counter() - t0, 1),
+        "local_device_count": int(jax.device_count()),
+    }
+    result = {
+        "metric": f"resident_bytes_per_device_{b}x{c}",
+        "value": value,
+        "unit": "bytes",
+        "detail": detail,
+    }
+    print(json.dumps(result))
+    print(
+        f"# census {b}x{c}: verdict={decision['verdict']} "
+        f"per_device={value / (1 << 30):.2f}GiB @"
+        f"{decision['min_devices']}dev (budget "
+        f"{budget / (1 << 30):.0f}GiB, requested {n_dev}dev: "
+        f"i32 {decision['per_device_i32'] / (1 << 30):.2f} / f16 "
+        f"{decision['per_device_f16'] / (1 << 30):.2f}GiB); model err "
+        f"{validation['prev_planes_err_pct']}%",
+        file=sys.stderr,
+    )
+    _save_round_artifact(result, "BENCH_CENSUS")
 
 
 def _save_round_artifact(result: dict, prefix: str) -> None:
@@ -1086,6 +1245,9 @@ def main():
         return
     if scenario == "restart":
         run_restart_scenario()
+        return
+    if scenario == "census":
+        run_census_scenario()
         return
     if scenario:
         raise SystemExit(f"unknown bench scenario {scenario!r}")

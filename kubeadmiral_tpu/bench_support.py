@@ -245,11 +245,19 @@ def bench_platform_detail() -> dict:
     import os
 
     label = os.environ.get("BENCH_PLATFORM")
-    if not label:
-        import jax
+    import jax
 
+    if not label:
         label = jax.default_backend()
+    try:
+        device_count = int(jax.device_count())
+    except Exception:
+        device_count = 1
     return {
         "platform": label,
+        # Visible device count (ISSUE 12): folded into the bench-gate
+        # baseline key so a multi-device round never gates against a
+        # single-device baseline (and vice versa).
+        "device_count": device_count,
         "platform_error": os.environ.get("BENCH_PLATFORM_ERROR"),
     }

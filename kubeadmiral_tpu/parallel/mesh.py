@@ -222,3 +222,23 @@ def rows_only_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def rows_first_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Rank-``ndim`` tensors sharded on their FIRST axis only (objects),
+    every later axis whole per shard — the survivor-stream layout: a
+    gathered [G, ...] sub-problem partitions its row axis across the
+    ``objects`` mesh axis so each device solves G/N rows concurrently
+    (the tick is row-independent), while per-row sorts/scans along the
+    cluster/candidate axes stay safely un-sharded (the pack-sort rule:
+    GSPMD mis-combines sorts along a sharded dimension)."""
+    return NamedSharding(mesh, P(OBJECTS, *([None] * (ndim - 1))))
+
+
+def objects_axis_size(mesh: Optional[Mesh]) -> int:
+    """Device count along the ``objects`` axis (1 for no mesh) — the
+    scale-out factor the engine's geometry / pipeline-depth policies key
+    on (per-device budgets multiply by this)."""
+    if mesh is None:
+        return 1
+    return int(mesh.devices.shape[0])

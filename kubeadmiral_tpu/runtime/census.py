@@ -1,0 +1,300 @@
+"""The c6 memory census: does the resident working set fit on the mesh?
+
+At c6 scale (1M objects x 10k clusters) the engine's resident planes are
+~10^10 cells: six [B, C] output planes per chunk (selected i8, replicas
+i32, counted i8, scores i32-or-f16, feasible i8, reasons i32), the
+cached per-object input tensors, the precomputed tie-break plane and the
+[B] companion vectors.  Whether that fits a device — and at how many
+devices, and with which compression engaged — must be a NUMBER before
+the first on-chip c6 run, not a discovery during it.  This module owns
+that number:
+
+* :func:`project` — the analytic inventory: walks the engine's real
+  geometry policy (``SchedulerEngine._tick_geometry`` via a throwaway
+  engine, so chunk split / padding / ladder rules can never drift from
+  the model) and books every resident plane family at its device dtype,
+  per device: rows-sharded [B, ...] planes divide across the objects
+  mesh axis, replicated [B] vectors book whole on every device.
+
+* :func:`validate` — the honesty check: schedules a small live world,
+  walks the ACTUAL device buffers
+  (``SchedulerEngine.resident_state_bytes``) and compares them against
+  the model at the same shape.  A model that can't predict 8k x 256 has
+  no business predicting 1M x 10k; ``bench.py --scenario census`` fails
+  its artifact when the error exceeds the tolerance.
+
+* :func:`decide` — the compress-or-shard decision against the HBM
+  budget knob (``KT_HBM_BUDGET_GB``, default 16 GiB/device): fits as-is
+  -> ``fits``; fits with the f16 score plane (``KT_SCORE_F16``, exact
+  by construction behind the per-row exactness guard — see
+  scheduler/engine.py) -> ``compress``; otherwise the minimum
+  objects-axis device count that fits (compression engaged) ->
+  ``shard``.
+
+``bench.py --scenario census`` emits the artifact
+(``BENCH_CENSUS_r<n>.json``) and ``tools/bench_gate.py`` surfaces it —
+a census over budget at the configured device count FAILS the gate.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Device dtype widths of the resident planes (scheduler/engine.py store
+# sites; the reasons plane is i32 on device — its 10 reason bits would
+# fit i16, which is the next compression lever after scores and is
+# called out by `decide` when it would matter).
+_PREV_PLANE_BYTES = {
+    "selected": 1, "replicas": 4, "counted": 1,
+    "scores_i32": 4, "scores_f16": 2,
+    "feasible": 1, "reasons": 4,
+}
+# Compact-format per-object residency per row: the id vectors
+# (gvk/tol/sel/pref/place i32 + placement_has i8 + filter/score enables
+# i8[5]+i8[5] + request i64[R] + max_clusters/total/... i32) plus the
+# sparse entry block and key bytes.  These are shape-dependent; the
+# constants below are the per-row fixed part measured at the bench
+# worlds (validate() catches drift between this table and the real
+# featurizer — see test_multidevice.py's census block).
+_PER_ROW_FIXED = 96
+_SPARSE_ENTRY_BYTES = 6 * 4  # idx/min/max/weight/capacity/cur i32 slots
+_KEY_BYTE = 1
+
+
+def hbm_budget_bytes() -> int:
+    """KT_HBM_BUDGET_GB (GiB per device, default 16 — a v4/v5 class
+    chip's usable HBM after XLA scratch)."""
+    return int(
+        float(os.environ.get("KT_HBM_BUDGET_GB", "16")) * (1 << 30)
+    )
+
+
+def _geometry(n_objects: int, n_clusters: int, device_count: int):
+    """The engine's REAL geometry at this shape/topology: a program-free
+    throwaway engine with a stub mesh of the requested objects-axis size
+    runs the actual ``SchedulerEngine._tick_geometry`` — the census can
+    model topologies larger than the local device set, and the model
+    can never drift from the policy it predicts."""
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+    eng = SchedulerEngine.__new__(SchedulerEngine)
+    # The minimal attribute set _tick_geometry reads.
+    if device_count <= 1:
+        eng.mesh = None
+    else:
+        class _StubGrid:
+            shape = (device_count, 1)
+
+        class _StubMesh:
+            devices = _StubGrid()
+
+        eng.mesh = _StubMesh()
+    eng.min_bucket = 64
+    eng.min_cluster_bucket = 8
+    eng.chunk_size = 4096
+    eng.canonical_c = 256
+    eng.cell_budget = int(os.environ.get("KT_CELL_BUDGET", str(4096 * 5120)))
+    eng.megachunk_rows = int(os.environ.get("KT_MEGACHUNK_ROWS", "4096"))
+    c_bucket, eff_chunk, ladder = SchedulerEngine._tick_geometry(
+        eng, n_clusters
+    )
+    n_chunks = -(-n_objects // eff_chunk)
+    # Padding follows SchedulerEngine._bucket_rows exactly: multi-chunk
+    # batches pad EVERY chunk (incl. the tail) to eff_chunk; a
+    # single-chunk batch pads to its ladder rung / pow2 bucket.
+    if n_chunks > 1:
+        b_pad_total = n_chunks * eff_chunk
+    else:
+        tail = n_objects
+        b_pad_total = SchedulerEngine._bucket_rows(
+            eng, tail, ladder, eff_chunk, False
+        )
+    return {
+        "c_bucket": c_bucket,
+        "eff_chunk": eff_chunk,
+        "n_chunks": n_chunks,
+        "padded_rows": b_pad_total,
+    }
+
+
+def project(
+    n_objects: int,
+    n_clusters: int,
+    device_count: int = 1,
+    score_f16: Optional[bool] = None,
+    sparse_entries: int = 8,
+    key_len: int = 64,
+    with_scores_plane: bool = True,
+) -> dict:
+    """Analytic resident-plane inventory at (B, C) on an N-device
+    objects mesh, in bytes.  ``sparse_entries`` / ``key_len`` size the
+    compact per-object block (bench worlds measure ~8 sparse slots and
+    <=64 key bytes)."""
+    if score_f16 is None:
+        score_f16 = os.environ.get("KT_SCORE_F16", "0") in ("1", "true", "yes")
+    geo = _geometry(n_objects, n_clusters, device_count)
+    rows = geo["padded_rows"]
+    cells = rows * geo["c_bucket"]
+    sco = "scores_f16" if score_f16 else "scores_i32"
+    prev = {
+        name: cells * width
+        for name, width in _PREV_PLANE_BYTES.items()
+        if name not in ("scores_i32", "scores_f16")
+    }
+    prev["scores"] = cells * _PREV_PLANE_BYTES[sco]
+    per_object = rows * (
+        _PER_ROW_FIXED
+        + sparse_entries * _SPARSE_ENTRY_BYTES
+        + key_len * _KEY_BYTE
+    )
+    tiebreak = cells * 4  # i32[B, C], compact drift path
+    vectors = rows * 4 + (rows * 1 if score_f16 else 0)  # nfeas + exactness
+    total = sum(prev.values()) + per_object + tiebreak + vectors
+    # Rows-sharded planes divide across the mesh; [B] vectors replicate.
+    per_device = (total - vectors) // device_count + vectors
+    return {
+        "n_objects": n_objects,
+        "n_clusters": n_clusters,
+        "device_count": device_count,
+        "score_dtype": "f16" if score_f16 else "i32",
+        "geometry": {
+            k: geo[k] for k in ("c_bucket", "eff_chunk", "n_chunks",
+                                "padded_rows")
+        },
+        "by_family": {
+            "prev_planes": sum(prev.values()),
+            "per_object": per_object,
+            "tiebreak": tiebreak,
+            "vectors": vectors,
+        },
+        "prev_plane_split": prev,
+        "total": total,
+        "per_device": per_device,
+    }
+
+
+def validate(n_objects: int = 8192, n_clusters: int = 256) -> dict:
+    """Model-vs-live cross check: schedule a real world at a small
+    shape, walk the actual device buffers and compare against
+    :func:`project` at the same shape/topology.  Returns both numbers
+    and the relative error of the families the model claims to predict
+    (prev planes — the c6-dominant family; per-object/tiebreak are
+    workload-shaped and compared loosely)."""
+    import numpy as np
+
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+    rng = np.random.default_rng(20260805)
+    units, clusters = _census_world(rng, n_objects, n_clusters)
+    eng = SchedulerEngine()
+    eng.schedule(units, clusters)
+    live = eng.resident_state_bytes()
+    model = project(
+        n_objects, n_clusters,
+        device_count=live["device_count"],
+        score_f16=eng.score_f16,
+    )
+    lp, mp = live["by_family"]["prev_planes"], model["by_family"]["prev_planes"]
+    err = abs(lp - mp) / max(1, lp)
+    return {
+        "shape": f"{n_objects}x{n_clusters}",
+        "live": live,
+        "model_prev_planes": mp,
+        "live_prev_planes": lp,
+        "prev_planes_err_pct": round(err * 100.0, 2),
+        "ok": err <= 0.15,
+    }
+
+
+def _census_world(rng, b: int, c: int):
+    """A small live world for validate(): the bench build_world shape
+    without importing bench.py (which owns process-level env policy)."""
+    from kubeadmiral_tpu.models.types import (
+        ClusterState, MODE_DIVIDE, SchedulingUnit, parse_resources,
+    )
+
+    gvk = "apps/v1/Deployment"
+    clusters = [
+        ClusterState(
+            name=f"member-{j:05d}",
+            labels={"region": ("us", "eu", "ap")[j % 3], "tier": str(j % 4)},
+            allocatable=parse_resources(
+                {"cpu": str(16 + j % 32), "memory": f"{64 + j % 128}Gi"}
+            ),
+            available=parse_resources(
+                {"cpu": str(8 + j % 16), "memory": f"{32 + j % 64}Gi"}
+            ),
+            api_resources=frozenset({gvk}),
+        )
+        for j in range(c)
+    ]
+    units = [
+        SchedulingUnit(
+            gvk=gvk,
+            namespace=f"ns-{i % 97}",
+            name=f"workload-{i:06d}",
+            scheduling_mode=MODE_DIVIDE if i % 4 else "Duplicate",
+            desired_replicas=(i % 50) + 1 if i % 4 else None,
+            resource_request=parse_resources(
+                {"cpu": f"{(i % 4) * 250}m", "memory": f"{(i % 8) * 256}Mi"}
+            ),
+            max_clusters=(i % 20) + 1 if i % 5 == 0 else None,
+        )
+        for i in range(b)
+    ]
+    return units, clusters
+
+
+def decide(
+    n_objects: int,
+    n_clusters: int,
+    device_count: int,
+    budget_bytes: Optional[int] = None,
+) -> dict:
+    """The compress-or-shard decision at (B, C, N) against the budget:
+
+    * ``fits``      — i32 scores fit per device as-is;
+    * ``compress``  — over budget at i32, under with the f16 score plane
+                      (engage KT_SCORE_F16);
+    * ``shard``     — over budget even compressed: the verdict carries
+                      the minimum objects-axis device count that fits
+                      (compression engaged), i.e. how much further the
+                      mesh must scale out.
+    """
+    if budget_bytes is None:
+        budget_bytes = hbm_budget_bytes()
+    plain = project(n_objects, n_clusters, device_count, score_f16=False)
+    packed = project(n_objects, n_clusters, device_count, score_f16=True)
+    if plain["per_device"] <= budget_bytes:
+        verdict, engaged = "fits", plain
+    elif packed["per_device"] <= budget_bytes:
+        verdict, engaged = "compress", packed
+    else:
+        verdict, engaged = "shard", packed
+    min_devices = device_count
+    if verdict == "shard":
+        n = device_count
+        while n < 4096:
+            n *= 2
+            if project(n_objects, n_clusters, n, score_f16=True)[
+                "per_device"
+            ] <= budget_bytes:
+                break
+        min_devices = n
+    over = engaged["per_device"] > budget_bytes
+    return {
+        "verdict": verdict,
+        "budget_bytes": budget_bytes,
+        "per_device_i32": plain["per_device"],
+        "per_device_f16": packed["per_device"],
+        "per_device": engaged["per_device"],
+        "over_budget": bool(over),
+        "min_devices": min_devices,
+        "projection": engaged,
+        # The next lever if even sharding is unpalatable: the reasons
+        # plane's 10 reason bits fit i16 (the flight recorder already
+        # stores i16 host-side) — halves another i32 plane.
+        "reasons_i16_would_save": engaged["geometry"]["padded_rows"]
+        * engaged["geometry"]["c_bucket"] * 2 // device_count,
+    }

@@ -81,6 +81,7 @@ PROGRAM_KINDS = (
     "patch",           # stale-row device input scatter repair
     "stack",           # window-drain same-shape transfer stacking
     "zeros",           # device-resident zero prev-plane builders
+    "score_pack",      # f16 score-plane compress / upcast (KT_SCORE_F16)
 )
 
 _UNTRACKED_RING = 4096
@@ -89,7 +90,7 @@ _UNTRACKED_RING = 4096
 class DispatchRecord:
     __slots__ = (
         "seq", "tick", "kind", "shape", "t_dispatch", "t_ready",
-        "queue_s", "device_s", "note",
+        "queue_s", "device_s", "note", "device",
     )
 
     def __init__(self, seq: int, tick: Optional[int], kind: str):
@@ -102,6 +103,13 @@ class DispatchRecord:
         self.queue_s = 0.0
         self.device_s = 0.0
         self.note = "ok"
+        # Which device(s) the dispatched output resides on: "d<id>" for
+        # a single committed device, "mesh<N>" for a GSPMD output
+        # spanning N devices, "?" when the sharding is unreadable.  The
+        # label rides engine_device_seconds / engine_queue_wait_seconds
+        # and the waterfall rows, so multi-device rounds attribute
+        # device time per lane instead of flattening the mesh.
+        self.device = "?"
 
 
 class _TickEntry:
@@ -271,6 +279,19 @@ class DispatchLedger:
                 rec.shape = "x".join(str(d) for d in leaf.shape)
             except Exception:
                 rec.shape = "?"
+            try:
+                # Off the hot path (watcher thread): derive the device
+                # lane from the output's sharding.
+                devs = getattr(leaf, "sharding", None)
+                devs = sorted(
+                    d.id for d in devs.device_set
+                ) if devs is not None else []
+                if len(devs) == 1:
+                    rec.device = f"d{devs[0]}"
+                elif devs:
+                    rec.device = f"mesh{len(devs)}"
+            except Exception:
+                pass
             del leaf
             start = rec.t_dispatch
             if self._chain_ready is not None and self._chain_ready > start:
@@ -286,11 +307,11 @@ class DispatchLedger:
                 try:
                     m.histogram(
                         "engine_device_seconds", rec.device_s,
-                        program=rec.kind,
+                        program=rec.kind, device=rec.device,
                     )
                     m.histogram(
                         "engine_queue_wait_seconds", rec.queue_s,
-                        program=rec.kind,
+                        program=rec.kind, device=rec.device,
                     )
                 except Exception:
                     pass
@@ -336,6 +357,7 @@ class DispatchLedger:
     @staticmethod
     def _summarize(records) -> dict:
         by: dict[str, dict] = {}
+        by_dev: dict[str, dict] = {}
         dev = queue = 0.0
         for r in records:
             slot = by.setdefault(
@@ -344,16 +366,27 @@ class DispatchLedger:
             slot["n"] += 1
             slot["device_ms"] += r.device_s * 1e3
             slot["queue_ms"] += r.queue_s * 1e3
+            lane = by_dev.setdefault(
+                getattr(r, "device", "?"),
+                {"n": 0, "device_ms": 0.0, "queue_ms": 0.0},
+            )
+            lane["n"] += 1
+            lane["device_ms"] += r.device_s * 1e3
+            lane["queue_ms"] += r.queue_s * 1e3
             dev += r.device_s
             queue += r.queue_s
         for slot in by.values():
             slot["device_ms"] = round(slot["device_ms"], 3)
             slot["queue_ms"] = round(slot["queue_ms"], 3)
+        for lane in by_dev.values():
+            lane["device_ms"] = round(lane["device_ms"], 3)
+            lane["queue_ms"] = round(lane["queue_ms"], 3)
         return {
             "records": len(records),
             "device_ms": round(dev * 1e3, 3),
             "queue_ms": round(queue * 1e3, 3),
             "by_program": by,
+            "by_device": by_dev,
         }
 
     def tick_summary(self, tick: Optional[int] = None, timeout: float = 5.0) -> dict:
@@ -413,6 +446,7 @@ class DispatchLedger:
                         "seq": r.seq,
                         "kind": r.kind,
                         "shape": r.shape,
+                        "device": getattr(r, "device", "?"),
                         "t_ms": round((r.t_dispatch - e.t0) * 1e3, 3),
                         "queue_ms": round(r.queue_s * 1e3, 3),
                         "device_ms": round(r.device_s * 1e3, 3),

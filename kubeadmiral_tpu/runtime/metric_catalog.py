@@ -139,18 +139,34 @@ CATALOG: dict[str, MetricSpec] = {
         "gate-drain entry, cleared when every gated chunk settles)."),
     # -- dispatch ledger (runtime/devprof.py) -----------------------------
     "engine_device_seconds": MetricSpec(
-        "histogram", "seconds", ("program",),
+        "histogram", "seconds", ("program", "device"),
         "Measured device occupancy per dispatched program (the dispatch "
         "ledger's in-order chain model: ready_i - max(dispatch_i, "
         "ready_{i-1})), labeled by program kind (tick, tick_narrow, "
-        "gate, resolve, pack, ...).  Pure execution time — jit tracing "
-        "happens host-side before the observation and never lands "
-        "here."),
+        "gate, resolve, pack, ...) and device lane (d<id> for a single "
+        "committed device, mesh<N> for a GSPMD program spanning N "
+        "devices).  Pure execution time — jit tracing happens host-side "
+        "before the observation and never lands here."),
     "engine_queue_wait_seconds": MetricSpec(
-        "histogram", "seconds", ("program",),
+        "histogram", "seconds", ("program", "device"),
         "Time each dispatched program sat enqueued behind earlier "
         "device work before executing — the dispatch backpressure the "
-        "host-side stage timers misattribute to fetch/decode."),
+        "host-side stage timers misattribute to fetch/decode.  Same "
+        "device-lane label as engine_device_seconds."),
+    "engine_resident_bytes": MetricSpec(
+        "gauge", "bytes", ("family",),
+        "Device bytes of the engine's resident working set, by plane "
+        "family (prev_planes = the six [B, C] output planes, per_object "
+        "= cached input tensors, tiebreak = precomputed planner "
+        "tie-break planes, vectors = [B] nfeas / score-exactness "
+        "companions) — the live half of the c6 memory census "
+        "(runtime/census.py; bench --scenario census)."),
+    "engine_resident_bytes_per_device": MetricSpec(
+        "gauge", "bytes", (),
+        "Resident working-set bytes PER DEVICE (rows-sharded planes "
+        "divided by the objects-axis device count, replicated vectors "
+        "booked whole) — the number compared against the KT_HBM_BUDGET_GB "
+        "knob by the census."),
     "engine_dispatch_inflight": MetricSpec(
         "gauge", "dispatches", (),
         "Dispatched programs whose readiness the ledger has not yet "

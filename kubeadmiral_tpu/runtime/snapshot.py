@@ -38,6 +38,15 @@ mismatch — topology, geometry, engine config — falls back to cold for
 the affected chunks.  Every outcome lands in
 ``engine_snapshot_total{result}``.
 
+Multi-device round trip (ISSUE 12): capture gathers the engine's
+SHARDED prev planes host-side (``np.asarray`` on a GSPMD array collects
+the shards), and ``stage_restore`` re-device_puts them under the mesh's
+row shardings — a sharded engine restores bit-identically with the
+zero-dispatch no-op replay preserved (tier-1:
+tests/test_multidevice.py).  The device topology is part of the
+engine's snapshot config fingerprint, so a 4-device snapshot staged
+into a 2-device engine is REJECTED (cold boot), never reinterpreted.
+
 Knobs: ``KT_SNAPSHOT_DIR`` (no default — snapshots are opt-in),
 ``KT_SNAPSHOT_EVERY`` (persist every N-th state-changing tick, default
 1), ``KT_SNAPSHOT_KEEP`` (retained generations, default 2).  See

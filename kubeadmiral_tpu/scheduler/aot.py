@@ -23,9 +23,18 @@ persistent cache (``engine_persistent_cache_total{result}``) by disk
 entry delta — the restart harness asserts the ladder is 100% hits on a
 second warm boot, catching silent cache-key drift.
 
-Multi-device meshes are out of scope (exports pin the device topology);
-the engine constructs the store disabled under a mesh and every dispatch
-stays a live trace.  Knob: ``KT_AOT`` (default on; ``0`` disables).
+Multi-device topology (ISSUE 12): jax.export pins the device topology a
+program was exported at, so the manifest guard carries ``devices``
+(visible device count) next to jax version / platform / x64 / code hash
+— a warm boot at a different device count rejects the whole manifest
+loudly instead of deserializing single-device programs into a mesh.
+Meshed engines construct the store in ``live_trace_only`` mode: every
+(program, shape) resolution is counted honestly as ``traced`` in
+``engine_aot_programs_total`` (the deliberate live-trace record — warm
+boots at N>1 pay the trace ladder and the telemetry SAYS so, instead of
+a disabled store silently reporting nothing), and export / preload are
+no-ops.  The restart bench measures that N>1 warm-boot cost explicitly
+(detail.multidevice).  Knob: ``KT_AOT`` (default on; ``0`` disables).
 """
 
 from __future__ import annotations
@@ -159,12 +168,20 @@ class AotStore:
         metrics=None,
         cache_dir: Optional[str] = None,
         enabled: Optional[bool] = None,
+        live_trace_only: bool = False,
     ):
         self.metrics = metrics
         if enabled is None:
             enabled = os.environ.get("KT_AOT", "1") not in ("0", "false", "no")
         self.dir = cache_dir if cache_dir is not None else default_dir()
-        self.enabled = bool(enabled) and self.dir is not None
+        # Live-trace-only mode (meshed engines): resolutions are COUNTED
+        # (engine_aot_programs_total{result=traced} — the deliberate
+        # record that this topology runs without AOT artifacts) but
+        # nothing is exported, loaded or preloaded.
+        self.live_trace_only = bool(live_trace_only)
+        self.enabled = bool(enabled) and (
+            self.dir is not None or self.live_trace_only
+        )
         self._lock = threading.Lock()
         self._export_tls = threading.local()
         self._entries: dict[str, dict] = {}
@@ -178,7 +195,7 @@ class AotStore:
         self._preloaded: dict[str, Callable] = {}
         self._dirty = False
         self.stats = {"loaded": 0, "traced": 0, "rejected": 0}
-        if self.enabled:
+        if self.enabled and not self.live_trace_only:
             _register_pytrees()
             self._load_manifest()
 
@@ -187,6 +204,11 @@ class AotStore:
         return {
             "jax": jax.__version__,
             "platform": jax.default_backend(),
+            # Device topology: exports pin it, so a manifest from one
+            # device count must not serve another (a 1-device export
+            # deserialized into a 4-device mesh replays single-device
+            # placement semantics silently).
+            "devices": jax.device_count(),
             "x64": bool(jax.config.jax_enable_x64),
             "code": code_fingerprint(),
         }
@@ -219,7 +241,7 @@ class AotStore:
     def save_manifest(self) -> None:
         """Atomically persist the manifest (blobs are already on disk:
         each was written tmp+rename before its entry existed)."""
-        if not self.enabled:
+        if not self.enabled or self.live_trace_only:
             return
         with self._lock:
             if not self._dirty:
@@ -301,7 +323,7 @@ class AotStore:
     def note_world(self, world_key: str) -> None:
         """Record that the export ladder ran at this prewarm world, so a
         later boot at the same world may preload + skip the ladder."""
-        if not self.enabled:
+        if not self.enabled or self.live_trace_only:
             return
         with self._lock:
             if world_key not in self._worlds:
@@ -309,6 +331,8 @@ class AotStore:
                 self._dirty = True
 
     def has_world(self, world_key: str) -> bool:
+        if self.live_trace_only:
+            return False  # meshed prewarms always run the example ladder
         return self.enabled and world_key in self._worlds
 
     def preload_all(self) -> int:
@@ -319,8 +343,10 @@ class AotStore:
         the persistent cache, and live dispatches route straight to the
         compiled executables.  Returns the number of programs now
         preloaded; individual failures count ``rejected`` and fall back
-        to live traces at first use."""
-        if not self.enabled:
+        to live traces at first use.  Live-trace-only stores (meshed
+        topologies) preload NOTHING and return 0 — the honest number a
+        warm boot at N>1 reports."""
+        if not self.enabled or self.live_trace_only:
             return 0
         with self._lock:
             entries = dict(self._entries)
@@ -382,6 +408,11 @@ class AotStore:
         """Pick the route for one (program, signature): a jitted
         deserialized export, an export-and-use (export mode), or the
         live jit function."""
+        if self.live_trace_only:
+            # Meshed topology: the deliberate live-trace record — one
+            # honest ``traced`` count per (program, shape), no blobs.
+            self._count("traced")
+            return fn
         eid = _entry_id(key, sig)
         compiled = self._preloaded.get(eid)
         if compiled is not None:

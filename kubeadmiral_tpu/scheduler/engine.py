@@ -348,6 +348,18 @@ class _CachedChunk:
     # row, so one narrow-selecting batch can't whipsaw K down and force
     # the next ordinary batch through the overflow re-fetch.
     pack_shrink_votes: int = 0
+    # Per-row score-plane exactness vector (device i8[B], KT_SCORE_F16
+    # only): 1 = the stored f16 score row round-trips to the true i32
+    # scores bit-exactly, 0 = quantization was lossy for the row.
+    # Inexact rows are FORCED out of every score-consuming fast path
+    # (drift-gate skip classification, delta-diff replay) into the
+    # recompute machinery — the same cert->dense-fallback contract the
+    # narrow solve uses, so compression can cost a re-solve, never a
+    # wrong placement.  None = unknown: treat every row as inexact.
+    prev_sco_exact: Optional[object] = None
+    # Host cache of the inexact row indices (np.int64), read lazily from
+    # prev_sco_exact once per store generation; None = not read yet.
+    sco_inexact_host: Optional[object] = None
 
 
 class _SnapshotView:
@@ -670,6 +682,39 @@ class SchedulerEngine:
         self.survivor_stats = {
             "rows": 0, "groups": 0, "padded_rows": 0, "fallback_rows": 0,
         }
+        # f16 score-plane compression (KT_SCORE_F16=1 opts in, default
+        # off): the resident prev SCORE plane ([B, C], the largest
+        # numeric plane after replicas) is stored float16 with a per-row
+        # exactness vector; rows whose i32 scores don't round-trip
+        # through f16 bit-exactly are forced out of every score-
+        # consuming fast path into the recompute machinery (the existing
+        # cert->dense-fallback contract), so placements stay bit-
+        # identical to the uncompressed engine by construction.  The c6
+        # memory census (runtime/census.py) is what flips this on: at
+        # 1M x 10k the score plane is ~40% of the numeric resident
+        # bytes.  Side constraints while compressed: want_scores chunks
+        # skip the delta-diff replay (full refetch), and the legacy
+        # three-stream resolve/replan paths (which consume stored
+        # scores directly) are disabled — the default unified survivor
+        # kernel needs no stored scores and is unaffected.
+        self.score_f16 = os.environ.get("KT_SCORE_F16", "0") in (
+            "1", "true", "yes",
+        )
+        # Survivor-stream row sharding (KT_SURVIVOR_ROWSHARD=0 reverts
+        # to replicated gathers): under a mesh, the gathered [G, ...]
+        # survivor/replan/resolve/narrow-fallback sub-problems constrain
+        # to rows-first shardings instead of full replication, so each
+        # {256,128,64}-row group's row axis partitions across the
+        # objects mesh axis — N devices solve G/N rows each instead of
+        # all solving all G.  This is what turns the drift tick's ~74
+        # serial survivor-group executions into ~74/N device-parallel
+        # waves (ISSUE 12); per-row math is row-independent and the
+        # cluster/candidate axes stay whole per shard (the pack-sort
+        # rule), so outputs are bit-identical either way (enforced by
+        # tests/test_multidevice.py and the dryrun parity blocks).
+        self.survivor_rowshard = os.environ.get(
+            "KT_SURVIVOR_ROWSHARD", "1"
+        ) not in ("0", "false", "no")
         # Pallas slab front (KT_PALLAS=1 opts in, default off): the
         # narrow programs compute phase 1 with the fused
         # ops/pallas_slab.py kernel instead of the XLA pass —
@@ -791,7 +836,22 @@ class SchedulerEngine:
         # 391 chunk masks x ~18ms = 7.0s of a 8.9s tick), so the window
         # amortizes round trips ~D-fold; in-flight memory is D x the
         # chunk's output planes (D=16 at [256, 5120] i32 ~ 340MB).
-        self.pipeline_depth = max(1, int(os.environ.get("KT_PIPELINE_DEPTH", "16")))
+        # KT_PIPELINE_DEPTH is PER-DEVICE (ISSUE 12): a meshed engine
+        # multiplies it by the objects-axis device count after mesh
+        # resolution below, so every device's queue holds the same
+        # in-flight window a single-device engine would — N devices
+        # drain N x the chunks per window, keeping all queues full.
+        self.pipeline_depth_per_device = max(
+            1, int(os.environ.get("KT_PIPELINE_DEPTH", "16"))
+        )
+        self.pipeline_depth = self.pipeline_depth_per_device
+        # Adaptive-K observation buffer: (entry id) -> [entry, c_bucket,
+        # [nsel arrays]].  Per-device window drains read the same
+        # chunk's wire in several device-local pieces; votes must be
+        # cast ONCE per tick on the aggregate, not per piece (a piece-
+        # wise shrink-vote double-counts and whipsaws K) — flushed by
+        # _flush_nsel at the end of every _schedule_impl.
+        self._nsel_pending: dict[int, list] = {}
         # Distinct (fmt, rows, clusters) program shapes dispatched — the
         # observable program count the bucket ladder promises to bound
         # (each unique shape is one XLA compile, amortized by the
@@ -846,18 +906,32 @@ class SchedulerEngine:
         self._pcache_count = self._pcache_entries()
 
         self.mesh = self._resolve_mesh(mesh)
+        if self.mesh is not None:
+            # Per-device in-flight windows (see pipeline_depth_per_device
+            # above): N objects-axis devices -> N x the window.
+            from kubeadmiral_tpu.parallel.mesh import objects_axis_size
+
+            self.pipeline_depth = (
+                self.pipeline_depth_per_device * objects_axis_size(self.mesh)
+            )
         # AOT program store (scheduler/aot.py, KT_AOT): program builders
         # route through it so a warm boot preloads jax.export artifacts
         # instead of re-tracing the prewarm ladder; cold processes
         # export as a side effect and keep their own (donating) live
-        # jits.  Exports pin the device topology, so meshes stay on
-        # live traces.  Documented trade: warm boots' PRELOADED programs
-        # do not donate prev buffers (export drops donation) —
-        # correctness is unaffected (the engine already treats donated
-        # inputs as dead), HBM-tight deployments can set KT_AOT=0.
+        # jits.  Exports pin the device topology (the manifest guard
+        # carries device count + platform), so MESHED engines run in
+        # live-trace-only mode: every (program, shape) resolution is
+        # recorded honestly as ``traced`` in engine_aot_programs_total
+        # instead of the store silently claiming a preload it cannot
+        # perform — a warm boot at N>1 pays the trace ladder and SAYS
+        # so (the restart bench reports the measured cost).  Documented
+        # trade: warm boots' PRELOADED programs do not donate prev
+        # buffers (export drops donation) — correctness is unaffected
+        # (the engine already treats donated inputs as dead), HBM-tight
+        # deployments can set KT_AOT=0.
         self._aot = aot_mod.AotStore(
             metrics=self.metrics,
-            enabled=None if self.mesh is None else False,
+            live_trace_only=self.mesh is not None,
         )
         # Staged crash-recovery state (runtime/snapshot.py): consumed by
         # the FIRST _schedule_impl call, which has the units + clusters
@@ -994,6 +1068,10 @@ class SchedulerEngine:
         self._narrow_programs: dict[tuple, object] = {}
         self._fallback_programs: dict[str, object] = {}
         self._cert_repair_cache: dict[str, object] = {}
+        # f16 score-plane compression programs (KT_SCORE_F16): the
+        # compress (+exactness) store companion and the i32 upcast the
+        # diff/gate paths feed from the stored plane.
+        self._sco_cache: dict[str, object] = {}
         # Donating `prev` (argnums 1) lets XLA alias the previous tick's
         # output planes into the new ones: full dispatches stop holding
         # two [B, C] output generations live at once.
@@ -1019,6 +1097,7 @@ class SchedulerEngine:
             self._grid_sharding = None
             self._replicated = None
             self._rows_only_sharding = None
+            self._rows_first = None
             self._pack_programs: dict[tuple, object] = {}
             return
         from kubeadmiral_tpu.parallel import mesh as M
@@ -1070,6 +1149,23 @@ class SchedulerEngine:
         rep = M.replicated(self.mesh)
         self._replicated = rep
         self._rows_only_sharding = M.rows_only_sharding(self.mesh)
+        # Survivor-stream layout (KT_SURVIVOR_ROWSHARD): rank -> rows-
+        # first sharding for the gathered sub-problems; None keeps the
+        # pre-ISSUE-12 replicated gathers.
+        if self.survivor_rowshard:
+            mesh_ref = self.mesh
+            rf_cache: dict[int, object] = {}
+
+            def _rows_first(ndim: int):
+                sh = rf_cache.get(ndim)
+                if sh is None:
+                    sh = M.rows_first_sharding(mesh_ref, ndim)
+                    rf_cache[ndim] = sh
+                return sh
+
+            self._rows_first = _rows_first
+        else:
+            self._rows_first = None
         self._pack_programs = {}
         self._gather = jax.jit(
             _gather_packed,
@@ -1250,34 +1346,84 @@ class SchedulerEngine:
         self._narrow_programs[key] = fn
         return fn
 
-    def _fallback_program(self, fmt: str):
-        """Dense re-solve of uncertified narrow rows, straight from the
-        chunk's device-resident inputs: gather the rows, run the full-
-        width tick on [K, C], return the planes the narrow solve may
-        have gotten wrong (scores/feasible come from the shared phase 1
-        and are exact by construction).  jax re-traces per (K, B, C)
-        shape; K is pow2-bucketed by the caller."""
-        fn = self._fallback_programs.get(fmt)
-        if fn is not None:
-            return fn
-        per_object = tuple(self._per_object_fields(fmt))
-        replicated = self._replicated
+    def _gather_constrainer(self, per_object):
+        """Sharding-constraint closure for gathered [G, ...] sub-problems
+        (narrow fallback, survivor / replan / resolve streams): returns
+        ``constrain(sub, extras) -> (sub, extras)`` for use INSIDE the
+        jitted impls, or None off-mesh.
 
-        def impl(device_in, idx, _fmt=fmt):
-            rows = {name: getattr(device_in, name)[idx] for name in per_object}
-            sub = device_in._replace(**rows)
-            if replicated is not None:
-                # The re-solve is a full-width tick: its select/planner
-                # sorts run along the CLUSTER axis, which must not stay
-                # sharded (GSPMD shard-sums sorted axes — the pack-sort
-                # rule), and the gathered rows are few — so the whole
-                # [K, C] sub-problem replicates, cluster planes included.
+        Default (KT_SURVIVOR_ROWSHARD): the gathered per-object rows
+        (and the extra gathered row-planes — reasons / scores /
+        feasibility / tie-break) constrain to ROWS-FIRST shardings, so
+        the group's row axis partitions across the objects mesh axis and
+        N devices each solve G/N rows of the (row-independent) solve —
+        the per-device chunk-stream layout that turns serial survivor
+        group executions into device-parallel waves.  Cluster planes and
+        vocabulary tables replicate (tiny, and their axes must be whole
+        per shard for the full-width sorts).  KT_SURVIVOR_ROWSHARD=0
+        reverts to replicating the whole sub-problem (the pre-ISSUE-12
+        behavior); outputs are bit-identical either way."""
+        replicated = self._replicated
+        if replicated is None:
+            return None
+        rows_first = self._rows_first
+        per_set = frozenset(per_object)
+
+        def constrain(sub, extras=()):
+            if rows_first is None:
                 sub = type(sub)(
                     *(
                         jax.lax.with_sharding_constraint(x, replicated)
                         for x in sub
                     )
                 )
+                extras = tuple(
+                    jax.lax.with_sharding_constraint(x, replicated)
+                    if x is not None
+                    else None
+                    for x in extras
+                )
+                return sub, extras
+            sub = type(sub)(
+                *(
+                    jax.lax.with_sharding_constraint(
+                        x,
+                        rows_first(x.ndim) if name in per_set else replicated,
+                    )
+                    for name, x in zip(sub._fields, sub)
+                )
+            )
+            extras = tuple(
+                jax.lax.with_sharding_constraint(x, rows_first(x.ndim))
+                if x is not None
+                else None
+                for x in extras
+            )
+            return sub, extras
+
+        return constrain
+
+    def _fallback_program(self, fmt: str):
+        """Dense re-solve of uncertified narrow rows, straight from the
+        chunk's device-resident inputs: gather the rows, run the full-
+        width tick on [K, C], return the planes the narrow solve may
+        have gotten wrong (scores/feasible come from the shared phase 1
+        and are exact by construction).  jax re-traces per (K, B, C)
+        shape; K is pow2-bucketed by the caller.  Under a mesh the
+        gathered rows ride the rows-first survivor layout (see
+        _gather_constrainer) — the full-width sorts run along the
+        CLUSTER axis, which stays whole per shard either way."""
+        fn = self._fallback_programs.get(fmt)
+        if fn is not None:
+            return fn
+        per_object = tuple(self._per_object_fields(fmt))
+        constrain = self._gather_constrainer(per_object)
+
+        def impl(device_in, idx, _fmt=fmt):
+            rows = {name: getattr(device_in, name)[idx] for name in per_object}
+            sub = device_in._replace(**rows)
+            if constrain is not None:
+                sub, _ = constrain(sub)
             inp = expand_compact(sub) if _fmt == "compact" else sub
             out = schedule_tick.__wrapped__(inp)
             return out.selected, out.replicas, out.counted, out.reasons
@@ -1422,7 +1568,43 @@ class SchedulerEngine:
         return min(k, c_bucket)
 
     def _observe_nsel(self, entry, nsel, c_bucket: int) -> None:
-        """Feed a fetched batch's true selected counts into the chunk's
+        """Buffer one fetched batch's true selected counts for the
+        chunk's adaptive pack-K hint.  Observations are NOT applied
+        here: a tick's wire crosses in several device-local pieces
+        (window drains, survivor groups, overflow re-fetches), and
+        applying the shrink-vote state machine per piece double-counts
+        votes — e.g. two narrow pieces of one batch would cast two
+        consecutive shrink votes and halve K where the aggregate batch
+        casts one (the per-device-safety loose end of ISSUE 12).
+        _flush_nsel aggregates every piece per entry and commits ONE
+        vote per tick."""
+        if entry is None:
+            return
+        nsel = np.asarray(nsel)
+        if nsel.size == 0:
+            return
+        slot = self._nsel_pending.get(id(entry))
+        if slot is None:
+            self._nsel_pending[id(entry)] = [entry, c_bucket, [nsel]]
+        else:
+            slot[1] = max(slot[1], c_bucket)
+            slot[2].append(nsel)
+
+    def _flush_nsel(self) -> None:
+        """Commit the tick's buffered nsel observations: one aggregated
+        vote per touched chunk entry (see _observe_nsel)."""
+        if not self._nsel_pending:
+            return
+        pending, self._nsel_pending = self._nsel_pending, {}
+        for entry, c_bucket, pieces in pending.values():
+            self._commit_nsel(
+                entry,
+                pieces[0] if len(pieces) == 1 else np.concatenate(pieces),
+                c_bucket,
+            )
+
+    def _commit_nsel(self, entry, nsel, c_bucket: int) -> None:
+        """Feed a tick's aggregated selected counts into the chunk's
         adaptive pack-K hint: pick the pow2 K minimizing expected wire
         bytes over the OBSERVED distribution — every row pays the
         (4K+2)-int wire width, overflow rows additionally pay the
@@ -1528,11 +1710,25 @@ class SchedulerEngine:
         full revalidation).  At wide C the row buckets are a fixed
         3-rung ladder so the number of distinct (expensive) programs is
         bounded; at narrow C free pow2 buckets are fine (those compiles
-        are cheap)."""
+        are cheap).
+
+        Device-count-aware layout (ISSUE 12): KT_CELL_BUDGET and
+        KT_MEGACHUNK_ROWS are PER-DEVICE limits — a mesh with N devices
+        on the objects axis multiplies both, because every [B, C] chunk
+        dispatches rows-sharded so each device resides only B/N rows of
+        it.  At c6 shapes (1M x 10k) a single device's budget would
+        shrink chunks ~4x (and quadruple the dispatch count); 4 devices
+        keep the full 4096-row megachunk.  Row buckets stay pow2 and
+        the objects axis is pow2 <= min_bucket, so every rung divides
+        evenly across the mesh."""
         c_bucket = _cluster_bucket(n_clusters, self.min_cluster_bucket)
+        n_dev = 1 if self.mesh is None else int(self.mesh.devices.shape[0])
         max_rows = max(
             self.min_bucket,
-            min(self.megachunk_rows, self.cell_budget // max(1, c_bucket)),
+            min(
+                self.megachunk_rows * n_dev,
+                (self.cell_budget * n_dev) // max(1, c_bucket),
+            ),
         )
         eff_chunk = min(self.chunk_size, 1 << (max_rows.bit_length() - 1))
         ladder = None
@@ -2055,6 +2251,7 @@ class SchedulerEngine:
             "narrow": self.narrow,
             "narrow_m": self.narrow_m,
             "mesh": None if self.mesh is None else tuple(self.mesh.devices.shape),
+            "score_f16": self.score_f16,
         }
 
     def snapshot_state(self) -> Optional[dict]:
@@ -2088,6 +2285,10 @@ class SchedulerEngine:
                 or e.stale_out_rows  # device planes disagree with decodes
             ):
                 continue
+            # np.asarray on a sharded device array gathers the shards
+            # host-side — capture works identically at any device count
+            # (the sharded-engine round trip is pinned by
+            # tests/test_multidevice.py).
             sel, rep, cnt, sco = (np.asarray(p) for p in e.prev_out)
             chunks[idx] = {
                 "n": len(e.units),
@@ -2103,6 +2304,16 @@ class SchedulerEngine:
                 "feas": np.asarray(e.prev_feas),
                 "rsn": np.asarray(e.prev_reasons),
             }
+            if self.score_f16:
+                # The exactness vector cannot be re-derived from the
+                # f16 plane alone (the true i32 scores are gone), so it
+                # rides the snapshot; a missing vector restores as
+                # all-inexact (conservative).
+                chunks[idx]["sco_exact"] = (
+                    np.asarray(e.prev_sco_exact)
+                    if e.prev_sco_exact is not None
+                    else None
+                )
             rows += len(e.units)
         if not chunks:
             return None
@@ -2122,6 +2333,52 @@ class SchedulerEngine:
             },
             "rows": rows,
             "chunks": chunks,
+        }
+
+    def resident_state_bytes(self) -> dict:
+        """Walk the chunk cache and sum the ACTUAL device bytes of the
+        resident working set, by plane family — the live half of the c6
+        memory census (runtime/census.py projects the same inventory
+        analytically to 1M x 10k and validates its model against this).
+        ``per_device`` divides rows-sharded planes by the objects-axis
+        device count and books replicated planes whole on every device
+        — the number the HBM budget knob is compared against."""
+        n_dev = 1 if self.mesh is None else int(self.mesh.devices.shape[0])
+
+        def nbytes(x) -> int:
+            return int(getattr(x, "nbytes", 0) or 0)
+
+        fams = {
+            "prev_planes": 0,     # sel/rep/cnt/sco + feas + reasons [B, C]
+            "per_object": 0,      # cached per-object input tensors
+            "tiebreak": 0,        # precomputed planner tie-break planes
+            "vectors": 0,         # nfeas / sco_exact [B] companions
+        }
+        for e in self._chunk_cache.values():
+            if e.prev_out is not None:
+                fams["prev_planes"] += sum(nbytes(p) for p in e.prev_out)
+            fams["prev_planes"] += nbytes(e.prev_feas) + nbytes(e.prev_reasons)
+            if e.device_per_object is not None:
+                fams["per_object"] += sum(
+                    nbytes(a) for a in e.device_per_object.values()
+                )
+            fams["tiebreak"] += nbytes(e.tiebreak_dev)
+            fams["vectors"] += nbytes(e.prev_nfeas) + nbytes(e.prev_sco_exact)
+        total = sum(fams.values())
+        # Rows-sharded [B, ...] planes divide across the objects axis;
+        # the [B] vectors are replicated per device.
+        sharded = total - fams["vectors"]
+        per_device = sharded // n_dev + fams["vectors"]
+        for family, v in fams.items():
+            self.metrics.store("engine_resident_bytes", v, family=family)
+        self.metrics.store("engine_resident_bytes_per_device", per_device)
+        return {
+            "by_family": fams,
+            "total": total,
+            "device_count": n_dev,
+            "per_device": per_device,
+            "score_dtype": "f16" if self.score_f16 else "i32",
+            "chunks": len(self._chunk_cache),
         }
 
     def stage_restore(self, payload: Optional[dict], assume_fresh: bool = False) -> None:
@@ -2206,6 +2463,11 @@ class SchedulerEngine:
             self.featurize_rows["full"] += len(chunk)
             if fmt != cs["fmt"]:
                 continue
+            if cs["has_scores"] and self.score_f16:
+                # The serialized score plane is f16: lossy rows' score
+                # DICTS cannot be replayed bit-exactly — cold-solve the
+                # chunk instead (want_scores consumers are rare).
+                continue
             host_bytes = sum(
                 np.asarray(getattr(inputs, name)).nbytes
                 for name in self._per_object_fields(fmt)
@@ -2265,23 +2527,44 @@ class SchedulerEngine:
             grid = self._grid_sharding
 
             def put(arr, dtype):
+                # Under a mesh the planes re-device_put straight into
+                # the grid (rows x clusters) layout every consumer
+                # program expects — restore never leaves a plane
+                # committed to one device of a multi-device engine.
                 arr = np.ascontiguousarray(np.asarray(arr), dtype=dtype)
                 return (
                     jax.device_put(arr, grid) if grid is not None else jax.device_put(arr)
+                )
+
+            def put_rep(arr):
+                # [B] companion vectors are replicated per device (the
+                # layout _nfeas_program / the repair scatter emit).
+                return (
+                    jax.device_put(arr, self._replicated)
+                    if self._replicated is not None
+                    else jax.device_put(arr)
                 )
 
             sel, rep = cs["sel"], cs["rep"]
             cnt, sco = cs["cnt"], cs["sco"]
             entry.prev_out = (
                 put(sel, np.int8), put(rep, np.int32),
-                put(cnt, np.int8), put(sco, np.int32),
+                put(cnt, np.int8),
+                put(sco, np.float16 if self.score_f16 else np.int32),
             )
             entry.prev_feas = put(cs["feas"], np.int8)
             entry.prev_reasons = put(cs["rsn"], np.int32)
+            if self.score_f16:
+                se = cs.get("sco_exact")
+                if se is not None:
+                    entry.prev_sco_exact = put_rep(
+                        np.ascontiguousarray(se, dtype=np.int8)
+                    )
+                entry.sco_inexact_host = None
             # The cached nfeas vector is DERIVED, not serialized: a
             # host-side row sum at restore keeps the snapshot format
             # stable and the zero-dispatch fresh-resume guarantee intact.
-            entry.prev_nfeas = jax.device_put(
+            entry.prev_nfeas = put_rep(
                 (np.asarray(cs["feas"]) != 0).sum(axis=1).astype(np.int32)
             )
             n = len(chunk)
@@ -2562,9 +2845,15 @@ class SchedulerEngine:
                     prev_valid
                     and entry.prev_out is not None
                     and entry.prev_out[0].shape == out_shape
+                    # Compressed score planes can't replay score dicts
+                    # bit-exactly for lossy rows; want_scores chunks do
+                    # a full refetch instead of trusting the diff.
+                    and not (self.score_f16 and entry.prev_has_scores)
                 )
                 prev = (
-                    entry.prev_out if delta_ok else self._zeros_for(out_shape)
+                    self._prev_for_diff(entry)
+                    if delta_ok
+                    else self._zeros_for(out_shape)
                 )
                 narrow_m = self._narrow_m(inputs, c_bucket)
                 self._count_dispatch(fmt, b_pad, c_bucket)
@@ -2664,6 +2953,9 @@ class SchedulerEngine:
                     pending_sub, chunk_results, view, timings, eff_chunk,
                     ladder, c_bucket, vocab,
                 )
+        # One aggregated adaptive-K vote per chunk per tick (the pieces
+        # arrived across window drains / survivor groups above).
+        self._flush_nsel()
 
         results: list[ScheduleResult] = []
         for part in chunk_results:
@@ -3182,11 +3474,16 @@ class SchedulerEngine:
         are DONATED: XLA updates them in place instead of copying ~20MB
         of [B, C] state per repaired chunk (the engine re-references
         the returned planes; nothing else holds the old ones)."""
-        fn = self._repair_program_cache.get("repair")
+        compressed = self.score_f16
+        key = ("repair", compressed)
+        fn = self._repair_program_cache.get(key)
         if fn is None:
-            def impl(planes, slab, src, dst, nfeas):
+            def impl(planes, slab, src, dst, nfeas, sco_exact=None):
+                # .astype(p.dtype) is a no-op for matching dtypes; under
+                # KT_SCORE_F16 it casts the slab's fresh i32 scores into
+                # the stored f16 plane.
                 out = tuple(
-                    p.at[dst].set(s[src], mode="drop")
+                    p.at[dst].set(s[src].astype(p.dtype), mode="drop")
                     for p, s in zip(planes, slab)
                 )
                 # slab[4] is the slab's feasibility plane.  The nfeas
@@ -3199,25 +3496,57 @@ class SchedulerEngine:
                 # the live vector (caught by the nfeas-consistency
                 # differential as an all-zero cached count).
                 nf_rows = jnp.sum(slab[4][src] != 0, axis=1, dtype=jnp.int32)
-                return out + (nfeas.at[dst].set(nf_rows, mode="drop"),)
+                res = out + (nfeas.at[dst].set(nf_rows, mode="drop"),)
+                if sco_exact is not None:
+                    # Repaired rows carry truly fresh scores: their
+                    # exactness resets from the f16 round-trip of the
+                    # slab's i32 plane (same rule as the store-side
+                    # compressor).
+                    s3 = slab[3][src]
+                    ex_rows = jnp.all(
+                        s3.astype(jnp.float16).astype(s3.dtype) == s3,
+                        axis=1,
+                    ).astype(jnp.int8)
+                    res = res + (sco_exact.at[dst].set(ex_rows, mode="drop"),)
+                return res
 
             donate = (0,) if self.donate else ()
             if self._grid_sharding is not None:
                 grid, rep = self._grid_sharding, self._replicated
+                in_sh = ((grid,) * 6, (grid,) * 6, rep, rep, rep)
+                out_sh = (grid,) * 6 + (rep,)
+                if compressed:
+                    in_sh = in_sh + (rep,)
+                    out_sh = out_sh + (rep,)
                 fn = jax.jit(
                     impl,
-                    in_shardings=(
-                        (grid,) * 6, (grid,) * 6, rep, rep, rep,
-                    ),
-                    out_shardings=(grid,) * 6 + (rep,),
+                    in_shardings=in_sh,
+                    out_shardings=out_sh,
                     donate_argnums=donate,
                 )
             else:
                 fn = jax.jit(impl, donate_argnums=donate)
-            fn = self._aot.wrap("repair", fn)
+            fn = self._aot.wrap(f"repair:{'f16' if compressed else 'f32'}", fn)
             fn = self._obs_wrap("repair", fn)
-            self._repair_program_cache["repair"] = fn
+            self._repair_program_cache[key] = fn
         return fn
+
+    def _ensure_sco_exact_vec(self, entry):
+        """The entry's device exactness vector for repair dispatches —
+        a missing vector materializes as all-zero (every row inexact),
+        which only ever forces extra recomputes, never a wrong skip."""
+        b_pad = entry.prev_out[0].shape[0]
+        vec = entry.prev_sco_exact
+        if vec is None or tuple(vec.shape) != (b_pad,):
+            zeros = np.zeros(b_pad, np.int8)
+            vec = (
+                jax.device_put(zeros, self._replicated)
+                if self._replicated is not None
+                else jax.device_put(zeros)
+            )
+            entry.prev_sco_exact = vec
+            entry.sco_inexact_host = None
+        return vec
 
     def _repair_prev_planes(
         self, entry, changed_rows, offset: int, slabs, slab_cut: int
@@ -3258,6 +3587,9 @@ class SchedulerEngine:
         planes = entry.prev_out + (entry.prev_feas, entry.prev_reasons)
         nfeas = self._ensure_nfeas(entry)
         fn = self._repair_program()
+        sco_exact = (
+            self._ensure_sco_exact_vec(entry) if self.score_f16 else None
+        )
         for s, (srcs, dsts) in segments.items():
             out = slabs[s][1]
             slab_planes = (
@@ -3278,12 +3610,19 @@ class SchedulerEngine:
                 dseg = dsts[g : g + 128]
                 dst[: len(dseg)] = dseg
                 self.dispatches_total += 1
-                out7 = fn(planes, slab_planes, src, dst, nfeas)
-                planes, nfeas = out7[:6], out7[6]
+                if sco_exact is not None:
+                    out8 = fn(planes, slab_planes, src, dst, nfeas, sco_exact)
+                    planes, nfeas, sco_exact = out8[:6], out8[6], out8[7]
+                else:
+                    out7 = fn(planes, slab_planes, src, dst, nfeas)
+                    planes, nfeas = out7[:6], out7[6]
         entry.prev_out = planes[:4]
         entry.prev_feas = planes[4]
         entry.prev_reasons = planes[5]
         entry.prev_nfeas = nfeas
+        if sco_exact is not None:
+            entry.prev_sco_exact = sco_exact
+            entry.sco_inexact_host = None
         entry.stale_out_rows = (
             sorted(set(entry.stale_out_rows) - set(changed_rows))
             if entry.stale_out_rows
@@ -3396,6 +3735,131 @@ class SchedulerEngine:
             nf = self._nfeas_program()(entry.prev_feas)
             entry.prev_nfeas = nf
         return nf
+
+    # -- f16 score-plane compression (KT_SCORE_F16, ISSUE 12) -------------
+    def _sco_compress_program(self, with_old: bool):
+        """Jitted store-side compressor: i32[B, C] scores -> (f16[B, C],
+        i8[B] exactness).  A row is exact iff every score round-trips
+        i32 -> f16 -> i32 bit-identically; ``with_old`` ANDs a previous
+        exactness vector in (the drift gate's changed-column refresh
+        writes THROUGH the stored plane, so a row once lossy stays
+        flagged until a recompute stores truly fresh scores)."""
+        key = f"compress:{int(with_old)}"
+        fn = self._sco_cache.get(key)
+        if fn is None:
+            if with_old:
+                def impl(sco, old):
+                    f16 = sco.astype(jnp.float16)
+                    exact = jnp.all(
+                        f16.astype(jnp.int32) == sco, axis=1
+                    ).astype(jnp.int8)
+                    return f16, exact * old
+            else:
+                def impl(sco):
+                    f16 = sco.astype(jnp.float16)
+                    exact = jnp.all(
+                        f16.astype(jnp.int32) == sco, axis=1
+                    ).astype(jnp.int8)
+                    return f16, exact
+
+            if self._grid_sharding is not None:
+                grid, rep = self._grid_sharding, self._replicated
+                in_sh = (grid, rep) if with_old else (grid,)
+                fn = jax.jit(
+                    impl, in_shardings=in_sh, out_shardings=(grid, rep)
+                )
+            else:
+                fn = jax.jit(impl)
+            fn = self._aot.wrap(key, fn)
+            fn = self._obs_wrap("score_pack", fn)
+            self._sco_cache[key] = fn
+        return fn
+
+    def _sco_upcast_program(self):
+        """f16[B, C] stored scores -> i32[B, C] for the diff / gate
+        programs (exact rows upcast bit-identically; inexact rows are
+        forced out of every consumer that could act on the difference)."""
+        fn = self._sco_cache.get("upcast")
+        if fn is None:
+            def impl(f16):
+                return f16.astype(jnp.int32)
+
+            if self._grid_sharding is not None:
+                fn = jax.jit(
+                    impl,
+                    in_shardings=self._grid_sharding,
+                    out_shardings=self._grid_sharding,
+                )
+            else:
+                fn = jax.jit(impl)
+            fn = self._aot.wrap("sco_upcast", fn)
+            fn = self._obs_wrap("score_pack", fn)
+            self._sco_cache["upcast"] = fn
+        return fn
+
+    def _compress_scores(self, entry, sco_dev, and_old: bool = False):
+        """Store one fresh f32/i32 score plane compressed on the entry:
+        sets the f16 plane + exactness vector, invalidates the host
+        cache of inexact rows.  Returns the f16 plane."""
+        self.dispatches_total += 1
+        if and_old and entry.prev_sco_exact is not None:
+            f16, exact = self._sco_compress_program(True)(
+                sco_dev, entry.prev_sco_exact
+            )
+        else:
+            f16, exact = self._sco_compress_program(False)(sco_dev)
+        entry.prev_sco_exact = exact
+        entry.sco_inexact_host = None
+        return f16
+
+    def _sco_inexact_rows(self, entry) -> np.ndarray:
+        """Host indices of rows whose stored f16 scores are lossy (the
+        rows every score-consuming fast path must treat as unknown).
+        Missing vector = every row inexact — conservative, never wrong."""
+        cached = entry.sco_inexact_host
+        if cached is not None:
+            return cached
+        if entry.prev_sco_exact is None:
+            b = (
+                entry.prev_out[0].shape[0]
+                if entry.prev_out is not None
+                else 0
+            )
+            rows = np.arange(b, dtype=np.int64)
+        else:
+            rows = np.nonzero(
+                self._read_np(entry.prev_sco_exact) == 0
+            )[0].astype(np.int64)
+        entry.sco_inexact_host = rows
+        return rows
+
+    def _prev_for_diff(self, entry) -> tuple:
+        """The prev planes in the dtype the tick's diff expects: the
+        stored f16 score plane upcasts to i32 on device (exact rows
+        reproduce the true scores, so their diff bits behave exactly
+        like the uncompressed engine's; lossy rows flag as score-
+        changed and simply re-fetch)."""
+        prev = entry.prev_out
+        if not self.score_f16 or prev[3].dtype != jnp.float16:
+            return prev
+        self.dispatches_total += 1
+        return prev[:3] + (self._sco_upcast_program()(prev[3]),)
+
+    def _store_prev(self, entry, out) -> None:
+        """Central prev-plane store: every fetch path that adopts a
+        fresh TickOutputs as the chunk's resident state funnels through
+        here, so the nfeas companion vector, the optional f16 score
+        compression (+ exactness vector) and the stale-marking reset
+        can never drift apart across store sites."""
+        if self.score_f16:
+            sco = self._compress_scores(entry, out.scores)
+        else:
+            sco = out.scores
+        entry.prev_out = (out.selected, out.replicas, out.counted, sco)
+        entry.prev_feas = out.feasible
+        entry.prev_reasons = out.reasons
+        self._store_nfeas(entry, out.feasible)
+        entry.stale_out_rows = None
 
     def _gate_program(self, fmt: str):
         """Jitted drift gate per format (jax re-traces per shape; the
@@ -3647,12 +4111,21 @@ class SchedulerEngine:
         self.upload_bytes["cluster"] += sum(a.nbytes for a in slices)
         fin_idx = self._fin_rows(entry, b_pad)
         nfeas = self._ensure_nfeas(entry)
+        # Compressed score plane: the gate consumes (and donates) an
+        # i32 plane — upcast the stored f16 copy.  Exact rows classify
+        # identically to the uncompressed engine; lossy rows are forced
+        # into the recompute set at drain time (_drain_drift_gates), so
+        # a quantized rank compare can never decide a skip.
+        prev_sco = entry.prev_out[3]
+        if self.score_f16 and prev_sco.dtype == jnp.float16:
+            self.dispatches_total += 1
+            prev_sco = self._sco_upcast_program()(prev_sco)
         if fmt == "compact":
             return gate(
                 entry.device_per_object,
                 self._tables_device(vocab, c_bucket),
                 entry.prev_feas,
-                entry.prev_out[3],
+                prev_sco,
                 *slices,
                 info["didx"], info["dvalid"], info["dcpu"], fin_idx,
                 nfeas,
@@ -3660,7 +4133,7 @@ class SchedulerEngine:
         return gate(
             entry.device_per_object,
             entry.prev_feas,
-            entry.prev_out[3],
+            prev_sco,
             *slices,
             info["didx"], info["dvalid"], info["dcpu"], fin_idx,
             nfeas,
@@ -3776,10 +4249,11 @@ class SchedulerEngine:
         survivor rows' cached device inputs plus the stored prev planes,
         expand (compact) and run ops.pipeline.drift_resolve — select +
         planner from gate-refreshed state, no full-width sorts, no
-        phase 1.  Like the narrow fallback, the gathered sub-problem is
-        replicated under a mesh (survivor rows are few and the
-        resolve's per-row scans must see the whole cluster axis); the
-        output planes are constrained back to the grid layout so both
+        phase 1.  Like the narrow fallback, the gathered sub-problem
+        rides the rows-first survivor layout under a mesh (see
+        _gather_constrainer — each group's rows partition across the
+        objects axis; KT_SURVIVOR_ROWSHARD=0 reverts to replication);
+        the output planes are constrained back to the grid layout so both
         the in-place prev-plane repair and the (separately dispatched,
         cheap-to-trace) wire pack consume them directly.  The wire pack
         is NOT fused in here: its K comes from the per-chunk adaptive
@@ -3792,6 +4266,7 @@ class SchedulerEngine:
         per_object = tuple(self._per_object_fields(fmt))
         replicated = self._replicated
         grid = self._grid_sharding
+        constrain = self._gather_constrainer(per_object)
 
         def impl(device_in, idx, prev_feas, prev_scores, prev_reasons,
                  ao, uo, an, un, didx, dvalid, tb=None, _fmt=fmt, _m=m):
@@ -3801,19 +4276,10 @@ class SchedulerEngine:
             sco_r = prev_scores[idx]
             rsn_r = prev_reasons[idx]
             tb_r = tb[idx] if tb is not None else None
-            if replicated is not None:
-                sub = type(sub)(
-                    *(
-                        jax.lax.with_sharding_constraint(x, replicated)
-                        for x in sub
-                    )
+            if constrain is not None:
+                sub, (feas_r, sco_r, rsn_r, tb_r) = constrain(
+                    sub, (feas_r, sco_r, rsn_r, tb_r)
                 )
-                feas_r, sco_r, rsn_r = (
-                    jax.lax.with_sharding_constraint(x, replicated)
-                    for x in (feas_r, sco_r, rsn_r)
-                )
-                if tb_r is not None:
-                    tb_r = jax.lax.with_sharding_constraint(tb_r, replicated)
             inp = (
                 expand_compact(sub, tiebreak=tb_r)
                 if _fmt == "compact"
@@ -3878,6 +4344,11 @@ class SchedulerEngine:
         immediately, overlapping later chunks' gate compute; results are
         drained batched by _drain_drift_resolve."""
         if not self.drift_resolve or self.fetch_format != "packed":
+            return []
+        if self.score_f16:
+            # The sort-free resolve consumes the stored score plane
+            # directly; under compression those rows ride the unified
+            # kernel (no stored scores needed) or the slab path instead.
             return []
         if (
             entry.prev_reasons is None
@@ -3947,8 +4418,9 @@ class SchedulerEngine:
         (``scored=False``: sort-free selection-known replan for kinf
         rows) or drift_scoreonly (``scored=True``: stored-plane phase 1
         + the narrow select/planner for finite-K rows).  Mesh handling
-        mirrors _resolve_program: the gathered sub-problem replicates,
-        outputs constrain back to the grid for the in-place repair."""
+        mirrors _resolve_program: the gathered sub-problem rides the
+        rows-first survivor layout (_gather_constrainer), outputs
+        constrain back to the grid for the in-place repair."""
         key = (fmt, m, scored)
         cache = self._scoreonly_programs if scored else self._replan_programs
         fn = cache.get(key)
@@ -3958,6 +4430,7 @@ class SchedulerEngine:
         replicated = self._replicated
         grid = self._grid_sharding
         i32_keys = self.phase1_i32
+        constrain = self._gather_constrainer(per_object)
 
         def impl(device_in, idx, prev_reasons, prev_scores, tb=None,
                  _fmt=fmt, _m=m, _scored=scored):
@@ -3966,17 +4439,10 @@ class SchedulerEngine:
             rsn_r = prev_reasons[idx]
             sco_r = prev_scores[idx]
             tb_r = tb[idx] if tb is not None else None
-            if replicated is not None:
-                sub = type(sub)(
-                    *(
-                        jax.lax.with_sharding_constraint(x, replicated)
-                        for x in sub
-                    )
+            if constrain is not None:
+                sub, (rsn_r, sco_r, tb_r) = constrain(
+                    sub, (rsn_r, sco_r, tb_r)
                 )
-                rsn_r = jax.lax.with_sharding_constraint(rsn_r, replicated)
-                sco_r = jax.lax.with_sharding_constraint(sco_r, replicated)
-                if tb_r is not None:
-                    tb_r = jax.lax.with_sharding_constraint(tb_r, replicated)
             inp = (
                 expand_compact(sub, tiebreak=tb_r)
                 if _fmt == "compact"
@@ -4025,6 +4491,11 @@ class SchedulerEngine:
         format, narrow disabled, or no eligible rows); cert failures
         stay in the recompute set and take the slab path."""
         if not self.replan or self.fetch_format != "packed":
+            return []
+        if self.score_f16:
+            # The replan consumes the stored score plane; compressed
+            # engines route fit-flip survivors through the unified
+            # kernel / slab path instead (see _dispatch_drift_resolve).
             return []
         if (
             entry.prev_reasons is None
@@ -4092,8 +4563,11 @@ class SchedulerEngine:
         docstring).  Needs NO stored score plane (scores recompute from
         stored filters) and NO delta-column info (wide drifts ride it
         too).  Mesh handling mirrors _resolve_program: the gathered
-        sub-problem replicates, outputs constrain back to the grid for
-        the in-place repair; the wire pack is fused at K = narrow M."""
+        sub-problem rides the rows-first survivor layout
+        (_gather_constrainer — N devices each solve G/N rows of a
+        group, the ISSUE 12 per-device stream), outputs constrain back
+        to the grid for the in-place repair; the wire pack is fused at
+        K = narrow M."""
         key = (fmt, m)
         fn = self._survivor_programs.get(key)
         if fn is not None:
@@ -4102,22 +4576,15 @@ class SchedulerEngine:
         replicated = self._replicated
         grid = self._grid_sharding
         i32_keys = self.phase1_i32
+        constrain = self._gather_constrainer(per_object)
 
         def impl(device_in, idx, prev_reasons, tb=None, _fmt=fmt, _m=m):
             rows = {name: getattr(device_in, name)[idx] for name in per_object}
             sub = device_in._replace(**rows)
             rsn_r = prev_reasons[idx]
             tb_r = tb[idx] if tb is not None else None
-            if replicated is not None:
-                sub = type(sub)(
-                    *(
-                        jax.lax.with_sharding_constraint(x, replicated)
-                        for x in sub
-                    )
-                )
-                rsn_r = jax.lax.with_sharding_constraint(rsn_r, replicated)
-                if tb_r is not None:
-                    tb_r = jax.lax.with_sharding_constraint(tb_r, replicated)
+            if constrain is not None:
+                sub, (rsn_r, tb_r) = constrain(sub, (rsn_r, tb_r))
             inp = (
                 expand_compact(sub, tiebreak=tb_r)
                 if _fmt == "compact"
@@ -4250,6 +4717,9 @@ class SchedulerEngine:
         planes = entry.prev_out + (entry.prev_feas, entry.prev_reasons)
         nfeas = self._ensure_nfeas(entry)
         fn = self._repair_program()
+        sco_exact = (
+            self._ensure_sco_exact_vec(entry) if self.score_f16 else None
+        )
         out_planes = (
             out.selected, out.replicas, out.counted, out.scores,
             out.feasible, out.reasons,
@@ -4264,12 +4734,19 @@ class SchedulerEngine:
             dseg = np.asarray(dst_rows[g : g + 128])
             dst[: dseg.size] = dseg
             self.dispatches_total += 1
-            out7 = fn(planes, out_planes, src, dst, nfeas)
-            planes, nfeas = out7[:6], out7[6]
+            if sco_exact is not None:
+                out8 = fn(planes, out_planes, src, dst, nfeas, sco_exact)
+                planes, nfeas, sco_exact = out8[:6], out8[6], out8[7]
+            else:
+                out7 = fn(planes, out_planes, src, dst, nfeas)
+                planes, nfeas = out7[:6], out7[6]
         entry.prev_out = planes[:4]
         entry.prev_feas = planes[4]
         entry.prev_reasons = planes[5]
         entry.prev_nfeas = nfeas
+        if sco_exact is not None:
+            entry.prev_sco_exact = sco_exact
+            entry.sco_inexact_host = None
         return True
 
     def _drain_drift_resolve(
@@ -4384,15 +4861,29 @@ class SchedulerEngine:
             timings["fetch"] += dt
             t0 = time.perf_counter()
             self.drift_stats["gated"] += 1
+            # Rows whose cached prev planes are unreliable (patched
+            # without a successful device write-back) are gate-blind:
+            # force them into the recompute set.  Under KT_SCORE_F16,
+            # rows whose stored scores were quantized lossily are
+            # equally gate-blind for the rank compare — forced too,
+            # BEFORE the refreshed plane replaces the exactness vector.
+            forced = set()
+            if self.score_f16:
+                forced.update(
+                    int(r)
+                    for r in self._sco_inexact_rows(entry)
+                    if r < n
+                )
             # The gate refreshed the changed columns of the stored score
             # plane (skipped rows stay exact for future drift gates;
             # recomputed rows are overwritten by the slab repair).
-            entry.prev_out = entry.prev_out[:3] + (devs[1],)
+            if self.score_f16:
+                entry.prev_out = entry.prev_out[:3] + (
+                    self._compress_scores(entry, devs[1], and_old=True),
+                )
+            else:
+                entry.prev_out = entry.prev_out[:3] + (devs[1],)
             rec = set(np.nonzero(mask & DRIFT_RECOMPUTE)[0].tolist())
-            # Rows whose cached prev planes are unreliable (patched
-            # without a successful device write-back) are gate-blind:
-            # force them into the recompute set.
-            forced = set()
             if entry.stale_out_rows:
                 forced.update(r for r in entry.stale_out_rows if r < n)
             if entry.stale_rows:
@@ -4573,8 +5064,13 @@ class SchedulerEngine:
                 delta_ok = (
                     entry.prev_out is not None
                     and entry.prev_out[0].shape == shape
+                    and not (self.score_f16 and entry.prev_has_scores)
                 )
-                prev = entry.prev_out if delta_ok else self._zeros_for(shape)
+                prev = (
+                    self._prev_for_diff(entry)
+                    if delta_ok
+                    else self._zeros_for(shape)
+                )
                 narrow_m = self._narrow_m(entry.inputs, c_bucket)
                 self._count_dispatch(fmt, b_pad, c_bucket)
                 if narrow_m is not None:
@@ -5125,11 +5621,7 @@ class SchedulerEngine:
 
     def _note_skip(self, entry, out, view) -> None:
         self.fetch_stats["skip"] += 1
-        entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
-        entry.prev_feas = out.feasible
-        entry.prev_reasons = out.reasons
-        self._store_nfeas(entry, out.feasible)
-        entry.stale_out_rows = None
+        self._store_prev(entry, out)
         entry.prev_view = view
 
     def _record_decisions(
@@ -5183,11 +5675,7 @@ class SchedulerEngine:
             entry, idx_rows, changed_results, rsn, sco, view,
             program=f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}",
         )
-        entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
-        entry.prev_feas = out.feasible
-        entry.prev_reasons = out.reasons
-        self._store_nfeas(entry, out.feasible)
-        entry.stale_out_rows = None
+        self._store_prev(entry, out)
         entry.prev_results = merged
         entry.prev_view = view
         return merged, idx_rows
@@ -5219,11 +5707,7 @@ class SchedulerEngine:
             # inputs, and the next tick's no-op shortcut would replay
             # stale placements (ADVICE r2).  The caller shares the
             # stored list's rows — frozen results make that safe.
-            entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
-            entry.prev_feas = out.feasible
-            entry.prev_reasons = out.reasons
-            self._store_nfeas(entry, out.feasible)
-            entry.stale_out_rows = None
+            self._store_prev(entry, out)
             entry.prev_results = results
             entry.prev_has_scores = want_scores
             entry.prev_view = view
@@ -5348,11 +5832,7 @@ class SchedulerEngine:
             entry, idx_rows, results, packed, over_pos, over_dense, view,
             program=f"{entry.fmt}:{out.selected.shape[0]}x{out.selected.shape[1]}",
         )
-        entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
-        entry.prev_feas = out.feasible
-        entry.prev_reasons = out.reasons
-        self._store_nfeas(entry, out.feasible)
-        entry.stale_out_rows = None
+        self._store_prev(entry, out)
         entry.prev_results = merged
         entry.prev_view = view
         return merged, idx_rows
@@ -5375,11 +5855,7 @@ class SchedulerEngine:
             ),
         )
         if entry is not None:
-            entry.prev_out = (out.selected, out.replicas, out.counted, out.scores)
-            entry.prev_feas = out.feasible
-            entry.prev_reasons = out.reasons
-            self._store_nfeas(entry, out.feasible)
-            entry.stale_out_rows = None
+            self._store_prev(entry, out)
             entry.prev_results = results
             entry.prev_has_scores = want_scores
             entry.prev_view = view

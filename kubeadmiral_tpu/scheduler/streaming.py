@@ -104,11 +104,21 @@ class StreamingScheduler:
         self.metrics = metrics if metrics is not None else (
             getattr(engine, "metrics", None) or null_metrics()
         )
-        self.slab_rows = (
-            int(os.environ.get("KT_SLAB_ROWS", "1024"))
-            if slab_rows is None
-            else int(slab_rows)
-        )
+        if slab_rows is None:
+            slab_rows = int(os.environ.get("KT_SLAB_ROWS", "1024"))
+            # Per-device slab sizing (ISSUE 12): the churn revalidation
+            # slabs ride the engine's rows-sharded dispatch, so a meshed
+            # engine's row watermark scales with the objects-axis device
+            # count — a 1024-row slab spread over 8 devices is 128 rows
+            # each, below the padding knee the watermark exists to
+            # clear.  An explicit slab_rows arg or KT_SLAB_ROWS is
+            # taken verbatim... the env knob scales too (it is the
+            # per-device number, like KT_CELL_BUDGET); only the
+            # constructor arg is absolute.
+            mesh = getattr(engine, "mesh", None)
+            if mesh is not None:
+                slab_rows *= int(mesh.devices.shape[0])
+        self.slab_rows = int(slab_rows)
         self.slab_age_ms = (
             float(os.environ.get("KT_SLAB_AGE_MS", "50"))
             if slab_age_ms is None

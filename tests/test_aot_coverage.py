@@ -45,6 +45,8 @@ EXPECTED_JIT_SITES = {
     "_tb_program",           # tiebreak plane full/patch builders
     "_repair_program",
     "_prewarm_ladder",       # the transient prewarm-only repair chain seed
+    "_sco_compress_program",  # f16 score-plane compress + exactness (ISSUE 12)
+    "_sco_upcast_program",    # f16 -> i32 upcast for diff/gate consumers
 }
 
 
@@ -131,6 +133,9 @@ def test_every_builder_routes_through_aot_and_ledger(tmp_path, monkeypatch):
         ("_tb_program/full", eng._tb_program("full")),
         ("_tb_program/patch", eng._tb_program("patch")),
         ("_repair_program", eng._repair_program()),
+        ("_sco_compress_program", eng._sco_compress_program(False)),
+        ("_sco_compress_program/old", eng._sco_compress_program(True)),
+        ("_sco_upcast_program", eng._sco_upcast_program()),
     ]
     for what, fn in builders:
         _assert_covered(fn, what)

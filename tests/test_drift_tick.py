@@ -426,17 +426,41 @@ class TestGeometryInvariance:
         )
 
     def test_cell_budget_knob_bounds_rows(self):
-        """KT_CELL_BUDGET / KT_MEGACHUNK_ROWS shape the chunk geometry."""
-        eng = SchedulerEngine(cell_budget=512 * 64, megachunk_rows=4096)
+        """KT_CELL_BUDGET / KT_MEGACHUNK_ROWS shape the chunk geometry.
+        Both are PER-DEVICE limits (ISSUE 12): a mesh with N devices on
+        the objects axis multiplies them, because chunks dispatch
+        rows-sharded and each device resides only B/N rows."""
+        eng = SchedulerEngine(cell_budget=512 * 64, megachunk_rows=4096,
+                              mesh=None)
         c_bucket, eff_chunk, _ = eng._tick_geometry(512)
         assert c_bucket == 512 and eff_chunk == 64
-        eng2 = SchedulerEngine(megachunk_rows=256)
+        eng2 = SchedulerEngine(megachunk_rows=256, mesh=None)
         _, eff2, _ = eng2._tick_geometry(512)
         assert eff2 == 256
         # Default budget keeps full megachunks through the 5k config.
-        eng3 = SchedulerEngine()
+        eng3 = SchedulerEngine(mesh=None)
         _, eff3, _ = eng3._tick_geometry(5000)
         assert eff3 == 4096, eff3
+        # Device-count-aware layout: the same per-device budget on an
+        # N-device objects mesh allows N x the cells per chunk (capped
+        # by chunk_size), so c6-wide cluster axes keep full megachunks.
+        import jax
+
+        from kubeadmiral_tpu.parallel import mesh as M
+
+        if len(jax.devices()) >= 4:
+            mesh = M.make_mesh(jax.devices()[:4])
+            eng4 = SchedulerEngine(cell_budget=512 * 64, megachunk_rows=4096,
+                                   mesh=mesh)
+            _, eff4, _ = eng4._tick_geometry(512)
+            assert eff4 == 64 * 4, eff4
+            # c6's 10k cluster axis: one device's budget halves the
+            # megachunk; 4 devices keep the full 4096 rows.
+            solo = SchedulerEngine(mesh=None)
+            _, eff_solo, _ = solo._tick_geometry(10_000)
+            eng5 = SchedulerEngine(mesh=mesh)
+            _, eff_mesh, _ = eng5._tick_geometry(10_000)
+            assert eff_solo == 2048 and eff_mesh == 4096, (eff_solo, eff_mesh)
 
 
 class TestPrewarmLadder:

@@ -1,0 +1,342 @@
+"""Multi-device engine scale-out: tier-1 parity at N ∈ {2, 4} (ISSUE 12).
+
+Promotes the ``dryrun_multichip`` engine-parity blocks into the tier-1
+suite: a meshed engine over N forced host devices must be bit-identical
+to the single-device engine through the full chain — cold, no-op replay,
+churn sub-batch, capacity-drift gate, the unified survivor stream
+(rows-sharded groups under KT_SURVIVOR_ROWSHARD), fit-flip replans —
+including the flight recorder's reason counts.  Plus the ISSUE 12
+satellites: the sharded snapshot round-trip, the per-device-safe
+adaptive-K aggregation, the f16 score-plane compression contract, the
+AOT topology guard, and a forced-device-count subprocess proving the
+pre-import env path (auto mesh, per-device pipeline windows).
+
+The ambient test harness forces 8 virtual CPU devices (conftest.py), so
+N ∈ {2, 4} meshes build in-process from explicit device subsets; only
+the auto-resolution test needs a subprocess (device count binds at jax
+backend init)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+
+from kubeadmiral_tpu.models.types import parse_resources  # noqa: E402
+from kubeadmiral_tpu.parallel import mesh as M  # noqa: E402
+from kubeadmiral_tpu.runtime import census  # noqa: E402
+from kubeadmiral_tpu.runtime.flightrec import FlightRecorder  # noqa: E402
+from kubeadmiral_tpu.scheduler.engine import SchedulerEngine  # noqa: E402
+
+from __graft_entry__ import _example_units_clusters  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _world(b=96, c=16):
+    units, clusters = _example_units_clusters(b, c)
+    # Mix in finite-K rows so the drift gate's top-K machinery and the
+    # unified survivor kernel both engage (the dryrun's flip_units mix).
+    units = [
+        dataclasses.replace(u, max_clusters=None if i % 2 else 2 + i % 3)
+        for i, u in enumerate(units)
+    ]
+    return units, clusters
+
+
+def _mesh(n):
+    devices = jax.devices()
+    if len(devices) < n:
+        pytest.skip(f"need {n} devices, have {len(devices)}")
+    return M.make_mesh(devices[:n])
+
+
+def _engine(mesh, rec=None, **kw):
+    return SchedulerEngine(
+        mesh=mesh, min_bucket=32, narrow_m=8,
+        flight_recorder=rec if rec is not None else None,
+        **kw,
+    )
+
+
+def _drifts(clusters):
+    halved = list(clusters)
+    halved[0] = dataclasses.replace(
+        halved[0],
+        available={k: max(0, v // 2) for k, v in halved[0].available.items()},
+    )
+    # Column 1 keeps only 700m cpu free: a real fit flip for a fraction
+    # of rows — the unified survivor stream's regime.
+    squeezed = [
+        dataclasses.replace(
+            cl, available={**cl.available, **parse_resources({"cpu": "700m"})}
+        )
+        if j == 1
+        else cl
+        for j, cl in enumerate(clusters)
+    ]
+    boosted = [
+        dataclasses.replace(cl, available=dict(cl.allocatable))
+        if j == 3
+        else cl
+        for j, cl in enumerate(clusters)
+    ]
+    return halved, squeezed, boosted
+
+
+def _rec_state(rec: FlightRecorder) -> dict:
+    """Per-key (placements, reason_counts, feasible_n) — the recorder
+    fields that must match between meshed and single-device engines."""
+    return {
+        k: (
+            dict(r.placements),
+            None
+            if r.reason_counts is None
+            else tuple(np.asarray(r.reason_counts).tolist()),
+            None if r.feasible_n is None else int(r.feasible_n),
+        )
+        for k, r in rec._index.items()
+    }
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_parity_chain_vs_single_device(n):
+    """steady / noop / churn / drift / survivor chains at N devices are
+    bit-identical to N=1, flight-recorder reason counts included."""
+    units, clusters = _world()
+    rec_m = FlightRecorder(enabled=True)
+    rec_s = FlightRecorder(enabled=True)
+    meshed = _engine(_mesh(n), rec=rec_m)
+    single = _engine(None, rec=rec_s)
+    assert meshed.pipeline_depth == meshed.pipeline_depth_per_device * n
+
+    # Cold + no-op replay.
+    assert meshed.schedule(units, clusters) == single.schedule(units, clusters)
+    cold_dispatches = meshed.dispatches_total
+    assert meshed.schedule(units, clusters) == single.schedule(units, clusters)
+    assert meshed.dispatches_total == cold_dispatches, "noop re-dispatched"
+    assert meshed.fetch_stats["noop"] >= 1
+
+    # Churn sub-batch.
+    churned = list(units)
+    churned[0] = dataclasses.replace(
+        churned[0], desired_replicas=(units[0].desired_replicas or 1) + 7
+    )
+    assert meshed.schedule(churned, clusters) == single.schedule(
+        churned, clusters
+    )
+    assert meshed.fetch_stats["subbatch"] >= 1
+
+    # Capacity drift -> gate; cpu squeeze -> fit-flip survivors through
+    # the (rows-sharded) unified kernel; boost -> top-K membership flip.
+    halved, squeezed, boosted = _drifts(clusters)
+    for world in (halved, squeezed, boosted):
+        assert meshed.schedule(churned, world) == single.schedule(
+            churned, world
+        ), "drift parity"
+    assert meshed.drift_stats["gated"] >= 1
+    assert (
+        meshed.drift_stats["unified"] + meshed.drift_stats["unified_fallback"]
+        > 0
+    ), meshed.drift_stats
+    # Same drift classification on both sides (the gate is exact).
+    for k in ("skip", "unified", "recompute", "wcheck_changed"):
+        assert meshed.drift_stats[k] == single.drift_stats[k], (
+            k, meshed.drift_stats, single.drift_stats,
+        )
+
+    # Flight recorder: identical per-key placements + reason counts.
+    assert _rec_state(rec_m) == _rec_state(rec_s)
+
+
+def test_snapshot_round_trip_sharded():
+    """A sharded engine's snapshot restores bit-identical into a fresh
+    sharded engine — prev planes gathered at capture, re-device_put with
+    the mesh shardings at restore, zero-dispatch no-op replay preserved."""
+    units, clusters = _world(b=64)
+    src = _engine(_mesh(4))
+    want = src.schedule(units, clusters)
+    payload = src.snapshot_state()
+    assert payload is not None and payload["config"]["mesh"] == (4, 1)
+
+    dst = _engine(_mesh(4))
+    dst.stage_restore(payload, assume_fresh=True)
+    before = dst.dispatches_total
+    got = dst.schedule(units, clusters)
+    assert got == want
+    assert dst.restore_info["result"] == "loaded", dst.restore_info
+    assert dst.restore_info["fresh"] is True, dst.restore_info
+    assert dst.dispatches_total == before, "fresh resume dispatched"
+    # The restored planes live under the mesh shardings: a drift tick
+    # rides the gate path on sharded buffers, parity-exact.
+    halved, _, _ = _drifts(clusters)
+    single = _engine(None)
+    single.schedule(units, clusters)
+    assert dst.schedule(units, halved) == single.schedule(units, halved)
+    assert dst.drift_stats["gated"] >= 1
+
+
+def test_snapshot_topology_mismatch_rejected():
+    """A 4-device snapshot must not restore into a 2-device engine (the
+    plane shardings and geometry differ): rejected -> cold, never a
+    reinterpretation."""
+    units, clusters = _world(b=64)
+    src = _engine(_mesh(4))
+    want = src.schedule(units, clusters)
+    payload = src.snapshot_state()
+    dst = _engine(_mesh(2))
+    dst.stage_restore(payload, assume_fresh=True)
+    assert dst.schedule(units, clusters) == want  # cold solve, same answer
+    assert dst.restore_info["result"] == "rejected"
+
+
+def test_observe_nsel_aggregates_per_tick():
+    """The adaptive-K hint casts ONE vote per tick on the aggregated
+    observations: two device-local wire pieces of one batch must not
+    double-count shrink votes (the regression: piecewise observation
+    halved K after a single narrow tick)."""
+    eng = _engine(None)
+    entry = type("E", (), {"pack_k_hint": 64, "pack_shrink_votes": 0})()
+    narrow = np.ones(32, np.int64)  # rows selecting 1 cluster each
+    # Old behavior: each piece votes shrink -> two consecutive votes ->
+    # hint halves within one tick.  New behavior: one aggregated vote.
+    eng._observe_nsel(entry, narrow, 256)
+    eng._observe_nsel(entry, narrow, 256)
+    eng._flush_nsel()
+    assert entry.pack_shrink_votes == 1, entry.pack_shrink_votes
+    assert entry.pack_k_hint == 64, entry.pack_k_hint
+    # The second tick's aggregate casts the second vote -> decay engages
+    # exactly as the hysteresis contract documents.
+    eng._observe_nsel(entry, narrow, 256)
+    eng._observe_nsel(entry, narrow, 256)
+    eng._flush_nsel()
+    assert entry.pack_shrink_votes == 0
+    assert entry.pack_k_hint == 32
+
+
+def test_score_f16_parity(monkeypatch):
+    """KT_SCORE_F16: compressed resident score planes stay bit-identical
+    through steady/churn/drift — lossy rows are forced into recompute by
+    the exactness guard, never trusted."""
+    units, clusters = _world()
+    monkeypatch.setenv("KT_SCORE_F16", "1")
+    packed = _engine(_mesh(4))
+    monkeypatch.delenv("KT_SCORE_F16")
+    plain = _engine(None)
+    assert packed.score_f16 and not plain.score_f16
+
+    assert packed.schedule(units, clusters) == plain.schedule(units, clusters)
+    entry = packed._chunk_cache[0]
+    assert entry.prev_out[3].dtype == np.float16
+    assert entry.prev_sco_exact is not None
+    churned = list(units)
+    churned[5] = dataclasses.replace(churned[5], desired_replicas=83)
+    assert packed.schedule(churned, clusters) == plain.schedule(
+        churned, clusters
+    )
+    halved, squeezed, _ = _drifts(clusters)
+    for world in (halved, squeezed):
+        assert packed.schedule(churned, world) == plain.schedule(
+            churned, world
+        )
+    assert packed.drift_stats["gated"] >= 1
+    # The snapshot carries the compressed plane + exactness vector and
+    # round-trips into a compressed engine.
+    payload = packed.snapshot_state()
+    assert payload["config"]["score_f16"] is True
+    monkeypatch.setenv("KT_SCORE_F16", "1")
+    fresh = _engine(_mesh(4))
+    fresh.stage_restore(payload, assume_fresh=True)
+    assert fresh.schedule(churned, squeezed) == plain.schedule(
+        churned, squeezed
+    )
+
+
+def test_census_model_validates_against_live_engine():
+    """The c6 census model predicts the live engine's resident prev
+    planes at a small shape (the honesty check bench --scenario census
+    gates on), and the decision cascade engages compression/sharding."""
+    v = census.validate(512, 64)
+    assert v["ok"], v
+    # c6 at 4 devices with a tight budget: the decision must resolve to
+    # a finite configuration, and the f16 projection must actually be
+    # smaller than i32.
+    d = census.decide(1_000_000, 10_000, 4, budget_bytes=16 << 30)
+    assert d["per_device_f16"] < d["per_device_i32"]
+    assert d["verdict"] in ("fits", "compress", "shard")
+    if d["verdict"] == "shard":
+        assert d["min_devices"] > 4
+        resolved = census.project(
+            1_000_000, 10_000, d["min_devices"], score_f16=True
+        )
+        assert resolved["per_device"] <= 16 << 30
+    # Geometry is device-count-aware: more devices, bigger megachunks.
+    g1 = census.project(1_000_000, 10_000, 1)["geometry"]
+    g4 = census.project(1_000_000, 10_000, 4)["geometry"]
+    assert g4["eff_chunk"] > g1["eff_chunk"]
+
+
+def test_aot_live_trace_under_mesh():
+    """Meshed engines run the AOT store in live-trace-only mode: honest
+    ``traced`` counts, zero preloads, no manifest writes — and the
+    manifest guard carries the device topology."""
+    eng = _engine(_mesh(2))
+    assert eng._aot.live_trace_only
+    units, clusters = _world(b=32)
+    eng.schedule(units, clusters)
+    assert eng._aot.stats["traced"] > 0
+    assert eng._aot.stats["loaded"] == 0
+    assert eng._aot.preload_all() == 0
+    guard = eng._aot._guard()
+    assert guard["devices"] == jax.device_count()
+
+
+@pytest.mark.slow
+def test_forced_device_count_subprocess():
+    """The pre-import env path (the one a real deployment uses): a fresh
+    process with XLA_FLAGS forcing 2 host devices auto-resolves a 2x1
+    objects mesh, scales the per-device pipeline window, and schedules
+    bit-identically to an explicit single-device engine in the same
+    process."""
+    code = (
+        "import dataclasses, json\n"
+        "import jax\n"
+        "assert len(jax.devices()) == 2, jax.devices()\n"
+        "from kubeadmiral_tpu.scheduler.engine import SchedulerEngine\n"
+        "from __graft_entry__ import _example_units_clusters\n"
+        "units, clusters = _example_units_clusters(64, 16)\n"
+        "auto = SchedulerEngine(min_bucket=32)\n"
+        "assert auto.mesh is not None and auto.mesh.devices.shape == (2, 1)\n"
+        "assert auto.pipeline_depth == auto.pipeline_depth_per_device * 2\n"
+        "single = SchedulerEngine(mesh=None, min_bucket=32)\n"
+        "assert auto.schedule(units, clusters) == "
+        "single.schedule(units, clusters)\n"
+        "drifted = list(clusters)\n"
+        "drifted[0] = dataclasses.replace(drifted[0], available={k: max(0, "
+        "v // 2) for k, v in drifted[0].available.items()})\n"
+        "assert auto.schedule(units, drifted) == "
+        "single.schedule(units, drifted)\n"
+        "print(json.dumps({'ok': True, 'aot': dict(auto._aot.stats)}))\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import json
+
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["ok"] and doc["aot"]["loaded"] == 0

@@ -41,6 +41,18 @@ REPO = Path(__file__).resolve().parent.parent
 _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 
 
+def _platform_key(detail: dict) -> str:
+    """The baseline key a round gates within: platform + device count
+    (ISSUE 12).  A 4-device round must never gate against single-device
+    baselines (its throughput story is different physics) nor seed
+    them; artifacts predating the device_count field are single-device
+    by construction (bench never forced host devices before ISSUE 12).
+    The first round at a new (platform, device_count) triggers the
+    PR-8 "NOTHING GATED" loud warning, exactly like a platform move."""
+    platform = detail.get("platform") or "unknown"
+    return f"{platform}/d{detail.get('device_count') or 1}"
+
+
 def load_rounds(root: Path) -> list[dict]:
     """[{round, path, metric, platform, value, tick_ms}], skipping
     failed/unparseable rounds (with a note)."""
@@ -65,7 +77,7 @@ def load_rounds(root: Path) -> list[dict]:
                 "round": int(m.group(1)),
                 "path": path.name,
                 "metric": parsed.get("metric", ""),
-                "platform": detail.get("platform") or "unknown",
+                "platform": _platform_key(detail),
                 "value": float(value),
                 "tick_ms": detail.get("tick_ms"),
                 # Informational fields carried through (never gated, and
@@ -296,7 +308,7 @@ def gate_churn(root: Path, tolerance: float) -> int:
                 "round": int(m.group(1)),
                 "path": path.name,
                 "metric": parsed.get("metric", ""),
-                "platform": detail.get("platform") or "unknown",
+                "platform": _platform_key(detail),
                 "value": float(parsed["value"]),
                 "p99": detail.get("latency_ms_p99"),
                 "featurize": detail.get("featurize_per_flush_ms"),
@@ -451,7 +463,9 @@ def gate_restart(root: Path, tolerance: float) -> int:
                 "round": int(m.group(1)),
                 "path": path.name,
                 "metric": parsed.get("metric", ""),
-                "platform": detail.get("platform") or "unknown",
+                "platform": _platform_key(detail),
+                "device_count": detail.get("device_count") or 1,
+                "multidevice": detail.get("multidevice"),
                 "value": float(parsed["value"]),
                 "cold_boot_ms": detail.get("cold_boot_ms"),
                 "ratio": detail.get("warm_vs_cold_pct"),
@@ -489,7 +503,17 @@ def gate_restart(root: Path, tolerance: float) -> int:
         print("bench-gate: RESTART PARITY FAILURE", file=sys.stderr)
         ok = False
     aot = latest.get("aot") or {}
-    if aot.get("loaded", 0) == 0 or aot.get("traced", 0) > 0:
+    if latest.get("device_count", 1) > 1:
+        # Multi-device topology: AOT is live-trace-only BY DESIGN
+        # (exports pin topology — scheduler/aot.py), so traced>0 /
+        # loaded=0 is the honest expected shape, not a regression.
+        print(
+            f"bench-gate: restart at device_count="
+            f"{latest['device_count']}: AOT live-trace-only by design "
+            f"(traced={aot.get('traced')}, loaded={aot.get('loaded')}) "
+            f"— preload check not applicable"
+        )
+    elif aot.get("loaded", 0) == 0 or aot.get("traced", 0) > 0:
         print(
             f"bench-gate: RESTART AOT REGRESSION: warm boot traced "
             f"{aot.get('traced')} program(s), loaded {aot.get('loaded')} — "
@@ -497,6 +521,23 @@ def gate_restart(root: Path, tolerance: float) -> int:
             file=sys.stderr,
         )
         ok = False
+    if latest.get("multidevice"):
+        md = latest["multidevice"]
+        if md.get("error"):
+            print(
+                f"bench-gate: restart multidevice probe errored: "
+                f"{md['error']} — informational",
+            )
+        else:
+            print(
+                f"bench-gate: restart multidevice probe: "
+                f"N={md.get('device_count')} "
+                f"warm_boot_ms={md.get('warm_boot_ms')} "
+                f"(prewarm {md.get('prewarm_s')}s, first tick "
+                f"{md.get('first_tick_ms')}ms, aot={md.get('aot')}) — "
+                f"the live-trace ladder cost a multi-device failover "
+                f"pays; informational"
+            )
     priors = [
         r for r in rounds[:-1]
         if r["metric"] == latest["metric"] and r["platform"] == latest["platform"]
@@ -517,6 +558,69 @@ def gate_restart(root: Path, tolerance: float) -> int:
         print(
             f"bench-gate: RESTART LATENCY REGRESSION: "
             f"{latest['value']:.1f}ms > {ceil:.1f}ms",
+            file=sys.stderr,
+        )
+        ok = False
+    return 0 if ok else 1
+
+
+_CENSUS_RE = re.compile(r"^BENCH_CENSUS_r(\d+)\.json$")
+
+
+def gate_census(root: Path) -> int:
+    """Gate the c6 memory-census artifacts (BENCH_CENSUS_r*.json,
+    written by ``bench.py --scenario census``): the RESOLVED
+    configuration (compression and/or sharding engaged) must be under
+    the HBM budget, and the model must validate against the live
+    engine — either failing fails the round.  The raw verdict /
+    per-device numbers are surfaced every round."""
+    latest = None
+    for path in sorted(root.glob("BENCH_CENSUS_r*.json")):
+        if not _CENSUS_RE.match(path.name):
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench-gate: {path.name}: unreadable ({e})", file=sys.stderr)
+            return 2
+        parsed = doc.get("parsed") or {}
+        if doc.get("rc", 0) != 0 or parsed.get("value") is None:
+            continue
+        latest = (path.name, parsed)
+    if latest is None:
+        return 0
+    name, parsed = latest
+    detail = parsed.get("detail") or {}
+    decision = detail.get("decision") or {}
+    validation = detail.get("validation") or {}
+    gib = 1 << 30
+    print(
+        f"bench-gate: census {name} shape={detail.get('census_shape')} "
+        f"verdict={decision.get('verdict')} "
+        f"resolved per_device={parsed['value'] / gib:.2f}GiB @"
+        f"{decision.get('min_devices')}dev "
+        f"(budget {detail.get('budget_gb')}GiB, requested "
+        f"{detail.get('requested_devices')}dev: "
+        f"i32 {(decision.get('per_device_i32') or 0) / gib:.2f} / "
+        f"f16 {(decision.get('per_device_f16') or 0) / gib:.2f}GiB; "
+        f"model err {validation.get('prev_planes_err_pct')}%)"
+    )
+    ok = True
+    if detail.get("over_budget"):
+        print(
+            f"bench-gate: CENSUS OVER BUDGET: the resolved configuration "
+            f"({parsed['value'] / gib:.2f}GiB/device) exceeds "
+            f"{detail.get('budget_gb')}GiB — no compress-or-shard "
+            f"configuration fits; raise KT_HBM_BUDGET_GB only for real "
+            f"hardware",
+            file=sys.stderr,
+        )
+        ok = False
+    if validation.get("ok") is False:
+        print(
+            f"bench-gate: CENSUS MODEL INVALID: live-vs-model prev-plane "
+            f"error {validation.get('prev_planes_err_pct')}% exceeds "
+            f"tolerance — the projection cannot be trusted",
             file=sys.stderr,
         )
         ok = False
@@ -570,8 +674,9 @@ def main() -> int:
     rc = gate(load_rounds(args.root), args.tolerance)
     churn_rc = gate_churn(args.root, args.tolerance)
     restart_rc = gate_restart(args.root, args.tolerance)
+    census_rc = gate_census(args.root)
     report_e2e_chaos(args.root)
-    return rc or churn_rc or restart_rc
+    return rc or churn_rc or restart_rc or census_rc
 
 
 if __name__ == "__main__":
