@@ -43,7 +43,7 @@ from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation import retain
-from kubeadmiral_tpu.runtime import trace
+from kubeadmiral_tpu.runtime import slo, trace
 from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
 from kubeadmiral_tpu.federation.rollout import (
     LAST_RS_NAME,
@@ -235,17 +235,25 @@ def run_batch_with_retries(
             time.sleep(delay)
         pending = retryable
         attempt += 1
+    elapsed = time.monotonic() - started
+    final_transport = transport_failed or any(
+        (r or {}).get("code") == 500
+        and ((r or {}).get("status") or {}).get("reason") == "Transport"
+        for r in results
+    )
     if breaker is not None:
-        elapsed = time.monotonic() - started
-        final_transport = transport_failed or any(
-            (r or {}).get("code") == 500
-            and ((r or {}).get("status") or {}).get("reason") == "Transport"
-            for r in results
-        )
         if final_transport:
             breaker.record_failure(latency_s=elapsed)
         else:
             breaker.note_ok(elapsed)
+    # Per-member write attribution (retries included): the histogram a
+    # slow member shows up in when the engine is innocent
+    # (member_write_seconds{cluster}), joined with breaker state at
+    # GET /debug/members via the registry's latency reservoir.
+    if cluster and not final_transport:
+        slo.member_write(cluster, elapsed)
+        if breakers is not None:
+            breakers.note_write(cluster, elapsed, ops=n)
     return [r if r is not None else {"code": 500, "status": {
         "reason": "Transport", "message": "batch never ran"}} for r in results]
 
@@ -315,10 +323,11 @@ class ImmediateSink:
                             latency_s=time.monotonic() - start
                         )
                 else:
+                    elapsed = time.monotonic() - start
                     if self.breakers is not None:
-                        self.breakers.for_member(cluster).note_ok(
-                            time.monotonic() - start
-                        )
+                        self.breakers.for_member(cluster).note_ok(elapsed)
+                        self.breakers.note_write(cluster, elapsed, ops=1)
+                    slo.member_write(cluster, elapsed)
                 continuation(result)
 
         if self._inline:

@@ -16,7 +16,7 @@ from typing import Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
-from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime import pending, slo
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
 from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_key
@@ -307,6 +307,11 @@ class FederateController:
         self.worker = Worker(
             f"federate-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
         )
+        # The source resource is the pipeline's ingress: its watch
+        # events mint the SLO provenance tokens the whole
+        # event→placement-written decomposition hangs off
+        # (runtime/slo.py).
+        slo.track(host, self._source_resource)
         host.watch(self._source_resource, self._on_event, replay=True)
         host.watch(self._fed_resource, self._on_event, replay=True)
 

@@ -45,6 +45,7 @@ from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.runtime import flightrec as FR
 from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime import slo as SLO
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import Result, Worker
 from kubeadmiral_tpu.testing.fakekube import FakeKube
@@ -208,6 +209,14 @@ class MonitorController:
                 ready += 1
         self.metrics.store("monitor.clusters.total", total_clusters)
         self.metrics.store("monitor.clusters.ready", ready)
+        # End-to-end SLO sampling (runtime/slo.py): publish the
+        # freshness gauge pair and run one burn-rate evaluation pass —
+        # on THIS periodic tick precisely so a silently-wedged dispatch
+        # path stays visible when no new events flow to trigger anything
+        # else.
+        rec = SLO.get_default()
+        if rec.enabled:
+            rec.evaluate(extra=self.metrics)
         # Member circuit-breaker health (transport/breaker.py): how many
         # members the fleet's shared registry currently short-circuits.
         registry = getattr(self.fleet, "_member_breakers", None)

@@ -29,7 +29,7 @@ from kubeadmiral_tpu.models import profile as PR
 from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.models.types import parse_resources
-from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime import pending, slo
 from kubeadmiral_tpu.runtime.eventsink import DefederatingRecorderMux
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.hostbatch import HostBatch
@@ -435,6 +435,10 @@ class SchedulerController:
 
     def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
         results: dict[str, Result] = {}
+        # SLO provenance: the batch pickup closes the ingress→scheduler
+        # "queued" stage for every due key carrying a token
+        # (runtime/slo.py; non-pending keys are one dict probe each).
+        slo.mark_many(keys, "queued")
         clusters = self._clusters()
         clusters_hash = self._clusters_hash(clusters)
         # One profile lookup per distinct name per batch, not per object.
@@ -504,6 +508,11 @@ class SchedulerController:
 
         if not to_schedule:
             return results
+        # Unit assembly done: the "slab" stage (trigger hashing +
+        # featurization prep) closes; "engine" closes when the solve
+        # returns, "fetch" when the placements are persisted below.
+        slo_keys = [k for k, _, _, _ in to_schedule] if slo.active() else ()
+        slo.mark_many(slo_keys, "slab")
         with trace.span(
             "scheduler.engine_tick", ftc=self.ftc.name, units=len(units)
         ) as tick_span, self.metrics.timer(
@@ -525,6 +534,7 @@ class SchedulerController:
                 units, clusters, outcomes, plugins, webhook_eval
             )
             tick_span.set(tick=getattr(self.engine, "last_tick_id", 0))
+        slo.mark_many(slo_keys, "engine")
         self.metrics.counter(f"scheduler-{self.ftc.name}.scheduled", len(units))
         self.metrics.counter(
             "scheduler_scheduled_total", len(units), ftc=self.ftc.name
@@ -557,6 +567,7 @@ class SchedulerController:
             finally:
                 # ONE bulk host round trip persists every placement.
                 hb.flush()
+        slo.mark_many(slo_keys, "fetch")
         return results
 
     # -- webhook (out-of-process) plugins --------------------------------

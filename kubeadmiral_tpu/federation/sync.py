@@ -41,7 +41,7 @@ from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
 from kubeadmiral_tpu.federation.version import VersionManager
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.runtime.eventsink import DefederatingRecorderMux
-from kubeadmiral_tpu.runtime import pending
+from kubeadmiral_tpu.runtime import pending, slo
 from kubeadmiral_tpu.runtime.hostbatch import HostBatch
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
@@ -609,6 +609,10 @@ class SyncController:
             )
             with self._index_lock:
                 self._member_index.setdefault(fed_key, set()).add(cluster)
+            # SLO provenance: a member apiserver acked this placement —
+            # the token closes (and the e2e latency histogram samples)
+            # once every expected placement has acked.
+            slo.written(fed_key, cluster)
 
         dispatcher = D.ManagedDispatcher(
             self._member_client,
@@ -742,6 +746,14 @@ class SyncController:
                     continue
                 dispatcher.update(cname, cluster_obj, version)
 
+        # SLO provenance: member writes are staged — the "dispatch"
+        # stage closes here, and the declared placements become the
+        # token's ack set (the freshness gauges count what has not
+        # landed: a breaker-open or hard-down member keeps its
+        # placements pending, which is exactly the staleness signal).
+        slo.expect(fed_key, selected)
+        slo.mark(fed_key, "dispatch")
+
         def finish(hb: HostBatch, results: dict, key: str) -> Result:
             """Runs after the tick's sink flushes: status/version
             bookkeeping over the completed dispatch round.  Host writes
@@ -779,6 +791,10 @@ class SyncController:
             )
             if not ok:
                 return Result.retry()
+            # Fully-OK round: any still-pending token is a no-op
+            # (version-skips) or partially-acked event — settle it so
+            # the freshness gauges only count genuinely unwritten work.
+            slo.settle(key)
             if D.WAITING_FOR_REMOVAL in status_map.values():
                 # A member object is finalizer-gated mid-removal; no host
                 # event will fire when it finishes, so revisit on a timer
@@ -1000,6 +1016,9 @@ class SyncController:
 
     # -- deletion (controller.go:723-819) --------------------------------
     def _ensure_deletion(self, fed: FederatedResource) -> Result:
+        # An object heading for deletion will never be written: its
+        # provenance token (if any) must not wedge the freshness gauges.
+        slo.forget(fed.key)
         self.versions.delete(fed.namespace, fed.name)
         fins = fed.obj["metadata"].get("finalizers", [])
         if C.SYNC_FINALIZER not in fins:

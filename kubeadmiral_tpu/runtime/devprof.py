@@ -418,6 +418,91 @@ class DispatchLedger:
                 return e
         return None
 
+    def chrome_events(
+        self,
+        epoch: float,
+        max_ticks: int = 8,
+        max_records: int = 2048,
+        timeout: float = 2.0,
+    ) -> list[dict]:
+        """The ledger's recent dispatch records as Chrome trace events on
+        per-device lanes, timestamped against the span tracer's epoch
+        (trace.epoch()) so GET /debug/trace shows host spans and device
+        timelines on ONE timeline, correlated by tick id in args.
+
+        Each record renders as a device-occupancy slice (name = program
+        kind) on a synthetic ``device <lane>`` thread, preceded by a
+        ``queue:<kind>`` slice when the program waited behind earlier
+        device work — the same chain-model split the waterfall reports.
+        """
+        if not self.enabled:
+            return []
+        self.drain(timeout)
+        with self._cv:
+            records = [
+                r
+                for e in list(self._ticks)[-max_ticks:]
+                for r in e.records
+            ]
+        records.sort(key=lambda r: r.seq)
+        if len(records) > max_records:
+            records = records[-max_records:]
+        pid = os.getpid()
+        # Stable synthetic tids per device lane, far above real thread
+        # ids' typical range so tools sort them into their own block.
+        lanes: dict[str, int] = {}
+        events: list[dict] = []
+        for r in records:
+            lane = getattr(r, "device", "?")
+            if lane not in lanes:
+                lanes[lane] = 0x64657600 + len(lanes)
+            tid = lanes[lane]
+            t_ready = r.t_ready if r.t_ready is not None else r.t_dispatch
+            start = t_ready - r.device_s
+            args = {
+                "tick": r.tick,
+                "seq": r.seq,
+                "shape": r.shape,
+                "queue_ms": round(r.queue_s * 1e3, 3),
+                "device_ms": round(r.device_s * 1e3, 3),
+            }
+            if r.note != "ok":
+                args["note"] = r.note
+            if r.queue_s > 0:
+                events.append(
+                    {
+                        "name": f"queue:{r.kind}",
+                        "ph": "X",
+                        "ts": round((r.t_dispatch - epoch) * 1e6, 3),
+                        "dur": round(r.queue_s * 1e6, 3),
+                        "pid": pid,
+                        "tid": tid,
+                        "args": args,
+                    }
+                )
+            events.append(
+                {
+                    "name": r.kind,
+                    "ph": "X",
+                    "ts": round((start - epoch) * 1e6, 3),
+                    "dur": round(r.device_s * 1e6, 3),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": args,
+                }
+            )
+        for lane, tid in lanes.items():
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": f"device {lane} (dispatch ledger)"},
+                }
+            )
+        return events
+
     def waterfall(
         self,
         tick: Optional[int] = None,

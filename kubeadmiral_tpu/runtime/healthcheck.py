@@ -65,8 +65,9 @@ class HealthServer:
     text format) and ``/debug/trace`` (Chrome trace JSON) alongside the
     pprof-analogue ``/debug/*`` routes, the decision-audit routes
     ``/debug/decisions`` / ``/debug/explain`` / ``/debug/drift``
-    (runtime/flightrec.py) and the member-health route
-    ``/debug/members`` (transport/breaker.py) — one port for the whole
+    (runtime/flightrec.py), the member-health route
+    ``/debug/members`` (transport/breaker.py) and the end-to-end SLO
+    route ``/debug/slo`` (runtime/slo.py) — one port for the whole
     operability surface."""
 
     def __init__(
@@ -79,6 +80,7 @@ class HealthServer:
         flightrec=None,
         drift=None,
         members=None,
+        slo=None,
     ):
         self.registry = registry
         self.metrics = metrics
@@ -86,6 +88,7 @@ class HealthServer:
         self.flightrec = flightrec
         self.drift = drift
         self.members = members
+        self.slo = slo
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
@@ -112,7 +115,7 @@ class HealthServer:
                         self, path, raw_query,
                         metrics=outer.metrics, tracer=outer.tracer,
                         flightrec=outer.flightrec, drift=outer.drift,
-                        members=outer.members,
+                        members=outer.members, slo=outer.slo,
                     ):
                         self.send_error(404)
                     return

@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable, Optional
 
-from kubeadmiral_tpu.runtime import trace
+from kubeadmiral_tpu.runtime import slo, trace
 from kubeadmiral_tpu.testing.fakekube import ADDED, DELETED, FakeKube, obj_key
 
 Handler = Callable[[str, dict], None]
@@ -47,6 +47,12 @@ class Informer:
             else:
                 self._cache[key] = obj
             handlers = list(self._handlers)
+        # SLO provenance fallback ingress: stores whose own watch
+        # fan-out already mints tokens (FakeKube, HttpKube) mark
+        # themselves _slo_ingress; anything else gets its birth
+        # timestamp here, once per event, before handler fan-out.
+        if not getattr(self.kube, "_slo_ingress", False):
+            slo.ingest(self.kube, self.resource, event, obj)
         # The root span of the reconcile path: handler work (enqueues,
         # trigger checks) nests under the event that caused it.
         with trace.span(

@@ -110,6 +110,13 @@ class ControllerManager:
         # manager's metrics registry so one /metrics scrape covers
         # controllers and the device hot path alike.
         self.engine = engine or SchedulerEngine(metrics=self.metrics)
+        # The end-to-end SLO recorder (runtime/slo.py) reports into the
+        # same registry, so slo_* families and member_write_seconds ride
+        # the one /metrics scrape (last manager wins for the process
+        # default, like the dispatch ledger's attach).
+        from kubeadmiral_tpu.runtime import slo as SLO
+
+        SLO.get_default().attach(self.metrics)
         # Durable engine snapshots (runtime/snapshot.py): opt-in via
         # KT_SNAPSHOT_DIR.  The manager owns the glue — the engine hook
         # that persists after converged ticks, the per-kind

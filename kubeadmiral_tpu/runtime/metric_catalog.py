@@ -177,7 +177,9 @@ CATALOG: dict[str, MetricSpec] = {
         "enqueue -> its slab's flush start, per event), apply (event "
         "application + world snapshot, per flush), engine (the flush's "
         "engine tick, per flush).  queued+apply+engine bounds the "
-        "event->placement-visible latency histogram."),
+        "event->placement-visible latency histogram.  Extended bucket "
+        "ladder (to 120s): the queued stage legitimately reaches "
+        "seconds under slab-age coalescing and must not saturate +Inf."),
     "engine_stream_events_total": MetricSpec(
         "counter", "events", ("kind",),
         "Streaming-scheduler events flushed, by kind: upsert (object "
@@ -309,6 +311,85 @@ CATALOG: dict[str, MetricSpec] = {
         "Member /healthz heartbeat probe latency (the cluster "
         "controller's reachability probe, which doubles as the "
         "breaker's half-open probe)."),
+    # -- end-to-end SLO layer (runtime/slo.py) ----------------------------
+    "slo_event_to_written_seconds": MetricSpec(
+        "histogram", "seconds", ("stage",),
+        "Event→placement-written latency decomposed by pipeline stage "
+        "(the provenance-token decomposition; stages in SLO_STAGES "
+        "order plus 'total').  Consecutive intervals of one clock — the "
+        "stage sum equals the measured end-to-end latency by "
+        "construction.  Extended buckets (to 300s) so outage-scale "
+        "latencies land in finite buckets."),
+    "slo_oldest_pending_event_seconds": MetricSpec(
+        "gauge", "seconds", (),
+        "Age of the oldest watch event whose expected member writes "
+        "have not all acked — how stale the written world is versus the "
+        "observed world.  Rises monotonically while a dispatch path is "
+        "wedged, even when no new events flow; sampled by the monitor "
+        "tick."),
+    "slo_unwritten_placements": MetricSpec(
+        "gauge", "placements", (),
+        "Expected (object, member) placement writes not yet acked "
+        "across all pending provenance tokens — the freshness gauge's "
+        "volume companion."),
+    "slo_burn_rate": MetricSpec(
+        "gauge", "ratio", ("objective", "window"),
+        "Error-budget burn rate per declared SLO objective "
+        "(SLO_OBJECTIVES) and window: 1.0 = spending budget exactly as "
+        "fast as allowed; an objective is RED when every window burns "
+        "≥ 1.  Served with red/green detail at GET /debug/slo."),
+    "slo_events_total": MetricSpec(
+        "counter", "events", ("result",),
+        "Provenance-token lifecycle outcomes: minted (new token), "
+        "superseded (newer event replaced an in-flight token), echo "
+        "(MODIFIED without a generation bump — our own write echo, no "
+        "token), dropped (pending cap hit), written (finalized on full "
+        "ack), settled (no-op sync round, dropped without a sample), "
+        "forgotten (object deleted mid-flight), expired "
+        "(KT_SLO_MAX_AGE_S aged out)."),
+    "member_write_seconds": MetricSpec(
+        "histogram", "seconds", ("cluster",),
+        "Per-member write-batch round-trip latency (retries included) "
+        "as dispatch observed it — joined with breaker state and "
+        "shed/retry tallies in GET /debug/members, so a slow member is "
+        "distinguishable from a slow engine."),
+}
+
+# -- end-to-end SLO catalog ------------------------------------------------
+# Provenance stage vocabulary (runtime/slo.py STAGES): metrics-lint fails
+# when the recorder's stages drift from this documented order.
+SLO_STAGES: tuple[str, ...] = (
+    "queued", "slab", "engine", "fetch", "dispatch", "write",
+)
+
+
+class SLOObjectiveSpec(NamedTuple):
+    kind: str         # "ratio" (latency-threshold) | "gauge" (freshness)
+    target: float     # required good-event fraction (ratio kinds)
+    threshold_s: float  # default threshold; env overrides
+    env: str          # KT_SLO_* env var overriding threshold_s
+    help: str
+
+
+# The declared objectives the in-process evaluator runs (runtime/slo.py
+# SLOEvaluator builds exactly these; metrics-lint cross-checks both
+# directions so the burn-rate label vocabulary never drifts from docs).
+SLO_OBJECTIVES: dict[str, SLOObjectiveSpec] = {
+    "event_to_written_p99": SLOObjectiveSpec(
+        "ratio", 0.99, 5.0, "KT_SLO_E2E_P99_S",
+        "99% of watch events reach an acked member placement write "
+        "within the threshold (the end-to-end latency SLO items 4/5 "
+        "gate on)."),
+    "member_write_p99": SLOObjectiveSpec(
+        "ratio", 0.99, 2.0, "KT_SLO_WRITE_P99_S",
+        "99% of per-member write batches (retries included) complete "
+        "within the threshold — the member-side half of the "
+        "member-vs-engine triage."),
+    "freshness": SLOObjectiveSpec(
+        "gauge", 0.0, 30.0, "KT_SLO_FRESHNESS_S",
+        "The oldest pending event stays younger than the threshold: "
+        "the written world may not silently fall behind the observed "
+        "world."),
 }
 
 # -- decision audit vocabulary -------------------------------------------

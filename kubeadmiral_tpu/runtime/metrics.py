@@ -90,6 +90,27 @@ class Histogram:
         out.append((float("inf"), self.count))
         return out
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Interpolated quantile from the fixed buckets — the same
+        linear-within-bucket estimate Prometheus' histogram_quantile()
+        computes, so in-process percentiles (the SLO evaluator,
+        /debug/slo, bench detail) agree with dashboard math.  Returns
+        None on an empty histogram; a quantile landing in the +Inf
+        bucket clamps to the highest finite bound (the estimate is a
+        floor there, exactly as in PromQL)."""
+        if self.count == 0:
+            return None
+        q = min(1.0, max(0.0, float(q)))
+        target = q * self.count
+        running = 0
+        lower = 0.0
+        for bound, n in zip(self.buckets, self.counts):
+            if n > 0 and running + n >= target:
+                return lower + (bound - lower) * ((target - running) / n)
+            running += n
+            lower = bound
+        return self.buckets[-1] if self.buckets else None
+
 
 class Metrics:
     def __init__(self):
@@ -180,6 +201,24 @@ class Metrics:
 
     def sum_counter(self, name: str) -> float:
         return sum(self.counter_family(name).values())
+
+    def histogram_quantiles(
+        self, name: str, qs: Sequence[float] = (0.5, 0.99), **tags
+    ) -> dict[float, Optional[float]]:
+        """Interpolated quantile snapshot of one histogram series —
+        the shared percentile extraction the SLO evaluator, /debug/slo
+        and bench detail all read instead of re-implementing bucket
+        math.  Missing series yield all-None values."""
+        with self._lock:
+            hist = self.histograms.get(series_key(name, tags))
+        if hist is None:
+            return {q: None for q in qs}
+        return {q: hist.quantile(q) for q in qs}
+
+    def histogram_count(self, name: str, **tags) -> int:
+        with self._lock:
+            hist = self.histograms.get(series_key(name, tags))
+        return 0 if hist is None else hist.count
 
     def snapshot(self) -> dict:
         """JSON-friendly dump sharing the exposition vocabulary — what

@@ -31,7 +31,9 @@ main.go:21).  The Python control plane's equivalent serves:
 * ``GET /metrics`` — the metrics registry in Prometheus text format
   (runtime/metrics.py), the pkg/stats exposition analogue.
 * ``GET /debug/trace`` — completed reconcile-path spans as Chrome
-  trace-event JSON (runtime/trace.py); load in chrome://tracing.
+  trace-event JSON (runtime/trace.py) MERGED with the dispatch ledger's
+  device records on per-device lanes (one timeline, correlated by tick
+  id; ``?device=0`` for host spans only); load in chrome://tracing.
 * ``GET /debug/decisions`` — the scheduling flight recorder's ring
   summary (runtime/flightrec.py): recent ticks, record volumes.
 * ``GET /debug/explain?key=<ns/name>`` — per-cluster verdicts for one
@@ -43,8 +45,13 @@ main.go:21).  The Python control plane's equivalent serves:
   controller's drift detector).
 * ``GET /debug/members`` — per-member circuit-breaker health
   (transport/breaker.py): state, consecutive failures, latency EWMA,
-  shed-write and dispatch-retry tallies — the degraded-member runbook's
-  first stop (docs/operations.md).
+  shed-write and dispatch-retry tallies, and the per-member write
+  latency reservoir (p50/p99) the SLO layer joins in — the
+  degraded-member runbook's first stop (docs/operations.md).
+* ``GET /debug/slo`` — the end-to-end SLO surface (runtime/slo.py):
+  per-stage event→placement-written percentiles, the slowest-N
+  exemplars fully decomposed, freshness gauges, and the burn-rate
+  evaluator's red/green objective status.
 
 ``respond_debug`` is the shared route handler: the health server mounts
 it so one port serves livez/readyz/metrics/debug, and
@@ -176,7 +183,7 @@ def _send(http_handler, body: bytes, content_type: str) -> None:
 
 def respond_debug(
     http_handler, path: str, raw_query: str, metrics=None, tracer=None,
-    flightrec=None, drift=None, members=None,
+    flightrec=None, drift=None, members=None, slo=None,
 ) -> bool:
     """Serve a /metrics or /debug/* route on any BaseHTTPRequestHandler;
     returns False when the path isn't one of ours (caller handles it).
@@ -204,9 +211,30 @@ def respond_debug(
         from kubeadmiral_tpu.runtime import trace as trace_mod
 
         active = tracer or trace_mod.get_default()
+        doc = active.chrome_trace()
+        # Merge the dispatch ledger's device records as their own
+        # per-device lanes (timestamps share the span tracer's epoch, so
+        # one trace load shows host + device timelines correlated by
+        # tick id).  ?device=0 yields the host-only trace.
+        query = {k: v[-1] for k, v in parse_qs(raw_query).items()}
+        if query.get("device", "1") not in ("0", "false", "no"):
+            try:
+                from kubeadmiral_tpu.runtime import devprof
+
+                doc["traceEvents"].extend(
+                    devprof.get_default().chrome_events(trace_mod.epoch())
+                )
+            except Exception:
+                pass  # a wedged ledger must not take the trace down
+        _send(http_handler, json.dumps(doc).encode(), "application/json")
+        return True
+    if path == "/debug/slo":
+        from kubeadmiral_tpu.runtime import slo as slo_mod
+
+        recorder = slo if slo is not None else slo_mod.get_default()
         _send(
             http_handler,
-            active.chrome_trace_json().encode(),
+            json.dumps(recorder.summary()).encode(),
             "application/json",
         )
         return True
@@ -255,7 +283,7 @@ class ProfilingServer:
 
     def __init__(
         self, host: str = "127.0.0.1", port: int = 0, metrics=None,
-        tracer=None, flightrec=None, drift=None, members=None,
+        tracer=None, flightrec=None, drift=None, members=None, slo=None,
     ):
         self._host = host
         self._port = port
@@ -264,6 +292,7 @@ class ProfilingServer:
         self.flightrec = flightrec
         self.drift = drift
         self.members = members
+        self.slo = slo
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -282,7 +311,7 @@ class ProfilingServer:
                     self, split.path, split.query,
                     metrics=outer.metrics, tracer=outer.tracer,
                     flightrec=outer.flightrec, drift=outer.drift,
-                    members=outer.members,
+                    members=outer.members, slo=outer.slo,
                 ):
                     self.send_error(404)
 

@@ -35,6 +35,14 @@ DEFAULT_RING = 16384
 _EPOCH = time.perf_counter()
 
 
+def epoch() -> float:
+    """The process trace epoch (a perf_counter reading): other
+    timestamp sources merging into the Chrome trace — the dispatch
+    ledger's device lanes (runtime/devprof.py) — subtract this so one
+    trace load shows host spans and device records on one timeline."""
+    return _EPOCH
+
+
 class Span:
     __slots__ = (
         "name", "span_id", "parent_id", "start", "end", "args", "tid",

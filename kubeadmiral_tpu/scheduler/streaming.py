@@ -46,10 +46,23 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.runtime import slo as SLO
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
 
 log = logging.getLogger("kubeadmiral.streaming")
+
+# Stream stage/latency buckets (ISSUE 13 satellite): the engine/apply
+# stages are ms-scale, but the `queued` stage legitimately reaches
+# SECONDS under slab-age coalescing and backpressure — the default
+# ladder's 10s top bucket would saturate to +Inf on a backed-up stream
+# and percentile interpolation would lose the tail.  One extended
+# ladder for the whole family keeps the series comparable while giving
+# the queued stage finite buckets out to 120s.
+STREAM_STAGE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
 
 # A gvk no member cluster serves: the row fails the APIResources filter
 # everywhere, selects nothing, and carries no policy structure — the
@@ -313,6 +326,15 @@ class StreamingScheduler:
                 # world length is new to the engine too.
                 if len(self._units) > world0:
                     dirty.update(range(world0, len(self._units)))
+            # SLO provenance through the slab: upsert events carrying a
+            # token close their coalesce ("slab") stage at flush start
+            # and their "engine" stage when the solve returns.
+            upsert_keys = (
+                [ev.payload.key for ev in drained if ev.kind == "upsert"]
+                if SLO.active()
+                else ()
+            )
+            SLO.mark_many(upsert_keys, "slab", t_flush)
             t_engine = self.clock()
             # The event log knows EXACTLY which rows moved — hand the
             # engine that set so its featurize identity walk is
@@ -331,6 +353,7 @@ class StreamingScheduler:
             )
             self._last_engine_tick = self.engine.tick_seq
             now = self.clock()
+            SLO.mark_many(upsert_keys, "engine", now)
             tick_id = getattr(self.engine, "last_tick_id", 0)
             # Correlate the flush with the engine tick it produced: the
             # engine.schedule span nests under this one on the thread,
@@ -357,20 +380,26 @@ class StreamingScheduler:
                 m.histogram(
                     "engine_stream_stage_seconds",
                     max(0.0, t_engine - t_flush),
+                    buckets=STREAM_STAGE_BUCKETS,
                     stage="apply",
                 )
                 m.histogram(
                     "engine_stream_stage_seconds",
                     max(0.0, now - t_engine),
+                    buckets=STREAM_STAGE_BUCKETS,
                     stage="engine",
                 )
                 for ev in drained:
                     m.counter("engine_stream_events_total", kind=ev.kind)
                     lat = now - ev.t
-                    m.histogram("engine_stream_latency_seconds", lat)
+                    m.histogram(
+                        "engine_stream_latency_seconds", lat,
+                        buckets=STREAM_STAGE_BUCKETS,
+                    )
                     m.histogram(
                         "engine_stream_stage_seconds",
                         max(0.0, t_flush - ev.t),
+                        buckets=STREAM_STAGE_BUCKETS,
                         stage="queued",
                     )
                     self.latencies.append(lat)

@@ -19,6 +19,7 @@ import functools
 import threading
 from typing import Callable, Iterable, Optional
 
+from kubeadmiral_tpu.runtime import slo as _slo
 from kubeadmiral_tpu.utils.unstructured import copy_json
 
 ADDED = "ADDED"
@@ -69,6 +70,10 @@ class FakeKube:
     # Tests flip this to simulate a failing /healthz probe.
     healthy: bool = True
 
+    # This store's watch fan-out mints SLO provenance tokens itself
+    # (runtime/slo.py): informers layered on top must not double-mint.
+    _slo_ingress = True
+
     def __init__(self, name: str = "host"):
         self.name = name
         self._lock = threading.RLock()
@@ -95,6 +100,11 @@ class FakeKube:
         # watching, per-handler deep copies dominate the control plane's
         # host time at scale.  Handlers must not mutate delivered objects.
         snapshot = copy_json(obj)
+        # SLO provenance: this is the single per-event point where a
+        # watch event enters the in-process control plane — the birth
+        # timestamp of the event→placement-written clock (runtime/slo.py;
+        # untracked stores/resources early-out on one dict probe).
+        _slo.ingest(self, resource, event, snapshot)
         for handler in handlers:
             handler(event, snapshot)
         for observer in self._all_watchers:

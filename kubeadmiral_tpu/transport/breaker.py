@@ -35,6 +35,7 @@ import os
 import threading
 import time
 import weakref
+from collections import deque
 from typing import Callable, Optional
 
 CLOSED = "closed"
@@ -339,6 +340,13 @@ class BreakerRegistry:
         self._callbacks: list[TransitionCallback] = []
         self._shed: dict[str, int] = {}
         self._retries: dict[str, int] = {}
+        # Per-member write-latency reservoir (dispatch feeds it via
+        # note_write): bounded recent samples + cumulative totals, so
+        # /debug/members joins write p50/p99 with breaker state and a
+        # slow member is triaged without leaving the endpoint.
+        self._write_lat: dict[str, "deque[float]"] = {}
+        self._write_ops: dict[str, int] = {}
+        self._write_flushes: dict[str, int] = {}
         _REGISTRIES.add(self)
 
     def for_member(self, name: str) -> MemberBreaker:
@@ -393,6 +401,17 @@ class BreakerRegistry:
                 "member_dispatch_retries_total", n, cluster=name
             )
 
+    def note_write(self, name: str, seconds: float, ops: int = 1) -> None:
+        """One completed write batch against this member (dispatch's
+        per-member attribution feed; retries included in ``seconds``)."""
+        with self._lock:
+            reservoir = self._write_lat.get(name)
+            if reservoir is None:
+                reservoir = self._write_lat[name] = deque(maxlen=256)
+            reservoir.append(float(seconds))
+            self._write_ops[name] = self._write_ops.get(name, 0) + int(ops)
+            self._write_flushes[name] = self._write_flushes.get(name, 0) + 1
+
     def shed_total(self) -> int:
         with self._lock:
             return sum(self._shed.values())
@@ -428,11 +447,26 @@ class BreakerRegistry:
             breakers = dict(self._breakers)
             shed = dict(self._shed)
             retries = dict(self._retries)
+            write_lat = {n: sorted(d) for n, d in self._write_lat.items()}
+            write_ops = dict(self._write_ops)
+            write_flushes = dict(self._write_flushes)
         out = {}
         for name, breaker in sorted(breakers.items()):
             entry = breaker.snapshot()
             entry["shed_writes"] = shed.get(name, 0)
             entry["dispatch_retries"] = retries.get(name, 0)
+            ranked = write_lat.get(name)
+            if ranked:
+                entry["write_latency"] = {
+                    "flushes": write_flushes.get(name, 0),
+                    "ops": write_ops.get(name, 0),
+                    "p50_ms": round(ranked[len(ranked) // 2] * 1e3, 3),
+                    "p99_ms": round(
+                        ranked[min(len(ranked) - 1, int(len(ranked) * 0.99))]
+                        * 1e3, 3,
+                    ),
+                    "max_ms": round(ranked[-1] * 1e3, 3),
+                }
             out[name] = entry
         return out
 

@@ -31,6 +31,7 @@ from typing import Callable, Optional
 from urllib.parse import urlsplit
 
 from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.runtime import slo as _slo
 from kubeadmiral_tpu.testing.fakekube import (
     ADDED,
     DELETED,
@@ -87,6 +88,10 @@ class _NoDelayConnection(http.client.HTTPConnection):
 
 class HttpKube:
     """One apiserver client; duck-types FakeKube."""
+
+    # Watch streams of this client mint SLO provenance tokens themselves
+    # (_ResourceWatch._dispatch): informers on top must not double-mint.
+    _slo_ingress = True
 
     def __init__(
         self,
@@ -364,6 +369,10 @@ class _ResourceWatch:
                 "name": meta.get("name"),
                 "namespace": meta.get("namespace", ""),
             }
+        # SLO provenance: the HTTP watch stream is where an event enters
+        # this control plane — mint the birth timestamp before handler
+        # fan-out (once per event; untracked resources early-out).
+        _slo.ingest(self.kube, self.resource, event, obj)
         with self._lock:
             handlers = list(self._handlers)
         for handler in handlers:

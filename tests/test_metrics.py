@@ -153,6 +153,68 @@ class TestHistogram:
         assert h.count == 3
 
 
+class TestHistogramQuantiles:
+    """Interpolated percentile extraction (ISSUE 13 satellite): the
+    shared helper the SLO evaluator and /debug/slo read, verified
+    against known distributions."""
+
+    def test_empty_histogram_returns_none(self):
+        assert Histogram().quantile(0.5) is None
+
+    def test_single_bucket_interpolates_linearly(self):
+        # 100 observations all in the (0.025, 0.05] bucket: the pXX
+        # estimate walks linearly across the bucket, exactly as
+        # PromQL's histogram_quantile.
+        h = Histogram()
+        for _ in range(100):
+            h.observe(0.03)
+        assert h.quantile(0.5) == 0.025 + 0.025 * 0.5
+        assert h.quantile(0.99) == 0.025 + 0.025 * 0.99
+
+    def test_uniform_distribution_hits_bucket_edges(self):
+        # One observation per bucket of (1, 2, 3, 4): p50 falls at the
+        # upper edge of the second bucket, p25 at the first.
+        h = Histogram(buckets=(1.0, 2.0, 3.0, 4.0))
+        for v in (0.5, 1.5, 2.5, 3.5):
+            h.observe(v)
+        assert h.quantile(0.5) == 2.0
+        assert h.quantile(0.25) == 1.0
+        assert h.quantile(1.0) == 4.0
+
+    def test_skewed_distribution(self):
+        # 90 fast + 10 slow: p50 interpolates inside the fast bucket,
+        # p99 inside the slow one.
+        h = Histogram(buckets=(0.1, 1.0, 10.0))
+        for _ in range(90):
+            h.observe(0.05)
+        for _ in range(10):
+            h.observe(5.0)
+        assert h.quantile(0.5) == (50 / 90) * 0.1
+        assert h.quantile(0.99) == 1.0 + 9.0 * ((99 - 90) / 10)
+
+    def test_inf_bucket_clamps_to_top_finite_bound(self):
+        h = Histogram(buckets=(1.0, 2.0))
+        for _ in range(10):
+            h.observe(50.0)  # all land in +Inf
+        assert h.quantile(0.99) == 2.0
+
+    def test_registry_snapshot_helper(self):
+        m = Metrics()
+        for _ in range(100):
+            m.histogram("slo_event_to_written_seconds", 0.03, stage="total")
+        qs = m.histogram_quantiles(
+            "slo_event_to_written_seconds", (0.5, 0.99), stage="total"
+        )
+        assert qs[0.5] == 0.025 + 0.025 * 0.5
+        assert m.histogram_count(
+            "slo_event_to_written_seconds", stage="total"
+        ) == 100
+        # Missing series: all-None, zero count.
+        missing = m.histogram_quantiles("nope", (0.5,), stage="x")
+        assert missing[0.5] is None
+        assert m.histogram_count("nope") == 0
+
+
 class TestCatalog:
     def test_new_vocabulary_is_cataloged(self):
         for name in (

@@ -152,7 +152,10 @@ class TestObservabilityEndToEnd:
             status, headers, body = fetch(port, "/debug/trace")
             assert status == 200
             doc = json.loads(body)
-            events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            all_x = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            # Host spans carry span ids; the ledger's device-lane slices
+            # (merged below) are id-less.
+            events = [e for e in all_x if "span_id" in e.get("args", {})]
             by_id = {e["args"]["span_id"]: e for e in events}
             names = {e["name"] for e in events}
             # The reconcile path is covered informer -> device -> member
@@ -178,6 +181,42 @@ class TestObservabilityEndToEnd:
             chain = ancestors(dispatch)
             assert "engine.schedule" in chain, chain
             assert "worker.tick" in chain, chain
+
+            # Device lanes merged from the dispatch ledger (ISSUE 13):
+            # the engine tick's program dispatches render on their own
+            # `device <lane>` threads in the SAME trace document, so one
+            # load shows host + device timelines correlated by tick id.
+            lane_meta = [
+                e
+                for e in doc["traceEvents"]
+                if e.get("ph") == "M"
+                and e["name"] == "thread_name"
+                and str(e.get("args", {}).get("name", "")).startswith(
+                    "device "
+                )
+            ]
+            assert lane_meta, "no device lanes merged into /debug/trace"
+            lane_tids = {e["tid"] for e in lane_meta}
+            device_slices = [
+                e
+                for e in doc["traceEvents"]
+                if e.get("ph") == "X" and e.get("tid") in lane_tids
+            ]
+            assert device_slices and any(
+                e["args"].get("tick") for e in device_slices
+            )
+
+            # Host-only escape hatch: ?device=0 drops the merged lanes.
+            _, _, body = fetch(port, "/debug/trace?device=0")
+            host_only = json.loads(body)
+            assert not [
+                e
+                for e in host_only["traceEvents"]
+                if e.get("ph") == "M"
+                and str(e.get("args", {}).get("name", "")).startswith(
+                    "device "
+                )
+            ]
         finally:
             server.stop()
 

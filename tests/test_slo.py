@@ -1,0 +1,642 @@
+"""End-to-end SLO layer (runtime/slo.py; ISSUE 13).
+
+Covers the provenance-token lifecycle (mint/mark/expect/written/settle,
+generation-gated echo suppression, exact stage decomposition), the
+freshness gauges, the multi-window burn-rate evaluator, the /debug/slo
+surface, a full membersim round (decomposition sums to the measured
+end-to-end latency per event), and the chaos acceptance: a hard-down
+member under the kwok-lite farm makes the freshness gauge rise and the
+burn rate flip red, with per-member write attribution separating the
+sick member from healthy ones, then recover green after the fault
+clears.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from test_e2e_slice import make_deployment, make_node
+
+from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.clusterctl import (
+    FEDERATED_CLUSTERS,
+    FederatedClusterController,
+    NODES,
+)
+from kubeadmiral_tpu.federation.federate import FederateController
+from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+from kubeadmiral_tpu.federation.sync import SyncController
+from kubeadmiral_tpu.models.ftc import default_ftcs
+from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+from kubeadmiral_tpu.runtime import slo
+from kubeadmiral_tpu.runtime.healthcheck import HealthCheckRegistry, HealthServer
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet, FakeKube
+from kubeadmiral_tpu.transport import breaker as B
+from kubeadmiral_tpu.transport.faults import FaultPolicy
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def fresh_default():
+    """Install a fresh default recorder for the test, restore after —
+    the process default accumulates tracked stores across the suite."""
+    rec = slo.SLORecorder(enabled=True)
+    prev = slo.set_default(rec)
+    try:
+        yield rec
+    finally:
+        if prev is not None:
+            slo.set_default(prev)
+
+
+def _meta(name, gen=None, ns="default"):
+    meta = {"namespace": ns, "name": name}
+    if gen is not None:
+        meta["generation"] = gen
+    return {"metadata": meta}
+
+
+# -- recorder unit tests ---------------------------------------------------
+class TestProvenanceToken:
+    def setup_method(self):
+        self.clock = FakeClock()
+        self.rec = slo.SLORecorder(
+            enabled=True, clock=self.clock, windows=(1.0, 3.0)
+        )
+        self.store = FakeKube("host")
+        self.rec.track(self.store, "apps/v1/deployments")
+
+    def ingest(self, event, obj):
+        self.rec.ingest(self.store, "apps/v1/deployments", event, obj)
+
+    def test_decomposition_sums_exactly_to_total(self):
+        self.ingest("ADDED", _meta("web", gen=1))
+        bounds = {}
+        for stage in ("queued", "slab", "engine", "fetch", "dispatch"):
+            self.clock.advance(0.1)
+            self.rec.mark("default/web", stage)
+            bounds[stage] = self.clock.t
+        self.rec.expect("default/web", {"c1", "c2"})
+        self.clock.advance(0.2)
+        self.rec.written("default/web", "c1")
+        assert self.rec.pending_count() == 1  # c2 still unacked
+        self.clock.advance(0.3)
+        self.rec.written("default/web", "c2")
+        assert self.rec.pending_count() == 0
+        summary = self.rec.summary()
+        (exemplar,) = summary["slowest"]
+        assert exemplar["key"] == "default/web"
+        assert exemplar["total_s"] == pytest.approx(1.0)
+        assert sum(exemplar["stages_s"].values()) == pytest.approx(
+            exemplar["total_s"]
+        )
+        # Each marked stage closed a 0.1s interval; write closed the
+        # 0.5s ack tail.
+        for stage in ("queued", "slab", "engine", "fetch", "dispatch"):
+            assert exemplar["stages_s"][stage] == pytest.approx(0.1)
+        assert exemplar["stages_s"]["write"] == pytest.approx(0.5)
+        # Histograms observed every stage plus the total.
+        for stage in slo.STAGES + ("total",):
+            assert (
+                self.rec.metrics.histogram_count(
+                    "slo_event_to_written_seconds", stage=stage
+                )
+                == 1
+            )
+
+    def test_generation_gating_suppresses_own_write_echoes(self):
+        self.ingest("ADDED", _meta("web", gen=1))
+        assert self.rec.pending_count() == 1
+        self.rec.written("default/web", "c1")  # no expect: first ack closes
+        assert self.rec.pending_count() == 0
+        # Finalizer/annotation/status echoes keep generation 1: no token.
+        self.ingest("MODIFIED", _meta("web", gen=1))
+        assert self.rec.pending_count() == 0
+        assert (
+            self.rec.metrics.get_counter("slo_events_total", result="echo")
+            == 1
+        )
+        # A real spec change bumps generation: new token.
+        self.ingest("MODIFIED", _meta("web", gen=2))
+        assert self.rec.pending_count() == 1
+
+    def test_delete_forgets_and_rearms_generation(self):
+        self.ingest("ADDED", _meta("web", gen=1))
+        self.ingest("DELETED", _meta("web", gen=1))
+        assert self.rec.pending_count() == 0
+        assert (
+            self.rec.metrics.get_counter(
+                "slo_events_total", result="forgotten"
+            )
+            == 1
+        )
+        # Re-creation at generation 1 mints again (the gen memory reset).
+        self.ingest("ADDED", _meta("web", gen=1))
+        assert self.rec.pending_count() == 1
+
+    def test_settle_emits_partial_acks_and_drops_noops(self):
+        # Partial ack + version-skips: the sample must not be lost.
+        self.ingest("ADDED", _meta("a", gen=1))
+        self.rec.expect("default/a", {"c1", "c2"})
+        self.clock.advance(0.4)
+        self.rec.written("default/a", "c1")
+        self.rec.settle("default/a")
+        assert self.rec.pending_count() == 0
+        assert (
+            self.rec.metrics.histogram_count(
+                "slo_event_to_written_seconds", stage="total"
+            )
+            == 1
+        )
+        # Pure no-op round: dropped without a sample.
+        self.ingest("ADDED", _meta("b", gen=1))
+        self.rec.settle("default/b")
+        assert self.rec.pending_count() == 0
+        assert (
+            self.rec.metrics.histogram_count(
+                "slo_event_to_written_seconds", stage="total"
+            )
+            == 1
+        )
+        assert (
+            self.rec.metrics.get_counter("slo_events_total", result="settled")
+            == 1
+        )
+
+    def test_untracked_stores_and_resources_mint_nothing(self):
+        other = FakeKube("member")
+        self.rec.ingest(other, "apps/v1/deployments", "ADDED", _meta("web"))
+        self.rec.ingest(self.store, "v1/configmaps", "ADDED", _meta("cm"))
+        assert self.rec.pending_count() == 0
+
+    def test_freshness_counts_unacked_placements(self):
+        self.ingest("ADDED", _meta("a", gen=1))
+        self.clock.advance(1.0)
+        self.ingest("ADDED", _meta("b", gen=1))
+        self.rec.expect("default/a", {"c1", "c2", "c3"})
+        self.rec.written("default/a", "c1")
+        assert self.rec.unwritten_placements() == 2 + 1  # a: 2 left, b: 1
+        assert self.rec.oldest_pending_seconds() == pytest.approx(1.0)
+        m = Metrics()
+        self.rec.publish(extra=m)
+        assert m.stores["slo_oldest_pending_event_seconds"] == pytest.approx(
+            1.0
+        )
+        assert m.stores["slo_unwritten_placements"] == 3
+
+    def test_disabled_recorder_is_inert(self):
+        rec = slo.SLORecorder(enabled=False, clock=self.clock)
+        rec.track(self.store, "apps/v1/deployments")
+        rec.ingest(self.store, "apps/v1/deployments", "ADDED", _meta("web"))
+        rec.mark("default/web", "queued")
+        rec.written("default/web", "c1")
+        assert rec.pending_count() == 0
+        assert rec.summary() == {"enabled": False}
+
+    def test_exemplar_ring_keeps_slowest_n(self):
+        rec = slo.SLORecorder(enabled=True, clock=self.clock, exemplars=3)
+        for i, dt in enumerate((0.1, 0.9, 0.3, 0.7, 0.5)):
+            key = f"default/o{i}"
+            rec.mint(key)
+            self.clock.advance(dt)
+            rec.written(key, "c1")
+        slowest = rec.summary()["slowest"]
+        assert [e["total_s"] for e in slowest] == pytest.approx(
+            [0.9, 0.7, 0.5]
+        )
+
+
+class TestBurnRateEvaluator:
+    def test_ratio_objective_red_then_green(self):
+        clock = FakeClock()
+        ev = slo.SLOEvaluator(clock=clock, windows=(1.0, 3.0))
+        threshold = ev.thresholds["event_to_written_p99"]
+        # A burst of threshold breaches: both windows burn hot → red.
+        for _ in range(10):
+            ev.observe("event_to_written_p99", threshold + 1.0)
+            clock.advance(0.1)
+            ev.evaluate()
+        status = ev.status()["event_to_written_p99"]
+        assert status["red"], status
+        assert all(b >= 1.0 for b in status["burn"].values())
+        # Healthy traffic after the burst: the fast window clears first
+        # (multi-window semantics), then the slow one.
+        for _ in range(40):
+            ev.observe("event_to_written_p99", 0.001)
+            clock.advance(0.2)
+            ev.evaluate()
+        status = ev.status()["event_to_written_p99"]
+        assert not status["red"], status
+
+    def test_gauge_objective_tracks_freshness(self):
+        clock = FakeClock()
+        ev = slo.SLOEvaluator(clock=clock, windows=(1.0, 3.0))
+        threshold = ev.thresholds["freshness"]
+        ev.sample_gauge("freshness", threshold * 2)
+        status = ev.evaluate()["freshness"]
+        assert status["red"]
+        assert status["burn"]["1s"] == pytest.approx(2.0)
+        # Recovery: the windowed max holds red until the breach ages out.
+        ev.sample_gauge("freshness", 0.0)
+        clock.advance(0.5)
+        status = ev.evaluate()["freshness"]
+        assert status["red"]  # breach still inside both windows
+        clock.advance(4.0)
+        status = ev.evaluate()["freshness"]
+        assert not status["red"]
+
+    def test_objectives_match_catalog(self):
+        from kubeadmiral_tpu.runtime import metric_catalog as MC
+
+        ev = slo.SLOEvaluator()
+        assert set(ev.objectives) == set(MC.SLO_OBJECTIVES)
+        assert tuple(slo.STAGES) == MC.SLO_STAGES
+
+
+# -- membersim integration -------------------------------------------------
+class TestSLOEndToEnd:
+    """A full reconcile round closes every token, the decomposition sums
+    to the measured end-to-end latency per event (ISSUE 13 acceptance:
+    within 10%; exact by construction), and /debug/slo serves it."""
+
+    def _build(self, fresh_default):
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        self.ftc = dataclasses.replace(
+            ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
+        )
+        self.fleet = ClusterFleet()
+        self.metrics = Metrics()
+        fresh_default.attach(self.metrics)
+        gvk = "apps/v1/Deployment"
+        self.controllers = [
+            FederatedClusterController(
+                self.fleet, api_resource_probe=[gvk], metrics=self.metrics
+            ),
+            FederateController(self.fleet.host, self.ftc, metrics=self.metrics),
+            SchedulerController(self.fleet.host, self.ftc, metrics=self.metrics),
+            SyncController(self.fleet, self.ftc, metrics=self.metrics),
+        ]
+        for name in ("c1", "c2", "c3"):
+            member = self.fleet.add_member(name)
+            member.create(NODES, make_node("n1", "64", "128Gi"))
+            self.fleet.host.create(
+                FEDERATED_CLUSTERS,
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                },
+            )
+        self.fleet.host.create(
+            PROPAGATION_POLICIES,
+            {
+                "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "pp", "namespace": "default"},
+                "spec": {"schedulingMode": "Divide"},
+            },
+        )
+        self._settle()
+
+    def _settle(self, max_rounds=200):
+        for _ in range(max_rounds):
+            if not any(c.worker.step() for c in self.controllers):
+                return
+
+    def test_round_closes_tokens_with_exact_decomposition(
+        self, fresh_default
+    ):
+        self._build(fresh_default)
+        for i in range(5):
+            self.fleet.host.create(
+                self.ftc.source.resource,
+                make_deployment(name=f"app-{i}", replicas=2 + i),
+            )
+        self._settle()
+        rec = fresh_default
+        assert rec.pending_count() == 0, "tokens left pending after a round"
+        assert rec.unwritten_placements() == 0
+        summary = rec.summary()
+        total = summary["stages"]["total"]
+        assert total["count"] == 5
+        assert summary["slowest"], "no exemplars retained"
+        for exemplar in summary["slowest"]:
+            stage_sum = sum(exemplar["stages_s"].values())
+            assert stage_sum == pytest.approx(
+                exemplar["total_s"], rel=0.10, abs=1e-6
+            )
+            # The pipeline stages all closed: the decomposition is real,
+            # not one undifferentiated "write" bucket.
+            for stage in ("queued", "slab", "engine", "fetch", "dispatch"):
+                assert stage in exemplar["stages_s"], exemplar
+            assert exemplar["acked"], exemplar
+        # member_write_seconds carries per-member attribution.
+        assert any(
+            rec.metrics.histogram_count("member_write_seconds", cluster=c)
+            for c in ("c1", "c2", "c3")
+        )
+
+    def test_debug_slo_and_metrics_exposition(self, fresh_default):
+        self._build(fresh_default)
+        self.fleet.host.create(
+            self.ftc.source.resource, make_deployment(name="web", replicas=3)
+        )
+        self._settle()
+        registry = HealthCheckRegistry()
+        server = HealthServer(
+            registry, metrics=self.metrics, slo=fresh_default
+        )
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/slo", timeout=10
+            ) as r:
+                doc = json.loads(r.read())
+            assert doc["enabled"] is True
+            assert doc["stages"]["total"]["count"] >= 1
+            assert set(doc["objectives"]) == {
+                "event_to_written_p99", "member_write_p99", "freshness",
+            }
+            assert doc["red"] == []
+            assert doc["slowest"][0]["stages_s"]
+            # The shared registry exposition carries the SLO families
+            # (recorder attached + monitor-style publish).
+            fresh_default.evaluate(extra=self.metrics)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as r:
+                text = r.read().decode()
+            assert "slo_event_to_written_seconds_bucket" in text
+            assert "slo_oldest_pending_event_seconds" in text
+            assert 'slo_burn_rate{objective="freshness"' in text
+            assert "member_write_seconds_bucket" in text
+        finally:
+            server.stop()
+
+
+# -- chaos: the fault-injection acceptance ---------------------------------
+def _settle(named, deadline_s=20.0, idle_rounds=3):
+    deadline = time.monotonic() + deadline_s
+    idle = 0
+    while time.monotonic() < deadline and idle < idle_rounds:
+        progressed = False
+        for _, ctl in named:
+            while ctl.worker.step():
+                progressed = True
+        if progressed:
+            idle = 0
+        else:
+            idle += 1
+            time.sleep(0.03)
+
+
+class TestSLOUnderChaos:
+    """ISSUE 13 acceptance: during a hard-down member window the
+    freshness gauges rise and the burn rate flips red; shed writes show
+    in the sick member's attribution while healthy members keep serving
+    write latencies; after recovery the gauges drop and the burn flips
+    back green."""
+
+    N_MEMBERS = 4
+    N_OBJECTS = 6
+
+    def test_freshness_and_burn_flip_red_then_green(self, monkeypatch):
+        monkeypatch.setenv("KT_DISPATCH_DEADLINE_S", "1.0")
+        monkeypatch.setenv("KT_BREAKER_OPEN_S", "2.0")
+        monkeypatch.setenv("KT_BREAKER_STALL_S", "0.4")
+        monkeypatch.setenv("KT_BREAKER_FAILURES", "2")
+        monkeypatch.setenv("KT_RETRY_BASE_S", "0.02")
+        monkeypatch.setenv("KT_RETRY_CAP_S", "0.05")
+        monkeypatch.setenv("KT_RETRY_MAX", "1")
+        monkeypatch.setenv("KT_SLO_FRESHNESS_S", "0.5")
+        monkeypatch.setenv("KT_SLO_WINDOWS_S", "1,4")
+
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        rec = slo.SLORecorder(enabled=True)
+        prev = slo.set_default(rec)
+        ftc = dataclasses.replace(
+            next(f for f in default_ftcs() if f.name == "deployments.apps"),
+            controllers=(("kubeadmiral.io/global-scheduler",),),
+        )
+        farm = KwokLiteFarm()
+        farm.fleet.factory.timeout = 0.6
+        fleet = farm.fleet
+        try:
+            for i in range(self.N_MEMBERS):
+                name = f"m{i}"
+                member = farm.add_member(name)
+                member.create(NODES, make_node("n1", "64", "128Gi"))
+                fleet.host.create(
+                    FEDERATED_CLUSTERS,
+                    {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                     "kind": "FederatedCluster",
+                     "metadata": {"name": name},
+                     "spec": farm.cluster_spec(name)},
+                )
+            fleet.host.create(
+                PROPAGATION_POLICIES,
+                {"apiVersion": "core.kubeadmiral.io/v1alpha1",
+                 "kind": "PropagationPolicy",
+                 "metadata": {"name": "pp", "namespace": "default"},
+                 "spec": {"schedulingMode": "Divide"}},
+            )
+            named = [
+                ("cluster", FederatedClusterController(
+                    fleet, api_resource_probe=["apps/v1/Deployment"],
+                    resync_seconds=2.0,
+                )),
+                ("federate", FederateController(fleet.host, ftc)),
+                ("schedule", SchedulerController(fleet.host, ftc)),
+                ("sync", SyncController(fleet, ftc)),
+            ]
+            clusterctl = named[0][1]
+            sync = named[-1][1]
+            _settle(named)
+
+            for i in range(self.N_OBJECTS):
+                fleet.host.create(
+                    ftc.source.resource,
+                    make_deployment(name=f"app-{i}", replicas=3 + i),
+                )
+            _settle(named)
+            # Converged baseline: every token closed, freshness flat.
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline and rec.pending_count():
+                _settle(named, deadline_s=5.0)
+                time.sleep(0.1)
+            assert rec.pending_count() == 0, "baseline never converged"
+            assert not rec.evaluate()["freshness"]["red"]
+
+            placements = {}
+            for key in fleet.host.keys(ftc.federated.resource):
+                fed = fleet.host.get(ftc.federated.resource, key)
+                placements[key] = set(C.get_placement(fed, C.SCHEDULER))
+            down = sorted({c for p in placements.values() for c in p})[0]
+            down_keys = [k for k, p in placements.items() if down in p]
+            assert down_keys
+
+            # -- hard-down window ----------------------------------------
+            farm.set_fault(down, FaultPolicy(partition=True))
+            breaker = B.for_fleet(fleet).for_member(down)
+            registry = B.for_fleet(fleet)
+
+            # Churn the down member's objects: new tokens whose expected
+            # placements include the dead member.
+            for key in down_keys:
+                obj = fleet.host.get(ftc.source.resource, key)
+                obj["spec"]["replicas"] = obj["spec"].get("replicas", 1) + 1
+                fleet.host.update(ftc.source.resource, obj)
+
+            went_red = False
+            peak = 0.0
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                sync.worker.enqueue_all(
+                    fleet.host.keys(ftc.federated.resource)
+                )
+                _settle(named, deadline_s=4.0)
+                status = rec.evaluate()
+                peak = max(peak, rec.oldest_pending_seconds())
+                if status["freshness"]["red"] and peak > 0.5:
+                    went_red = True
+                    break
+                time.sleep(0.2)
+            assert went_red, (
+                f"freshness never flipped red (peak {peak:.2f}s, "
+                f"status {rec.evaluate()['freshness']})"
+            )
+            assert rec.unwritten_placements() > 0
+            assert breaker.state != B.CLOSED
+
+            # Per-member attribution separates sick from healthy: the
+            # down member shed writes; healthy members kept serving
+            # (write-latency reservoirs populated, nothing shed).
+            snapshot = registry.snapshot()
+            assert snapshot[down]["shed_writes"] > 0
+            healthy = [n for n in snapshot if n != down]
+            assert any(
+                snapshot[n].get("write_latency", {}).get("flushes", 0) > 0
+                for n in healthy
+            ), snapshot
+            assert rec.metrics.histogram_count(
+                "member_write_seconds",
+                cluster=[n for n in healthy
+                         if snapshot[n].get("write_latency")][0],
+            ) > 0
+
+            # -- recovery ------------------------------------------------
+            farm.clear_fault(down)
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline and breaker.state != B.CLOSED:
+                clusterctl.worker.enqueue(down)  # heartbeat = probe
+                while clusterctl.worker.step():
+                    pass
+                time.sleep(0.2)
+            assert breaker.state == B.CLOSED
+
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and rec.unwritten_placements():
+                sync.worker.enqueue_all(
+                    fleet.host.keys(ftc.federated.resource)
+                )
+                _settle(named, deadline_s=5.0)
+                time.sleep(0.2)
+            assert rec.unwritten_placements() == 0, (
+                "shed writes never converged after recovery"
+            )
+            assert rec.oldest_pending_seconds() < 0.5
+
+            # The gauge recovered; the windowed burn drains back green.
+            deadline = time.monotonic() + 15.0
+            green = False
+            while time.monotonic() < deadline:
+                if not rec.evaluate()["freshness"]["red"]:
+                    green = True
+                    break
+                time.sleep(0.3)
+            assert green, rec.evaluate()["freshness"]
+        finally:
+            farm.close()
+            slo.set_default(prev or slo.SLORecorder())
+
+
+# -- streaming bucket regression (ISSUE 13 satellite) ----------------------
+class TestStreamStageBuckets:
+    def test_seconds_scale_queued_stage_lands_in_finite_bucket(self):
+        """The queued stage can legitimately reach seconds under
+        slab-age coalescing: a 2s (and even a 30s) observation must land
+        in a finite bucket, not saturate +Inf."""
+        from kubeadmiral_tpu.scheduler.streaming import STREAM_STAGE_BUCKETS
+
+        m = Metrics()
+        for value in (2.0, 30.0):
+            m.histogram(
+                "engine_stream_stage_seconds", value,
+                buckets=STREAM_STAGE_BUCKETS, stage="queued",
+            )
+        hist = m.histograms['engine_stream_stage_seconds{stage=queued}']
+        assert hist.counts[-1] == 0, "observation saturated the +Inf bucket"
+        assert hist.count == 2
+        # And the quantile estimate stays finite/meaningful.
+        assert hist.quantile(0.99) <= STREAM_STAGE_BUCKETS[-1]
+
+    def test_streaming_flush_emits_rebucketed_family(self):
+        """The live streaming path emits its stage family with the
+        extended ladder (a 2s-old event's queued observation is finite)
+        and closes slab/engine marks on pending tokens."""
+        from kubeadmiral_tpu.models import types as T
+        from kubeadmiral_tpu.scheduler.streaming import StreamingScheduler
+
+        class _Engine:
+            metrics = None
+            tick_seq = 0
+            last_tick_id = 0
+
+            def schedule(self, units, clusters, **kw):
+                self.tick_seq += 1
+                return [None] * len(units)
+
+        clock = FakeClock()
+        metrics = Metrics()
+        rec = slo.SLORecorder(enabled=True, clock=clock)
+        prev = slo.set_default(rec)
+        try:
+            s = StreamingScheduler(
+                _Engine(), clusters=[], units=[], slab_rows=64,
+                slab_age_ms=1.0, grow_block=8, metrics=metrics, clock=clock,
+            )
+            unit = T.SchedulingUnit(
+                gvk="apps/v1/Deployment", namespace="default", name="web",
+                scheduling_mode=T.MODE_DUPLICATE,
+            )
+            rec.mint(unit.key)
+            s.offer(unit)
+            clock.advance(2.0)  # the event coalesces for 2s
+            s.flush()
+            hist = metrics.histograms[
+                "engine_stream_stage_seconds{stage=queued}"
+            ]
+            assert hist.count == 1
+            assert hist.counts[-1] == 0, "2s queued saturated +Inf"
+            # The token's slab/engine stages closed in the flush.
+            entry = rec._pending[unit.key]
+            assert {s_ for s_, _ in entry.marks} == {"slab", "engine"}
+        finally:
+            slo.set_default(prev or slo.SLORecorder())

@@ -94,3 +94,48 @@ class TestChromeExport:
         meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
         assert meta and meta[0]["name"] == "thread_name"
         assert doc["displayTimeUnit"] == "ms"
+
+
+class TestDeviceLaneMerge:
+    """The dispatch ledger's records render as their own per-device
+    lanes in the Chrome trace (ISSUE 13 satellite): one trace load shows
+    host spans + device timelines, correlated by tick id, on the shared
+    trace epoch."""
+
+    def test_ledger_records_become_device_lane_events(self):
+        import jax.numpy as jnp
+
+        from kubeadmiral_tpu.runtime import trace as trace_mod
+        from kubeadmiral_tpu.runtime.devprof import DispatchLedger
+
+        ledger = DispatchLedger(enabled=True, ring_ticks=4)
+        tick = ledger.begin_tick(kind="test")
+        out = jnp.arange(8) + 1
+        ledger.observe("tick", out)
+        ledger.end_tick({"device": 0.001})
+        assert ledger.drain(5.0)
+
+        events = ledger.chrome_events(trace_mod.epoch())
+        slices = [e for e in events if e["ph"] == "X"]
+        assert slices, "no device-lane slices exported"
+        device_slice = next(e for e in slices if e["name"] == "tick")
+        assert device_slice["args"]["tick"] == tick
+        assert device_slice["args"]["shape"] == "8"
+        assert device_slice["ts"] >= 0  # on the span tracer's epoch
+        lanes = [
+            e for e in events
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        ]
+        assert lanes and all(
+            e["args"]["name"].startswith("device ") for e in lanes
+        )
+        # The lane tid is synthetic and shared between the slice and its
+        # metadata row.
+        assert device_slice["tid"] in {e["tid"] for e in lanes}
+
+    def test_disabled_ledger_exports_nothing(self):
+        from kubeadmiral_tpu.runtime import trace as trace_mod
+        from kubeadmiral_tpu.runtime.devprof import DispatchLedger
+
+        ledger = DispatchLedger(enabled=False)
+        assert ledger.chrome_events(trace_mod.epoch()) == []

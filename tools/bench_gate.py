@@ -13,7 +13,11 @@ against a cpu-fallback number):
 * device-stage latency (``stage_ms.device`` and
   ``drift_stage_ms.device``) gates the same way — a select/planner
   regression must fail here even when an unchanged tick total hides it
-  behind fetch/decode wins (ISSUE 5).
+  behind fetch/decode wins (ISSUE 5);
+* the end-to-end p99 event→placement-written latency
+  (``BENCH_E2E*.json`` ``detail.slo.e2e_p99_ms``, ISSUE 13) gates as a
+  latency ceiling with the gate_wait-style absolute slack
+  (``gate_e2e``).
 
 Rounds that failed to run (``rc != 0`` or no parsed value) are skipped;
 with no comparable prior round the gate passes trivially.
@@ -627,6 +631,106 @@ def gate_census(root: Path) -> int:
     return 0 if ok else 1
 
 
+_E2E_RE = re.compile(r"^BENCH_E2E(?:_[A-Z]+)?_r(\d+)\.json$")
+
+
+def gate_e2e(root: Path, tolerance: float) -> int:
+    """Gate the end-to-end p99 event→placement-written latency
+    (BENCH_E2E*_r*.json, ``detail.slo.e2e_p99_ms`` — ISSUE 13): ceiling
+    vs the best prior same-metric+platform round carrying it, with the
+    gate_wait-style 250 ms absolute slack for timer jitter.  Rounds
+    predating the SLO layer carry no block and are skipped as priors;
+    the first round that DOES carry it passes with the loud
+    NOTHING-GATED warning and becomes the baseline.  Throughput and the
+    stage split are surfaced informationally."""
+    rounds = []
+    for path in sorted(root.glob("BENCH_E2E*.json")):
+        m = _E2E_RE.match(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench-gate: {path.name}: unreadable ({e})", file=sys.stderr)
+            return 2
+        parsed = doc.get("parsed") or {}
+        value = parsed.get("value")
+        detail = parsed.get("detail") or doc.get("detail") or {}
+        metric = parsed.get("metric") or doc.get("metric") or ""
+        if value is None:
+            value = doc.get("value")
+        if doc.get("rc", 0) != 0 or value is None:
+            continue
+        slo = detail.get("slo") or {}
+        rounds.append(
+            {
+                "round": int(m.group(1)),
+                "path": path.name,
+                "metric": metric,
+                "platform": _platform_key(detail),
+                "value": float(value),
+                "p99": slo.get("e2e_p99_ms"),
+                "p50": slo.get("e2e_p50_ms"),
+                "decomp_err": slo.get("decomposition_err_pct"),
+                "stages": slo.get("stages_ms"),
+            }
+        )
+    if not rounds:
+        return 0
+    rounds.sort(key=lambda r: r["round"])
+    latest = rounds[-1]
+    if latest["p99"] is None:
+        print(
+            f"bench-gate: {latest['path']} ({latest['metric']}) carries no "
+            f"detail.slo block (pre-SLO round) — e2e p99 not gated"
+        )
+        return 0
+    print(
+        f"bench-gate: e2e {latest['path']} value={latest['value']:.1f} "
+        f"objects/s, event→written p50={latest['p50']}ms "
+        f"p99={latest['p99']:.1f}ms "
+        f"(decomposition err {latest['decomp_err']}%) — throughput "
+        f"informational"
+    )
+    if latest.get("stages"):
+        print(
+            "bench-gate: e2e stage p99 ms: "
+            + " ".join(
+                f"{stage}={spec.get('p99')}"
+                for stage, spec in latest["stages"].items()
+            )
+        )
+    priors = [
+        r
+        for r in rounds[:-1]
+        if r["metric"] == latest["metric"]
+        and r["platform"] == latest["platform"]
+        and r.get("p99") is not None
+    ]
+    if not priors:
+        print(
+            f"bench-gate: WARNING: {latest['path']} ({latest['metric']}, "
+            f"platform={latest['platform']}) has no prior round carrying "
+            f"e2e p99 — NOTHING GATED this round; this artifact becomes "
+            f"the baseline the next round gates against"
+        )
+        return 0
+    best = min(r["p99"] for r in priors)
+    ceil = best * (1.0 + tolerance) + 250.0
+    print(
+        f"bench-gate: e2e p99={latest['p99']:.1f}ms vs best prior "
+        f"{best:.1f}ms (ceiling {ceil:.1f})"
+    )
+    if latest["p99"] > ceil:
+        print(
+            f"bench-gate: E2E P99 REGRESSION: {latest['p99']:.1f}ms > "
+            f"{ceil:.1f}ms — the event→placement-written SLO regressed",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def report_e2e_chaos(root: Path) -> None:
     """Informational: surface the newest e2e artifact's degraded-fleet
     (chaos) numbers — tick-stall p99 and shed-write counts — next to
@@ -675,8 +779,9 @@ def main() -> int:
     churn_rc = gate_churn(args.root, args.tolerance)
     restart_rc = gate_restart(args.root, args.tolerance)
     census_rc = gate_census(args.root)
+    e2e_rc = gate_e2e(args.root, args.tolerance)
     report_e2e_chaos(args.root)
-    return rc or churn_rc or restart_rc or census_rc
+    return rc or churn_rc or restart_rc or census_rc or e2e_rc
 
 
 if __name__ == "__main__":

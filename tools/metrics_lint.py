@@ -38,6 +38,8 @@ from kubeadmiral_tpu.runtime.metric_catalog import (  # noqa: E402
     DECISION_REASONS,
     EVENT_REASONS,
     FLIGHT_RECORDER_FIELDS,
+    SLO_OBJECTIVES,
+    SLO_STAGES,
     is_cataloged,
 )
 
@@ -156,6 +158,26 @@ def lint_decision_vocabulary() -> list[str]:
             f"catalog FLIGHT_RECORDER_FIELDS {FLIGHT_RECORDER_FIELDS} — "
             f"update the catalog (and docs/observability.md) with the "
             f"record schema"
+        )
+    # SLO vocabulary (ISSUE 13): the provenance stage order and the
+    # evaluator's objective set are catalog-enforced like metric names —
+    # the slo_event_to_written_seconds{stage} and slo_burn_rate
+    # {objective} label vocabularies must never drift from the docs.
+    from kubeadmiral_tpu.runtime import slo as SLO
+
+    if tuple(SLO.STAGES) != SLO_STAGES:
+        errors.append(
+            f"runtime/slo.py: STAGES {tuple(SLO.STAGES)} != catalog "
+            f"SLO_STAGES {SLO_STAGES} — update the catalog (and "
+            f"docs/observability.md) with the stage vocabulary"
+        )
+    evaluator_names = set(SLO.SLOEvaluator().objectives)
+    if evaluator_names != set(SLO_OBJECTIVES):
+        errors.append(
+            f"runtime/slo.py: evaluator objectives "
+            f"{sorted(evaluator_names)} != catalog SLO_OBJECTIVES "
+            f"{sorted(SLO_OBJECTIVES)} — catalog every objective (and "
+            f"document it in docs/observability.md) first"
         )
     return errors
 
