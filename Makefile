@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-churn bench-gate bench-restart graft-check graft-dryrun native metrics-lint chaos chaos-e2e profile profile-smoke restart-smoke
+.PHONY: test test-fast bench bench-churn bench-gate bench-restart graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -30,6 +30,15 @@ chaos:
 # detail.chaos (see docs/operations.md "Degraded member runbook").
 chaos-e2e:
 	$(PYTEST_ENV) BENCH_E2E_CHAOS=1 python bench_e2e.py
+
+# ktlint (tools/ktlint, ISSUE 14): the repo-specific static analyzer —
+# AOT/ledger routing of every jax.jit site, the pack-sort sharding
+# contracts, donated-buffer read-after-dispatch, the KT_* knob catalog
+# (code <-> docs, zero orphans), and lock discipline over declared-
+# shared fields.  See docs/static_analysis.md; suppressions need a
+# written reason.  `--json` emits the per-rule summary bench.py embeds.
+lint:
+	python -m tools.ktlint
 
 # Fails on metric emissions not in runtime/metric_catalog.py — the
 # exposition, the docs and the source stay one vocabulary (see
@@ -54,10 +63,10 @@ bench-gate:
 restart-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_restart.py -q
 
-test: metrics-lint restart-smoke
+test: lint metrics-lint restart-smoke
 	$(PYTEST_ENV) python -m pytest tests/ -q --ignore=tests/test_restart.py
 
-test-fast: metrics-lint
+test-fast: lint metrics-lint
 	$(PYTEST_ENV) python -m pytest tests/ -q -x -m "not slow"
 
 bench:

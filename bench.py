@@ -44,6 +44,20 @@ import time
 
 import numpy as np
 
+def ktlint_summary():
+    """Per-rule static-analysis violation counts embedded in the BENCH
+    artifact (detail.ktlint): tools/bench_gate.py fails a round where a
+    previously-clean rule regresses, so an invariant break cannot ride
+    in on a green perf number (ISSUE 14).  Never fails the bench
+    itself — a broken analyzer reports as {"error": ...}."""
+    try:
+        from tools.ktlint import summary
+
+        return summary()
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": str(e)}
+
+
 CONFIG = os.environ.get("BENCH_CONFIG", "3")
 SHAPES = {"3": (10_000, 500), "4": (50_000, 2_000), "5": (100_000, 5_000)}
 N_OBJECTS, N_CLUSTERS = SHAPES.get(CONFIG, SHAPES["3"])
@@ -1311,6 +1325,7 @@ def main():
             "stage_ms": detail,
             "device_attr": device_attr,
             "telemetry": telemetry,
+            "ktlint": ktlint_summary(),
             "baseline": "native-seqsched(g++ -O3)"
             if native_seconds is not None
             else "python-oracle",

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from kubeadmiral_tpu.parallel import shardguard
+
 from kubeadmiral_tpu.ops import filters as F
 from kubeadmiral_tpu.ops import reasons as RSN
 from kubeadmiral_tpu.ops import scores as S
@@ -336,6 +338,7 @@ def _planner_weights(inp: TickInputs, selected):
     return jnp.where(selected, weights, 0)
 
 
+# ktlint: ignore[aot-ledger-coverage] oracle/test entry point: the engine never dispatches this jit — it re-traces schedule_tick.__wrapped__ inside its own aot+ledger-wrapped tick programs (see scheduler/engine._tick_with_diff)
 @jax.jit
 def schedule_tick(inp: TickInputs) -> TickOutputs:
     _note_trace(
@@ -520,6 +523,7 @@ def _decode_comp(sorted_comp, c, i32_keys):
     return (sorted_comp % c).astype(jnp.int32)
 
 
+@shardguard.rows_first
 def _plan_topm(inp: TickInputs, selected, weights, m: int, cs):
     """Planner over the top-M member slots in ITS OWN processing order —
     the narrow planner leg, shared by the narrow tick, the score-only
@@ -620,6 +624,7 @@ def _plan_topm(inp: TickInputs, selected, weights, m: int, cs):
     return divide_replicas, pcert & ~spec_out
 
 
+@shardguard.rows_first
 def _narrow_solve(
     inp: TickInputs, feasible, reasons, totals, m: int, rows_only,
     i32_keys: bool,
@@ -1353,6 +1358,7 @@ def drift_wcheck(
     return (w_old != w_new).any(axis=-1).astype(jnp.int8)
 
 
+@shardguard.rows_first
 def drift_resolve(
     inp: TickInputs,   # gathered survivor rows [n, C] (expanded)
     prev_feas_rows,    # i8[n, C] previous feasibility at those rows
@@ -1556,6 +1562,7 @@ class PackedRows(NamedTuple):
     #                  bit (ops.reasons.REASON_BITS order), valid slots only
 
 
+@shardguard.rows_only
 def pack_rows(selected, replicas, counted, scores, reasons, k: int) -> PackedRows:
     """Top-k-compact dense output planes (any leading row count) into the
     packed layout.  Slot order is (score desc, cluster index asc) over

@@ -38,6 +38,8 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
+from kubeadmiral_tpu.parallel import shardguard
 import numpy as np
 
 INT32_INF = np.int32(np.iinfo(np.int32).max)
@@ -95,6 +97,7 @@ def _running_remainder(r0: jax.Array, c: jax.Array) -> jax.Array:
     return jnp.concatenate([jnp.full((1,), r0, dtype=c.dtype), rem_after[:-1]])
 
 
+@shardguard.rows_first
 def _distribute(
     weight: jax.Array,
     min_replicas: jax.Array,
@@ -441,6 +444,7 @@ def plan_batch_narrow(
     return jax.vmap(_plan_one_narrow)(inp, tail_weight, best_tail, comp)
 
 
+# ktlint: ignore[aot-ledger-coverage] host-validation entry (plan_batch) and oracle comparisons only: inside the engine this traces INLINE into the aot+ledger-wrapped tick programs, never as its own dispatch
 @jax.jit
 def plan_batch_jit(inp: PlannerInputs) -> PlannerOutputs:
     """Plan every object in the batch in one XLA dispatch (no host checks).

@@ -13,9 +13,12 @@ import jax
 from jax import lax
 import jax.numpy as jnp
 
+from kubeadmiral_tpu.parallel import shardguard
+
 from kubeadmiral_tpu.ops.planner import INT32_INF
 
 
+@shardguard.rows_first
 def select_topk(scores, feasible, max_clusters):
     """scores i64[B,C], feasible bool[B,C], max_clusters i32[B] -> bool[B,C].
 

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from kubeadmiral_tpu.parallel import shardguard
+
 from kubeadmiral_tpu.ops.scores import _floordiv_smallq
 
 SUM_WEIGHT = 1000
@@ -36,6 +38,7 @@ def _round_half_div(num, den):
     return _floordiv_smallq(2 * num + den, 2 * den)
 
 
+@shardguard.rows_first
 def dynamic_weights(selected, cpu_alloc, cpu_avail, compute_dtype=jnp.int64):
     """selected bool[B,C]; cpu_alloc/cpu_avail i64[C] -> i32[B,C] weights.
 

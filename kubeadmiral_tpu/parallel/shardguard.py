@@ -1,0 +1,80 @@
+"""Sharding-contract declarations for order-sensitive device ops.
+
+GSPMD mis-combines sorts, scans and reshapes along a SHARDED dimension:
+a ``lax.sort``/``cumsum`` whose axis is partitioned across the mesh
+lowers to per-shard partials that get shard-summed (observed twice by
+``dryrun_multichip``, once as 11/11 wrong fallback rows in PR 5).  The
+repo's standing rule — the "pack-sort rule" (see
+``parallel/mesh.rows_only_sharding``) — is that any such op must run
+with the axis it orders over WHOLE on every shard: rows-only /
+rows-first layouts for per-row ops, full replication otherwise.
+
+This module turns that convention into a DECLARATION the static
+analyzer can check (``tools/ktlint`` rule ``sharding-discipline``):
+every function containing a sort-family call (``sort``/``argsort``/
+``top_k``/``cumsum``/``argmin``/``argmax`` …) must be decorated with
+the contract describing the layout its callers are required to
+constrain it under.  The decorators are zero-overhead — they tag the
+function with ``__sharding_contract__`` and return it unchanged, so
+jit tracing, vmap and donation are unaffected.
+
+Contracts (mirroring ``parallel/mesh.py``'s constraint helpers):
+
+* ``rows_first``  — per-row op inside a rank-N tensor sharded on the
+  FIRST (objects) axis only; every ordered-over axis is whole per
+  shard (``mesh.rows_first_sharding``).
+* ``rows_only``   — the [B, C] special case (``mesh.rows_only_sharding``).
+* ``replicated``  — the op's operands must be fully replicated before
+  it runs (``mesh.replicated``); used for cross-row ops.
+
+Adding a sort to an undecorated function fails ``make lint`` — the
+author must either pick the contract (and its callers the matching
+constraint) or suppress with a written justification.  See
+docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+ROWS_ONLY = "rows-only"
+ROWS_FIRST = "rows-first"
+REPLICATED = "replicated"
+
+CONTRACTS = (ROWS_ONLY, ROWS_FIRST, REPLICATED)
+
+
+def shard_contract(spec: str) -> Callable[[F], F]:
+    """Declare the sharding layout a sort-carrying function requires.
+
+    ``spec`` must be one of :data:`CONTRACTS`.  The returned decorator
+    only tags the function — enforcement is the caller constraining its
+    operands (``mesh.rows_only_sharding``/``rows_first_sharding``/
+    ``replicated``) plus the multichip dryrun's parity blocks; ktlint
+    enforces that the declaration exists at all.
+    """
+    if spec not in CONTRACTS:
+        raise ValueError(f"unknown sharding contract {spec!r}; use one of {CONTRACTS}")
+
+    def deco(fn: F) -> F:
+        fn.__sharding_contract__ = spec
+        return fn
+
+    return deco
+
+
+def rows_only(fn: F) -> F:
+    """Contract: [B, C] operands sharded over objects only."""
+    return shard_contract(ROWS_ONLY)(fn)
+
+
+def rows_first(fn: F) -> F:
+    """Contract: rank-N operands sharded on the first (row) axis only."""
+    return shard_contract(ROWS_FIRST)(fn)
+
+
+def replicated(fn: F) -> F:
+    """Contract: operands fully replicated before the op runs."""
+    return shard_contract(REPLICATED)(fn)

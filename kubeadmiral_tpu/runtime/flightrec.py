@@ -47,6 +47,7 @@ from typing import Callable, Mapping, Optional, Sequence
 import numpy as np
 
 from kubeadmiral_tpu.ops import reasons as RSN
+from kubeadmiral_tpu.runtime import lockcheck
 
 
 class DecisionRecord:
@@ -101,7 +102,19 @@ class _TickEntry:
         self.programs: set[str] = set()
 
 
+@lockcheck.shared_field_guard
 class FlightRecorder:
+    # Ring/index state fed by the engine's fetch stage and read by
+    # /debug/explain server threads (ktlint lock-discipline +
+    # runtime/lockcheck.py).
+    _shared_fields_ = {
+        "_ring": "_lock",
+        "_index": "_lock",
+        "_tick_seq": "_lock",
+        "_bytes": "_lock",
+        "_current": "_lock",
+    }
+
     def __init__(
         self,
         max_ticks: Optional[int] = None,
@@ -116,7 +129,7 @@ class FlightRecorder:
         self.topk = int(env.get("KT_FLIGHTREC_TOPK", "8")) if topk is None else topk
         self.enabled = (env.get("KT_FLIGHTREC", "1") != "0") if enabled is None else enabled
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("flightrec")
         self._ring: deque[_TickEntry] = deque()
         self._index: dict[str, DecisionRecord] = {}
         self._tick_seq = 0

@@ -1,0 +1,289 @@
+"""The knob catalog: every ``KT_*`` environment knob this control plane
+reads.
+
+Sibling of :mod:`kubeadmiral_tpu.runtime.metric_catalog`, with the same
+contract: ONE source of truth, three consumers.
+
+* ``tools/ktlint`` (rule ``knob-catalog``, run by ``make lint``) walks
+  every source tree for ``os.environ``/``getenv``/env-helper reads of
+  literal ``KT_*`` names and FAILS on names not listed here — a new
+  knob must be cataloged (and thereby documented) before it ships.  The
+  same rule cross-checks the docs: every ``KT_*`` token mentioned under
+  ``docs/`` must be cataloged, and every catalog entry must be both
+  read somewhere in code and documented in its anchor file — zero
+  orphans in either direction (the pre-ktlint state was 61 knobs read
+  vs 63 named in docs, with no check either way).
+* ``docs/operations.md`` / ``docs/observability.md`` render the
+  operator-facing knob tables; ``anchor`` names the file that owns a
+  knob's row.
+* Tests assert the catalog's shape so the vocabulary cannot drift
+  silently (tests/test_ktlint.py).
+
+Naming rules: public knobs match ``^KT_[A-Z0-9_]+$``.  Process-internal
+sentinels (subprocess handshakes like ``_KT_DRYRUN_SUBPROCESS``) carry
+a leading underscore and are exempt from the catalog by convention.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class KnobSpec(NamedTuple):
+    type: str     # bool | int | float | str | path
+    default: str  # rendered default ("" = unset)
+    anchor: str   # docs file owning the operator-facing row
+    help: str
+
+
+_OPS = "operations.md"
+_OBS = "observability.md"
+
+KNOBS: dict[str, KnobSpec] = {
+    # -- engine geometry & fast paths (scheduler/engine.py) --------------
+    "KT_CELL_BUDGET": KnobSpec(
+        "int", "4096*5120", _OPS,
+        "Megachunk sizing: cells (rows x padded clusters) per chunk dispatch."),
+    "KT_MEGACHUNK_ROWS": KnobSpec(
+        "int", "4096", _OPS,
+        "Independent cap on rows per chunk at any cluster width."),
+    "KT_DONATE": KnobSpec(
+        "bool", "1", _OPS,
+        "Donate the previous tick's output planes into each tick dispatch."),
+    "KT_PIPELINE_DEPTH": KnobSpec(
+        "int", "16", _OPS,
+        "In-flight chunk window before the batched device->host drain."),
+    "KT_FETCH_FORMAT": KnobSpec(
+        "str", "packed", _OPS,
+        "Result-fetch wire format: packed [B,K] slots or dense [B,C] planes."),
+    "KT_PACK_K": KnobSpec(
+        "int", "16", _OPS,
+        "Minimum packed-slot bucket K (adapts per chunk from observed counts)."),
+    "KT_PACK_OVERFLOW_PCT": KnobSpec(
+        "float", "0.01", _OPS,
+        "Adaptive-K target overflow fraction."),
+    "KT_PACK_WIDEN": KnobSpec(
+        "float", "1.25", _OPS,
+        "Adaptive-K widen-once cap."),
+    "KT_NARROW": KnobSpec(
+        "bool", "1", _OPS,
+        "Narrow [B,M] candidate solve with per-row exactness certificate."),
+    "KT_NARROW_M": KnobSpec(
+        "int", "128", _OPS,
+        "Floor for the narrow candidate width M."),
+    "KT_REPLAN": KnobSpec(
+        "bool", "1", _OPS,
+        "Fit-flip survivors ride the selection-known replan / score-only kernels."),
+    "KT_DRIFT_RESOLVE": KnobSpec(
+        "bool", "1", _OPS,
+        "Sort-free survivor resolve from stored planes on drift ticks."),
+    "KT_SURVIVOR_UNIFIED": KnobSpec(
+        "bool", "1", _OPS,
+        "One unified survivor kernel per gated chunk (vs three streams)."),
+    "KT_SURVIVOR_ROWSHARD": KnobSpec(
+        "bool", "1", _OPS,
+        "Rows-first sharding for gathered survivor sub-problems under a mesh."),
+    "KT_SCORE_F16": KnobSpec(
+        "bool", "0", _OPS,
+        "f16 compression of the resident prev SCORE plane (exactness-guarded)."),
+    "KT_PHASE1_I32": KnobSpec(
+        "bool", "1", _OPS,
+        "i32 phase-1 arithmetic where the range analysis allows."),
+    "KT_DELTA_FEAT": KnobSpec(
+        "bool", "1", _OPS,
+        "Row-wise featurize patches + streaming dirty-row hints."),
+    "KT_PALLAS": KnobSpec(
+        "bool", "0", _OPS,
+        "Fused Pallas phase-1 front for the narrow slab programs."),
+    "KT_HBM_BUDGET_GB": KnobSpec(
+        "float", "16", _OPS,
+        "Per-device HBM budget the c6 memory census compares against."),
+    "KT_COMPILE_CACHE_DIR": KnobSpec(
+        "path", "~/.cache/kubeadmiral_tpu/xla-cache", _OPS,
+        "Persistent XLA compilation-cache location (empty/0 disables)."),
+    "KT_DRYRUN_LARGE": KnobSpec(
+        "str", "2048x512,1024x5120", _OPS,
+        "Large sharding-validation shapes in __graft_entry__.dryrun_multichip."),
+    # -- AOT store & restart (scheduler/aot.py, runtime/snapshot.py) -----
+    "KT_AOT": KnobSpec(
+        "bool", "1", _OPS,
+        "AOT program store: warm boots preload jax.export artifacts."),
+    "KT_AOT_DIR": KnobSpec(
+        "path", "<compile-cache>/aot", _OPS,
+        "AOT manifest root override (bench/restart isolation)."),
+    "KT_SNAPSHOT_DIR": KnobSpec(
+        "path", "", _OPS,
+        "Durable engine-snapshot directory (unset disables snapshots)."),
+    "KT_SNAPSHOT_KEEP": KnobSpec(
+        "int", "2", _OPS,
+        "Snapshot generations retained."),
+    "KT_SNAPSHOT_EVERY": KnobSpec(
+        "int", "1", _OPS,
+        "Persist every Nth converged state-changing tick."),
+    "KT_SNAPSHOT_KILL": KnobSpec(
+        "str", "", _OPS,
+        "Fault injection for the SIGKILL matrix: die mid-write/pre-rename."),
+    "KT_SHUTDOWN_DEADLINE_S": KnobSpec(
+        "float", "10", _OPS,
+        "SIGTERM drain deadline before hard exit."),
+    # -- streaming front-end (scheduler/streaming.py) --------------------
+    "KT_SLAB_ROWS": KnobSpec(
+        "int", "1024", _OPS,
+        "Row-slab size watermark (per-device under a mesh)."),
+    "KT_SLAB_AGE_MS": KnobSpec(
+        "float", "50", _OPS,
+        "Row-slab age watermark."),
+    "KT_SLAB_GROW": KnobSpec(
+        "int", "<engine chunk>", _OPS,
+        "Placeholder-pool grow block."),
+    # -- logging & concurrency harness (runtime/) ------------------------
+    "KT_LOG_LEVEL": KnobSpec(
+        "str", "WARNING", _OPS,
+        "Level for the kubeadmiral.* logger tree."),
+    "KT_LOG_JSON": KnobSpec(
+        "bool", "0", _OPS,
+        "JSON-lines log emission."),
+    "KT_LOCKCHECK": KnobSpec(
+        "bool", "0", _OPS,
+        "Instrumented locks + declared-shared-field write guard "
+        "(runtime/lockcheck.py; tests enable it suite-wide)."),
+    # -- observability (runtime/devprof.py, flightrec.py, slo.py) --------
+    "KT_DEVPROF": KnobSpec(
+        "bool", "1", _OPS,
+        "Dispatch ledger: per-program device-time attribution."),
+    "KT_DEVPROF_TICKS": KnobSpec(
+        "int", "8", _OPS,
+        "Tick waterfalls kept in the ledger ring."),
+    "KT_PROFILE_DIR": KnobSpec(
+        "path", "/tmp/kt-jax-profile", _OPS,
+        "Root directory for on-demand jax.profiler artifacts."),
+    "KT_PROFILE_TICKS": KnobSpec(
+        "int", "0", _OPS,
+        "Bench-side jax.profiler capture around N scheduling ticks."),
+    "KT_FLIGHTREC": KnobSpec(
+        "bool", "1", _OBS,
+        "Decision flight recorder master switch."),
+    "KT_FLIGHTREC_TICKS": KnobSpec(
+        "int", "8", _OBS,
+        "Flight-recorder tick ring size."),
+    "KT_FLIGHTREC_BYTES": KnobSpec(
+        "int", "256<<20", _OBS,
+        "Flight-recorder byte budget."),
+    "KT_FLIGHTREC_TOPK": KnobSpec(
+        "int", "8", _OBS,
+        "Per-decision top-K score introspection width."),
+    "KT_SLO": KnobSpec(
+        "bool", "1", _OPS,
+        "Provenance-token SLO path master switch."),
+    "KT_SLO_E2E_P99_S": KnobSpec(
+        "float", "5.0", _OPS,
+        "event_to_written_p99 objective threshold."),
+    "KT_SLO_WRITE_P99_S": KnobSpec(
+        "float", "2.0", _OPS,
+        "member_write_p99 objective threshold."),
+    "KT_SLO_FRESHNESS_S": KnobSpec(
+        "float", "30", _OPS,
+        "freshness objective threshold (oldest pending event age)."),
+    "KT_SLO_WINDOWS_S": KnobSpec(
+        "str", "60,300", _OPS,
+        "Burn-rate windows (seconds, comma-separated)."),
+    "KT_SLO_EXEMPLARS": KnobSpec(
+        "int", "32", _OPS,
+        "Slowest-N exemplar ring at /debug/slo."),
+    "KT_SLO_PENDING_CAP": KnobSpec(
+        "int", "200000", _OPS,
+        "Bound on in-flight provenance tokens."),
+    "KT_SLO_MAX_AGE_S": KnobSpec(
+        "float", "0", _OPS,
+        "Age-out for pending tokens (0 = never)."),
+    # -- member transport & dispatch (transport/, federation/dispatch.py) -
+    "KT_BREAKER_FAILURES": KnobSpec(
+        "int", "3", _OPS,
+        "Consecutive failures that open a member's breaker."),
+    "KT_BREAKER_STALL_S": KnobSpec(
+        "float", "1.0", _OPS,
+        "Single-round-trip stall threshold (opens immediately)."),
+    "KT_BREAKER_LATENCY_S": KnobSpec(
+        "float", "5.0", _OPS,
+        "Latency-EWMA open threshold."),
+    "KT_BREAKER_OPEN_S": KnobSpec(
+        "float", "5.0", _OPS,
+        "Cool-down before half-open."),
+    "KT_DISPATCH_DEADLINE_S": KnobSpec(
+        "float", "30", _OPS,
+        "Per-tick member-write deadline budget."),
+    "KT_DISPATCH_POOL": KnobSpec(
+        "int", "8", _OPS,
+        "Bounded in-flight pool of the per-op fan-out."),
+    "KT_RETRY_MAX": KnobSpec(
+        "int", "3", _OPS,
+        "Retries per op beyond the first attempt."),
+    "KT_RETRY_BASE_S": KnobSpec(
+        "float", "0.05", _OPS,
+        "Retry backoff base."),
+    "KT_RETRY_CAP_S": KnobSpec(
+        "float", "2.0", _OPS,
+        "Retry backoff cap."),
+    "KT_FARM_SUBPROCESS": KnobSpec(
+        "str", "", _OPS,
+        "kwok-lite farm: run members as subprocesses."),
+    # -- bench / CI drivers (bench.py, bench_e2e.py, tools/) -------------
+    "KT_BENCH_GATE_TOL": KnobSpec(
+        "float", "0.10", _OPS,
+        "bench-gate regression tolerance (fraction)."),
+    "KT_CHURN_FLOOR": KnobSpec(
+        "float", "<3x r03>", _OPS,
+        "bench-gate churn objects-revalidated/s floor override."),
+    "KT_CHURN_P99_CEIL_MS": KnobSpec(
+        "float", "3000", _OPS,
+        "bench-gate churn event->placement p99 ceiling."),
+    "KT_CENSUS_OBJECTS": KnobSpec(
+        "int", "1000000", _OPS,
+        "c6 memory-census world: objects."),
+    "KT_CENSUS_CLUSTERS": KnobSpec(
+        "int", "10000", _OPS,
+        "c6 memory-census world: clusters."),
+    "KT_CENSUS_DEVICES": KnobSpec(
+        "int", "4", _OPS,
+        "c6 memory-census world: devices on the objects axis."),
+    "KT_CENSUS_VALIDATE_OBJECTS": KnobSpec(
+        "int", "8192", _OPS,
+        "Census model-validation slice: objects."),
+    "KT_CENSUS_VALIDATE_CLUSTERS": KnobSpec(
+        "int", "256", _OPS,
+        "Census model-validation slice: clusters."),
+    "KT_RESTART_WARM": KnobSpec(
+        "bool", "0", _OPS,
+        "Restart bench: this process is the warm successor."),
+    "KT_RESTART_BENCH_DIR": KnobSpec(
+        "path", "", _OPS,
+        "Restart bench: shared workdir (snapshots + AOT manifest)."),
+    "KT_RESTART_TIMEOUT_S": KnobSpec(
+        "int", "3600", _OPS,
+        "Restart bench: per-phase subprocess timeout."),
+    "KT_RESTART_MULTIDEV": KnobSpec(
+        "int", "4", _OPS,
+        "Restart bench: N-device warm-boot phase (0 skips)."),
+    "KT_RESTART_DIR": KnobSpec(
+        "path", "", _OPS,
+        "SIGKILL matrix: victim/successor workdir."),
+    "KT_RESTART_OBJECTS": KnobSpec(
+        "int", "192", _OPS,
+        "SIGKILL matrix world: objects."),
+    "KT_RESTART_CLUSTERS": KnobSpec(
+        "int", "10", _OPS,
+        "SIGKILL matrix world: clusters."),
+    "KT_RESTART_PREWARM": KnobSpec(
+        "bool", "0", _OPS,
+        "SIGKILL matrix: run the prewarm ladder in the victim."),
+    "KT_RESTART_KILL_PHASE": KnobSpec(
+        "str", "", _OPS,
+        "SIGKILL matrix: phase the victim dies in."),
+    "KT_RESTART_ARTIFACT": KnobSpec(
+        "path", "successor.json", _OPS,
+        "SIGKILL matrix: successor's convergence artifact path."),
+}
+
+
+def is_cataloged(name: str) -> bool:
+    return name in KNOBS

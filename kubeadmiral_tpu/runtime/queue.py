@@ -14,6 +14,8 @@ from __future__ import annotations
 import heapq
 import threading
 import time
+
+from kubeadmiral_tpu.runtime import lockcheck
 from dataclasses import dataclass, field
 
 
@@ -41,13 +43,26 @@ class Backoff:
         self._delays.pop(key, None)
 
 
+@lockcheck.shared_field_guard
 class DirtyQueue:
     """Thread-safe delayed queue; at most one pending entry per key
     (latest-wins, like DelayingDeliverer's key map)."""
 
+    # Every field below is touched by producer add()s and the worker's
+    # drain loop concurrently; _wakeup is a Condition OVER _lock, so
+    # `with self._wakeup:` satisfies the same lock (ktlint
+    # lock-discipline + runtime/lockcheck.py).
+    _shared_fields_ = {
+        "_heap": "_lock|_wakeup",
+        "_pending": "_lock|_wakeup",
+        "_enqueued_at": "_lock|_wakeup",
+        "_seq": "_lock|_wakeup",
+        "last_drain_waits": "_lock|_wakeup",
+    }
+
     def __init__(self, clock=time.monotonic):
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("dirtyqueue")
         self._heap: list[_Entry] = []
         self._pending: dict[str, _Entry] = {}
         # key -> first-enqueue time while pending: the true queue wait

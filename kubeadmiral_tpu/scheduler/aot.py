@@ -51,6 +51,8 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from kubeadmiral_tpu.runtime import lockcheck
+
 log = logging.getLogger("kubeadmiral.aot")
 
 MANIFEST_VERSION = 1
@@ -158,10 +160,22 @@ def default_dir() -> Optional[str]:
     )
 
 
+@lockcheck.shared_field_guard
 class AotStore:
     """One engine's AOT program manifest: route program calls through
     deserialized exports when a valid entry exists, export newly traced
     programs while :meth:`export_mode` is active (the prewarm ladder)."""
+
+    # Manifest/route state shared by dispatch threads, the background
+    # prewarm thread and preload_all workers; mutations must hold
+    # _lock (ktlint rule lock-discipline + runtime/lockcheck.py).
+    _shared_fields_ = {
+        "_entries": "_lock",
+        "_worlds": "_lock",
+        "_preloaded": "_lock",
+        "_dirty": "_lock",
+        "stats": "_lock",
+    }
 
     def __init__(
         self,
@@ -182,7 +196,7 @@ class AotStore:
         self.enabled = bool(enabled) and (
             self.dir is not None or self.live_trace_only
         )
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("aotstore")
         self._export_tls = threading.local()
         self._entries: dict[str, dict] = {}
         # Prewarm-world fingerprints the manifest's export ladder ran at
@@ -235,8 +249,9 @@ class AotStore:
             )
             self._count("rejected")
             return
-        self._entries = dict(doc.get("entries") or {})
-        self._worlds = set(doc.get("worlds") or ())
+        with self._lock:
+            self._entries = dict(doc.get("entries") or {})
+            self._worlds = set(doc.get("worlds") or ())
 
     def save_manifest(self) -> None:
         """Atomically persist the manifest (blobs are already on disk:
@@ -296,7 +311,10 @@ class AotStore:
         return _AotProgram(self, key, fn)
 
     def _count(self, result: str, n: int = 1) -> None:
-        self.stats[result] = self.stats.get(result, 0) + n
+        # Read-modify-write shared across prewarm + dispatch threads:
+        # the un-locked form lost updates under the thread storm.
+        with self._lock:
+            self.stats[result] = self.stats.get(result, 0) + n
         if self.metrics is not None:
             self.metrics.counter("engine_aot_programs_total", n, result=result)
 
@@ -369,7 +387,8 @@ class AotStore:
         for eid, compiled in compiled_list:
             if compiled is None:
                 continue
-            self._preloaded[eid] = compiled
+            with self._lock:
+                self._preloaded[eid] = compiled
             self._count("loaded")
             n += 1
         return n
@@ -392,6 +411,7 @@ class AotStore:
                 exported.in_tree, leaves
             )
             before = self._pcache_entries()
+            # ktlint: ignore[aot-ledger-coverage] this IS the AotStore: the jit of a deserialized export is the wrapped route itself; the engine's outer _AotProgram proxy is already ledger-wrapped by _obs_wrap
             compiled = jax.jit(exported.call).lower(*args, **kwargs).compile()
             self._note_pcache(before)
         except Exception as e:
@@ -459,6 +479,7 @@ class AotStore:
             log.warning("AOT deserialize failed for %s (%s); live-tracing", key, e)
             self._count("rejected")
             return None
+        # ktlint: ignore[aot-ledger-coverage] this IS the AotStore: the jit of a deserialized export is the wrapped route itself; the engine's outer _AotProgram proxy is already ledger-wrapped by _obs_wrap
         return jax.jit(exported.call)
 
     def _precompiled_route(
@@ -552,6 +573,7 @@ class AotStore:
                 "nbytes": len(blob),
             }
             self._dirty = True
+        # ktlint: ignore[aot-ledger-coverage] this IS the AotStore: the jit of a deserialized export is the wrapped route itself; the engine's outer _AotProgram proxy is already ledger-wrapped by _obs_wrap
         return jax.jit(exported.call)
 
 

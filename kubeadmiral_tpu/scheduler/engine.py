@@ -64,6 +64,7 @@ from kubeadmiral_tpu.ops.pipeline import (
 from kubeadmiral_tpu.ops.planner import INT32_INF
 from kubeadmiral_tpu.runtime import devprof as devprof_mod
 from kubeadmiral_tpu.runtime import flightrec as flightrec_mod
+from kubeadmiral_tpu.runtime import lockcheck
 from kubeadmiral_tpu.scheduler import aot as aot_mod
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
@@ -542,6 +543,7 @@ def _unpack_bits(words: np.ndarray, c: int) -> np.ndarray:
     return bits[:, :c]
 
 
+@lockcheck.shared_field_guard
 class SchedulerEngine:
     """Chunked, shape-bucketed driver around ops.pipeline.schedule_tick.
 
@@ -558,6 +560,16 @@ class SchedulerEngine:
     ``mesh="auto"`` builds an (objects, clusters) mesh whenever more
     than one device is visible; pass an explicit jax.sharding.Mesh or
     ``None`` (single-device) to override."""
+
+    # Cross-thread staging surface (manager boot thread vs the
+    # streaming pump's ticks); everything else on the engine is
+    # serialized by _schedule_lock's with-block in schedule() or is
+    # builder-cache state safe under the GIL's dict atomicity
+    # (ktlint lock-discipline + runtime/lockcheck.py).
+    _shared_fields_ = {
+        "_pending_restore": "_schedule_lock",
+        "restore_info": "_schedule_lock",
+    }
 
     def __init__(
         self,
@@ -896,7 +908,7 @@ class SchedulerEngine:
         # workers (worker.run(workers=N)) gain nothing from overlap
         # anyway: the device serializes, and each tick schedules the
         # whole pending set.
-        self._schedule_lock = threading.Lock()
+        self._schedule_lock = lockcheck.make_lock("engine-schedule")
 
         # Persistent XLA compilation-cache telemetry (the cache itself
         # is enabled in kubeadmiral_tpu.__init__; KT_COMPILE_CACHE_DIR
@@ -1044,11 +1056,15 @@ class SchedulerEngine:
         # Window-drain stacker: one device-side stack of same-shape
         # buffers -> ONE host transfer for the whole window (jax traces
         # a variant per (arity, shape); arities are bounded by the
-        # pipeline depth and shapes by the bucket ladder).
-        self._stack = jax.jit(lambda *xs: jnp.stack(xs))
+        # pipeline depth and shapes by the bucket ladder).  AOT-routed
+        # like every other program: a warm boot preloads the window
+        # shapes its prewarm ladder drained instead of re-tracing them.
+        self._stack = self._aot.wrap("stack", jax.jit(lambda *xs: jnp.stack(xs)))
         # Device-side concat (the sub-batch write-back repair stacks
         # hetero-height slabs); jax traces one variant per shape tuple.
-        self._concat = jax.jit(lambda *xs: jnp.concatenate(xs))
+        self._concat = self._aot.wrap(
+            "concat", jax.jit(lambda *xs: jnp.concatenate(xs))
+        )
         # Per-shape program caches for the drift gate, its dynamic-
         # weight check, the sort-free survivor resolve, the fit-flip
         # replan / score-only solves, the precomputed tie-break plane,
@@ -1122,12 +1138,12 @@ class SchedulerEngine:
             M.output_shardings(self.mesh),
             M.rows_sharding(self.mesh),
         )
-        self._tick = jax.jit(
+        self._tick = aot("tick", jax.jit(
             _tick_with_diff,
             in_shardings=in_shardings,
             out_shardings=out_shardings,
             donate_argnums=donate,
-        )
+        ))
         self._cluster_shardings = M.field_shardings(
             self.mesh, _CLUSTER_ONLY_FIELDS
         )
@@ -1137,7 +1153,7 @@ class SchedulerEngine:
         self._table_shardings = M.compact_field_shardings(
             self.mesh, Cmp.TABLE_FIELDS
         )
-        self._tick_compact = jax.jit(
+        self._tick_compact = aot("tick_compact", jax.jit(
             _tick_compact_with_diff,
             in_shardings=(
                 M.compact_input_shardings(self.mesh),
@@ -1145,7 +1161,7 @@ class SchedulerEngine:
             ),
             out_shardings=out_shardings,
             donate_argnums=donate,
-        )
+        ))
         rep = M.replicated(self.mesh)
         self._replicated = rep
         self._rows_only_sharding = M.rows_only_sharding(self.mesh)
@@ -1167,21 +1183,21 @@ class SchedulerEngine:
         else:
             self._rows_first = None
         self._pack_programs = {}
-        self._gather = jax.jit(
+        self._gather = aot("gather", jax.jit(
             _gather_packed,
             in_shardings=(grid, grid, grid, grid, rep),
             out_shardings=rep,
-        )
-        self._gather3 = jax.jit(
+        ))
+        self._gather3 = aot("gather3", jax.jit(
             _gather_packed3,
             in_shardings=(grid, grid, grid, rep),
             out_shardings=rep,
-        )
-        self._gather5 = jax.jit(
+        ))
+        self._gather5 = aot("gather5", jax.jit(
             _gather_packed5,
             in_shardings=(grid, grid, grid, grid, grid, rep),
             out_shardings=rep,
-        )
+        ))
         # Overflow gathers bit-pack via a reshape+sum along the cluster
         # axis: like the pack sort, the gathered rows must be replicated
         # before that (GSPMD mis-combines reshapes of sharded axes).
@@ -1214,26 +1230,26 @@ class SchedulerEngine:
                 axis=1,
             )
 
-        self._gather_over3 = jax.jit(
+        self._gather_over3 = aot("over3", jax.jit(
             _over3_meshed,
             in_shardings=(grid, grid, grid, rep),
             out_shardings=rep,
-        )
-        self._gather_over4 = jax.jit(
+        ))
+        self._gather_over4 = aot("over4", jax.jit(
             _over4_meshed,
             in_shardings=(grid, grid, grid, grid, rep),
             out_shardings=rep,
-        )
-        self._patch = jax.jit(
+        ))
+        self._patch = aot("patch", jax.jit(
             _patch_rows,
             in_shardings=(self._per_object_shardings, rep, rep),
             out_shardings=self._per_object_shardings,
-        )
-        self._patch_compact = jax.jit(
+        ))
+        self._patch_compact = aot("patch_compact", jax.jit(
             _patch_rows,
             in_shardings=(self._per_object_shardings_compact, rep, rep),
             out_shardings=self._per_object_shardings_compact,
-        )
+        ))
 
     def _zeros_for(self, shape: tuple) -> tuple:
         """Device-resident zero prev planes.  Under donation the tick
@@ -2389,11 +2405,16 @@ class SchedulerEngine:
         telemetry only: freshness is RE-PROVEN inside the engine by
         cluster-tensor equality plus the per-row signature walk, so a
         lying watermark can cost a re-solve, never a wrong placement."""
-        if payload is None:
-            self._pending_restore = None
-            return
-        self._pending_restore = (payload, bool(assume_fresh))
+        # Under the schedule lock: the manager stages from its boot
+        # thread while a streaming pump may already be ticking — an
+        # unlocked swap could hand _consume_restore a torn pair.
+        with self._schedule_lock:
+            if payload is None:
+                self._pending_restore = None
+                return
+            self._pending_restore = (payload, bool(assume_fresh))
 
+    @lockcheck.assumes_held("_schedule_lock")
     def _consume_restore(self, units, clusters, view: ClusterView) -> None:
         payload, assume_fresh = self._pending_restore
         self._pending_restore = None
@@ -6314,6 +6335,7 @@ class SchedulerEngine:
         # non-donated inputs) and threads each call's results.
         big = max(shapes)
         pshape = (big, c_bucket)
+        # ktlint: ignore[aot-ledger-coverage] prewarm-only transient: runs once to seed the repair chain, is never dispatched by a tick (no ledger kind), and exporting a zeros builder per shape would bloat the AOT manifest for a program a warm boot never calls
         all_planes = jax.jit(
             lambda: (
                 jnp.zeros(pshape, jnp.int8),

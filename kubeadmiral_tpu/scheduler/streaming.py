@@ -46,6 +46,7 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from kubeadmiral_tpu.models import types as T
+from kubeadmiral_tpu.runtime import lockcheck
 from kubeadmiral_tpu.runtime import slo as SLO
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
@@ -91,6 +92,7 @@ class _Event:
     t: float
 
 
+@lockcheck.shared_field_guard
 class StreamingScheduler:
     """Always-on front-end over a :class:`SchedulerEngine`.
 
@@ -100,6 +102,12 @@ class StreamingScheduler:
     with :attr:`units`; per-event placement-visible latency is recorded
     to the ``engine_stream_latency_seconds`` histogram and the bounded
     :attr:`latencies` deque (bench percentiles)."""
+
+    # The producer<->pump surface: watch/informer threads append
+    # events, the pump drains them (ktlint lock-discipline +
+    # runtime/lockcheck.py).  World/result state (_units, results) is
+    # pump-thread-only by contract and stays undeclared.
+    _shared_fields_ = {"_pending": "_lock"}
 
     def __init__(
         self,
@@ -154,7 +162,7 @@ class StreamingScheduler:
         self.grow_block = max(1, int(grow_block))
         self.follower_index = follower_index
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("streaming")
         self._pending: deque[_Event] = deque()
         self._units: list[T.SchedulingUnit] = list(units)
         self._clusters: list[T.ClusterState] = list(clusters)

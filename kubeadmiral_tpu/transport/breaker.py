@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from kubeadmiral_tpu.runtime import lockcheck
 import time
 import weakref
 from collections import deque
@@ -106,11 +108,26 @@ class BreakerConfig:
         self.ewma_alpha = ewma_alpha
 
 
+@lockcheck.shared_field_guard
 class MemberBreaker:
     """One member's circuit state.  Thread-safe; the CLOSED fast paths
     (``allow`` with a closed breaker, ``note_ok`` with no failure
     history) are lock-free attribute reads so the per-(object, cluster)
     hot loops pay nothing while the fleet is healthy."""
+
+    # Circuit state shared by every dispatch/sync thread of the fleet
+    # (ktlint lock-discipline + runtime/lockcheck.py); reads may be
+    # lock-free (the documented fast paths), writes never.
+    _shared_fields_ = {
+        "_state": "_lock",
+        "_consecutive": "_lock",
+        "_opened_at": "_lock",
+        "_probe_inflight": "_lock",
+        "_ewma_latency": "_lock",
+        "_failures_total": "_lock",
+        "_opens_total": "_lock",
+        "_last_error_at": "_lock",
+    }
 
     def __init__(self, name: str, config: BreakerConfig,
                  registry: Optional["BreakerRegistry"] = None,
@@ -119,7 +136,7 @@ class MemberBreaker:
         self.config = config
         self._registry = registry
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("breaker")
         self._state = CLOSED
         self._consecutive = 0
         self._opened_at = 0.0
@@ -327,15 +344,26 @@ class MemberBreaker:
 _REGISTRIES: "weakref.WeakSet[BreakerRegistry]" = weakref.WeakSet()
 
 
+@lockcheck.shared_field_guard
 class BreakerRegistry:
     """One fleet's breakers + shed/retry accounting + telemetry."""
+
+    _shared_fields_ = {
+        "_breakers": "_lock",
+        "_callbacks": "_lock",
+        "_shed": "_lock",
+        "_retries": "_lock",
+        "_write_lat": "_lock",
+        "_write_ops": "_lock",
+        "_write_flushes": "_lock",
+    }
 
     def __init__(self, metrics=None, config: Optional[BreakerConfig] = None,
                  clock=time.monotonic):
         self.metrics = metrics
         self.config = config or BreakerConfig()
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("breaker-registry")
         self._breakers: dict[str, MemberBreaker] = {}
         self._callbacks: list[TransitionCallback] = []
         self._shed: dict[str, int] = {}

@@ -18,6 +18,13 @@ import os
 import re
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Concurrency harness (runtime/lockcheck.py): instrumented locks +
+# declared-shared-field write guard, ON for the whole suite (default
+# off in production).  Must be set before any kubeadmiral_tpu import —
+# lock construction and class decoration read it.  An explicit ambient
+# setting (bisecting with it off) is respected.
+os.environ.setdefault("KT_LOCKCHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 match = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
 if match and int(match.group(1)) >= 8:

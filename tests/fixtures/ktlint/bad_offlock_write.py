@@ -1,0 +1,19 @@
+# ktlint fixture: known-BAD for lock-discipline.
+# Declared-shared fields mutated without the declared lock — the PR-3
+# race class (a worker thread persisting state lock-free).
+import threading
+
+
+class BadShared:
+    _shared_fields_ = {"_pending": "_lock", "_seq": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._seq = 0
+
+    def enqueue(self, item):
+        self._pending.append(item)
+
+    def bump(self):
+        self._seq += 1

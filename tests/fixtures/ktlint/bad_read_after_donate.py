@@ -1,0 +1,20 @@
+# ktlint fixture: known-BAD for donation-discipline.
+# `prev` is donated into the tick dispatch, then read afterwards — its
+# device buffer is dead (aliased into the outputs).
+import jax
+
+
+def _tick_impl(inp, prev):
+    return inp, prev
+
+
+class BadDispatch:
+    def _build(self):
+        donate = (1,) if self.donate else ()
+        self._tick = self._aot.wrap(
+            "tick", jax.jit(_tick_impl, donate_argnums=donate)
+        )
+
+    def run(self, inp, prev):
+        out, mask = self._tick(inp, prev)
+        return out, prev[0].sum()

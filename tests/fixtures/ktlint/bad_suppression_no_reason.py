@@ -1,0 +1,9 @@
+# ktlint fixture: known-BAD for suppression-format.
+# A suppression without a written justification is itself a violation
+# (and does NOT silence the underlying rule).
+import jax
+
+
+@jax.jit  # ktlint: ignore[aot-ledger-coverage]
+def sneaky(x):
+    return x

@@ -1,0 +1,14 @@
+# ktlint fixture: known-BAD for sharding-discipline.
+# A device sort with no declared contract — under GSPMD a sharded
+# cluster axis would shard-sum this silently.
+import jax.numpy as jnp
+from jax import lax
+
+
+def rank_clusters(scores):
+    comp = scores.astype(jnp.int64)
+    return lax.sort(comp, dimension=-1)
+
+
+def running_share(weights):
+    return jnp.cumsum(weights, axis=-1)

@@ -1,0 +1,9 @@
+# ktlint fixture: known-BAD for knob-catalog.
+# Reads of KT_* knobs that are not in runtime/knob_catalog.py.
+import os
+
+
+def tuning():
+    a = os.environ.get("KT_TOTALLY_UNDECLARED_KNOB", "1")
+    b = os.environ["KT_ANOTHER_ROGUE_KNOB"]
+    return a, b

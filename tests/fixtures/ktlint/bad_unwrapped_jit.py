@@ -1,0 +1,17 @@
+# ktlint fixture: known-BAD for aot-ledger-coverage.
+# A builder that jits and dispatches without AotStore.wrap or
+# _obs_wrap — the program escapes warm-boot preload AND the ledger.
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated_escape(x):
+    return x + 1
+
+
+class BadEngine:
+    def _rogue_program(self):
+        fn = jax.jit(lambda x: jnp.sum(x))
+        self._cache = fn
+        return fn

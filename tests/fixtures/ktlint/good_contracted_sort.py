@@ -1,0 +1,32 @@
+# ktlint fixture: known-GOOD twin for sharding-discipline.
+# The same sorts under declared contracts (and one nested helper whose
+# enclosing function carries the declaration).
+import jax.numpy as jnp
+from jax import lax
+
+from kubeadmiral_tpu.parallel import shardguard
+
+
+@shardguard.rows_first
+def rank_clusters(scores):
+    comp = scores.astype(jnp.int64)
+    return lax.sort(comp, dimension=-1)
+
+
+@shardguard.rows_only
+def pack_plane(plane):
+    def inner(p):
+        return jnp.cumsum(p, axis=-1)
+
+    return inner(plane)
+
+
+@shardguard.replicated
+def global_rank(totals):
+    return jnp.argmax(totals, axis=-1)
+
+
+def host_only(rows):
+    import numpy as np
+
+    return np.sort(rows)  # host numpy: exempt, nothing shards it

@@ -1,0 +1,16 @@
+# ktlint fixture: known-GOOD twin for knob-catalog.
+# Cataloged knobs, through both the direct and the helper idiom; the
+# leading-underscore subprocess sentinel is exempt by convention.
+import os
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    return float(raw) if raw else default
+
+
+def tuning():
+    depth = int(os.environ.get("KT_PIPELINE_DEPTH", "16"))
+    deadline = _env_float("KT_DISPATCH_DEADLINE_S", 30.0)
+    internal = os.environ.get("_KT_DRYRUN_SUBPROCESS")
+    return depth, deadline, internal

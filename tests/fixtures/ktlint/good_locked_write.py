@@ -1,0 +1,29 @@
+# ktlint fixture: known-GOOD twin for lock-discipline.
+# Every mutation path: lexical with-block, the *_locked convention,
+# and an @assumes_held method (runtime-verified under KT_LOCKCHECK).
+import threading
+
+from kubeadmiral_tpu.runtime import lockcheck
+
+
+class GoodShared:
+    _shared_fields_ = {"_pending": "_lock", "_seq": "_lock"}
+
+    def __init__(self):
+        self._lock = lockcheck.make_lock("good-shared")
+        self._pending = []
+        self._seq = 0
+
+    def enqueue(self, item):
+        with self._lock:
+            self._pending.append(item)
+            self._seq += 1
+
+    def _drain_locked(self):
+        drained = list(self._pending)
+        self._pending.clear()
+        return drained
+
+    @lockcheck.assumes_held("_lock")
+    def reset_seq(self):
+        self._seq = 0

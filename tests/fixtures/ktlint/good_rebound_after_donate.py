@@ -1,0 +1,33 @@
+# ktlint fixture: known-GOOD twin for donation-discipline.
+# Donated buffers are rebound from the dispatch result (the repair-
+# chain threading idiom) or simply never read again; a read in the
+# OTHER arm of a branch is an alternative, not a continuation.
+import jax
+
+
+def _tick_impl(inp, prev):
+    return inp, prev
+
+
+class GoodDispatch:
+    def _build(self):
+        donate = (1,) if self.donate else ()
+        self._tick = self._aot.wrap(
+            "tick", jax.jit(_tick_impl, donate_argnums=donate)
+        )
+
+    def run(self, inp, prev):
+        out, mask = self._tick(inp, prev)
+        return out
+
+    def run_threaded(self, inp, prev):
+        # Rebind-from-result: the returned planes REPLACE the dead ones.
+        out, prev = self._tick(inp, prev)
+        return out, prev
+
+    def run_branched(self, inp, prev, narrow):
+        if narrow:
+            out, mask = self._tick(inp, prev)
+        else:
+            out = self._dense(inp, prev)
+        return out

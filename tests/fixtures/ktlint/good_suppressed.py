@@ -1,0 +1,10 @@
+# ktlint fixture: known-GOOD for the suppression mechanism.
+# A justified suppression (comment-above form) silences exactly the
+# named rule on the next line.
+import jax
+
+
+# ktlint: ignore[aot-ledger-coverage] fixture: oracle entry point outside the dispatch surface
+@jax.jit
+def oracle_entry(x):
+    return x

@@ -1,0 +1,21 @@
+# ktlint fixture: known-GOOD twin for aot-ledger-coverage.
+# The builder idiom: jit -> AotStore.wrap -> _obs_wrap, plus the
+# _build_programs / _instrument_programs split (wrap in another method).
+import jax
+import jax.numpy as jnp
+
+
+class GoodEngine:
+    def _builder_program(self):
+        fn = jax.jit(lambda x: jnp.sum(x))
+        fn = self._aot.wrap("builder", fn)
+        fn = self._obs_wrap("builder", fn)
+        self._cache = fn
+        return fn
+
+    def _build_programs(self):
+        aot = self._aot.wrap
+        self._tick = aot("tick", jax.jit(lambda x: x * 2))
+
+    def _instrument_programs(self):
+        self._tick = self._obs_wrap("tick", self._tick)

@@ -1,77 +1,43 @@
-"""AOT + ledger coverage guard (ISSUE 10 satellite).
+"""AOT + ledger coverage guard (ISSUE 10 satellite; source half
+rewritten over ktlint in ISSUE 14).
 
 Every jitted program the engine can dispatch must route through BOTH
 ``AotStore.wrap`` (so warm-boot failover can preload it instead of
 re-tracing) and ``SchedulerEngine._obs_wrap`` (so the dispatch ledger
 attributes its device time).  A builder that skips either silently
-escapes restart failover or /debug/waterfall — the replan/score-only/
-tiebreak kernels of this PR are exactly the kind of addition that could
-slip through.
+escapes restart failover or /debug/waterfall.
 
 Two teeth:
 
-* a SOURCE enumeration: every ``jax.jit(`` call site inside
-  ``scheduler/engine.py`` must live in a method on the expected list —
-  adding a new builder without extending this test fails it;
+* the STATIC half is ktlint's ``aot-ledger-coverage`` rule (tools/
+  ktlint/rules/aot_ledger.py), which replaced this file's hand-rolled
+  regex enumeration of ``scheduler/engine.py`` with a package-wide AST
+  pass — here we assert the rule runs clean over the live tree AND that
+  it actually saw the engine's jit sites (so an AST regression cannot
+  pass vacuously);
 * a RUNTIME check: each builder's product carries the AOT wrapper
   inside the ledger wrapper (single-device engines; meshes construct
-  the store disabled by design and are excluded from the AOT contract).
+  the store live-trace-only and their wrap is a counted pass-through).
 """
-
-import re
 
 import pytest
 
 from kubeadmiral_tpu.scheduler import aot as aot_mod
-from kubeadmiral_tpu.scheduler import engine as engine_mod
 from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
-
-# Methods (or module functions) of scheduler/engine.py that may contain
-# jax.jit call sites.  Every one is exercised by the runtime half below;
-# a NEW jit site must be added here AND covered there.
-EXPECTED_JIT_SITES = {
-    "_build_programs",       # tick/tick_compact/gathers/overflow/patch/stack
-    "_zeros_for",            # zero prev-plane builders
-    "_narrow_program",
-    "_fallback_program",
-    "_cert_repair_program",
-    "_pack_program",
-    "_gate_program",
-    "_wcheck_program",
-    "_resolve_program",
-    "_replan_program",       # replan + score-only variants
-    "_survivor_program",     # unified survivor kernel (ISSUE 11)
-    "_nfeas_program",        # cached per-row feasible-count reduce
-    "_tb_program",           # tiebreak plane full/patch builders
-    "_repair_program",
-    "_prewarm_ladder",       # the transient prewarm-only repair chain seed
-    "_sco_compress_program",  # f16 score-plane compress + exactness (ISSUE 12)
-    "_sco_upcast_program",    # f16 -> i32 upcast for diff/gate consumers
-}
+from tools.ktlint import rule_by_id, run_rules
 
 
-def test_source_enumerates_every_jit_site():
-    src = open(engine_mod.__file__).read()
-    # Walk jit call sites back to their enclosing def.
-    sites = set()
-    defs = [
-        (m.start(), m.group(1))
-        for m in re.finditer(r"\n    def (\w+)\(", src)
-    ]
-    for m in re.finditer(r"jax\.jit\(", src):
-        owner = None
-        for pos, name in defs:
-            if pos < m.start():
-                owner = name
-            else:
-                break
-        assert owner is not None, "jax.jit outside any method"
-        sites.add(owner)
-    assert sites == EXPECTED_JIT_SITES, (
-        "engine jit call sites changed; update EXPECTED_JIT_SITES and "
-        "extend the runtime coverage below",
-        sites ^ EXPECTED_JIT_SITES,
-    )
+def test_ktlint_aot_rule_is_clean_package_wide():
+    """One source of truth: the same rule `make lint` enforces.  Any
+    new jit site anywhere in kubeadmiral_tpu/ must route through
+    aot.wrap + _obs_wrap (or carry a justified suppression) before this
+    passes — the generalization of the old EXPECTED_JIT_SITES list."""
+    rule = rule_by_id("aot-ledger-coverage")
+    violations, _ = run_rules([rule])
+    assert [v.format() for v in violations] == []
+    # The denominator: engine.py alone holds 40+ jit call sites; fewer
+    # seen means the walker lost the tree, not that the tree is clean.
+    assert rule.stats["jit_sites"] >= 40
 
 
 def _is_aot_wrapped(fn) -> bool:

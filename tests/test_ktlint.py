@@ -1,0 +1,125 @@
+"""ktlint's own coverage (ISSUE 14 satellite): every rule trips on its
+known-bad fixture, passes its known-good twin, and the full-tree run is
+clean — the `make lint` contract, asserted from the suite so a rule
+regression (or a repo regression) fails tests even when `make lint`
+is skipped.
+
+Fixtures live in tests/fixtures/ktlint/ and are PARSED, never imported
+— a fixture full of deliberate violations must lint without executing.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from kubeadmiral_tpu.runtime.knob_catalog import KNOBS, KnobSpec
+from tools.ktlint import all_rules, run, run_rules, rule_by_id
+
+FIXTURES = Path(__file__).parent / "fixtures" / "ktlint"
+
+
+def _run_rule(rule_id: str, *fixtures: str):
+    rule = rule_by_id(rule_id)
+    violations, _ = run_rules([rule], paths=[FIXTURES / f for f in fixtures])
+    return [v for v in violations if v.rule == rule_id], rule
+
+
+# -- per-rule fixture pairs: bad must trip, good twin must pass ----------
+
+CASES = [
+    ("aot-ledger-coverage", "bad_unwrapped_jit.py", "good_wrapped_jit.py"),
+    ("sharding-discipline", "bad_uncontracted_sort.py",
+     "good_contracted_sort.py"),
+    ("donation-discipline", "bad_read_after_donate.py",
+     "good_rebound_after_donate.py"),
+    ("knob-catalog", "bad_undeclared_knob.py", "good_declared_knob.py"),
+    ("lock-discipline", "bad_offlock_write.py", "good_locked_write.py"),
+]
+
+
+@pytest.mark.parametrize("rule_id,bad,good", CASES)
+def test_bad_fixture_trips(rule_id, bad, good):
+    violations, _ = _run_rule(rule_id, bad)
+    assert violations, f"{bad} must trip {rule_id}"
+
+
+@pytest.mark.parametrize("rule_id,bad,good", CASES)
+def test_good_twin_passes(rule_id, bad, good):
+    violations, _ = _run_rule(rule_id, good)
+    assert violations == [], (
+        f"{good} must pass {rule_id}: " + "\n".join(v.format() for v in violations)
+    )
+
+
+def test_bad_fixtures_trip_for_the_right_reason():
+    """Spot-check messages so a rule that trips on the WRONG line
+    doesn't vacuously satisfy the pair contract."""
+    v, _ = _run_rule("aot-ledger-coverage", "bad_unwrapped_jit.py")
+    assert any("@jax.jit" in x.message for x in v)
+    assert any("AotStore.wrap" in x.message for x in v)
+    v, _ = _run_rule("donation-discipline", "bad_read_after_donate.py")
+    assert any("'prev'" in x.message for x in v)
+    v, _ = _run_rule("knob-catalog", "bad_undeclared_knob.py")
+    assert {"KT_TOTALLY_UNDECLARED_KNOB", "KT_ANOTHER_ROGUE_KNOB"} <= {
+        x.message.split("'")[1] for x in v
+    }
+    v, _ = _run_rule("lock-discipline", "bad_offlock_write.py")
+    assert any(".append()" in x.message for x in v)
+    assert any("rebind" in x.message for x in v)
+
+
+# -- suppressions --------------------------------------------------------
+
+def test_suppression_with_reason_silences_the_rule():
+    violations, _ = run(
+        rule_ids=["aot-ledger-coverage"],
+        paths=[FIXTURES / "good_suppressed.py"],
+    )
+    assert violations == []
+
+
+def test_suppression_without_reason_is_itself_a_violation():
+    violations, _ = run(
+        rule_ids=["aot-ledger-coverage"],
+        paths=[FIXTURES / "bad_suppression_no_reason.py"],
+    )
+    rules_hit = {v.rule for v in violations}
+    # The malformed suppression reports AND does not silence the rule.
+    assert "suppression-format" in rules_hit
+    assert "aot-ledger-coverage" in rules_hit
+
+
+# -- the make-lint contract: full tree clean, denominators real ----------
+
+def test_full_tree_is_clean():
+    violations, summary = run()
+    assert violations == [], "\n".join(v.format() for v in violations)
+    assert set(summary.values()) == {0}
+
+
+def test_rules_actually_saw_the_tree():
+    """Zero violations must come from inspection, not a walker that
+    matched nothing.  The jit floor also replaces the old
+    test_aot_coverage source enumeration: engine.py alone holds 40+
+    sites, so a count below that means the rule lost the tree."""
+    rules = all_rules()
+    run_rules(rules)
+    stats = {r.id: r.stats for r in rules}
+    assert stats["aot-ledger-coverage"]["jit_sites"] >= 40
+    assert stats["sharding-discipline"]["sort_sites"] >= 10
+    assert stats["donation-discipline"]["dispatch_sites"] >= 10
+    assert stats["knob-catalog"]["knob_reads"] >= 60
+    assert stats["lock-discipline"]["declared_classes"] >= 5
+    assert stats["lock-discipline"]["mutation_sites"] >= 50
+
+
+# -- knob catalog shape --------------------------------------------------
+
+def test_knob_catalog_shape():
+    assert len(KNOBS) >= 60
+    for name, spec in KNOBS.items():
+        assert name.startswith("KT_"), name
+        assert isinstance(spec, KnobSpec)
+        assert spec.type in ("bool", "int", "float", "str", "path"), name
+        assert spec.anchor in ("operations.md", "observability.md"), name
+        assert spec.help, name

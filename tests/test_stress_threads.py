@@ -15,6 +15,8 @@ import time
 
 from test_e2e_slice import make_deployment, make_node
 
+from kubeadmiral_tpu.runtime import lockcheck
+
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation.clusterctl import (
     FEDERATED_CLUSTERS,
@@ -37,6 +39,13 @@ from kubeadmiral_tpu.transport.faults import FaultInjector, FaultPolicy, FaultyK
 
 class TestThreadStress:
     def test_concurrent_controllers_survive_event_storm(self):
+        # The -race half (ISSUE 14): the storm runs under the lockcheck
+        # harness — every make_lock() in the stack records acquisition
+        # order, every _shared_fields_ rebind checks its lock — and the
+        # test fails on any inversion or off-lock write the fuzz
+        # surfaced, even when the race didn't LOSE this run.
+        assert lockcheck.enabled(), "conftest must enable KT_LOCKCHECK"
+        lockcheck.reset()
         ftc = dataclasses.replace(
             next(f for f in default_ftcs() if f.name == "deployments.apps"),
             controllers=(("kubeadmiral.io/global-scheduler",),),
@@ -103,6 +112,9 @@ class TestThreadStress:
         for ctl in controllers:
             leaked = [t.name for t in ctl.worker._threads if t.is_alive()]
             assert not leaked, leaked
+        # Zero lock-order inversions, zero declared-shared fields
+        # touched lock-free, across everything the storm drove.
+        assert lockcheck.violations() == []
 
     def _storm_and_converge(self, fleet, ftc, controllers):
         fuzz_errors: list[BaseException] = []
@@ -157,7 +169,7 @@ class TestThreadStress:
 
         threads = [
             threading.Thread(target=fuzz, args=(seed,), daemon=True)
-            for seed in range(4)
+            for seed in range(6)
         ]
         for t in threads:
             t.start()
@@ -205,6 +217,163 @@ class TestThreadStress:
             if last is None:
                 break
         assert last is None, last
+
+
+class TestLockcheckHarness:
+    """The deterministic half of the -race analogue (ISSUE 14): the
+    lockcheck harness itself must catch the bug classes the storm can
+    only catch probabilistically."""
+
+    def test_lock_order_inversion_detected(self):
+        lockcheck.reset()
+        a = lockcheck.CheckedLock("order-a")
+        b = lockcheck.CheckedLock("order-b")
+        with a:
+            with b:
+                pass
+        # Opposite order on ONE thread is enough: the graph remembers.
+        with b:
+            with a:
+                pass
+        found = [v for v in lockcheck.violations()
+                 if "lock-order-inversion" in v]
+        assert found, "A->B then B->A must be reported"
+        lockcheck.reset()
+
+    def test_same_name_nesting_is_not_an_inversion(self):
+        lockcheck.reset()
+        a1 = lockcheck.CheckedLock("same-class")
+        a2 = lockcheck.CheckedLock("same-class")
+        with a1:
+            with a2:
+                pass
+        with a2:
+            with a1:
+                pass
+        assert lockcheck.violations() == []
+
+    def test_shared_field_guard_detects_offlock_rebind(self):
+        lockcheck.reset()
+
+        @lockcheck.shared_field_guard
+        class Box:
+            _shared_fields_ = {"value": "_lock"}
+
+            def __init__(self):
+                self._lock = lockcheck.make_lock("box")
+                self.value = 0  # pre-publication: exempt
+
+            def good(self, v):
+                with self._lock:
+                    self.value = v
+
+            def bad(self, v):
+                self.value = v
+
+        box = Box()
+        box.good(1)
+        assert lockcheck.violations() == []
+        box.bad(2)
+        found = [v for v in lockcheck.violations()
+                 if "shared-field-write" in v and "Box.value" in v]
+        assert found, "off-lock rebind of a declared field must report"
+        lockcheck.reset()
+
+    def test_assumes_held_verified_at_runtime(self):
+        lockcheck.reset()
+
+        class Engineish:
+            def __init__(self):
+                self._lock = lockcheck.make_lock("engineish")
+
+            @lockcheck.assumes_held("_lock")
+            def inner(self):
+                return True
+
+        e = Engineish()
+        with e._lock:
+            e.inner()
+        assert lockcheck.violations() == []
+        e.inner()
+        found = [v for v in lockcheck.violations() if "assumes-held" in v]
+        assert found, "entering an @assumes_held method lock-free must report"
+        lockcheck.reset()
+
+    def test_streaming_storm_is_lockcheck_clean(self):
+        """Widened storm surface: concurrent producers feed the
+        streaming front-end while a pump thread flushes through a real
+        engine — the PR-3 shape (worker thread persisting through an
+        engine tick) under the harness, driving the
+        streaming/engine/aot/flightrec lock set the controller storm
+        above never touches."""
+        from kubeadmiral_tpu.models.types import (
+            ClusterState,
+            SchedulingUnit,
+            parse_resources,
+        )
+        from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+        from kubeadmiral_tpu.scheduler.streaming import StreamingScheduler
+
+        lockcheck.reset()
+        gvk = "apps/v1/Deployment"
+        clusters = [
+            ClusterState(
+                name=f"c{j}",
+                allocatable=parse_resources({"cpu": "64"}),
+                available=parse_resources({"cpu": "64"}),
+                api_resources=frozenset({gvk}),
+            )
+            for j in range(4)
+        ]
+        engine = SchedulerEngine(chunk_size=32)
+        stream = StreamingScheduler(
+            engine, clusters, slab_rows=16, slab_age_ms=5.0, grow_block=32
+        )
+        stop = threading.Event()
+        errors: list[BaseException] = []
+
+        def produce(seed: int):
+            rng = random.Random(seed)
+            try:
+                for _ in range(80):
+                    name = f"obj-{seed}-{rng.randint(0, 15)}"
+                    if rng.random() < 0.8:
+                        stream.offer(SchedulingUnit(
+                            gvk=gvk, namespace="storm", name=name,
+                            desired_replicas=rng.randint(1, 5),
+                            resource_request=parse_resources(
+                                {"cpu": "100m"}
+                            ),
+                        ))
+                    else:
+                        stream.remove(f"storm/{name}")
+                    time.sleep(0.001)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def pump_loop():
+            try:
+                while not stop.is_set():
+                    stream.pump()
+                    time.sleep(0.002)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        producers = [
+            threading.Thread(target=produce, args=(s,), daemon=True)
+            for s in range(3)
+        ]
+        pump_thread = threading.Thread(target=pump_loop, daemon=True)
+        pump_thread.start()
+        for t in producers:
+            t.start()
+        for t in producers:
+            t.join(timeout=60)
+        stop.set()
+        pump_thread.join(timeout=60)
+        assert not errors, errors
+        stream.flush()
+        assert lockcheck.violations() == []
 
 
 class TestThreadStressHTTP:

@@ -762,6 +762,63 @@ def report_e2e_chaos(root: Path) -> None:
         return
 
 
+def gate_ktlint(root: Path) -> int:
+    """Fail when a previously-clean static-analysis rule regresses
+    (ISSUE 14).  Every BENCH_r*.json embeds ``detail.ktlint`` — the
+    per-rule violation counts of ``make lint`` at bench time.  The
+    newest round must report 0 for any rule that was 0 in EVERY prior
+    round that reported it; a rule first seen this round (a new rule
+    family) seeds the baseline instead of gating.  Rounds predating the
+    embed are skipped on both sides."""
+    reported: list[tuple[str, dict]] = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        if not _ROUND_RE.match(path.name):
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue  # load_rounds already reported malformed artifacts
+        kt = ((doc.get("parsed") or {}).get("detail") or {}).get("ktlint")
+        if isinstance(kt, dict) and "error" not in kt:
+            reported.append((path.name, kt))
+        elif isinstance(kt, dict):
+            print(
+                f"bench-gate: WARNING: {path.name} ktlint summary errored "
+                f"({kt.get('error')}) — static-analysis NOT gated for it"
+            )
+    if not reported:
+        print("bench-gate: no rounds embed detail.ktlint yet; not gated")
+        return 0
+    latest_name, latest = reported[-1]
+    priors = reported[:-1]
+    ok = True
+    for rule, count in sorted(latest.items()):
+        prior_counts = [kt[rule] for _, kt in priors if rule in kt]
+        if not prior_counts:
+            if count:
+                print(
+                    f"bench-gate: note: new ktlint rule {rule!r} seeds "
+                    f"with {count} violation(s); it gates from the next "
+                    f"round"
+                )
+            continue
+        if min(prior_counts) == 0 and count > 0:
+            ok = False
+            print(
+                f"bench-gate: FAIL {latest_name}: ktlint rule {rule!r} "
+                f"regressed to {count} violation(s) — it was clean in a "
+                f"prior round; fix the violations (or suppress with a "
+                f"written reason) before the round can gate green"
+            )
+    if ok:
+        print(
+            f"bench-gate: ktlint summary ok ({latest_name}: "
+            f"{sum(latest.values())} violation(s) across "
+            f"{len(latest)} rules)"
+        )
+    return 0 if ok else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -780,8 +837,9 @@ def main() -> int:
     restart_rc = gate_restart(args.root, args.tolerance)
     census_rc = gate_census(args.root)
     e2e_rc = gate_e2e(args.root, args.tolerance)
+    ktlint_rc = gate_ktlint(args.root)
     report_e2e_chaos(args.root)
-    return rc or churn_rc or restart_rc or census_rc or e2e_rc
+    return rc or churn_rc or restart_rc or census_rc or e2e_rc or ktlint_rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,55 @@
+"""ktlint: the repo-specific static analyzer (``make lint``).
+
+Public surface:
+
+* ``python -m tools.ktlint [--json] [--rule ID] [paths...]`` — the CLI
+  ``make lint`` runs (and ``make test`` runs ``lint``).
+* :func:`run` — programmatic run, returns (violations, summary).
+* :func:`summary` — ``{rule-id: violation-count}`` over the full tree;
+  what bench.py embeds under ``detail.ktlint`` and
+  ``tools/bench_gate.py`` gates on.
+
+See docs/static_analysis.md for the rule catalog and suppression
+policy, and tests/test_ktlint.py + tests/fixtures/ktlint/ for the
+per-rule known-bad/known-good fixtures.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+# Run both as `python -m tools.ktlint` from the repo root and as an
+# imported helper from bench/tests: the repo root must be importable.
+_REPO = Path(__file__).resolve().parent.parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from tools.ktlint.engine import (  # noqa: E402
+    Rule,
+    SourceFile,
+    Violation,
+    main,
+    run_rules,
+)
+from tools.ktlint.rules import all_rules, rule_by_id  # noqa: E402
+
+
+def run(rule_ids=None, paths=None):
+    """(violations, summary) for the given rules (default: all)."""
+    rules = all_rules()
+    if rule_ids is not None:
+        rules = [r for r in rules if r.id in set(rule_ids)]
+    return run_rules(rules, paths=paths)
+
+
+def summary() -> dict[str, int]:
+    """Full-tree per-rule violation counts (zeros included)."""
+    _, counts = run()
+    return counts
+
+
+__all__ = [
+    "Rule", "SourceFile", "Violation", "all_rules", "rule_by_id",
+    "run", "run_rules", "summary", "main",
+]

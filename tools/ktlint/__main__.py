@@ -1,0 +1,8 @@
+"""``python -m tools.ktlint`` — see tools/ktlint/__init__.py."""
+
+import sys
+
+from tools.ktlint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,250 @@
+"""ktlint core: source loading, suppressions, rule protocol, reporting.
+
+ktlint is the repo-specific static analyzer (``make lint``): an
+AST-based pass that turns conventions the code reviews kept re-litigating
+— AOT/ledger routing of jit sites, the pack-sort sharding rule, donated
+-buffer hygiene, the knob catalog, lock discipline — into machine-checked
+rules.  See docs/static_analysis.md for the rule catalog and policy.
+
+Design notes:
+
+* Rules are AST-only on the scanned tree — no imports of scanned
+  modules, so a fixture file full of deliberate violations (or a
+  half-written module) lints without executing.  The one exception is
+  the knob rule's catalog, imported from
+  ``kubeadmiral_tpu.runtime.knob_catalog`` (dependency-free).
+* Suppressions are source comments, same line or the line above::
+
+      # ktlint: ignore[rule-id] reason the invariant doesn't apply here
+
+  The reason is mandatory: a bare ``ignore[rule-id]`` is itself a
+  violation (``suppression-format``).  Suppressions are per-line and
+  per-rule; there is no file-level or wildcard opt-out.
+* Output: human one-per-line (``path:line: [rule] message``) or
+  ``--json`` ``{"violations": [...], "summary": {rule: count}}``.  The
+  summary always carries every registered rule (zeros included) — it is
+  what bench.py embeds in BENCH detail and tools/bench_gate.py gates
+  on (a previously-clean rule regressing fails the round).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional, Sequence
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+SUPPRESS_RE = re.compile(r"#\s*ktlint:\s*ignore\[([a-z0-9*-]+)\]\s*(.*?)\s*$")
+
+# Default tree every rule scans unless it declares its own roots.
+DEFAULT_ROOTS: tuple[str, ...] = ("kubeadmiral_tpu",)
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative, posix
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path,
+            "line": self.line, "message": self.message,
+        }
+
+
+@dataclass
+class SourceFile:
+    path: Path
+    rel: str
+    text: str
+    tree: ast.Module
+    # line -> {rule_id: reason}; a suppression comment covers its own
+    # line and the line below (the comment-above idiom).
+    suppressions: dict[int, dict[str, str]] = field(default_factory=dict)
+    bad_suppressions: list[Violation] = field(default_factory=list)
+
+
+def load_source(path: Path, repo: Path = REPO) -> SourceFile:
+    path = Path(path).resolve()
+    text = path.read_text()
+    tree = ast.parse(text, filename=str(path))
+    try:
+        rel = path.relative_to(repo).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    src = SourceFile(path=path, rel=rel, text=text, tree=tree)
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        m = SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rule_id, reason = m.group(1), m.group(2)
+        if not reason:
+            src.bad_suppressions.append(Violation(
+                "suppression-format", rel, lineno,
+                f"suppression of [{rule_id}] has no written justification "
+                f"— `# ktlint: ignore[{rule_id}] <reason>` is mandatory "
+                f"(docs/static_analysis.md, suppression policy)",
+            ))
+            continue
+        for covered in (lineno, lineno + 1):
+            src.suppressions.setdefault(covered, {})[rule_id] = reason
+    return src
+
+
+class Rule:
+    """One rule family.  Subclasses set ``id``/``doc`` and implement
+    :meth:`check`; ``roots`` widens the scanned tree beyond the
+    package (repo-relative files or directories)."""
+
+    id: str = ""
+    doc: str = ""
+    roots: tuple[str, ...] = DEFAULT_ROOTS
+
+    def __init__(self) -> None:
+        # Denominator stats (sites inspected etc.) so callers can assert
+        # the rule actually SAW the tree — a zero-violation result from
+        # an AST walk that matched nothing must not read as clean.
+        self.stats: dict[str, int] = {}
+        # True when check() runs over an explicit file list (fixtures)
+        # instead of the rule's full roots; repo-global cross-checks
+        # (docs/catalog closure) only make sense on a full scan.
+        self.partial: bool = False
+
+    def check(self, files: Sequence[SourceFile]) -> list[Violation]:
+        raise NotImplementedError
+
+
+def collect_files(
+    roots: Iterable[str], repo: Path = REPO,
+) -> list[SourceFile]:
+    files: list[SourceFile] = []
+    seen: set[Path] = set()
+    for root in roots:
+        path = repo / root
+        candidates = sorted(path.rglob("*.py")) if path.is_dir() else [path]
+        for f in candidates:
+            if not f.exists() or f in seen or "__pycache__" in f.parts:
+                continue
+            seen.add(f)
+            files.append(load_source(f, repo))
+    return files
+
+
+def run_rules(
+    rules: Sequence[Rule],
+    repo: Path = REPO,
+    paths: Optional[Sequence[Path]] = None,
+) -> tuple[list[Violation], dict[str, int]]:
+    """Run ``rules``; returns (violations, summary).  ``paths`` overrides
+    each rule's roots with an explicit file set (fixture runs)."""
+    cache: dict[tuple[str, ...], list[SourceFile]] = {}
+    violations: list[Violation] = []
+    summary: dict[str, int] = {r.id: 0 for r in rules}
+    summary["suppression-format"] = 0
+    bad_suppression_files: set[str] = set()
+    for rule in rules:
+        rule.partial = paths is not None
+        if paths is not None:
+            files = [load_source(Path(p), repo) for p in paths]
+        else:
+            files = cache.get(rule.roots)
+            if files is None:
+                files = collect_files(rule.roots, repo)
+                cache[rule.roots] = files
+        for f in files:
+            if f.rel not in bad_suppression_files:
+                bad_suppression_files.add(f.rel)
+                for v in f.bad_suppressions:
+                    violations.append(v)
+                    summary["suppression-format"] += 1
+        for v in rule.check(files):
+            suppressed = files_suppression(files, v)
+            if suppressed is not None:
+                continue
+            violations.append(v)
+            summary[rule.id] = summary.get(rule.id, 0) + 1
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations, summary
+
+
+def files_suppression(
+    files: Sequence[SourceFile], v: Violation,
+) -> Optional[str]:
+    """The suppression reason covering ``v``, or None."""
+    for f in files:
+        if f.rel == v.path:
+            return f.suppressions.get(v.line, {}).get(v.rule)
+    return None
+
+
+def render_human(violations: Sequence[Violation], summary: dict[str, int]) -> str:
+    lines = [v.format() for v in violations]
+    total = len(violations)
+    if total:
+        lines.append(f"ktlint: {total} violation(s)")
+    else:
+        lines.append("ktlint: ok")
+    return "\n".join(lines)
+
+
+def render_json(violations: Sequence[Violation], summary: dict[str, int]) -> str:
+    return json.dumps(
+        {
+            "violations": [v.as_dict() for v in violations],
+            "summary": dict(sorted(summary.items())),
+        },
+        indent=2,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    from tools.ktlint.rules import all_rules
+
+    parser = argparse.ArgumentParser(
+        prog="ktlint", description="repo-specific static analysis (make lint)"
+    )
+    parser.add_argument("--json", action="store_true", help="JSON output")
+    parser.add_argument(
+        "--rule", action="append", default=None,
+        help="run only this rule id (repeatable)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="explicit files to lint (default: each rule's roots)",
+    )
+    args = parser.parse_args(argv)
+    rules = all_rules()
+    if args.rule:
+        known = {r.id for r in rules}
+        unknown = set(args.rule) - known
+        if unknown:
+            print(f"ktlint: unknown rule(s) {sorted(unknown)}; "
+                  f"known: {sorted(known)}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in args.rule]
+    try:
+        violations, summary = run_rules(
+            rules, paths=args.paths or None
+        )
+    except SyntaxError as e:
+        print(f"ktlint: parse error: {e}", file=sys.stderr)
+        return 2
+    out = (
+        render_json(violations, summary)
+        if args.json
+        else render_human(violations, summary)
+    )
+    print(out)
+    return 1 if violations else 0

@@ -1,0 +1,30 @@
+"""ktlint rule registry.  Add a rule: implement ``engine.Rule`` in a
+module here, register it below, give it a fixtures pair under
+``tests/fixtures/ktlint/`` and a docs row in docs/static_analysis.md."""
+
+from __future__ import annotations
+
+from tools.ktlint.engine import Rule
+
+
+def all_rules() -> list[Rule]:
+    from tools.ktlint.rules.aot_ledger import AotLedgerRule
+    from tools.ktlint.rules.donation import DonationRule
+    from tools.ktlint.rules.knobs import KnobCatalogRule
+    from tools.ktlint.rules.locks import LockDisciplineRule
+    from tools.ktlint.rules.sharding import ShardingRule
+
+    return [
+        AotLedgerRule(),
+        ShardingRule(),
+        DonationRule(),
+        KnobCatalogRule(),
+        LockDisciplineRule(),
+    ]
+
+
+def rule_by_id(rule_id: str) -> Rule:
+    for rule in all_rules():
+        if rule.id == rule_id:
+            return rule
+    raise KeyError(rule_id)

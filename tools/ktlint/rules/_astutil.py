@@ -1,0 +1,111 @@
+"""Shared AST helpers for ktlint rules: parent links, dotted names,
+enclosing-scope walks, simple forward alias tracking."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    """Attach ``._kt_parent`` to every node (idempotent)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._kt_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_kt_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def dotted(node: ast.AST) -> str:
+    """``jax.lax.sort`` for an Attribute chain, ``sort`` for a Name;
+    "" for anything else (calls, subscripts)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def terminal_name(node: ast.AST) -> str:
+    """The last segment of a Name/Attribute (decorator matching)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def enclosing_functions(node: ast.AST) -> list[ast.FunctionDef]:
+    """Innermost-first chain of enclosing function defs."""
+    out = []
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(anc)
+    return out
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    for anc in ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+def enclosing_statement(node: ast.AST) -> ast.stmt:
+    """The statement node containing ``node`` (node itself if a stmt)."""
+    cur: ast.AST = node
+    while not isinstance(cur, ast.stmt):
+        nxt = parent(cur)
+        if nxt is None:
+            raise ValueError("node outside any statement")
+        cur = nxt
+    return cur  # type: ignore[return-value]
+
+
+def is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    """``self.<attr>`` (any attr when attr is None)."""
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and (attr is None or node.attr == attr)
+    )
+
+
+def call_args(call: ast.Call) -> list[ast.expr]:
+    return list(call.args) + [kw.value for kw in call.keywords]
+
+
+def assign_targets(stmt: ast.stmt) -> list[ast.expr]:
+    if isinstance(stmt, ast.Assign):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return [stmt.target]
+    return []
+
+
+def name_ids(expr: ast.expr) -> set[str]:
+    """Plain Name ids in an expression (tuples flattened)."""
+    out: set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+    return out
+
+
+def end_line(node: ast.AST) -> int:
+    return getattr(node, "end_lineno", None) or node.lineno

@@ -1,0 +1,191 @@
+"""Rule aot-ledger-coverage: every ``jax.jit`` product routes through
+``AotStore.wrap`` AND the dispatch ledger's ``_obs_wrap``.
+
+Generalizes tests/test_aot_coverage.py's hand-rolled source enumeration
+(which covered ``scheduler/engine.py`` only) to the whole package: a
+jitted program a builder forgets to wrap silently escapes warm-boot
+failover (no export) and /debug/waterfall (no device-time attribution)
+— the exact bug class the replan/score-only/tiebreak kernels nearly
+shipped with.
+
+A jit site is AOT-ROUTED when the ``jax.jit(...)`` call is an argument
+of a ``*.wrap(...)`` / ``aot(...)`` call, directly or through local
+name flow inside the same function (``fn = jax.jit(...); fn =
+self._aot.wrap(key, fn)``).  It is LEDGER-ROUTED when the product (or
+an alias, or the ``self.<attr>`` it lands on) is passed to
+``_obs_wrap`` — anywhere in the same class, because ``_build_programs``
+assigns and ``_instrument_programs`` wraps.  ``@jax.jit`` decorators
+can never be routed and always flag (suppress with a written reason
+when the function is an oracle/test entry point the engine re-traces
+via ``__wrapped__``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ktlint.engine import Rule, SourceFile, Violation
+from tools.ktlint.rules import _astutil as A
+
+RULE_ID = "aot-ledger-coverage"
+
+
+def _is_jit(node: ast.AST) -> bool:
+    return A.dotted(node) in ("jax.jit",)
+
+
+def _is_wrap_call(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "wrap":
+        return True
+    if isinstance(func, ast.Name) and func.id == "aot":
+        return True
+    return False
+
+
+def _is_obs_call(call: ast.Call) -> bool:
+    return A.terminal_name(call.func) == "_obs_wrap"
+
+
+def _flow(
+    fn_def: ast.AST, start_stmt: ast.stmt, seeds: set[str],
+) -> tuple[bool, bool, set[str]]:
+    """Forward alias walk from ``seeds`` (the jit product's names)
+    through the enclosing def: returns (aot_routed, obs_routed,
+    self_attrs) where self_attrs are ``self.X`` attributes the product
+    (or a wrapped alias) is stored into."""
+    aot_ok = False
+    obs_ok = False
+    aliases = set(seeds)
+    self_attrs: set[str] = set()
+    stmts = sorted(
+        (s for s in ast.walk(fn_def) if isinstance(s, ast.stmt)),
+        key=lambda s: s.lineno,
+    )
+    for stmt in stmts:
+        if stmt.lineno < start_stmt.lineno:
+            continue
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            hits = any(
+                isinstance(a, ast.Name) and a.id in aliases
+                for a in A.call_args(call)
+            )
+            if not hits:
+                continue
+            if _is_wrap_call(call):
+                aot_ok = True
+            if _is_obs_call(call):
+                obs_ok = True
+            # Propagate through any single-call assignment:
+            # fn = self._obs_wrap("k", fn) keeps `fn` an alias.
+            outer = A.parent(call)
+            if isinstance(outer, ast.Assign):
+                for t in outer.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+                    elif A.is_self_attr(t):
+                        self_attrs.add(t.attr)  # type: ignore[union-attr]
+                    elif isinstance(t, ast.Subscript) and A.is_self_attr(
+                        t.value
+                    ):
+                        pass  # program-cache store; routing already decided
+    return aot_ok, obs_ok, self_attrs
+
+
+def _class_obs_attrs(cls: ast.ClassDef) -> set[str]:
+    """``self.X`` attribute names the class passes to ``_obs_wrap``
+    anywhere (the _build_programs / _instrument_programs split)."""
+    out: set[str] = set()
+    for call in ast.walk(cls):
+        if isinstance(call, ast.Call) and _is_obs_call(call):
+            for a in A.call_args(call):
+                if A.is_self_attr(a):
+                    out.add(a.attr)  # type: ignore[union-attr]
+    return out
+
+
+class AotLedgerRule(Rule):
+    id = RULE_ID
+    doc = __doc__
+
+    def check(self, files):
+        violations: list[Violation] = []
+        sites = 0
+        for f in files:
+            A.annotate_parents(f.tree)
+            for node in ast.walk(f.tree):
+                # @jax.jit decorators.
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for deco in node.decorator_list:
+                        target = (
+                            deco.func if isinstance(deco, ast.Call) else deco
+                        )
+                        if _is_jit(target) or (
+                            isinstance(deco, ast.Call)
+                            and A.terminal_name(deco.func) == "partial"
+                            and any(_is_jit(a) for a in deco.args)
+                        ):
+                            sites += 1
+                            violations.append(Violation(
+                                RULE_ID, f.rel, deco.lineno,
+                                f"@jax.jit on {node.name}() cannot route "
+                                f"through AotStore.wrap/_obs_wrap — jit at "
+                                f"the dispatch site instead, or suppress "
+                                f"with the reason this program is outside "
+                                f"the engine's dispatch surface",
+                            ))
+                if not (isinstance(node, ast.Call) and _is_jit(node.func)):
+                    continue
+                sites += 1
+                aot_ok = False
+                obs_ok = False
+                # Directly nested in a wrap()/aot() call?
+                for anc in A.ancestors(node):
+                    if isinstance(anc, ast.Call):
+                        if _is_wrap_call(anc):
+                            aot_ok = True
+                        if _is_obs_call(anc):
+                            obs_ok = True
+                    if isinstance(anc, ast.stmt):
+                        break
+                stmt = A.enclosing_statement(node)
+                targets = A.assign_targets(stmt)
+                seeds = {
+                    t.id for t in targets if isinstance(t, ast.Name)
+                }
+                attr_targets = {
+                    t.attr for t in targets if A.is_self_attr(t)
+                }
+                fns = A.enclosing_functions(node)
+                flow_attrs: set[str] = set()
+                if fns and (seeds or not (aot_ok and obs_ok)):
+                    fa, fo, flow_attrs = _flow(fns[0], stmt, seeds)
+                    aot_ok = aot_ok or fa
+                    obs_ok = obs_ok or fo
+                attr_targets |= flow_attrs
+                if not obs_ok and attr_targets:
+                    cls = A.enclosing_class(node)
+                    if cls is not None and (
+                        attr_targets & _class_obs_attrs(cls)
+                    ):
+                        obs_ok = True
+                if not aot_ok:
+                    violations.append(Violation(
+                        RULE_ID, f.rel, node.lineno,
+                        "jax.jit product does not route through "
+                        "AotStore.wrap — warm-boot failover cannot "
+                        "preload it (scheduler/aot.py)",
+                    ))
+                if not obs_ok:
+                    violations.append(Violation(
+                        RULE_ID, f.rel, node.lineno,
+                        "jax.jit product does not route through "
+                        "_obs_wrap — the dispatch ledger cannot "
+                        "attribute its device time (runtime/devprof.py)",
+                    ))
+        self.stats["jit_sites"] = sites
+        return violations

@@ -1,0 +1,301 @@
+"""Rule donation-discipline: a buffer passed at a ``donate_argnums``
+position must not be read after the dispatch in the same scope.
+
+Under donation XLA aliases the donated input's buffer into the
+program's outputs — the Python reference still exists but the device
+buffer is dead; a later read raises (best case) or, under some
+backends, silently observes aliased bytes.  The engine donates every
+tick's prev planes (``KT_DONATE``), so the hazard sits on the hottest
+dispatch path.
+
+Two passes per module:
+
+1. **Collect donating programs.**  ``jax.jit(fn, donate_argnums=...)``
+   sites are walked back through their wrappers (``aot(...)``,
+   ``*.wrap(...)``, ``_obs_wrap(...)``) to what the product is bound
+   to: ``self.X = ...`` marks attribute X donating; a builder method
+   that returns the product (the per-key program-cache idiom) marks the
+   METHOD donating, so ``self._narrow_program(fmt, m)(...)`` call sites
+   inherit the positions.  ``donate_argnums`` literals, the
+   ``(1,) if cond else ()`` pattern, and a local ``donate = ...``
+   binding all resolve; an unresolvable spec flags its own violation
+   (the analyzer — like the reader — cannot tell what dies).
+2. **Check dispatch sites.**  At each call of a donating program, the
+   names passed at donated positions (plain names and tuple elements)
+   must not be loaded later in the same function body unless rebound
+   first.  The walk is lexical (single forward pass by line), which is
+   exactly the scope the invariant names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.ktlint.engine import Rule, Violation
+from tools.ktlint.rules import _astutil as A
+
+RULE_ID = "donation-discipline"
+
+WRAPPERS = {"wrap", "aot", "_obs_wrap"}
+
+
+def _resolve_positions(
+    spec: ast.expr, fn_def: Optional[ast.AST],
+) -> Optional[set[int]]:
+    """Donated argument positions, or None when unresolvable."""
+    if isinstance(spec, ast.Constant) and isinstance(spec.value, int):
+        return {spec.value}
+    if isinstance(spec, ast.Tuple):
+        out: set[int] = set()
+        for el in spec.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    if isinstance(spec, ast.IfExp):
+        a = _resolve_positions(spec.body, fn_def)
+        b = _resolve_positions(spec.orelse, fn_def)
+        if a is None or b is None:
+            return None
+        return a | b
+    if isinstance(spec, ast.Name) and fn_def is not None:
+        # A local `donate = ...` binding (last one wins lexically).
+        binding = None
+        best = -1
+        for stmt in ast.walk(fn_def):
+            if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == spec.id
+                for t in stmt.targets
+            ):
+                if best < stmt.lineno < spec.lineno:
+                    binding = stmt.value
+                    best = stmt.lineno
+        if binding is not None:
+            return _resolve_positions(binding, None)
+    return None
+
+
+def _unwrap_to_binding(jit_call: ast.Call) -> tuple[
+    Optional[str], Optional[str], Optional[ast.stmt],
+]:
+    """(self_attr, local_name, stmt) the (possibly wrapper-nested) jit
+    product is bound to."""
+    node: ast.AST = jit_call
+    while True:
+        outer = A.parent(node)
+        if isinstance(outer, ast.Call) and (
+            A.terminal_name(outer.func) in WRAPPERS
+        ):
+            node = outer
+            continue
+        break
+    stmt = A.enclosing_statement(node)
+    for t in A.assign_targets(stmt):
+        if A.is_self_attr(t):
+            return t.attr, None, stmt  # type: ignore[union-attr]
+        if isinstance(t, ast.Name):
+            return None, t.id, stmt
+    return None, None, stmt
+
+
+def _builder_returns(fn_def: ast.FunctionDef, local: str) -> bool:
+    """Does the builder method return (an alias of) ``local``?  Follows
+    the rewrap idiom `fn = self._obs_wrap(k, fn)`."""
+    aliases = {local}
+    for stmt in sorted(
+        (s for s in ast.walk(fn_def) if isinstance(s, ast.stmt)),
+        key=lambda s: s.lineno,
+    ):
+        if isinstance(stmt, ast.Assign) and isinstance(
+            stmt.value, ast.Call
+        ):
+            if any(
+                isinstance(a, ast.Name) and a.id in aliases
+                for a in A.call_args(stmt.value)
+            ):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+        if isinstance(stmt, ast.Return) and isinstance(
+            stmt.value, ast.Name
+        ) and stmt.value.id in aliases:
+            return True
+    return False
+
+
+def _branch_path(node: ast.AST) -> list[tuple[int, str]]:
+    """(id(ancestor), arm) pairs for every If/Try ancestor, where arm
+    is which field of the ancestor the node sits under."""
+    out: list[tuple[int, str]] = []
+    cur: ast.AST = node
+    while True:
+        par = A.parent(cur)
+        if par is None:
+            break
+        if isinstance(par, (ast.If, ast.Try)):
+            for arm in ("body", "orelse", "handlers", "finalbody"):
+                children = getattr(par, arm, None) or []
+                if any(c is cur for c in children):
+                    out.append((id(par), arm))
+                    break
+        cur = par
+    return out
+
+
+def _sibling_branches(a: ast.AST, b: ast.AST) -> bool:
+    """True when a and b sit in different arms of the same If/Try —
+    alternatives, not sequential."""
+    pa = dict(_branch_path(a))
+    for anc_id, arm in _branch_path(b):
+        other = pa.get(anc_id)
+        if other is not None and other != arm:
+            return True
+    return False
+
+
+def _donated_arg_names(call: ast.Call, positions: set[int]) -> set[str]:
+    names: set[str] = set()
+    for p in positions:
+        if p < len(call.args):
+            arg = call.args[p]
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, (ast.Tuple, ast.List)):
+                for el in arg.elts:
+                    if isinstance(el, ast.Name):
+                        names.add(el.id)
+    return names
+
+
+class DonationRule(Rule):
+    id = RULE_ID
+    doc = __doc__
+
+    def check(self, files):
+        violations: list[Violation] = []
+        dispatch_sites = 0
+        for f in files:
+            A.annotate_parents(f.tree)
+            donating_attrs: dict[str, set[int]] = {}
+            donating_builders: dict[str, set[int]] = {}
+            # Pass 1: collect.
+            for node in ast.walk(f.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and A.dotted(node.func) == "jax.jit"
+                ):
+                    continue
+                spec = next(
+                    (
+                        kw.value for kw in node.keywords
+                        if kw.arg == "donate_argnums"
+                    ),
+                    None,
+                )
+                if spec is None:
+                    continue
+                fns = A.enclosing_functions(node)
+                positions = _resolve_positions(
+                    spec, fns[0] if fns else None
+                )
+                if positions is None:
+                    violations.append(Violation(
+                        RULE_ID, f.rel, node.lineno,
+                        "donate_argnums is not statically resolvable "
+                        "(literal tuple, int, conditional of literals, "
+                        "or a local binding of those) — the analyzer "
+                        "cannot check post-dispatch reads of what dies "
+                        "here",
+                    ))
+                    continue
+                if not positions:
+                    continue
+                attr, local, _stmt = _unwrap_to_binding(node)
+                if attr is not None:
+                    donating_attrs[attr] = (
+                        donating_attrs.get(attr, set()) | positions
+                    )
+                elif local is not None and fns:
+                    if _builder_returns(fns[0], local):
+                        donating_builders[fns[0].name] = (
+                            donating_builders.get(fns[0].name, set())
+                            | positions
+                        )
+            if not donating_attrs and not donating_builders:
+                continue
+            # Pass 2: dispatch sites.
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                positions: Optional[set[int]] = None
+                func = node.func
+                if A.is_self_attr(func) and func.attr in donating_attrs:
+                    positions = donating_attrs[func.attr]
+                elif (
+                    isinstance(func, ast.Call)
+                    and A.is_self_attr(func.func)
+                    and func.func.attr in donating_builders
+                ):
+                    positions = donating_builders[func.func.attr]
+                if not positions:
+                    continue
+                dispatch_sites += 1
+                donated = _donated_arg_names(node, positions)
+                if not donated:
+                    continue
+                fns = A.enclosing_functions(node)
+                if not fns:
+                    continue
+                stmt = A.enclosing_statement(node)
+                violations.extend(self._reads_after(
+                    f, fns[0], stmt, donated, func,
+                ))
+        self.stats["dispatch_sites"] = dispatch_sites
+        return violations
+
+    def _reads_after(self, f, fn_def, dispatch_stmt, donated, func):
+        """Loads of ``donated`` names after the dispatch statement,
+        before any rebind, in lexical line order.  A read in a SIBLING
+        branch of an ancestor if/else (an alternative to the dispatch,
+        not its continuation) does not count, and a dispatch that
+        itself rebinds the name (``tb = prog(tb, ...)``) kills the
+        hazard immediately."""
+        out: list[Violation] = []
+        start = A.end_line(dispatch_stmt)
+        # Names the dispatch statement rebinds from its own result.
+        rebound_by_dispatch: set[str] = set()
+        for t in A.assign_targets(dispatch_stmt):
+            rebound_by_dispatch |= A.name_ids(t)
+        events: list[tuple[int, str, str]] = []  # (line, kind, name)
+        for node in ast.walk(fn_def):
+            if isinstance(node, ast.Name) and node.id in donated:
+                if node.lineno <= start:
+                    continue
+                if _sibling_branches(dispatch_stmt, node):
+                    continue
+                kind = (
+                    "store"
+                    if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "load"
+                )
+                events.append((node.lineno, kind, node.id))
+        live = set(donated) - rebound_by_dispatch
+        prog = A.dotted(func) or "donating program"
+        for line, kind, name in sorted(events):
+            if name not in live:
+                continue
+            if kind == "store":
+                live.discard(name)
+            else:
+                out.append(Violation(
+                    RULE_ID, f.rel, line,
+                    f"{name!r} was donated to {prog}(...) at line "
+                    f"{dispatch_stmt.lineno} — its device buffer is "
+                    f"dead; reading it here races the aliased output "
+                    f"(rebind it from the dispatch result, or drop "
+                    f"donation for this program)",
+                ))
+                live.discard(name)  # one report per name
+        return out

@@ -1,0 +1,149 @@
+"""Rule knob-catalog: every ``KT_*`` knob is declared, read, and
+documented — zero orphans in either direction.
+
+``runtime/knob_catalog.py`` is the single source of truth (sibling of
+``metric_catalog.py``).  Four checks:
+
+1. every literal ``KT_*`` name passed to a call (``os.environ.get``,
+   ``os.getenv``, ``setdefault``, the ``_env_float``/``_env_int``
+   helpers — ANY call, so helper renames can't dodge the rule) or used
+   as an ``environ`` subscript must be cataloged;
+2. every exact ``KT_*`` token in ``docs/*.md`` must be cataloged
+   (``KT_FOO_*`` wildcards document a family, not an entry);
+3. every catalog entry must be read somewhere in code (no dead knobs);
+4. every catalog entry must appear in its declared docs anchor file.
+
+Scanned code roots include the bench/CI drivers and
+``__graft_entry__.py`` — knobs read only by tooling still bind
+operators.  Internal subprocess sentinels (leading underscore,
+``_KT_*``) are exempt by convention.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.ktlint.engine import REPO, Rule, Violation
+from tools.ktlint.rules import _astutil as A
+
+RULE_ID = "knob-catalog"
+
+KNOB_RE = re.compile(r"^KT_[A-Z0-9_]+$")
+# Docs tokens: a trailing `*` (with or without a joining underscore,
+# `KT_RETRY*` / `KT_RETRY_*`) marks a family wildcard.
+DOCS_TOKEN_RE = re.compile(r"\b(KT_[A-Z0-9_]*[A-Z0-9])(_?\*)?")
+
+CODE_ROOTS = (
+    "kubeadmiral_tpu", "bench.py", "bench_e2e.py", "tools",
+    "__graft_entry__.py", "tpu_capture.py",
+)
+
+CATALOG_PATH = "kubeadmiral_tpu/runtime/knob_catalog.py"
+
+
+def _load_catalog():
+    from kubeadmiral_tpu.runtime.knob_catalog import KNOBS
+
+    return KNOBS
+
+
+def _literal_knobs(call: ast.Call):
+    for arg in A.call_args(call):
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if KNOB_RE.match(arg.value):
+                yield arg.value, arg.lineno
+
+
+class KnobCatalogRule(Rule):
+    id = RULE_ID
+    doc = __doc__
+    roots = CODE_ROOTS
+
+    def check(self, files):
+        knobs = _load_catalog()
+        violations: list[Violation] = []
+        # knob -> first (rel, line) read site.
+        reads: dict[str, tuple[str, int]] = {}
+        for f in files:
+            if f.rel == CATALOG_PATH:
+                continue  # the declarations themselves
+            A.annotate_parents(f.tree)
+            for node in ast.walk(f.tree):
+                found: list[tuple[str, int]] = []
+                if isinstance(node, ast.Call):
+                    found = list(_literal_knobs(node))
+                elif isinstance(node, ast.Subscript) and (
+                    A.terminal_name(node.value) in ("environ",)
+                ):
+                    sl = node.slice
+                    if isinstance(sl, ast.Constant) and isinstance(
+                        sl.value, str
+                    ) and KNOB_RE.match(sl.value):
+                        found = [(sl.value, node.lineno)]
+                for name, line in found:
+                    reads.setdefault(name, (f.rel, line))
+                    if name not in knobs:
+                        violations.append(Violation(
+                            RULE_ID, f.rel, line,
+                            f"env knob {name!r} is not in runtime/"
+                            f"knob_catalog.py — catalog it (type/default/"
+                            f"doc anchor) and document it before it ships",
+                        ))
+        if self.partial:
+            # Fixture/explicit-file run: per-site checks only — the
+            # docs/catalog closure is a property of the full tree.
+            self.stats["knob_reads"] = len(reads)
+            return violations
+        # Docs scan.
+        docs_exact: dict[str, tuple[str, int]] = {}
+        wildcards: list[str] = []
+        for md in sorted((REPO / "docs").glob("*.md")):
+            rel = md.relative_to(REPO).as_posix()
+            for lineno, line in enumerate(
+                md.read_text().splitlines(), start=1
+            ):
+                for m in DOCS_TOKEN_RE.finditer(line):
+                    token, star = m.group(1), m.group(2)
+                    if star:
+                        wildcards.append(token)
+                    else:
+                        docs_exact.setdefault(token, (rel, lineno))
+        for token, (rel, lineno) in sorted(docs_exact.items()):
+            if token not in knobs:
+                violations.append(Violation(
+                    RULE_ID, rel, lineno,
+                    f"docs name env knob {token!r} which is not in "
+                    f"runtime/knob_catalog.py — stale docs or an "
+                    f"undeclared knob",
+                ))
+        # Catalog closure: read somewhere + documented in anchor.
+        anchor_text: dict[str, str] = {}
+        for name, spec in sorted(knobs.items()):
+            if name not in reads:
+                violations.append(Violation(
+                    RULE_ID, CATALOG_PATH, 1,
+                    f"cataloged knob {name!r} is read nowhere in code — "
+                    f"dead entry; remove it or wire the read",
+                ))
+            anchor = spec.anchor
+            text = anchor_text.get(anchor)
+            if text is None:
+                anchor_file = REPO / "docs" / anchor
+                text = anchor_file.read_text() if anchor_file.exists() else ""
+                anchor_text[anchor] = text
+            documented = name in docs_exact or any(
+                name.startswith(w) for w in wildcards
+            )
+            if not documented or (text and name not in text and not any(
+                name.startswith(w) and w in text for w in wildcards
+            )):
+                violations.append(Violation(
+                    RULE_ID, CATALOG_PATH, 1,
+                    f"cataloged knob {name!r} is not documented in "
+                    f"docs/{anchor} (its declared anchor) — add the "
+                    f"operator-facing row",
+                ))
+        self.stats["knob_reads"] = len(reads)
+        self.stats["docs_tokens"] = len(docs_exact)
+        return violations

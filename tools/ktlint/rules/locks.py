@@ -1,0 +1,177 @@
+"""Rule lock-discipline: declared-shared fields are only mutated under
+their declared lock.
+
+Classes annotate cross-thread state in a ``_shared_fields_`` registry
+(``{"field": "lockattr"}``; alternates joined with ``|`` — e.g. a
+Condition sharing its underlying Lock).  This rule checks every
+mutation of ``self.<field>`` inside the class:
+
+* rebinds (``self.f = ...`` / ``self.f += ...``), item stores/deletes
+  (``self.f[k] = v``, ``del self.f[k]``), mutator method calls
+  (``self.f.append(...)``, ``.pop``, ``.update`` …) and
+  ``heapq.heappush/heappop(self.f, ...)``;
+* each must be lexically inside ``with self.<lockattr>:`` — or in a
+  context the registry's conventions mark as lock-held: ``__init__``
+  (pre-publication), a method named ``*_locked``, or a method decorated
+  ``@lockcheck.assumes_held("<lockattr>")`` (which the runtime harness
+  VERIFIES on entry under KT_LOCKCHECK).
+
+This is the static half of the PR-3 race-class guard
+(``runtime/lockcheck.py`` is the runtime half: lock-order inversions +
+off-lock rebinds under the thread storm).  It sees container mutations
+the runtime ``__setattr__`` guard cannot; the runtime sees dynamic
+call paths this rule cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tools.ktlint.engine import Rule, Violation
+from tools.ktlint.rules import _astutil as A
+
+RULE_ID = "lock-discipline"
+
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault",
+}
+
+HEAP_FUNCS = {"heapq.heappush", "heapq.heappop", "heapq.heapify"}
+
+
+def _shared_fields(cls: ast.ClassDef) -> Optional[dict[str, str]]:
+    for stmt in cls.body:
+        targets = A.assign_targets(stmt)
+        if not any(
+            isinstance(t, ast.Name) and t.id == "_shared_fields_"
+            for t in targets
+        ):
+            continue
+        value = getattr(stmt, "value", None)
+        if not isinstance(value, ast.Dict):
+            return None
+        out: dict[str, str] = {}
+        for k, v in zip(value.keys, value.values):
+            if isinstance(k, ast.Constant) and isinstance(
+                v, ast.Constant
+            ):
+                out[str(k.value)] = str(v.value)
+        return out
+    return None
+
+
+def _held_locks(node: ast.AST, method: ast.FunctionDef) -> set[str]:
+    """Lock attr names whose ``with self.<lock>:`` lexically encloses
+    ``node``, plus locks the method context assumes held."""
+    held: set[str] = set()
+    for anc in A.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if A.is_self_attr(item.context_expr):
+                    held.add(item.context_expr.attr)  # type: ignore
+        if anc is method:
+            break
+    if method.name == "__init__" or method.name.endswith("_locked"):
+        held.add("*")
+    for deco in method.decorator_list:
+        if isinstance(deco, ast.Call) and A.terminal_name(
+            deco.func
+        ) == "assumes_held":
+            for arg in deco.args:
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    held.update(arg.value.split("|"))
+    return held
+
+
+def _satisfied(lock_spec: str, held: set[str]) -> bool:
+    if "*" in held:
+        return True
+    return any(alt in held for alt in lock_spec.split("|"))
+
+
+class LockDisciplineRule(Rule):
+    id = RULE_ID
+    doc = __doc__
+
+    def check(self, files):
+        violations: list[Violation] = []
+        classes = 0
+        mutations = 0
+        for f in files:
+            A.annotate_parents(f.tree)
+            for cls in ast.walk(f.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                fields = _shared_fields(cls)
+                if fields is None:
+                    continue
+                if not fields:
+                    violations.append(Violation(
+                        RULE_ID, f.rel, cls.lineno,
+                        f"{cls.name}._shared_fields_ must be a literal "
+                        f"dict of field -> lock-attr strings",
+                    ))
+                    continue
+                classes += 1
+                for method in cls.body:
+                    if not isinstance(
+                        method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    for node, field, how in self._mutation_nodes(
+                        method, fields
+                    ):
+                        mutations += 1
+                        held = _held_locks(node, method)
+                        if _satisfied(fields[field], held):
+                            continue
+                        violations.append(Violation(
+                            RULE_ID, f.rel, node.lineno,
+                            f"{cls.name}.{field} is declared shared "
+                            f"(lock {fields[field]!r}) but is mutated "
+                            f"here ({how}) outside `with self."
+                            f"{fields[field].split('|')[0]}:` — the "
+                            f"PR-3 race class; hold the lock, or mark "
+                            f"the method *_locked / @assumes_held if "
+                            f"every caller already does",
+                        ))
+        self.stats["declared_classes"] = classes
+        self.stats["mutation_sites"] = mutations
+        return violations
+
+    def _mutation_nodes(self, method, fields):
+        for node in ast.walk(method):
+            # self.f = ... / self.f += ...
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for t in A.assign_targets(node):
+                    if A.is_self_attr(t) and t.attr in fields:
+                        yield node, t.attr, "rebind"
+                    # self.f[k] = v
+                    if isinstance(t, ast.Subscript) and A.is_self_attr(
+                        t.value
+                    ) and t.value.attr in fields:
+                        yield node, t.value.attr, "item store"
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and A.is_self_attr(
+                        t.value
+                    ) and t.value.attr in fields:
+                        yield node, t.value.attr, "item delete"
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in MUTATORS
+                    and A.is_self_attr(func.value)
+                    and func.value.attr in fields
+                ):
+                    yield node, func.value.attr, f".{func.attr}()"
+                elif A.dotted(func) in HEAP_FUNCS:
+                    for arg in node.args[:1]:
+                        if A.is_self_attr(arg) and arg.attr in fields:
+                            yield node, arg.attr, A.dotted(func)

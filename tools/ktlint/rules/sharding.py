@@ -1,0 +1,85 @@
+"""Rule sharding-discipline: order-sensitive device ops must declare a
+sharding contract.
+
+GSPMD mis-combines sorts/scans/reshapes along a SHARDED dimension —
+the shard-sum miscompile class ``dryrun_multichip`` caught twice (and
+once shipped wrong on 11/11 fallback rows, PR 5).  The repo's
+convention is the "pack-sort rule": any sort-family op runs with the
+axis it orders over whole on every shard.  This rule makes the
+convention checkable: every function containing a device sort-family
+call (``jnp``/``lax`` ``sort``/``argsort``/``top_k``/``cumsum``/
+``cummax``/``cummin``/``argmin``/``argmax``) must sit under (be, or be
+lexically nested in) a function decorated with a
+``parallel/shardguard.py`` contract — ``@rows_only``, ``@rows_first``,
+``@replicated`` or ``@shard_contract(...)`` — naming the layout its
+callers must constrain operands to.
+
+Host-side ``numpy`` sorts are exempt (nothing shards them); so are
+calls on receivers other than ``jnp``/``jax.numpy``/``lax``/``jax.lax``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ktlint.engine import Rule, Violation
+from tools.ktlint.rules import _astutil as A
+
+RULE_ID = "sharding-discipline"
+
+SORT_FAMILY = {
+    "sort", "argsort", "top_k", "approx_max_k", "approx_min_k",
+    "cumsum", "cummax", "cummin", "argmin", "argmax",
+}
+
+DEVICE_RECEIVERS = {"jnp", "lax", "jax.numpy", "jax.lax"}
+
+CONTRACT_DECORATORS = {
+    "rows_only", "rows_first", "replicated", "shard_contract",
+}
+
+
+def _is_device_sort(call: ast.Call) -> bool:
+    name = A.dotted(call.func)
+    if "." not in name:
+        return False
+    receiver, _, attr = name.rpartition(".")
+    return attr in SORT_FAMILY and receiver in DEVICE_RECEIVERS
+
+
+def _has_contract(fn: ast.FunctionDef) -> bool:
+    return any(
+        A.terminal_name(d) in CONTRACT_DECORATORS for d in fn.decorator_list
+    )
+
+
+class ShardingRule(Rule):
+    id = RULE_ID
+    doc = __doc__
+
+    def check(self, files):
+        violations: list[Violation] = []
+        sites = 0
+        for f in files:
+            A.annotate_parents(f.tree)
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call) and _is_device_sort(node)):
+                    continue
+                sites += 1
+                chain = A.enclosing_functions(node)
+                if any(_has_contract(fn) for fn in chain):
+                    continue
+                op = A.dotted(node.func)
+                where = (
+                    f"in {chain[0].name}()" if chain else "at module level"
+                )
+                violations.append(Violation(
+                    RULE_ID, f.rel, node.lineno,
+                    f"{op} {where} has no sharding contract — a sharded "
+                    f"operand axis would shard-sum silently under GSPMD; "
+                    f"declare @rows_only/@rows_first/@replicated "
+                    f"(parallel/shardguard.py) on the enclosing function "
+                    f"and constrain its callers to match",
+                ))
+        self.stats["sort_sites"] = sites
+        return violations
