@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-churn bench-gate bench-restart graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke
+.PHONY: test test-fast bench bench-churn bench-gate bench-restart bench-e2e bench-e2e-scale graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -16,6 +16,19 @@ kubeadmiral_tpu/native/libkadmhash.so: kubeadmiral_tpu/native/fnvhash.cpp kubead
 
 bench-e2e:
 	$(PYTEST_ENV) python bench_e2e.py
+
+# End-to-end over a kwok-lite HTTP farm at HUNDREDS of member
+# apiservers (real sockets, auth, watches): the write-path coalescing +
+# bulk-read + admission work measured at the member count it exists
+# for.  bench-gate keys the e2e baseline by (transport, members), so
+# the first scaled round trips the loud NOTHING-GATED warning and
+# seeds its own baseline (see docs/operations.md "Control-plane
+# write-path tuning").
+bench-e2e-scale:
+	$(PYTEST_ENV) BENCH_E2E_TRANSPORT=http \
+		BENCH_E2E_OBJECTS=$${BENCH_E2E_OBJECTS:-500} \
+		BENCH_E2E_CLUSTERS=$${BENCH_E2E_CLUSTERS:-500} \
+		python bench_e2e.py
 
 # Fault matrix (tests/test_faults.py): fault injection, circuit
 # breakers, stall-proof dispatch, watch recovery, the hard-down-member

@@ -36,6 +36,17 @@ CHAOS = os.environ.get("BENCH_E2E_CHAOS", "") in ("1", "true", "yes")
 CHAOS_ROUNDS = int(os.environ.get("BENCH_E2E_CHAOS_ROUNDS", 6))
 
 
+def _coalesce_detail() -> dict:
+    """The write-path knob state this round ran under (bench detail)."""
+    from kubeadmiral_tpu.federation import dispatch as D
+
+    return {
+        "enabled": D.write_coalesce(),
+        "member_batch": D.member_batch(),
+        "member_inflight": D.member_inflight(),
+    }
+
+
 class StageTimer:
     """Wraps each controller's worker.step() with cumulative timing."""
 
@@ -76,7 +87,21 @@ class StageTimer:
                     progressed |= stepped
                 self.stages[name] += time.perf_counter() - t0
             if not progressed:
-                return
+                # Keys may be pending but not yet DUE (admission
+                # backpressure defers enqueues under deep queues,
+                # KT_ADMIT_DEPTH): wait those short fuses out instead of
+                # quiescing early — but long-fuse requeues (heartbeats,
+                # WAITING_FOR_REMOVAL revisits) still read as idle,
+                # exactly as before.
+                dues = [
+                    d
+                    for _, ctl in self.controllers
+                    for d in (ctl.worker.queue.next_due_in(),)
+                    if d is not None and d <= 0.25
+                ]
+                if not dues:
+                    return
+                time.sleep(min(dues) + 0.002)
 
 
 def run_chaos(fleet, farm, timer, ftc, members) -> dict:
@@ -101,13 +126,23 @@ def run_chaos(fleet, farm, timer, ftc, members) -> dict:
     names = sorted(members)
     if len(names) < 3:
         return {"skipped": "needs >= 3 members"}
-    down, flappy = names[0], names[1]
+    # Partition the members actually HOLDING placements: the Divide
+    # planner gives capacity-proportional shares, so the lowest-capacity
+    # members (the first names) may legitimately hold zero objects — a
+    # partition there stalls nothing and the freshness assertion would
+    # measure an empty signal.
+    by_load = sorted(
+        names,
+        key=lambda n: len(members[n].keys(ftc.source.resource)),
+        reverse=True,
+    )
+    down, flappy = by_load[0], by_load[1]
     hard = FaultPolicy(partition=True)
     flap = FaultPolicy(partition=True, flap_period_s=0.5, flap_duty=0.4)
     injector = None
     if farm is not None:
-        if farm.member_procs:
-            return {"skipped": "subprocess farm members are not injectable"}
+        # Subprocess members are injectable too: farm.set_fault routes
+        # through the member's fault-control endpoint (POST /faultz).
         # Degraded-mode rounds are bounded by the member-client timeout
         # (one probe/read pays it before the breaker opens): use a
         # chaos-appropriate budget instead of the default 10 s.
@@ -466,6 +501,12 @@ def main():
         "unit": "objects/s",
         "detail": {
             "transport": TRANSPORT,
+            # The bench-gate baseline key folds (transport, members) in,
+            # the way device_count was folded in for engine rounds: a
+            # 500-member HTTP round must never gate against (or seed)
+            # an in-process 50-member baseline.
+            "members": N_CLUSTERS,
+            "write_coalesce": _coalesce_detail(),
             "farm": (
                 ("subprocess" if farm.member_subprocess else "inproc")
                 if farm is not None

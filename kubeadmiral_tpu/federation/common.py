@@ -190,3 +190,27 @@ def cluster_lifecycle_sig(cluster_obj: dict) -> tuple:
         conds.get("Ready") == "True",
         bool(cluster_obj["metadata"].get("deletionTimestamp")),
     )
+
+
+def metadata_change_sig(obj: dict, ignore_annotations: tuple = ()) -> int:
+    """Trigger signature of the fields a fed-object watch handler cares
+    about: generation (spec changes bump it), labels (policy binding),
+    annotations minus declared noise keys.  Status-subresource writes —
+    the bulk of a converged control plane's event volume — leave it
+    unchanged, so controllers keeping a key->sig map skip the requeue
+    entirely (the reference's schedulingtriggers.go idea applied at the
+    watch boundary)."""
+    md = obj.get("metadata", {})
+    ann = md.get("annotations") or {}
+    if ignore_annotations:
+        ann_items = tuple(
+            sorted(kv for kv in ann.items() if kv[0] not in ignore_annotations)
+        )
+    else:
+        ann_items = tuple(sorted(ann.items()))
+    return hash((
+        md.get("generation"),
+        bool(md.get("deletionTimestamp")),
+        tuple(sorted((md.get("labels") or {}).items())),
+        ann_items,
+    ))

@@ -11,10 +11,20 @@ through a *sink*:
   members), one client round trip per operation.
 * :class:`BatchSink` — the tick-native variant: a whole BatchWorker tick
   of sync reconciles stages its member writes here, and ``flush()``
-  issues ONE ``client.batch()`` round trip per member cluster covering
+  issues bulk ``client.batch()`` round trips per member cluster covering
   every staged object (transport/apiserver.py _serve_batch).  Per-op
   conflict/failure results flow back through the same continuations, so
   status/version bookkeeping is identical to the immediate path.
+
+Both sinks flush through the **per-member coalescing window**
+(:func:`run_member_batches`): a member's staged ops split into
+KT_MEMBER_BATCH-sized bulk requests, up to KT_MEMBER_INFLIGHT in flight
+at once (the engine's KT_PIPELINE_DEPTH trick at the HTTP layer), with
+the deadline and breaker re-checked between chunks.  KT_WRITE_COALESCE=0
+reverts to one request per (object, member) op — the reference's
+fan-out shape, kept as the bit-identical A/B baseline.  Point reads
+batch the same way (:func:`bulk_get`; KT_BULK_READS consumers in sync
+and the status controllers).
 
 The fan-out is **stall-proof** (docs/operations.md § Degraded member
 runbook): every flush path enforces the per-tick deadline budget
@@ -110,6 +120,42 @@ def dispatch_deadline() -> float:
     no flush path may block its caller past this, whatever a member
     socket does."""
     return _env_float("KT_DISPATCH_DEADLINE_S", 30.0)
+
+
+def write_coalesce() -> bool:
+    """KT_WRITE_COALESCE: stage-and-batch member writes (default).  0
+    reverts to ONE request per (object, member) operation — the
+    reference's dispatch/operation.go model, kept as the bit-identical
+    A/B baseline for the coalesced path."""
+    return os.environ.get("KT_WRITE_COALESCE", "1") not in ("0", "false", "no")
+
+
+def member_batch() -> int:
+    """KT_MEMBER_BATCH: max operations per bulk member request.  A
+    member's staged writes flush as ceil(n / batch) pipelined requests,
+    so one request never grows unboundedly large (bounded request
+    latency, bounded retry blast radius)."""
+    return max(1, int(_env_float("KT_MEMBER_BATCH", 128)))
+
+
+def member_inflight() -> int:
+    """KT_MEMBER_INFLIGHT: bulk requests concurrently in flight per
+    member during one flush — the engine's KT_PIPELINE_DEPTH trick at
+    the HTTP layer."""
+    return max(1, int(_env_float("KT_MEMBER_INFLIGHT", 4)))
+
+
+# Ops shed before their bulk request was ever dispatched (deadline
+# expiry mid-flush, breaker opening mid-flush) carry this marker so the
+# flush skips their continuations: statuses stay at the pre-recorded
+# *_TIMED_OUT values and the owning worker's backoff requeue re-drives
+# them — identical semantics to the whole-cluster shed path.
+_SHED = {"code": 503, "status": {"reason": "Shed",
+                                 "message": "write shed before dispatch"},
+         "shed": True}
+
+# Histogram buckets for coalesced batch sizes (ops per bulk request).
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
 def retry_delay(attempt: int, rng=None) -> float:
@@ -258,6 +304,167 @@ def run_batch_with_retries(
         "reason": "Transport", "message": "batch never ran"}} for r in results]
 
 
+def _note_chunk(breakers, cluster: str, n_ops: int, results: list[dict]) -> None:
+    """Per-bulk-request telemetry: batch-size histogram + outcome
+    counter (member_bulk_writes_total{cluster,result}) + the registry's
+    batch reservoir feeding GET /debug/members."""
+    if breakers is None or not cluster:
+        return
+    outcome = "ok"
+    for r in results:
+        code = (r or {}).get("code") or 0
+        reason = ((r or {}).get("status") or {}).get("reason")
+        if code >= 500 and reason == "Transport":
+            outcome = "transport"
+            break
+        if code >= 400:
+            outcome = "partial"
+    breakers.note_batch(cluster, n_ops, outcome)
+    metrics = getattr(breakers, "metrics", None)
+    if metrics is not None:
+        metrics.counter(
+            "member_bulk_writes_total", cluster=cluster, result=outcome
+        )
+        metrics.histogram("member_batch_ops", n_ops, buckets=_BATCH_BUCKETS)
+
+
+def run_member_batches(
+    client,
+    ops: list[dict],
+    deadline: float,
+    cluster: str = "",
+    breakers=None,
+    thread_registry: Optional[set] = None,
+) -> list[dict]:
+    """One member's staged writes as coalesced, pipelined bulk requests.
+
+    Ops split into KT_MEMBER_BATCH-sized chunks (KT_WRITE_COALESCE=0:
+    one op per request — the per-object A/B path) and dispatch under a
+    KT_MEMBER_INFLIGHT-bounded window; each chunk rides
+    :func:`run_batch_with_retries`, so per-op 409/5xx retry semantics
+    are identical to the un-coalesced path.  Between chunks the deadline
+    budget and the member's breaker are re-checked: a deadline expiry
+    mid-flush sheds the REMAINING chunks (their ops return the shed
+    marker — continuations must not run, member_shed_writes_total
+    counts them), and a breaker that opened mid-flush sheds without
+    touching another socket.  Always returns one result per op."""
+    n = len(ops)
+    if n == 0:
+        return []
+    size = member_batch() if write_coalesce() else 1
+    chunks = [ops[i:i + size] for i in range(0, n, size)]
+    breaker = breakers.for_member(cluster) if breakers is not None else None
+
+    def blocked() -> bool:
+        if time.monotonic() >= deadline:
+            return True
+        return breaker is not None and not breaker.allow(consume_probe=False)
+
+    def run_chunk(chunk: list[dict]) -> list[dict]:
+        # In-process stores deliver watch events synchronously on the
+        # writing thread: a pipelined chunk thread must count as "own
+        # write" for the controller's echo suppression, or every member
+        # write re-enqueues its object for a spurious re-sync.
+        ident = threading.get_ident()
+        added = thread_registry is not None and ident not in thread_registry
+        if added:
+            thread_registry.add(ident)
+        try:
+            if blocked():
+                return [_SHED] * len(chunk)
+            res = run_batch_with_retries(
+                client, chunk, deadline, cluster=cluster, breakers=breakers
+            )
+            _note_chunk(breakers, cluster, len(chunk), res)
+            return res
+        finally:
+            if added:
+                thread_registry.discard(ident)
+
+    inflight = member_inflight()
+    # A plain in-process store has no round trips to pipeline: chunk
+    # threads would cost GIL churn and move its synchronous watch
+    # delivery off the flushing thread for nothing.
+    if type(client) is FakeKube:
+        inflight = 1
+    if len(chunks) == 1 or inflight <= 1:
+        out: list[dict] = []
+        for chunk in chunks:
+            out.extend(run_chunk(chunk))
+        shed_n = sum(1 for r in out if r.get("shed"))
+        if shed_n and breakers is not None:
+            breakers.count_shed(cluster, shed_n)
+        return out
+    # Pipelined window: up to KT_MEMBER_INFLIGHT bulk requests in
+    # flight at once (each chunk re-checks deadline/breaker at start,
+    # so a mid-flush expiry degrades to shed markers, never new
+    # sockets).  The pool is per-flush-per-member but bounded by the
+    # caller's own concurrency (the sink's cluster fan-out pool).
+    pool = ThreadPoolExecutor(
+        max_workers=min(inflight, len(chunks)),
+        thread_name_prefix=f"member-batch-{cluster}",
+    )
+    try:
+        futures = [pool.submit(run_chunk, chunk) for chunk in chunks]
+        out = []
+        for f, chunk in zip(futures, chunks):
+            try:
+                out.extend(f.result())
+            except Exception as e:  # defensive: run_chunk shouldn't raise
+                out.extend(
+                    [{"code": 500, "status": {"reason": "Transport",
+                                              "message": str(e)}}] * len(chunk)
+                )
+    finally:
+        pool.shutdown(wait=False)
+    shed_n = sum(1 for r in out if r.get("shed"))
+    if shed_n and breakers is not None:
+        breakers.count_shed(cluster, shed_n)
+    return out
+
+
+_BULK_MISS = object()
+
+
+def bulk_get(
+    client,
+    resource: str,
+    keys: list[str],
+    cluster: str = "",
+    breakers=None,
+) -> Optional[dict[str, Optional[dict]]]:
+    """Batched point reads: ``get`` verbs through the bulk protocol,
+    KT_MEMBER_BATCH keys per request.  Returns {key: obj | None-for-404}
+    — a key absent from the result means the read failed non-fatally and
+    the caller should fall back to a direct read.  Returns None outright
+    on a transport-level failure (the whole endpoint is unreachable;
+    breaker evidence recorded)."""
+    out: dict[str, Optional[dict]] = {}
+    size = member_batch()
+    breaker = breakers.for_member(cluster) if breakers is not None else None
+    for i in range(0, len(keys), size):
+        chunk = keys[i:i + size]
+        start = time.monotonic()
+        try:
+            results = client.batch(
+                [{"verb": "get", "resource": resource, "key": k} for k in chunk]
+            )
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure(latency_s=time.monotonic() - start)
+            return None
+        if breaker is not None:
+            breaker.note_ok(time.monotonic() - start)
+        for k, res in zip(chunk, results):
+            code = (res or {}).get("code")
+            if code == 200:
+                out[k] = res.get("object")
+            elif code == 404:
+                out[k] = None
+            # anything else: leave the key out — direct-read fallback
+    return out
+
+
 # -- sinks ---------------------------------------------------------------
 # Live sinks, for graceful shutdown: SIGTERM drains in-flight flushes
 # under a bounded deadline and then finalizes every sink that still
@@ -284,7 +491,13 @@ def finalize_all_sinks(deadline_s: float = 0.0) -> int:
 class ImmediateSink:
     """One client call per operation, inline or on a bounded pool
     (operation.go:102-123's per-cluster goroutine fan-out; pool size =
-    the in-flight window, KT_DISPATCH_POOL)."""
+    the in-flight window, KT_DISPATCH_POOL).
+
+    Under KT_WRITE_COALESCE (pooled mode only — the inline in-process
+    path has no round trips to amortize), submits stage into a
+    per-member buffer instead of dispatching one call per op; ``wait()``
+    flushes each member's buffer through the pipelined bulk window
+    (:func:`run_member_batches`), one pooled task per member."""
 
     def __init__(
         self,
@@ -297,10 +510,45 @@ class ImmediateSink:
         self._pool = pool
         self._own_pool = False
         self._inline = inline
-        self._futures: list[tuple[str, Future]] = []
+        # (cluster, future, ops): ops is the shed weight a cancel counts.
+        self._futures: list[tuple[str, Future, int]] = []
         self._finalized = False
         self.breakers = breakers
+        self._coalesce = write_coalesce() and not inline
+        self._staged: dict[str, list[tuple[dict, Callable[[dict], None]]]] = {}
         _LIVE_SINKS.add(self)
+
+    def _flush_member(self, cluster: str, entries: list, deadline: float) -> None:
+        """One member's coalesced buffer -> pipelined bulk batches."""
+        with trace.span(
+            "dispatch.member_write", cluster=cluster, ops=len(entries)
+        ):
+            if self.breakers is not None and not self.breakers.allow(
+                cluster, consume_probe=False
+            ):
+                self.breakers.count_shed(cluster, len(entries))
+                return
+            try:
+                client = self.client_for_cluster(cluster)
+            except Exception as e:
+                results = [
+                    {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
+                ] * len(entries)
+            else:
+                results = run_member_batches(
+                    client,
+                    [op for op, _ in entries],
+                    deadline,
+                    cluster=cluster,
+                    breakers=self.breakers,
+                )
+            for (_, continuation), result in zip(entries, results):
+                if result.get("shed"):
+                    continue  # pre-recorded *_TIMED_OUT status stands
+                try:
+                    continuation(result)
+                except Exception:
+                    pass  # continuations record their own failures
 
     def submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
         if self._finalized:
@@ -309,6 +557,9 @@ class ImmediateSink:
             raise RuntimeError(
                 "ImmediateSink already finalized by wait(); build a fresh sink"
             )
+        if self._coalesce:
+            self._staged.setdefault(cluster, []).append((op, continuation))
+            return
 
         def run() -> None:
             with trace.span("dispatch.member_write", cluster=cluster):
@@ -339,7 +590,27 @@ class ImmediateSink:
         if self._pool is None:
             self._pool = ThreadPoolExecutor(max_workers=dispatch_pool_size())
             self._own_pool = True
-        self._futures.append((cluster, self._pool.submit(run)))
+        self._futures.append((cluster, self._pool.submit(run), 1))
+
+    def _flush_staged(self, deadline: float) -> None:
+        """Coalesced mode: hand each member's buffered ops to one pooled
+        flush task (the per-member pipelined bulk window runs inside)."""
+        staged, self._staged = self._staged, {}
+        if not staged:
+            return
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=dispatch_pool_size())
+            self._own_pool = True
+        for cluster, entries in staged.items():
+            self._futures.append(
+                (
+                    cluster,
+                    self._pool.submit(
+                        self._flush_member, cluster, entries, deadline
+                    ),
+                    len(entries),
+                )
+            )
 
     def wait(self, timeout: float) -> None:
         """Drain the fan-out under the deadline.  On expiry, not-yet-
@@ -347,18 +618,19 @@ class ImmediateSink:
         statuses stand) and the sink becomes unusable — a late submit
         raises instead of mutating a finalized status map."""
         deadline = time.monotonic() + timeout
+        self._flush_staged(deadline)
         try:
-            for cluster, f in self._futures:
+            for cluster, f, n_ops in self._futures:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     if f.cancel() and self.breakers is not None:
-                        self.breakers.count_shed(cluster)
+                        self.breakers.count_shed(cluster, n_ops)
                     continue
                 try:
                     f.result(timeout=remaining)
                 except FuturesTimeout:
                     if f.cancel() and self.breakers is not None:
-                        self.breakers.count_shed(cluster)
+                        self.breakers.count_shed(cluster, n_ops)
                 except Exception:  # failure statuses were pre-recorded
                     pass
         finally:
@@ -377,20 +649,26 @@ class ImmediateSink:
         if self._finalized:
             return 0
         shed = 0
+        # Coalesced ops still buffered never dispatched: all shed.
+        staged, self._staged = self._staged, {}
+        for cluster, entries in staged.items():
+            shed += len(entries)
+            if self.breakers is not None:
+                self.breakers.count_shed(cluster, len(entries))
         end = time.monotonic() + max(0.0, deadline_s)
         pending = list(self._futures)
-        for cluster, f in pending:
+        for cluster, f, n_ops in pending:
             if f.cancel():
-                shed += 1
+                shed += n_ops
                 if self.breakers is not None:
-                    self.breakers.count_shed(cluster)
+                    self.breakers.count_shed(cluster, n_ops)
                 continue
             try:
                 f.result(timeout=max(0.0, end - time.monotonic()))
             except FuturesTimeout:
-                shed += 1  # running past the drain budget: abandoned
+                shed += n_ops  # running past the drain budget: abandoned
                 if self.breakers is not None:
-                    self.breakers.count_shed(cluster)
+                    self.breakers.count_shed(cluster, n_ops)
             except Exception:
                 pass
         self._futures.clear()
@@ -492,6 +770,14 @@ class BatchSink:
                 with trace.span(
                     "dispatch.member_flush", cluster=cluster, ops=len(entries)
                 ):
+                    # A breaker that opened between staging and flush
+                    # (a sibling batch's transport failures) sheds the
+                    # WHOLE staged batch without touching a socket.
+                    if self.breakers is not None and not self.breakers.allow(
+                        cluster, consume_probe=False
+                    ):
+                        self.breakers.count_shed(cluster, len(entries))
+                        return
                     try:
                         client = self.client_for_cluster(cluster)
                     except Exception as e:
@@ -499,14 +785,19 @@ class BatchSink:
                             {"code": 500, "status": {"reason": "Transport", "message": str(e)}}
                         ] * len(entries)
                     else:
-                        results = run_batch_with_retries(
+                        results = run_member_batches(
                             client,
                             [op for op, _ in entries],
                             deadline,
                             cluster=cluster,
                             breakers=self.breakers,
+                            thread_registry=self.thread_registry,
                         )
                     for (_, continuation), result in zip(entries, results):
+                        if result.get("shed"):
+                            # Shed before dispatch: the pre-recorded
+                            # *_TIMED_OUT status stands.
+                            continue
                         try:
                             continuation(result)
                         except Exception:
@@ -720,7 +1011,13 @@ class ManagedDispatcher:
         # patch list share ONE assembled object (consumers that mutate —
         # the retention paths — copy first; create paths hand the shared
         # object to clients, which serialize/copy on write).
-        self._desired_cache: dict[str, dict] = {}
+        self._desired_cache: dict[object, dict] = {}
+        # id(patches) -> serialized cache key: the patch lists live in
+        # fed._ordered_overrides()'s cached dict (pinned by self.fed for
+        # this dispatcher's lifetime, so ids cannot be recycled), and
+        # re-serializing the same list per member cluster was a
+        # measurable share of the sync hot path.
+        self._patch_keys: dict[int, str] = {}
 
     # -- bookkeeping -----------------------------------------------------
     def _submit(self, cluster: str, op: dict, continuation: Callable[[dict], None]) -> None:
@@ -798,6 +1095,12 @@ class ManagedDispatcher:
         patches = self.fed._ordered_overrides().get(cluster) or ()
         if not patches and not extra:
             key = ""  # the common no-override case skips key serialization
+        elif extra is None:
+            key = self._patch_keys.get(id(patches))
+            if key is None:
+                key = json.dumps([patches, None], sort_keys=True, default=str)
+                with self._lock:
+                    self._patch_keys[id(patches)] = key
         else:
             key = json.dumps([patches, extra], sort_keys=True, default=str)
         with self._lock:

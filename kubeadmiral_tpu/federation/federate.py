@@ -312,13 +312,34 @@ class FederateController:
         # event→placement-written decomposition hangs off
         # (runtime/slo.py).
         slo.track(host, self._source_resource)
+        # Watch-boundary trigger filter for FED events: federate reads a
+        # fed object's template (generation), labels and annotations
+        # (the feedback annotations it mirrors to the source included),
+        # never its status — sync's per-round status-subresource write
+        # must not re-reconcile every object (common.metadata_change_sig).
+        self._fed_event_sigs: dict[str, int] = {}
         host.watch(self._source_resource, self._on_event, replay=True)
-        host.watch(self._fed_resource, self._on_event, replay=True)
+        host.watch(self._fed_resource, self._on_fed_event, replay=True)
 
     def _on_event(self, event: str, obj: dict) -> None:
         if self.worker.is_own_thread():
             return  # echo of this controller's own source/fed write
         self.worker.enqueue(obj_key(obj))
+
+    def _on_fed_event(self, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        if event == "DELETED":
+            self._fed_event_sigs.pop(key, None)
+            if not self.worker.is_own_thread():
+                self.worker.enqueue(key)
+            return
+        sig = C.metadata_change_sig(obj)
+        if self._fed_event_sigs.get(key) == sig:
+            return  # status-only fed write: nothing federate consumes
+        self._fed_event_sigs[key] = sig
+        if self.worker.is_own_thread():
+            return  # echo of this controller's own fed write
+        self.worker.enqueue(key)
 
     def run_until_idle(self) -> None:
         while self.worker.step():

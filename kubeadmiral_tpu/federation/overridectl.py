@@ -108,6 +108,9 @@ class OverrideController:
         self.worker = Worker(
             f"override-{ftc.name}", self.reconcile, metrics=self.metrics, clock=clock
         )
+        # Watch-boundary trigger filter (common.metadata_change_sig):
+        # status-only fed writes never re-enqueue.
+        self._event_sigs: dict[str, int] = {}
         host.watch(self._fed_resource, self._on_object_event, replay=True)
         host.watch(OVERRIDE_POLICIES, self._on_policy_event, replay=False)
         host.watch(CLUSTER_OVERRIDE_POLICIES, self._on_policy_event, replay=False)
@@ -115,9 +118,23 @@ class OverrideController:
 
     # -- event fan-in (controller.go:226-252) ----------------------------
     def _on_object_event(self, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        if event == "DELETED":
+            self._event_sigs.pop(key, None)
+            self.worker.enqueue(key)
+            return
+        # Override application reads spec (generation), labels (policy
+        # binding) and policy annotations; status writes and the
+        # per-sync-round syncing feedback never change the outcome.
+        sig = C.metadata_change_sig(
+            obj, ignore_annotations=(C.SOURCE_FEEDBACK_SYNCING,)
+        )
+        if self._event_sigs.get(key) == sig:
+            return
+        self._event_sigs[key] = sig
         if self.worker.is_own_thread():
             return  # echo of this controller's own spec.overrides write
-        self.worker.enqueue(obj_key(obj))
+        self.worker.enqueue(key)
 
     def _on_policy_event(self, event: str, obj: dict) -> None:
         pname = obj["metadata"]["name"]

@@ -31,6 +31,12 @@ class FederatedResource:
         self.obj = fed_obj
         self.ftc = ftc
         self._overrides_by_cluster: Optional[dict[str, list]] = None
+        # Version-hash memos: one reconcile computes each hash at plan
+        # time AND at finish time, and spec.template/spec.overrides are
+        # immutable for this wrapper's lifetime (reconcile mutates only
+        # metadata/status).
+        self._template_version: Optional[str] = None
+        self._override_version: Optional[str] = None
 
     # -- identity --------------------------------------------------------
     @property
@@ -192,10 +198,16 @@ class FederatedResource:
     def template_version(self) -> str:
         """Hash of the template (resource.go TemplateVersion via
         GetTemplateHash)."""
-        return f"{stable_json_hash(C.template(self.obj)):08x}"
+        if self._template_version is None:
+            self._template_version = f"{stable_json_hash(C.template(self.obj)):08x}"
+        return self._template_version
 
     def override_version(self) -> str:
-        return f"{stable_json_hash(self.obj.get('spec', {}).get('overrides', [])):08x}"
+        if self._override_version is None:
+            self._override_version = (
+                f"{stable_json_hash(self.obj.get('spec', {}).get('overrides', [])):08x}"
+            )
+        return self._override_version
 
 
 def should_adopt_preexisting(fed_obj: dict) -> bool:

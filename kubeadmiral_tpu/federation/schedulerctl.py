@@ -130,6 +130,13 @@ class SchedulerController:
         # pin it forever, since the trigger hash would keep matching).
         self._policy_cache: dict[tuple[str, str], P.PolicySpec] = {}
         self._policy_epoch: dict[tuple[str, str], int] = {}
+        # Watch-boundary trigger filter: last metadata_change_sig per
+        # key.  Status-subresource writes (sync's per-round status +
+        # every member ack echo) leave the sig unchanged and never
+        # re-enqueue — the trigger-hash skip in reconcile_batch would
+        # no-op them anyway, but only after paying a per-key replan
+        # check; at e2e scale that recheck WAS a whole extra tick.
+        self._event_sigs: dict[str, int] = {}
 
         host.watch(self._resource, self._on_object_event, replay=True)
         host.watch(P.PROPAGATION_POLICIES, self._on_policy_event, replay=False)
@@ -140,15 +147,35 @@ class SchedulerController:
 
     # -- event handlers (fan-in to the dirty queue) ----------------------
     def _on_object_event(self, event: str, obj: dict) -> None:
+        key = obj_key(obj)
+        if event == "DELETED":
+            self._event_sigs.pop(key, None)
+            self.worker.enqueue(key)
+            return
+        # The syncing feedback annotation churns once per sync round and
+        # never feeds a scheduling decision; everything else in
+        # generation/labels/annotations does (policy binding labels,
+        # pending-controllers, placements via generation).
+        sig = C.metadata_change_sig(
+            obj, ignore_annotations=(C.SOURCE_FEEDBACK_SYNCING,)
+        )
+        if self._event_sigs.get(key) == sig:
+            return  # status-only write / feedback noise: no requeue
+        self._event_sigs[key] = sig
+        if self.worker.is_own_thread():
+            # Echo of this controller's own persist (placements +
+            # trigger-hash annotation): the sig is recorded so the next
+            # foreign event diffs against the post-persist state, but
+            # the persist itself needs no replan.
+            return
         # The reconcile path's root span: the watch event that made the
         # object dirty (its tick shows up as a later worker.tick span;
         # the gap between the two is the queue wait, gauged by
         # worker_queue_wait_seconds).
         with trace.span(
-            "informer.event", resource=self._resource, event=event,
-            key=obj_key(obj),
+            "informer.event", resource=self._resource, event=event, key=key
         ):
-            self.worker.enqueue(obj_key(obj))
+            self.worker.enqueue(key)
 
     def _enqueue_objects_for_policies(self, policies: set[tuple[str, str]]) -> None:
         """Re-enqueue every federated object bound to one of the given

@@ -17,17 +17,27 @@ to the user:
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
+from kubeadmiral_tpu.federation.dispatch import bulk_get
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.runtime.hostbatch import HostBatch
 from kubeadmiral_tpu.runtime.informer import MemberStore
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
-from kubeadmiral_tpu.testing.fakekube import ClusterFleet, obj_key
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet, FakeKube, obj_key
 from kubeadmiral_tpu.transport import breaker as B
 from kubeadmiral_tpu.utils.unstructured import copy_json, get_path, set_path
+
+
+def _host_bulk_reads(host) -> bool:
+    """Bulk host point reads (KT_BULK_READS): only worth a round trip
+    on network hosts — an in-process store's view reads are free."""
+    return not isinstance(host, FakeKube) and os.environ.get(
+        "KT_BULK_READS", "1"
+    ) not in ("0", "false", "no")
 
 
 def _retry_pending_attach(store: MemberStore, worker, host, fed_resource) -> None:
@@ -170,21 +180,40 @@ class StatusController:
     # -- reconcile (status/controller.go:291-450) ------------------------
     def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
         """One tick: every due key's status CR recomputed against the
-        member store, all host writes staged into ONE batch."""
+        member store, all host writes staged into ONE batch.  Network
+        hosts prefetch the tick's federated objects (and the status CRs
+        of keys outside the skip cache) in bulk reads instead of two
+        GETs per key."""
         results: dict[str, Result] = {}
+        fed_cache = status_cache = None
+        if _host_bulk_reads(self.host) and keys:
+            fed_cache = bulk_get(self.host, self._fed_resource, keys)
+            cold = [k for k in keys if k not in self._last_written]
+            if cold:
+                status_cache = bulk_get(self.host, self._status_resource, cold)
         hb = HostBatch(self.host)
         for key in keys:
             try:
-                self._plan_one(key, hb, results)
+                self._plan_one(key, hb, results, fed_cache, status_cache)
             except Exception:
                 self.metrics.counter("status.plan_panic")
                 results[key] = Result.retry()
         hb.flush()
         return results
 
-    def _plan_one(self, key: str, hb: HostBatch, results: dict) -> None:
+    def _plan_one(
+        self,
+        key: str,
+        hb: HostBatch,
+        results: dict,
+        fed_cache: Optional[dict] = None,
+        status_cache: Optional[dict] = None,
+    ) -> None:
         self.metrics.counter("status.throughput")
-        fed_obj = _view_read(self.host, self._fed_resource, key)
+        if fed_cache is not None and key in fed_cache:
+            fed_obj = fed_cache[key]
+        else:
+            fed_obj = _view_read(self.host, self._fed_resource, key)
 
         def on_panic(_key=key) -> None:
             self._last_written.pop(_key, None)
@@ -211,7 +240,10 @@ class StatusController:
         if self._last_written.get(key) == fp:
             return  # nothing changed since our last verified write
 
-        existing = _view_read(self.host, self._status_resource, key)
+        if status_cache is not None and key in status_cache:
+            existing = status_cache[key]
+        else:
+            existing = _view_read(self.host, self._status_resource, key)
         if existing is None:
             desired = {
                 "apiVersion": self.ftc.status.api_version,
@@ -554,20 +586,38 @@ class StatusAggregator:
     # -- reconcile (statusaggregator/controller.go:291-399) --------------
     def reconcile_batch(self, keys: list[str]) -> dict[str, Result]:
         results: dict[str, Result] = {}
+        source_cache = fed_cache = None
+        if _host_bulk_reads(self.host) and keys:
+            # Aggregation reads two host objects per key; batch both.
+            source_cache = bulk_get(self.host, self._target_resource, keys)
+            fed_cache = bulk_get(self.host, self._fed_resource, keys)
         hb = HostBatch(self.host)
         for key in keys:
             try:
-                self._plan_one(key, hb, results)
+                self._plan_one(key, hb, results, source_cache, fed_cache)
             except Exception:
                 self.metrics.counter("statusagg.plan_panic")
                 results[key] = Result.retry()
         hb.flush()
         return results
 
-    def _plan_one(self, key: str, hb: HostBatch, results: dict) -> None:
+    def _plan_one(
+        self,
+        key: str,
+        hb: HostBatch,
+        results: dict,
+        source_cache: Optional[dict] = None,
+        fed_cache: Optional[dict] = None,
+    ) -> None:
         self.metrics.counter("statusagg.throughput")
-        source = _view_read(self.host, self._target_resource, key)
-        fed_obj = _view_read(self.host, self._fed_resource, key)
+        if source_cache is not None and key in source_cache:
+            source = source_cache[key]
+        else:
+            source = _view_read(self.host, self._target_resource, key)
+        if fed_cache is not None and key in fed_cache:
+            fed_obj = fed_cache[key]
+        else:
+            fed_obj = _view_read(self.host, self._fed_resource, key)
         if source is None or fed_obj is None:
             return
         if source["metadata"].get("deletionTimestamp"):

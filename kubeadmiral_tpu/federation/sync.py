@@ -19,6 +19,7 @@ instead of re-reconciling itself forever.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -210,6 +211,18 @@ class SyncController:
             if self._inline
             else ThreadPoolExecutor(max_workers=max_dispatch_workers)
         )
+        # Bulk point reads (KT_BULK_READS, network fleets only): one
+        # tick's federated objects and candidate member objects are
+        # prefetched through the /batch protocol — KT_MEMBER_BATCH keys
+        # per round trip — instead of one GET per (object, member) pair.
+        # In-process stores serve free view reads, so the in-memory
+        # fleet keeps the direct path.
+        self._bulk_reads = not self._inline and os.environ.get(
+            "KT_BULK_READS", "1"
+        ) not in ("0", "false", "no")
+        # (cluster, key) -> ("ok", obj|None) | ("err", message), valid
+        # for the duration of one reconcile_batch tick.
+        self._tick_reads: dict[tuple[str, str], tuple[str, object]] = {}
         # Per-member circuit breakers, SHARED across this fleet's
         # controllers (transport/breaker.py): a member that stalled one
         # flush short-circuits the next tick's reads and writes to
@@ -357,6 +370,16 @@ class SyncController:
         or erroring member) record breaker evidence and settle the
         cluster at ClusterNotReady — they must not escape and poison the
         whole object's plan.  Returns (ok, cluster_obj)."""
+        cached = self._tick_reads.get((cname, key))
+        if cached is not None:
+            kind, value = cached
+            if kind == "err":
+                # Breaker evidence was recorded once at prefetch time.
+                dispatcher.record_error(
+                    cname, D.CLUSTER_NOT_READY, f"member read failed: {value}"
+                )
+                return False, None
+            return True, value
         breaker = self.breakers.for_member(cname)
         start = time.monotonic()
         try:
@@ -419,6 +442,15 @@ class SyncController:
                     if is_cluster_joined(c)
                 ]
             )
+            # Bulk prefetch (network fleets): the tick's fed objects in
+            # batched host reads, then every candidate (object, member)
+            # pair in batched member reads — the per-object GET fan-out
+            # becomes ceil(n / KT_MEMBER_BATCH) round trips per member.
+            fed_cache: Optional[dict] = None
+            if self._bulk_reads and fed_keys:
+                fed_cache = D.bulk_get(self.host, self._fed_resource, fed_keys)
+                if fed_cache is not None:
+                    self._prefetch_member_reads(fed_keys, fed_cache, ctx)
             sink = D.BatchSink(
                 self._member_client,
                 pool=self.pool,
@@ -431,7 +463,7 @@ class SyncController:
                 # (worker.go:119-131 semantics), the rest of the tick
                 # proceeds and still flushes.
                 try:
-                    out = self._plan_one(key, ctx, sink)
+                    out = self._plan_one(key, ctx, sink, fed_cache)
                 except Exception:
                     self.metrics.counter(f"sync-{self.ftc.name}.plan_panic")
                     results[key] = Result.retry()
@@ -452,17 +484,78 @@ class SyncController:
             # object's status + syncing annotation.
             hb.flush()
         finally:
+            self._tick_reads.clear()
             self.worker._exit(ident)
         return results
 
+    def _prefetch_member_reads(
+        self, fed_keys: list[str], fed_cache: dict, ctx: _TickClusters
+    ) -> None:
+        """Populate ``self._tick_reads`` with every member object this
+        tick's planning will read: the candidate computation mirrors
+        :meth:`_sync_to_clusters` (over-fetching a skipped candidate is
+        harmless; a miss falls back to the direct read)."""
+        wanted: dict[str, list[str]] = {}
+        for key in fed_keys:
+            fed_obj = fed_cache.get(key)
+            if fed_obj is None or fed_obj["metadata"].get("deletionTimestamp"):
+                continue
+            try:
+                if pending.get_pending(fed_obj):
+                    continue
+            except KeyError:
+                continue
+            candidates = set(C.all_placement_clusters(fed_obj))
+            for entry in fed_obj.get("status", {}).get("clusters", ()):
+                cname = entry.get("cluster")
+                if cname:
+                    candidates.add(cname)
+            with self._index_lock:
+                candidates.update(self._member_index.get(key, ()))
+            for cname in candidates:
+                flags = ctx.flags.get(cname)
+                if flags is None or not flags[0]:
+                    continue  # not joined / not ready: never read
+                if not self.breakers.allow(cname, consume_probe=False):
+                    continue  # breaker-open: the plan short-circuits too
+                wanted.setdefault(cname, []).append(key)
+        for cname, keys in wanted.items():
+            try:
+                client = self._member_client(cname)
+            except Exception:
+                continue  # resolution failures take the direct path
+            got = D.bulk_get(
+                client, self._target_resource, keys,
+                cluster=cname, breakers=self.breakers,
+            )
+            if got is None:
+                # Transport-level failure: every planned read of this
+                # member settles ClusterNotReady without another socket
+                # (breaker evidence was recorded once by bulk_get).
+                for key in keys:
+                    self._tick_reads[(cname, key)] = (
+                        "err", "member bulk read failed"
+                    )
+                continue
+            for key in keys:
+                if key in got:
+                    self._tick_reads[(cname, key)] = ("ok", got[key])
+
     def _plan_one(
-        self, key: str, ctx: _TickClusters, sink: D.BatchSink
+        self,
+        key: str,
+        ctx: _TickClusters,
+        sink: D.BatchSink,
+        fed_cache: Optional[dict] = None,
     ) -> Union[Result, Callable[..., Result]]:
         """Everything up to (and including) staging one object's member
         writes; returns a finisher ``finish(hb, results, key)`` to run
         after the sink flushes, or a settled Result for the early-exit
         paths."""
-        fed_obj = self.host.try_get(self._fed_resource, key)
+        if fed_cache is not None and key in fed_cache:
+            fed_obj = fed_cache[key]
+        else:
+            fed_obj = self.host.try_get(self._fed_resource, key)
         if fed_obj is None:
             return Result.ok()
         fed = FederatedResource(fed_obj, self.ftc)

@@ -227,6 +227,33 @@ KNOBS: dict[str, KnobSpec] = {
     "KT_FARM_SUBPROCESS": KnobSpec(
         "str", "", _OPS,
         "kwok-lite farm: run members as subprocesses."),
+    "KT_WRITE_COALESCE": KnobSpec(
+        "bool", "1", _OPS,
+        "Coalesce staged member writes into bulk /batch requests "
+        "(0 = one request per (object, member) op — the A/B baseline)."),
+    "KT_MEMBER_BATCH": KnobSpec(
+        "int", "128", _OPS,
+        "Max operations per bulk member request (write coalescing and "
+        "bulk point reads)."),
+    "KT_MEMBER_INFLIGHT": KnobSpec(
+        "int", "4", _OPS,
+        "Bulk requests concurrently in flight per member during one "
+        "flush (the pipelined write window)."),
+    "KT_BULK_READS": KnobSpec(
+        "bool", "1", _OPS,
+        "Batch point reads on network fleets: fed objects and candidate "
+        "member objects prefetched through /batch instead of per-object "
+        "GETs."),
+    "KT_ADMIT_DEPTH": KnobSpec(
+        "int", "10000", _OPS,
+        "Queue depth past which new enqueues are admitted with a "
+        "coalescing delay (0 disables admission backpressure)."),
+    "KT_ADMIT_DELAY_MS": KnobSpec(
+        "int", "50", _OPS,
+        "Coalescing delay applied to enqueues past KT_ADMIT_DEPTH."),
+    "KT_ADMIT_BATCH": KnobSpec(
+        "int", "0", _OPS,
+        "Max keys one worker drain hands a tick (0 = unlimited)."),
     # -- bench / CI drivers (bench.py, bench_e2e.py, tools/) -------------
     "KT_BENCH_GATE_TOL": KnobSpec(
         "float", "0.10", _OPS,

@@ -59,6 +59,13 @@ CATALOG: dict[str, MetricSpec] = {
     "worker_queue_depth": MetricSpec(
         "gauge", "keys", ("controller",),
         "Pending keys in the controller's dirty queue."),
+    "worker_admission_total": MetricSpec(
+        "counter", "enqueues", ("controller",),
+        "Enqueues deferred by queue-depth-driven admission "
+        "(KT_ADMIT_DEPTH / KT_ADMIT_DELAY_MS): past the depth "
+        "threshold, new keys coalesce behind a short delay so an event "
+        "flood drains as bigger amortized ticks (freshness gauges "
+        "degrade gracefully) instead of thrashing per-event p99."),
     "worker_queue_oldest_age_seconds": MetricSpec(
         "gauge", "seconds", ("controller",),
         "Age of the longest-pending key; the first stuck-controller signal."),
@@ -353,6 +360,20 @@ CATALOG: dict[str, MetricSpec] = {
         "as dispatch observed it — joined with breaker state and "
         "shed/retry tallies in GET /debug/members, so a slow member is "
         "distinguishable from a slow engine."),
+    "member_bulk_writes_total": MetricSpec(
+        "counter", "requests", ("cluster", "result"),
+        "Coalesced bulk member-write requests (dispatch.run_member_"
+        "batches; KT_WRITE_COALESCE/KT_MEMBER_BATCH/KT_MEMBER_INFLIGHT) "
+        "by outcome: ok (every op landed), partial (per-op failures in "
+        "the results — retried per item), transport (the whole request "
+        "failed at the transport after retries).  Joined with the "
+        "batch-size reservoir in GET /debug/members."),
+    "member_batch_ops": MetricSpec(
+        "histogram", "ops", (),
+        "Operations per coalesced bulk member-write request — the "
+        "batch-size distribution of the write-path coalescing window "
+        "(1 everywhere means KT_WRITE_COALESCE=0 or nothing to "
+        "amortize)."),
 }
 
 # -- end-to-end SLO catalog ------------------------------------------------

@@ -90,13 +90,17 @@ class DirtyQueue:
             heapq.heappush(self._heap, entry)
             self._wakeup.notify()
 
-    def drain_due(self) -> list[str]:
-        """Pop every key whose delivery time has arrived."""
+    def drain_due(self, limit: int = 0) -> list[str]:
+        """Pop every key whose delivery time has arrived (at most
+        ``limit`` keys when limit > 0 — the admission drain cap that
+        bounds one tick's batch under an event flood)."""
         now = self._clock()
         out: list[str] = []
         waits: list[float] = []
         with self._lock:
             while self._heap and self._heap[0].due <= now:
+                if limit and len(out) >= limit:
+                    break
                 entry = heapq.heappop(self._heap)
                 if entry.key is _TOMBSTONE:
                     continue
@@ -108,6 +112,18 @@ class DirtyQueue:
             if out:
                 self.last_drain_waits = waits
         return out
+
+    def next_due_in(self) -> float | None:
+        """Seconds until the earliest pending key is due (0 when one is
+        due now, None when empty) — lets pollers distinguish a key
+        coalescing behind a short admission delay from a long-fuse
+        requeue."""
+        with self._lock:
+            while self._heap and self._heap[0].key is _TOMBSTONE:
+                heapq.heappop(self._heap)
+            if not self._heap:
+                return None
+            return max(0.0, self._heap[0].due - self._clock())
 
     def oldest_age(self) -> float:
         """Age of the longest-pending key (0 when empty) — the queue-lag

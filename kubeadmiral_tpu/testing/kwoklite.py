@@ -65,6 +65,8 @@ class KwokLiteFarm:
         self._member_stderr: dict[str, object] = {}
         self._member_urls: dict[str, str] = {}
         self._extra_clients: list[HttpKube] = []
+        # name -> admin client, for the fault-control endpoint.
+        self._member_clients: dict[str, HttpKube] = {}
         # Explicit opt-in only: consumers that reach into member_servers
         # (tests, the __main__ demo) default-construct the farm and must
         # not be flipped by ambient env; the bench passes the flag.
@@ -75,18 +77,34 @@ class KwokLiteFarm:
 
     # -- fault injection --------------------------------------------------
     def set_fault(self, name: str, policy: FaultPolicy) -> None:
-        """Apply a FaultPolicy to one member apiserver (in-process
-        members only; subprocess members run in their own interpreter
-        where this injector cannot reach)."""
+        """Apply a FaultPolicy to one member apiserver.  In-process
+        members share this farm's injector directly; subprocess members
+        are driven over the wire through the member's fault-control
+        endpoint (POST /faultz — exempt from the fault gate, so a
+        partition can always be cleared)."""
         if name in self.member_procs:
-            raise RuntimeError(
-                f"member {name} runs as a subprocess; fault injection "
-                "requires in-process members (member_subprocess=False)"
-            )
+            self._fault_request(name, policy)
+            return
         self.faults.set_fault(name, policy)
 
     def clear_fault(self, name: str) -> None:
+        if name in self.member_procs:
+            self._fault_request(name, None)
+            return
         self.faults.clear(name)
+
+    def _fault_request(self, name: str, policy: FaultPolicy | None) -> None:
+        import dataclasses
+
+        client = self._member_clients[name]
+        body = {
+            "policy": dataclasses.asdict(policy) if policy is not None else None
+        }
+        status, payload, _ = client._request("POST", "/faultz", body)
+        if status != 200:
+            raise RuntimeError(
+                f"fault control on {name} failed: HTTP {status} {payload}"
+            )
 
     def cluster_spec(self, name: str) -> dict:
         """The FederatedCluster spec fields pointing at this member."""
@@ -138,6 +156,7 @@ class KwokLiteFarm:
         )
         client = HttpKube(url, token=admin_token, name=name)
         self._extra_clients.append(client)
+        self._member_clients[name] = client
         return client
 
     def _launch_member(self, name: str) -> None:

@@ -25,13 +25,18 @@ import sys
 def main() -> None:
     from kubeadmiral_tpu.testing.fakekube import FakeKube
     from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+    from kubeadmiral_tpu.transport.faults import FaultInjector
 
     name = os.environ.get("KWOK_NAME", "member")
     token = os.environ.get("KWOK_TOKEN") or None
     port = int(os.environ.get("KWOK_PORT", "0"))
     store = FakeKube(name)
+    # The child's own injector, driven over the wire by the parent's
+    # farm.set_fault/clear_fault via POST /faultz — subprocess members
+    # are chaos-injectable exactly like in-process ones.
     server = KubeApiServer(
-        store, admin_token=token, port=port, mint_sa_tokens=True
+        store, admin_token=token, port=port, mint_sa_tokens=True,
+        fault_injector=FaultInjector(), fault_name=name,
     )
     print(json.dumps({"url": server.url}), flush=True)
     try:

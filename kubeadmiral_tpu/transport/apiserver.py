@@ -537,6 +537,44 @@ class _Handler(BaseHTTPRequestHandler):
                 results.append(self._status_entry(400, "BadRequest", str(e)))
         self._send_json(200, {"results": results})
 
+    def _serve_faultz(self, body: dict) -> None:
+        """POST /faultz — the fault-control endpoint (transport/faults.py
+        seam over the wire): lets a parent process drive fault injection
+        on a member apiserver running in ANOTHER process (the kwok-lite
+        subprocess farm), so `farm.set_fault` works for every member
+        shape.  Routed BEFORE the fault gate — a partitioned member must
+        still accept the request that clears its partition.
+
+        Body: {"policy": {FaultPolicy fields...} | null, "member": ...?}
+        — null clears; "member" defaults to this server's fault name."""
+        import dataclasses
+
+        from kubeadmiral_tpu.transport.faults import FaultInjector, FaultPolicy
+
+        api = self.api
+        if api.fault_injector is None:
+            api.fault_injector = FaultInjector()
+        member = body.get("member") or api.fault_name
+        policy = body.get("policy")
+        if policy is None:
+            api.fault_injector.clear(member)
+            self._send_json(200, {"status": "cleared", "member": member})
+            return
+        names = {f.name for f in dataclasses.fields(FaultPolicy)}
+        unknown = set(policy) - names
+        if unknown:
+            self._send_status(
+                400, "BadRequest", f"unknown FaultPolicy fields: {sorted(unknown)}"
+            )
+            return
+        try:
+            parsed = FaultPolicy(**policy)
+        except (TypeError, ValueError) as e:
+            self._send_status(400, "BadRequest", f"invalid FaultPolicy: {e}")
+            return
+        api.fault_injector.set_fault(member, parsed)
+        self._send_json(200, {"status": "ok", "member": member})
+
     @staticmethod
     def _status_entry(code: int, reason: str, message: str) -> dict:
         return {
@@ -556,6 +594,16 @@ class _Handler(BaseHTTPRequestHandler):
         # would be parsed as the next request line on this keep-alive
         # connection, corrupting the client's pooled connection.
         obj = self._read_body()
+        # Fault control is exempt from the fault gate by construction:
+        # clearing a partition must not hang on the partition itself.
+        if urlsplit(self.path).path == "/faultz":
+            if not self._check_auth():
+                return
+            if obj is None:
+                self._send_status(400, "BadRequest", "invalid JSON body")
+                return
+            self._serve_faultz(obj)
+            return
         if self._fault_gate():
             return
         if not self._check_auth():

@@ -356,6 +356,8 @@ class BreakerRegistry:
         "_write_lat": "_lock",
         "_write_ops": "_lock",
         "_write_flushes": "_lock",
+        "_batch_sizes": "_lock",
+        "_bulk_counts": "_lock",
     }
 
     def __init__(self, metrics=None, config: Optional[BreakerConfig] = None,
@@ -375,6 +377,12 @@ class BreakerRegistry:
         self._write_lat: dict[str, "deque[float]"] = {}
         self._write_ops: dict[str, int] = {}
         self._write_flushes: dict[str, int] = {}
+        # Coalesced bulk-write attribution (dispatch.run_member_batches
+        # feeds note_batch): recent per-request batch sizes + cumulative
+        # outcome counts, joined into GET /debug/members so an operator
+        # sees whether a member's writes actually coalesce.
+        self._batch_sizes: dict[str, "deque[int]"] = {}
+        self._bulk_counts: dict[str, dict[str, int]] = {}
         _REGISTRIES.add(self)
 
     def for_member(self, name: str) -> MemberBreaker:
@@ -440,6 +448,17 @@ class BreakerRegistry:
             self._write_ops[name] = self._write_ops.get(name, 0) + int(ops)
             self._write_flushes[name] = self._write_flushes.get(name, 0) + 1
 
+    def note_batch(self, name: str, ops: int, outcome: str) -> None:
+        """One coalesced bulk request against this member completed
+        (outcome: ok | partial | transport)."""
+        with self._lock:
+            reservoir = self._batch_sizes.get(name)
+            if reservoir is None:
+                reservoir = self._batch_sizes[name] = deque(maxlen=256)
+            reservoir.append(int(ops))
+            counts = self._bulk_counts.setdefault(name, {})
+            counts[outcome] = counts.get(outcome, 0) + 1
+
     def shed_total(self) -> int:
         with self._lock:
             return sum(self._shed.values())
@@ -478,6 +497,8 @@ class BreakerRegistry:
             write_lat = {n: sorted(d) for n, d in self._write_lat.items()}
             write_ops = dict(self._write_ops)
             write_flushes = dict(self._write_flushes)
+            batch_sizes = {n: sorted(d) for n, d in self._batch_sizes.items()}
+            bulk_counts = {n: dict(c) for n, c in self._bulk_counts.items()}
         out = {}
         for name, breaker in sorted(breakers.items()):
             entry = breaker.snapshot()
@@ -494,6 +515,13 @@ class BreakerRegistry:
                         * 1e3, 3,
                     ),
                     "max_ms": round(ranked[-1] * 1e3, 3),
+                }
+            sizes = batch_sizes.get(name)
+            if sizes:
+                entry["batch"] = {
+                    "requests": bulk_counts.get(name, {}),
+                    "p50_ops": sizes[len(sizes) // 2],
+                    "max_ops": sizes[-1],
                 }
             out[name] = entry
         return out

@@ -419,6 +419,10 @@ class TestDeadlines:
 
     def test_immediate_sink_pool_size_knob(self, monkeypatch):
         monkeypatch.setenv("KT_DISPATCH_POOL", "3")
+        # Pin the per-op path: under KT_WRITE_COALESCE submits buffer
+        # and the pool is created lazily at wait() (torn down before it
+        # returns), so the sizing knob is only observable here.
+        monkeypatch.setenv("KT_WRITE_COALESCE", "0")
         assert D.dispatch_pool_size() == 3
         sink = D.ImmediateSink(lambda c: FakeKube("m"))
         outcomes = []
@@ -886,3 +890,91 @@ class TestFlappingMemberChaos:
             and t.is_alive()
         ]
         assert not leaked, leaked
+
+
+class TestFaultControlEndpoint:
+    """POST /faultz (ISSUE 15): fault injection over the wire, so the
+    kwok-lite farm can chaos-inject SUBPROCESS members too."""
+
+    def test_set_and_clear_over_http(self):
+        from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+        from kubeadmiral_tpu.transport.client import HttpKube
+
+        store = FakeKube("m-f")
+        server = KubeApiServer(store, admin_token="tok")
+        try:
+            client = HttpKube(server.url, token="tok", timeout=2.0)
+            store.create("v1/pods", {"metadata": {"name": "p"}, "spec": {}})
+            assert client.get("v1/pods", "p")["metadata"]["name"] == "p"
+            # Inject a hard error policy via the endpoint.
+            status, payload, _ = client._request(
+                "POST", "/faultz", {"policy": {"error_rate": 1.0}}
+            )
+            assert status == 200 and payload["status"] == "ok"
+            with pytest.raises(TransportError):
+                client.get("v1/pods", "p")
+            # Clearing (policy: null) goes through even while faulted —
+            # the endpoint is exempt from the fault gate.
+            status, payload, _ = client._request(
+                "POST", "/faultz", {"policy": None}
+            )
+            assert status == 200 and payload["status"] == "cleared"
+            assert client.get("v1/pods", "p")["metadata"]["name"] == "p"
+            # Unknown fields are rejected loudly, not silently dropped.
+            status, payload, _ = client._request(
+                "POST", "/faultz", {"policy": {"no_such_field": 1}}
+            )
+            assert status == 400
+            client.close()
+        finally:
+            server.close()
+
+    def test_faultz_requires_auth(self):
+        from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+        from kubeadmiral_tpu.transport.client import HttpKube
+
+        store = FakeKube("m-f2")
+        server = KubeApiServer(store, admin_token="tok")
+        try:
+            anon = HttpKube(server.url, timeout=2.0)
+            status, _, _ = anon._request(
+                "POST", "/faultz", {"policy": {"error_rate": 1.0}}
+            )
+            assert status == 401
+            anon.close()
+        finally:
+            server.close()
+
+    def test_farm_routes_faults_to_inprocess_members(self):
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        farm = KwokLiteFarm()
+        try:
+            client = farm.add_member("m-0")
+            farm.set_fault("m-0", FaultPolicy(error_rate=1.0))
+            with pytest.raises(TransportError):
+                client.list("v1/pods")
+            farm.clear_fault("m-0")
+            assert client.list("v1/pods") == []
+        finally:
+            farm.close()
+
+
+@pytest.mark.slow
+class TestSubprocessFaultControl:
+    def test_subprocess_member_injectable(self):
+        """A subprocess farm member honors set_fault/clear_fault through
+        the fault-control endpoint (the chaos phase's enabling seam)."""
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        farm = KwokLiteFarm(member_subprocess=True)
+        try:
+            client = farm.add_member("m-sub")
+            assert client.list("v1/pods") == []
+            farm.set_fault("m-sub", FaultPolicy(error_rate=1.0))
+            with pytest.raises(TransportError):
+                client.list("v1/pods")
+            farm.clear_fault("m-sub")
+            assert client.list("v1/pods") == []
+        finally:
+            farm.close()

@@ -1,0 +1,521 @@
+"""Write-path coalescing window (ISSUE 15): batch x breaker x deadline.
+
+Covers the per-member coalescing window in federation/dispatch.py
+(run_member_batches + the BatchSink/ImmediateSink staging growth):
+
+* chunking honors KT_MEMBER_BATCH / KT_WRITE_COALESCE, result order is
+  per-op stable, continuations fire per item off the batch ack;
+* an open-breaker member sheds a whole staged batch without a socket;
+* mid-batch deadline expiry sheds the remainder (member_shed_writes
+  counted, statuses stay at their pre-recorded *_TIMED_OUT values);
+* a partial batch failure retries only the failed items;
+* KT_WRITE_COALESCE=0 A/B: member-visible objects and propagation
+  statuses bit-identical to the coalesced path;
+* queue-depth-driven admission backpressure (runtime/worker.py) and the
+  drain cap;
+* the watch-boundary trigger filters (status-only fed writes do not
+  re-enqueue scheduler/override/federate);
+* sync's bulk member-read prefetch over a real HTTP farm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubeadmiral_tpu.federation import dispatch as D
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.worker import BatchWorker, Worker
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet, FakeKube
+from kubeadmiral_tpu.transport import breaker as B
+
+
+class RecordingKube:
+    """FakeKube-duck client recording every batch() call (sizes + ops);
+    NOT a FakeKube subclass, so the coalescing window treats it as a
+    network client (pipelining + stall-capable paths engage)."""
+
+    def __init__(self, inner=None, fail_keys=(), fail_times=1, batch_delay=0.0):
+        self.inner = inner or FakeKube("m")
+        self.calls: list[list[dict]] = []
+        self.fail_keys = set(fail_keys)
+        self.fail_remaining = {k: fail_times for k in self.fail_keys}
+        self.batch_delay = batch_delay
+        self._lock = threading.Lock()
+
+    def batch(self, ops):
+        with self._lock:
+            self.calls.append([dict(op) for op in ops])
+        if self.batch_delay:
+            time.sleep(self.batch_delay)
+        results = []
+        for op in ops:
+            name = (op.get("object") or {}).get("metadata", {}).get("name") or op.get("key")
+            with self._lock:
+                left = self.fail_remaining.get(name, 0)
+                if left > 0:
+                    self.fail_remaining[name] = left - 1
+                    results.append({"code": 500, "status": {
+                        "reason": "InternalError", "message": "flaky"}})
+                    continue
+            results.extend(self.inner.batch([op]))
+        return results
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+def _create_op(i):
+    return {
+        "verb": "create",
+        "resource": "v1/pods",
+        "object": {"metadata": {"name": f"p-{i:03d}"}, "spec": {}},
+    }
+
+
+class TestRunMemberBatches:
+    def test_chunking_and_order(self, monkeypatch):
+        monkeypatch.setenv("KT_MEMBER_BATCH", "4")
+        monkeypatch.setenv("KT_MEMBER_INFLIGHT", "1")
+        client = RecordingKube()
+        ops = [_create_op(i) for i in range(10)]
+        out = D.run_member_batches(client, ops, time.monotonic() + 5.0, cluster="m")
+        assert [len(c) for c in client.calls] == [4, 4, 2]
+        assert len(out) == 10
+        # Per-op result order matches the op order.
+        for i, res in enumerate(out):
+            assert res["code"] == 201
+            assert res["object"]["metadata"]["name"] == f"p-{i:03d}"
+
+    def test_pipelined_window_preserves_order(self, monkeypatch):
+        monkeypatch.setenv("KT_MEMBER_BATCH", "2")
+        monkeypatch.setenv("KT_MEMBER_INFLIGHT", "3")
+        client = RecordingKube()
+        ops = [_create_op(i) for i in range(9)]
+        out = D.run_member_batches(client, ops, time.monotonic() + 5.0, cluster="m")
+        assert len(client.calls) == 5  # ceil(9 / 2)
+        assert [r["object"]["metadata"]["name"] for r in out] == [
+            f"p-{i:03d}" for i in range(9)
+        ]
+
+    def test_coalesce_off_is_per_object(self, monkeypatch):
+        monkeypatch.setenv("KT_WRITE_COALESCE", "0")
+        monkeypatch.setenv("KT_MEMBER_INFLIGHT", "1")
+        client = RecordingKube()
+        ops = [_create_op(i) for i in range(5)]
+        D.run_member_batches(client, ops, time.monotonic() + 5.0, cluster="m")
+        assert [len(c) for c in client.calls] == [1] * 5
+
+    def test_partial_failure_retries_only_failed_items(self, monkeypatch):
+        monkeypatch.setenv("KT_MEMBER_BATCH", "8")
+        monkeypatch.setenv("KT_RETRY_BASE_S", "0.001")
+        client = RecordingKube(fail_keys={"p-002"}, fail_times=1)
+        ops = [_create_op(i) for i in range(6)]
+        out = D.run_member_batches(client, ops, time.monotonic() + 5.0, cluster="m")
+        assert all(r["code"] == 201 for r in out)
+        # First request carried all 6 ops; the retry carried ONLY the
+        # failed item.
+        assert len(client.calls[0]) == 6
+        retried = client.calls[1]
+        assert len(retried) == 1
+        assert retried[0]["object"]["metadata"]["name"] == "p-002"
+
+    def test_mid_batch_deadline_sheds_remainder(self, monkeypatch):
+        monkeypatch.setenv("KT_MEMBER_BATCH", "2")
+        monkeypatch.setenv("KT_MEMBER_INFLIGHT", "1")
+        monkeypatch.setenv("KT_RETRY_MAX", "0")
+        metrics = Metrics()
+        registry = B.BreakerRegistry(metrics=metrics)
+        # Each chunk takes ~80 ms; the deadline allows roughly one.
+        client = RecordingKube(batch_delay=0.08)
+        ops = [_create_op(i) for i in range(10)]
+        out = D.run_member_batches(
+            client, ops, time.monotonic() + 0.1, cluster="m", breakers=registry
+        )
+        shed = [r for r in out if r.get("shed")]
+        landed = [r for r in out if not r.get("shed")]
+        assert shed and landed, (len(shed), len(landed))
+        assert len(out) == 10
+        # Shed ops counted via the registry (member_shed_writes_total).
+        assert registry.shed_total() == len(shed)
+        # The landed prefix is contiguous: ops dispatch in order.
+        assert all(r["code"] == 201 for r in landed)
+
+    def test_breaker_open_mid_flush_stops_sockets(self, monkeypatch):
+        monkeypatch.setenv("KT_MEMBER_BATCH", "2")
+        monkeypatch.setenv("KT_MEMBER_INFLIGHT", "1")
+        registry = B.BreakerRegistry(metrics=Metrics())
+        client = RecordingKube()
+        for _ in range(10):
+            registry.for_member("m").record_failure()
+        assert not registry.allow("m", consume_probe=False)
+        ops = [_create_op(i) for i in range(6)]
+        out = D.run_member_batches(
+            client, ops, time.monotonic() + 5.0, cluster="m", breakers=registry
+        )
+        assert client.calls == []  # not a single socket touched
+        assert all(r.get("shed") for r in out)
+        assert registry.shed_total() == 6
+
+
+class TestBatchSinkCoalesce:
+    def test_open_breaker_sheds_whole_staged_batch_without_socket(self):
+        registry = B.BreakerRegistry(metrics=Metrics())
+        for _ in range(10):
+            registry.for_member("m").record_failure()
+        client = RecordingKube()
+        sink = D.BatchSink(lambda c: client, breakers=registry)
+        statuses = []
+        for i in range(5):
+            sink.submit("m", _create_op(i), statuses.append)
+        sink.flush(timeout=5.0)
+        assert client.calls == []  # shed at flush time, no socket
+        assert statuses == []      # continuations never ran
+        assert registry.shed_total() >= 5
+
+    def test_batch_telemetry_emitted(self, monkeypatch):
+        monkeypatch.setenv("KT_MEMBER_BATCH", "3")
+        metrics = Metrics()
+        registry = B.BreakerRegistry(metrics=metrics)
+        client = RecordingKube()
+        sink = D.BatchSink(lambda c: client, breakers=registry)
+        done = []
+        for i in range(7):
+            sink.submit("m", _create_op(i), done.append)
+        sink.flush(timeout=5.0)
+        assert len(done) == 7
+        snap = registry.snapshot()["m"]
+        assert snap["batch"]["requests"].get("ok", 0) == 3  # ceil(7/3)
+        assert snap["batch"]["max_ops"] == 3
+        assert metrics.counters.get(
+            "member_bulk_writes_total{cluster=m,result=ok}"
+        ) == 3
+
+
+class TestCoalesceAB:
+    """KT_WRITE_COALESCE=0 must produce bit-identical member objects and
+    propagation statuses (the acceptance A/B)."""
+
+    def _run_world(self, monkeypatch, coalesce: str):
+        import dataclasses
+
+        from kubeadmiral_tpu.federation.sync import SyncController
+        from kubeadmiral_tpu.models.ftc import default_ftcs
+
+        monkeypatch.setenv("KT_WRITE_COALESCE", coalesce)
+        monkeypatch.setenv("KT_MEMBER_BATCH", "3")
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        ftc = dataclasses.replace(ftc, controllers=(), revision_history=False)
+        fleet = ClusterFleet()
+        for name in ("m-1", "m-2", "m-3"):
+            fleet.add_member(name)
+            fleet.host.create(
+                "core.kubeadmiral.io/v1alpha1/federatedclusters",
+                {
+                    "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                    "kind": "FederatedCluster",
+                    "metadata": {"name": name},
+                    "spec": {},
+                    "status": {"conditions": [
+                        {"type": "Joined", "status": "True"},
+                        {"type": "Ready", "status": "True"},
+                    ]},
+                },
+            )
+        ctl = SyncController(fleet, ftc)
+        for i in range(8):
+            fed = {
+                "apiVersion": ftc.federated.api_version,
+                "kind": ftc.federated.kind,
+                "metadata": {
+                    "name": f"web-{i}",
+                    "namespace": "default",
+                    "annotations": {
+                        "kubeadmiral.io/pending-controllers": "[]",
+                    },
+                },
+                "spec": {
+                    "template": {
+                        "apiVersion": "apps/v1",
+                        "kind": "Deployment",
+                        "metadata": {"name": f"web-{i}", "namespace": "default"},
+                        "spec": {"replicas": i + 1},
+                    },
+                    "placements": [
+                        {
+                            "controller": "kubeadmiral.io/global-scheduler",
+                            "placement": [
+                                {"cluster": "m-1"},
+                                {"cluster": "m-2" if i % 2 else "m-3"},
+                            ],
+                        }
+                    ],
+                },
+            }
+            fleet.host.create(ftc.federated.resource, fed)
+        while ctl.worker.step():
+            pass
+        dump = {}
+        for name in ("m-1", "m-2", "m-3"):
+            member = fleet.member(name)
+            dump[name] = {
+                key: _strip_volatile(member.get(ftc.source.resource, key))
+                for key in sorted(member.keys(ftc.source.resource))
+            }
+        statuses = {}
+        for key in sorted(fleet.host.keys(ftc.federated.resource)):
+            fed = fleet.host.get(ftc.federated.resource, key)
+            statuses[key] = (fed.get("status") or {}).get("clusters")
+        return dump, statuses
+
+    def test_ab_bit_identical(self, monkeypatch):
+        on_dump, on_status = self._run_world(monkeypatch, "1")
+        off_dump, off_status = self._run_world(monkeypatch, "0")
+        assert on_dump == off_dump
+        assert on_status == off_status
+        # Sanity: the world actually propagated.
+        assert any(on_dump[m] for m in on_dump)
+        assert all(
+            all(e["status"] == "OK" for e in entries)
+            for entries in on_status.values()
+            if entries
+        )
+
+
+def _strip_volatile(obj: dict) -> dict:
+    """Drop per-store sequencing fields that legitimately differ between
+    two separately-run worlds (rv/uid are allocation counters)."""
+    import copy
+
+    out = copy.deepcopy(obj)
+    out.get("metadata", {}).pop("resourceVersion", None)
+    out.get("metadata", {}).pop("uid", None)
+    return out
+
+
+class TestAdmission:
+    def test_enqueue_past_depth_defers(self, monkeypatch):
+        monkeypatch.setenv("KT_ADMIT_DEPTH", "10")
+        monkeypatch.setenv("KT_ADMIT_DELAY_MS", "200")
+        metrics = Metrics()
+        w = BatchWorker("admit-test", lambda keys: {}, metrics=metrics)
+        for i in range(11):
+            w.enqueue(f"k-{i}")
+        # Depth is now 11 > 10: the next enqueue defers.
+        w.enqueue("late")
+        due = w.queue.drain_due()
+        assert "late" not in due
+        assert len(due) == 11
+        assert w.queue.next_due_in() is not None
+
+    def test_admission_disabled(self, monkeypatch):
+        monkeypatch.setenv("KT_ADMIT_DEPTH", "0")
+        w = Worker("admit-off", lambda k: None)
+        for i in range(50):
+            w.enqueue(f"k-{i}")
+        assert len(w.queue.drain_due()) == 50
+
+    def test_drain_cap(self, monkeypatch):
+        monkeypatch.setenv("KT_ADMIT_BATCH", "5")
+        seen = []
+
+        def tick(keys):
+            seen.append(list(keys))
+            return {}
+
+        w = BatchWorker("drain-cap", tick, metrics=Metrics())
+        monkeypatch.setenv("KT_ADMIT_DEPTH", "0")
+        for i in range(12):
+            w.enqueue(f"k-{i}")
+        while w.step():
+            pass
+        assert [len(batch) for batch in seen] == [5, 5, 2]
+
+
+class TestEventSigFilters:
+    """Status-only fed writes must not re-enqueue the scheduling-side
+    controllers (the watch-boundary half of admission backpressure)."""
+
+    def _fed(self, gen=1, status=None, ann=None):
+        obj = {
+            "metadata": {
+                "name": "web", "namespace": "d", "generation": gen,
+                "labels": {"app": "web"},
+                "annotations": dict(ann or {}),
+            },
+            "spec": {"template": {}},
+        }
+        if status is not None:
+            obj["status"] = status
+        return obj
+
+    def test_scheduler_skips_status_only_writes(self):
+        import dataclasses
+
+        from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+        from kubeadmiral_tpu.models.ftc import default_ftcs
+
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        host = FakeKube("host")
+        ctl = SchedulerController(host, ftc)
+        ctl.worker.queue.drain_due()  # clear replay noise
+        ctl._on_object_event("ADDED", self._fed())
+        assert ctl.worker.queue.drain_due() == ["d/web"]
+        # Same metadata, status changed: a status-subresource write.
+        ctl._on_object_event("MODIFIED", self._fed(status={"clusters": []}))
+        assert ctl.worker.queue.drain_due() == []
+        # Generation bump (spec change): re-enqueues.
+        ctl._on_object_event("MODIFIED", self._fed(gen=2))
+        assert ctl.worker.queue.drain_due() == ["d/web"]
+        # Syncing-feedback annotation churn: filtered noise.
+        ctl._on_object_event(
+            "MODIFIED",
+            self._fed(gen=2, ann={"kubeadmiral.io/syncing": "{...}"}),
+        )
+        assert ctl.worker.queue.drain_due() == []
+        # Any other annotation (pending-controllers advance): enqueues.
+        ctl._on_object_event(
+            "MODIFIED",
+            self._fed(gen=2, ann={"kubeadmiral.io/pending-controllers": "[]"}),
+        )
+        assert ctl.worker.queue.drain_due() == ["d/web"]
+        # DELETED always enqueues and clears the sig.
+        ctl._on_object_event("DELETED", self._fed(gen=2))
+        assert ctl.worker.queue.drain_due() == ["d/web"]
+
+    def test_federate_skips_status_only_fed_writes(self):
+        from kubeadmiral_tpu.federation.federate import FederateController
+        from kubeadmiral_tpu.models.ftc import default_ftcs
+
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        host = FakeKube("host")
+        ctl = FederateController(host, ftc)
+        ctl.worker.queue.drain_due()
+        ctl._on_fed_event("ADDED", self._fed())
+        assert ctl.worker.queue.drain_due() == ["d/web"]
+        ctl._on_fed_event("MODIFIED", self._fed(status={"clusters": []}))
+        assert ctl.worker.queue.drain_due() == []
+        # The syncing annotation IS federate's trigger (it mirrors it to
+        # the source): must re-enqueue.
+        ctl._on_fed_event(
+            "MODIFIED", self._fed(ann={"kubeadmiral.io/syncing": "{}"})
+        )
+        assert ctl.worker.queue.drain_due() == ["d/web"]
+
+
+@pytest.mark.slow
+class TestBulkReadsHttp:
+    """Sync's bulk member-read prefetch over a real HTTP farm: the
+    propagated world must be identical with the prefetch on and off."""
+
+    def _world(self, monkeypatch, bulk: str):
+        import dataclasses
+
+        from kubeadmiral_tpu.federation.clusterctl import (
+            FederatedClusterController,
+            NODES,
+        )
+        from kubeadmiral_tpu.federation.sync import SyncController
+        from kubeadmiral_tpu.models.ftc import default_ftcs
+        from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+        monkeypatch.setenv("KT_BULK_READS", bulk)
+        gvk = "apps/v1/Deployment"
+        ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+        ftc = dataclasses.replace(ftc, controllers=(), revision_history=False)
+        farm = KwokLiteFarm()
+        try:
+            cluster_ctl = FederatedClusterController(
+                farm.fleet, api_resource_probe=[gvk]
+            )
+            members = {}
+            for name in ("m-1", "m-2"):
+                member = farm.add_member(name)
+                members[name] = member
+                member.create(NODES, {
+                    "apiVersion": "v1", "kind": "Node",
+                    "metadata": {"name": "n1"}, "spec": {},
+                    "status": {"allocatable": {"cpu": "32", "memory": "64Gi"},
+                               "conditions": [{"type": "Ready", "status": "True"}]},
+                })
+                farm.fleet.host.create(
+                    "core.kubeadmiral.io/v1alpha1/federatedclusters",
+                    {
+                        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+                        "kind": "FederatedCluster",
+                        "metadata": {"name": name},
+                        "spec": farm.cluster_spec(name),
+                    },
+                )
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                while cluster_ctl.worker.step():
+                    pass
+                joined = [
+                    c for c in farm.fleet.host.list(
+                        "core.kubeadmiral.io/v1alpha1/federatedclusters"
+                    )
+                    if any(
+                        cond.get("type") == "Ready" and cond.get("status") == "True"
+                        for cond in c.get("status", {}).get("conditions", [])
+                    )
+                ]
+                if len(joined) == 2:
+                    break
+                time.sleep(0.1)
+            assert len(joined) == 2, "members never joined"
+            sync = SyncController(farm.fleet, ftc)
+            assert sync._bulk_reads == (bulk != "0")
+            for i in range(6):
+                farm.fleet.host.create(ftc.federated.resource, {
+                    "apiVersion": ftc.federated.api_version,
+                    "kind": ftc.federated.kind,
+                    "metadata": {
+                        "name": f"w-{i}", "namespace": "default",
+                        "annotations": {
+                            "kubeadmiral.io/pending-controllers": "[]"},
+                    },
+                    "spec": {
+                        "template": {
+                            "apiVersion": "apps/v1", "kind": "Deployment",
+                            "metadata": {"name": f"w-{i}",
+                                         "namespace": "default"},
+                            "spec": {"replicas": 1 + i},
+                        },
+                        "placements": [{
+                            "controller": "kubeadmiral.io/global-scheduler",
+                            "placement": [{"cluster": "m-1"},
+                                          {"cluster": "m-2"}],
+                        }],
+                    },
+                })
+            deadline = time.monotonic() + 30.0
+            want = {f"default/w-{i}" for i in range(6)}
+            while time.monotonic() < deadline:
+                while sync.worker.step():
+                    pass
+                done = all(
+                    set(members[m].keys(ftc.source.resource)) >= want
+                    for m in members
+                )
+                if done:
+                    break
+                time.sleep(0.1)
+            out = {
+                m: {
+                    k: _strip_volatile(members[m].get(ftc.source.resource, k))
+                    for k in sorted(members[m].keys(ftc.source.resource))
+                }
+                for m in members
+            }
+            return out
+        finally:
+            farm.close()
+
+    def test_bulk_vs_direct_identical(self, monkeypatch):
+        bulk = self._world(monkeypatch, "1")
+        direct = self._world(monkeypatch, "0")
+        assert bulk == direct
+        assert all(len(v) == 6 for v in bulk.values())

@@ -634,6 +634,25 @@ def gate_census(root: Path) -> int:
 _E2E_RE = re.compile(r"^BENCH_E2E(?:_[A-Z]+)?_r(\d+)\.json$")
 
 
+def _e2e_baseline_key(detail: dict, metric: str) -> str:
+    """The baseline key an e2e round gates within: platform/devices +
+    TRANSPORT + MEMBER COUNT, the way device_count was folded in for
+    engine rounds (ISSUE 15) — a 500-member HTTP-farm round must never
+    gate against (or silently seed) an in-process 50-member baseline.
+    Artifacts predating the ``members`` detail field derive it from the
+    NxC metric suffix; the first round at a new (transport, members)
+    key trips the loud NOTHING-GATED warning, exactly like a platform
+    move."""
+    transport = detail.get("transport") or (
+        "http" if metric.endswith("_http") else "inproc"
+    )
+    members = detail.get("members")
+    if members is None:
+        m = re.search(r"_(\d+)x(\d+)", metric)
+        members = m.group(2) if m else "unknown"
+    return f"{_platform_key(detail)}/{transport}/m{members}"
+
+
 def gate_e2e(root: Path, tolerance: float) -> int:
     """Gate the end-to-end p99 event→placement-written latency
     (BENCH_E2E*_r*.json, ``detail.slo.e2e_p99_ms`` — ISSUE 13): ceiling
@@ -667,7 +686,7 @@ def gate_e2e(root: Path, tolerance: float) -> int:
                 "round": int(m.group(1)),
                 "path": path.name,
                 "metric": metric,
-                "platform": _platform_key(detail),
+                "platform": _e2e_baseline_key(detail, metric),
                 "value": float(value),
                 "p99": slo.get("e2e_p99_ms"),
                 "p50": slo.get("e2e_p50_ms"),
@@ -678,57 +697,61 @@ def gate_e2e(root: Path, tolerance: float) -> int:
     if not rounds:
         return 0
     rounds.sort(key=lambda r: r["round"])
-    latest = rounds[-1]
-    if latest["p99"] is None:
-        print(
-            f"bench-gate: {latest['path']} ({latest['metric']}) carries no "
-            f"detail.slo block (pre-SLO round) — e2e p99 not gated"
-        )
-        return 0
-    print(
-        f"bench-gate: e2e {latest['path']} value={latest['value']:.1f} "
-        f"objects/s, event→written p50={latest['p50']}ms "
-        f"p99={latest['p99']:.1f}ms "
-        f"(decomposition err {latest['decomp_err']}%) — throughput "
-        f"informational"
-    )
-    if latest.get("stages"):
-        print(
-            "bench-gate: e2e stage p99 ms: "
-            + " ".join(
-                f"{stage}={spec.get('p99')}"
-                for stage, spec in latest["stages"].items()
+    # Gate the LATEST round of every (metric, transport/members) group:
+    # an inproc round and a scaled HTTP-farm round landing together each
+    # gate against their own baselines (and a first round at a new key
+    # trips its own loud NOTHING-GATED warning, never silence).
+    groups: dict[tuple[str, str], list[dict]] = {}
+    for r in rounds:
+        groups.setdefault((r["metric"], r["platform"]), []).append(r)
+    ok = True
+    for (metric, platform), group in sorted(groups.items()):
+        latest = group[-1]
+        if latest["p99"] is None:
+            print(
+                f"bench-gate: {latest['path']} ({metric}) carries no "
+                f"detail.slo block (pre-SLO round) — e2e p99 not gated"
             )
-        )
-    priors = [
-        r
-        for r in rounds[:-1]
-        if r["metric"] == latest["metric"]
-        and r["platform"] == latest["platform"]
-        and r.get("p99") is not None
-    ]
-    if not priors:
+            continue
         print(
-            f"bench-gate: WARNING: {latest['path']} ({latest['metric']}, "
-            f"platform={latest['platform']}) has no prior round carrying "
-            f"e2e p99 — NOTHING GATED this round; this artifact becomes "
-            f"the baseline the next round gates against"
+            f"bench-gate: e2e {latest['path']} [{platform}] "
+            f"value={latest['value']:.1f} objects/s, event→written "
+            f"p50={latest['p50']}ms p99={latest['p99']:.1f}ms "
+            f"(decomposition err {latest['decomp_err']}%) — throughput "
+            f"informational"
         )
-        return 0
-    best = min(r["p99"] for r in priors)
-    ceil = best * (1.0 + tolerance) + 250.0
-    print(
-        f"bench-gate: e2e p99={latest['p99']:.1f}ms vs best prior "
-        f"{best:.1f}ms (ceiling {ceil:.1f})"
-    )
-    if latest["p99"] > ceil:
+        if latest.get("stages"):
+            print(
+                "bench-gate: e2e stage p99 ms: "
+                + " ".join(
+                    f"{stage}={spec.get('p99')}"
+                    for stage, spec in latest["stages"].items()
+                )
+            )
+        priors = [r for r in group[:-1] if r.get("p99") is not None]
+        if not priors:
+            print(
+                f"bench-gate: WARNING: {latest['path']} ({metric}, "
+                f"key={platform}) has no prior round carrying e2e p99 — "
+                f"NOTHING GATED for this key; this artifact becomes the "
+                f"baseline the next round gates against"
+            )
+            continue
+        best = min(r["p99"] for r in priors)
+        ceil = best * (1.0 + tolerance) + 250.0
         print(
-            f"bench-gate: E2E P99 REGRESSION: {latest['p99']:.1f}ms > "
-            f"{ceil:.1f}ms — the event→placement-written SLO regressed",
-            file=sys.stderr,
+            f"bench-gate: e2e p99={latest['p99']:.1f}ms vs best prior "
+            f"{best:.1f}ms (ceiling {ceil:.1f})"
         )
-        return 1
-    return 0
+        if latest["p99"] > ceil:
+            print(
+                f"bench-gate: E2E P99 REGRESSION [{platform}]: "
+                f"{latest['p99']:.1f}ms > {ceil:.1f}ms — the "
+                f"event→placement-written SLO regressed",
+                file=sys.stderr,
+            )
+            ok = False
+    return 0 if ok else 1
 
 
 def report_e2e_chaos(root: Path) -> None:
