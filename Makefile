@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-churn bench-gate bench-restart bench-e2e bench-e2e-scale graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke
+.PHONY: test test-fast bench bench-churn bench-gate bench-restart bench-soak bench-e2e bench-e2e-scale graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -112,6 +112,17 @@ profile-smoke:
 # gated restart_to_first_tick_ms metric (BENCH_RESTART_r<n>.json).
 bench-restart:
 	$(PYTEST_ENV) BENCH_SCENARIO=restart python bench.py
+
+# All-stressors-at-once gated soak (ISSUE 16): sustained arrival churn
+# + periodic capacity drift + one flapping and one hard-down member +
+# a mid-run SIGKILL/failover, all concurrently, over the full
+# federate->schedule->sync pipeline.  Placements must come out
+# bit-identical to an uninterrupted oracle run, and the recorded
+# telemetry timeline must show the burn-rate evaluator red ONLY inside
+# declared injection windows (SOAK_r<n>.json, gated by bench-gate; see
+# docs/observability.md "Soak observatory").
+bench-soak:
+	$(PYTEST_ENV) BENCH_SCENARIO=soak python bench.py
 
 bench-churn:
 	$(PYTEST_ENV) BENCH_SCENARIO=churn_rate \

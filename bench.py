@@ -286,6 +286,7 @@ def time_batched(rng, units, clusters, followers):
     profile_ticks = int(os.environ.get("KT_PROFILE_TICKS", "0") or 0)
     profile_dir = None
     timed_tick_ids = []
+    tick_walls = []
     t0 = time.perf_counter()
     for i in range(TICKS):
         if profile_ticks and i == 0:
@@ -299,8 +300,10 @@ def time_batched(rng, units, clusters, followers):
             )
             os.makedirs(profile_dir, exist_ok=True)
             _jax.profiler.start_trace(profile_dir)
+        t_tick = time.perf_counter()
         units = churn(rng, units)
         results = engine.schedule(units, clusters, follower_index=fidx)
+        tick_walls.append(time.perf_counter() - t_tick)
         timed_tick_ids.append(engine.last_tick_id)
         for stage, secs in engine.timings.items():
             detail[stage] = detail.get(stage, 0.0) + secs
@@ -402,6 +405,21 @@ def time_batched(rng, units, clusters, followers):
         device_attr["profile_dir"] = profile_dir
 
     detail = {k: round(v / TICKS * 1e3, 1) for k, v in detail.items()}
+    # Per-tick throughput series + median: the gate floors the MEDIAN
+    # round-to-round (one slow outlier tick — GC pause, first sub-batch
+    # compile — can no longer sink or save a round the way the mean
+    # could), while the full series stays in the artifact for forensics.
+    tick_rates = sorted(N_OBJECTS / w for w in tick_walls)
+    mid = len(tick_rates) // 2
+    median_rate = (
+        tick_rates[mid]
+        if len(tick_rates) % 2
+        else (tick_rates[mid - 1] + tick_rates[mid]) / 2.0
+    )
+    detail["objs_per_sec_series"] = [
+        round(N_OBJECTS / w, 1) for w in tick_walls
+    ]
+    detail["objs_per_sec_median"] = round(median_rate, 1)
     detail["device_attr"] = device_attr
     detail["drift_tick_ms"] = round(drift_ms, 1)
     # ISSUE 4: the drift-path stage breakdown + dispatch counts +
@@ -1069,6 +1087,291 @@ def run_census_scenario() -> None:
     _save_round_artifact(result, "BENCH_CENSUS")
 
 
+def _soak_schedule():
+    """The soak's deterministic script, sized by the KT_SOAK_* knobs
+    (docs/operations.md); every role (parent, oracle, victim,
+    successor) derives the identical schedule from the inherited env."""
+    from kubeadmiral_tpu.testing.soakharness import SoakSchedule
+
+    return SoakSchedule(
+        rounds=int(os.environ.get("KT_SOAK_ROUNDS", "10") or 10),
+        arrivals_per_round=int(os.environ.get("KT_SOAK_ARRIVALS", "6") or 6),
+        kill_round=int(os.environ.get("KT_SOAK_KILL_ROUND", "5") or 5),
+    )
+
+
+def _soak_observatory():
+    """Install the full observability stack a production manager would
+    run — SLO recorder, tenant ledger, telemetry timeline — sharing one
+    Metrics registry (the timeline samples it; /debug would serve it)."""
+    from kubeadmiral_tpu.runtime import slo as slo_mod
+    from kubeadmiral_tpu.runtime import tenancy, timeline
+    from kubeadmiral_tpu.runtime.metrics import Metrics
+
+    m = Metrics()
+    rec = slo_mod.reset_default()
+    ledger = tenancy.TenantLedger(metrics=m)
+    tenancy.set_default(ledger)
+    tl = timeline.Timeline(metrics=m)
+    timeline.set_default(tl)
+    return m, rec, ledger, tl
+
+
+def _soak_red_outside(timeline_doc: dict, windows: list) -> list:
+    """Every raw-tier slo_red sample > 0 whose timestamp is not covered
+    by a declared injection window (t1 None = open at process death =
+    covered through +inf).  Raw tier: each bucket is one sample at its
+    own instant, so a point's time IS the red instant — coarser tiers'
+    MAX-merge would smear a red sample across a whole bucket."""
+    out = []
+    slack = 0.25
+    raw = (timeline_doc.get("tiers") or {}).get("raw") or {}
+    for key, series in sorted((raw.get("series") or {}).items()):
+        if not key.startswith("slo_red{"):
+            continue
+        for t, v in series.get("points") or []:
+            if v <= 0:
+                continue
+            covered = any(
+                w["t0"] - slack
+                <= t
+                <= (w["t1"] if w["t1"] is not None else float("inf")) + slack
+                for w in windows
+            )
+            if not covered:
+                out.append({"series": key, "t": round(t, 3), "value": v})
+    return out
+
+
+def _soak_scheduled(tenants_doc: dict) -> int:
+    return sum(
+        t.get("scheduled", 0)
+        for t in (tenants_doc.get("tenants") or {}).values()
+    )
+
+
+def run_soak_scenario() -> None:
+    """--scenario soak: the all-stressors-at-once gated soak.
+
+    Four processes, one deterministic :class:`SoakSchedule`
+    (testing/soakharness.py): the ORACLE runs every round with no
+    faults and no restart; the VICTIM runs rounds 0..kill_round with a
+    flapping member, a hard-down member, arrival churn and capacity
+    drift all active, dumps its fleet + telemetry after every round,
+    then SIGKILLs itself; the SUCCESSOR restores the victim's fleet
+    dump + engine snapshot and finishes the remaining rounds under the
+    same faults.  The PARENT (this process) asserts the successor's
+    final placements are bit-identical to the oracle's, evaluates
+    "burn-rate evaluator never red outside a declared injection
+    window" from both recorded timelines, and emits the gated
+    SOAK_r<n>.json artifact (tools/bench_gate.py gate_soak)."""
+    import signal
+    import subprocess
+    import tempfile
+
+    # Chaos-grade SLO windows (the bench_e2e chaos stage's settings):
+    # freshness must notice a hard-down member within ~1s and the burn
+    # windows must decay within the post-recovery settle.  Children
+    # inherit these via the environment.
+    os.environ.setdefault("KT_SLO_FRESHNESS_S", "1.0")
+    os.environ.setdefault("KT_SLO_WINDOWS_S", "3,10")
+    role = os.environ.get("_KT_SOAK_ROLE", "")
+    workdir = os.environ.get("_KT_SOAK_DIR", "")
+    sched = _soak_schedule()
+    state_path = os.path.join(workdir, "soak_state.json") if workdir else ""
+
+    if role == "oracle":
+        from kubeadmiral_tpu.testing.soakharness import SoakHarness
+
+        m, rec, ledger, tl = _soak_observatory()
+        h = SoakHarness(sched, metrics=m)
+        h.attach_timeline(tl)
+        t0 = time.perf_counter()
+        for r in range(sched.rounds):
+            h.run_round(r, faults=False)
+        h.finish()
+        print(json.dumps({
+            "fingerprint": h.fingerprint(),
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+        }))
+        return
+
+    if role == "victim":
+        from kubeadmiral_tpu.runtime.snapshot import (
+            SnapshotManager,
+            SnapshotStore,
+        )
+        from kubeadmiral_tpu.testing.soakharness import SoakHarness
+
+        m, rec, ledger, tl = _soak_observatory()
+        h = SoakHarness(sched, metrics=m)
+        store = SnapshotStore(os.path.join(workdir, "snapshots"), metrics=m)
+        SnapshotManager(h.scheduler.engine, store, every=1)
+        h.attach_timeline(tl)
+        t0 = time.perf_counter()
+        for r in range(sched.kill_round + 1):
+            h.run_round(r, faults=True)
+            state = {
+                "round": r,
+                "elapsed_s": round(time.perf_counter() - t0, 3),
+                "windows": h.windows,
+                "timeline": tl.to_doc(),
+                "tenants": ledger.summary(),
+                "fleet": h.fleet.dump(),
+            }
+            tmp = state_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(state, fh)
+            os.replace(tmp, state_path)
+        # SIGKILL mid-fault-window: no atexit, no snapshot flush, no
+        # window close — the successor and the gate must cope with the
+        # state exactly as the last completed round left it.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+        return  # unreachable
+
+    if role == "successor":
+        from kubeadmiral_tpu.runtime.snapshot import (
+            SnapshotManager,
+            SnapshotStore,
+        )
+        from kubeadmiral_tpu.testing.fakekube import ClusterFleet
+        from kubeadmiral_tpu.testing.soakharness import SoakHarness
+
+        with open(state_path) as fh:
+            state = json.load(fh)
+        fleet = ClusterFleet.restore(state["fleet"])
+        m, rec, ledger, tl = _soak_observatory()
+        h = SoakHarness(sched, metrics=m, fleet=fleet)
+        store = SnapshotStore(os.path.join(workdir, "snapshots"), metrics=m)
+        mgr = SnapshotManager(h.scheduler.engine, store, every=1)
+        restored = mgr.restore()
+        h.attach_timeline(tl)
+        t0 = time.perf_counter()
+        for r in range(state["round"] + 1, sched.rounds):
+            h.run_round(r, faults=True)
+        h.finish()
+        print(json.dumps({
+            "fingerprint": h.fingerprint(),
+            "windows": h.windows,
+            "timeline": tl.to_doc(),
+            "tenants": ledger.summary(),
+            "slo": rec.summary(slowest=0),
+            "restore": restored,
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+        }))
+        return
+
+    # -- parent: orchestrate oracle -> victim -> SIGKILL -> successor ----
+    workdir = tempfile.mkdtemp(prefix="kt-bench-soak-")
+
+    def spawn(child_role: str) -> subprocess.CompletedProcess:
+        env = dict(os.environ)
+        env["_KT_SOAK_ROLE"] = child_role
+        env["_KT_SOAK_DIR"] = workdir
+        env["BENCH_SCENARIO"] = "soak"
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, env=env, timeout=1200,
+        )
+
+    def parse(proc: subprocess.CompletedProcess, who: str) -> dict:
+        if proc.returncode != 0:
+            raise SystemExit(
+                f"soak {who} failed rc={proc.returncode}:\n"
+                + proc.stderr[-4000:]
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    oracle = parse(spawn("oracle"), "oracle")
+    victim_proc = spawn("victim")
+    if victim_proc.returncode != -signal.SIGKILL:
+        raise SystemExit(
+            f"soak victim expected SIGKILL, got rc={victim_proc.returncode}:\n"
+            + victim_proc.stderr[-4000:]
+        )
+    state_path = os.path.join(workdir, "soak_state.json")
+    with open(state_path) as fh:
+        victim = json.load(fh)
+    succ = parse(spawn("successor"), "successor")
+
+    oracle_fp = oracle["fingerprint"]
+    succ_fp = succ["fingerprint"]
+    oracle_match = (
+        succ_fp["hash"] == oracle_fp["hash"]
+        and succ_fp["placements"] == oracle_fp["placements"]
+    )
+    mismatched = sorted(
+        k
+        for k in set(oracle_fp["placements"]) | set(succ_fp["placements"])
+        if oracle_fp["placements"].get(k) != succ_fp["placements"].get(k)
+    )
+    red_outside = _soak_red_outside(
+        victim["timeline"], victim["windows"]
+    ) + _soak_red_outside(succ["timeline"], succ["windows"])
+
+    scheduled = _soak_scheduled(victim["tenants"]) + _soak_scheduled(
+        succ["tenants"]
+    )
+    elapsed = victim["elapsed_s"] + succ["elapsed_s"]
+    rate = scheduled / max(elapsed, 1e-9)
+    p99_s = (
+        (succ["slo"].get("stages") or {}).get("total") or {}
+    ).get("p99_s")
+    tl_stats = {
+        k: succ["timeline"].get(k)
+        for k in (
+            "samples_total", "approx_bytes", "dropped_buckets_total",
+            "provider_errors_total", "sample_seconds_total",
+        )
+    }
+    from kubeadmiral_tpu.bench_support import bench_platform_detail
+
+    result = {
+        "metric": (
+            f"soak_objs_per_sec_{sched.rounds}r"
+            f"x{sched.arrivals_per_round}a"
+        ),
+        "value": round(rate, 1),
+        "unit": "objects/s",
+        "detail": {
+            **bench_platform_detail(),
+            "rounds": sched.rounds,
+            "kill_round": sched.kill_round,
+            "arrivals_per_round": sched.arrivals_per_round,
+            "objects": succ_fp["objects"],
+            "scheduled_total": scheduled,
+            "elapsed_s": round(elapsed, 3),
+            "oracle_match": oracle_match,
+            "mismatched_keys": mismatched[:20],
+            "red_outside_windows": red_outside,
+            "windows": {
+                "victim": victim["windows"],
+                "successor": succ["windows"],
+            },
+            "restore": succ["restore"],
+            "victim_rounds": victim["round"] + 1,
+            "event_p99_ms": round(p99_s * 1e3, 1)
+            if p99_s is not None
+            else None,
+            "timeline": tl_stats,
+            "tenants": succ["tenants"],
+            "ktlint": ktlint_summary(),
+        },
+    }
+    print(json.dumps(result))
+    print(
+        f"# soak: {sched.rounds} rounds (kill@{sched.kill_round}), "
+        f"{succ_fp['objects']} objects, {scheduled} scheduled in "
+        f"{elapsed:.1f}s -> {rate:.0f} obj/s; oracle_match={oracle_match} "
+        f"red_outside={len(red_outside)} restore={succ['restore']} "
+        f"event_p99={result['detail']['event_p99_ms']}ms",
+        file=sys.stderr,
+    )
+    _save_round_artifact(result, "SOAK")
+
+
 def _save_round_artifact(result: dict, prefix: str) -> None:
     """Persist a scenario result as <prefix>_r<n>.json (next free round
     number) so tools/bench_gate.py can compare rounds."""
@@ -1263,6 +1566,9 @@ def main():
     if scenario == "census":
         run_census_scenario()
         return
+    if scenario == "soak":
+        run_soak_scenario()
+        return
     if scenario:
         raise SystemExit(f"unknown bench scenario {scenario!r}")
     rng = np.random.default_rng(20260729)
@@ -1303,6 +1609,8 @@ def main():
 
     telemetry = detail.pop("telemetry", None)
     device_attr = detail.pop("device_attr", None)
+    objs_series = detail.pop("objs_per_sec_series", None)
+    objs_median = detail.pop("objs_per_sec_median", None)
     fetch_format = detail.pop("fetch_format", None)
     fetch_bytes = detail.pop("fetch_bytes", None)
     fetch_bytes_run = detail.pop("fetch_bytes_run_total", None)
@@ -1317,6 +1625,8 @@ def main():
             "config": CONFIG,
             **bench_platform_detail(),
             "tick_ms": round(tick_seconds * 1e3, 1),
+            "objs_per_sec_series": objs_series,
+            "objs_per_sec_median": objs_median,
             "fetch_format": fetch_format,
             "fetch_bytes": fetch_bytes,
             "fetch_bytes_run_total": fetch_bytes_run,

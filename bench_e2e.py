@@ -417,10 +417,27 @@ def main():
         fleet.host.create(ftc.source.resource, make_deployment(i))
     create_s = time.perf_counter() - t_create
 
+    # Telemetry timeline riding the measured settle (ISSUE 16): the
+    # sampler THREAD (not manual samples — this measures what a
+    # production manager pays) scrapes the SLO evaluator + process RSS
+    # into the downsampling ring; sample_seconds_total in the artifact
+    # is the sampler's own cumulative cost, the "timeline overhead"
+    # evidence.  KT_TIMELINE=0 removes the thread entirely.
+    from kubeadmiral_tpu.runtime import timeline as TL
+
+    tline = TL.Timeline()
+    tline.attach_runtime(slo=slo_rec)
+    TL.set_default(tline)
+    tline_on = tline.start()
+
     stages_before = dict(timer.stages)
     t0 = time.perf_counter()
     timer.settle()
     total_s = time.perf_counter() - t0
+
+    tline.stop()
+    if tline_on:
+        tline.sample_now()  # final scrape so short settles record >= 1
 
     # Verify full propagation: every placed (object, cluster) pair has a
     # member object and an OK propagation status.  (Divide mode drops
@@ -520,6 +537,17 @@ def main():
             "member_objects_expected": expected,
             "member_writes_per_sec": round(member_objects / total_s, 1),
             **({"slo": slo_detail} if slo_detail is not None else {}),
+            # Stats only (series filter matches nothing): the ring's
+            # size/cost accounting without the multi-KB series payload.
+            "timeline": {
+                k: tline.to_doc(series="\x00")[k]
+                for k in (
+                    "enabled",
+                    "samples_total",
+                    "approx_bytes",
+                    "sample_seconds_total",
+                )
+            },
         },
     }
     assert member_objects == expected, (member_objects, expected)

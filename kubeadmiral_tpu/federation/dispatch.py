@@ -53,7 +53,7 @@ from typing import Callable, Optional
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.federation import retain
-from kubeadmiral_tpu.runtime import slo, trace
+from kubeadmiral_tpu.runtime import slo, tenancy, trace
 from kubeadmiral_tpu.federation.retain import CURRENT_REVISION_ANNOTATION
 from kubeadmiral_tpu.federation.rollout import (
     LAST_RS_NAME,
@@ -156,6 +156,43 @@ _SHED = {"code": 503, "status": {"reason": "Shed",
 
 # Histogram buckets for coalesced batch sizes (ops per bulk request).
 _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def _op_tenant(op: dict) -> str:
+    """The tenant a member-write op belongs to: namespace (and labels,
+    for the KT_TENANT_LABEL override) of the op's object, falling back
+    to the namespace half of its "ns/name" key (delete verbs carry no
+    object)."""
+    meta = (op.get("object") or {}).get("metadata") or {}
+    ns = meta.get("namespace", "")
+    if not ns:
+        key = op.get("key", "")
+        ns = key.partition("/")[0] if "/" in key else ""
+    return tenancy.tenant_of(ns, meta.get("labels"))
+
+
+def _note_shed_tenants(items) -> None:
+    """Per-tenant shed attribution (no-op unless a ledger is installed).
+    ``items`` may be raw op dicts or the sinks' (op, continuation)
+    staging entries."""
+    if not tenancy.active():
+        return
+    for item in items:
+        op = item[0] if isinstance(item, tuple) else item
+        tenancy.note_shed(_op_tenant(op))
+
+
+def _note_write_tenants(ops, elapsed: float) -> None:
+    """Per-tenant write attribution for one completed batch round trip:
+    the batch latency lands once per tenant, weighted by its op count."""
+    if not tenancy.active():
+        return
+    groups: dict[str, int] = {}
+    for op in ops:
+        t = _op_tenant(op)
+        groups[t] = groups.get(t, 0) + 1
+    for t, n_ops in groups.items():
+        tenancy.note_write(t, elapsed, ops=n_ops)
 
 
 def retry_delay(attempt: int, rng=None) -> float:
@@ -298,6 +335,7 @@ def run_batch_with_retries(
     # GET /debug/members via the registry's latency reservoir.
     if cluster and not final_transport:
         slo.member_write(cluster, elapsed)
+        _note_write_tenants(ops, elapsed)
         if breakers is not None:
             breakers.note_write(cluster, elapsed, ops=n)
     return [r if r is not None else {"code": 500, "status": {
@@ -392,8 +430,11 @@ def run_member_batches(
         for chunk in chunks:
             out.extend(run_chunk(chunk))
         shed_n = sum(1 for r in out if r.get("shed"))
-        if shed_n and breakers is not None:
-            breakers.count_shed(cluster, shed_n)
+        if shed_n:
+            if breakers is not None:
+                breakers.count_shed(cluster, shed_n)
+            _note_shed_tenants(
+                op for op, r in zip(ops, out) if r.get("shed"))
         return out
     # Pipelined window: up to KT_MEMBER_INFLIGHT bulk requests in
     # flight at once (each chunk re-checks deadline/breaker at start,
@@ -418,8 +459,10 @@ def run_member_batches(
     finally:
         pool.shutdown(wait=False)
     shed_n = sum(1 for r in out if r.get("shed"))
-    if shed_n and breakers is not None:
-        breakers.count_shed(cluster, shed_n)
+    if shed_n:
+        if breakers is not None:
+            breakers.count_shed(cluster, shed_n)
+        _note_shed_tenants(op for op, r in zip(ops, out) if r.get("shed"))
     return out
 
 
@@ -527,6 +570,7 @@ class ImmediateSink:
                 cluster, consume_probe=False
             ):
                 self.breakers.count_shed(cluster, len(entries))
+                _note_shed_tenants(entries)
                 return
             try:
                 client = self.client_for_cluster(cluster)
@@ -579,6 +623,7 @@ class ImmediateSink:
                         self.breakers.for_member(cluster).note_ok(elapsed)
                         self.breakers.note_write(cluster, elapsed, ops=1)
                     slo.member_write(cluster, elapsed)
+                    _note_write_tenants((op,), elapsed)
                 continuation(result)
 
         if self._inline:
@@ -655,6 +700,7 @@ class ImmediateSink:
             shed += len(entries)
             if self.breakers is not None:
                 self.breakers.count_shed(cluster, len(entries))
+            _note_shed_tenants(entries)
         end = time.monotonic() + max(0.0, deadline_s)
         pending = list(self._futures)
         for cluster, f, n_ops in pending:
@@ -777,6 +823,7 @@ class BatchSink:
                         cluster, consume_probe=False
                     ):
                         self.breakers.count_shed(cluster, len(entries))
+                        _note_shed_tenants(entries)
                         return
                     try:
                         client = self.client_for_cluster(cluster)
@@ -821,6 +868,7 @@ class BatchSink:
                 "dispatch.shed", cluster=cluster, ops=len(entries),
                 stalled=stalled,
             ):
+                _note_shed_tenants(entries)
                 if self.breakers is None:
                     return
                 self.breakers.count_shed(cluster, len(entries))
@@ -898,6 +946,7 @@ class BatchSink:
             )
             if self.breakers is not None:
                 self.breakers.count_shed(cluster, len(entries))
+            _note_shed_tenants(entries)
         end = time.monotonic() + max(0.0, deadline_s)
         for t in self._helper_threads:
             t.join(max(0.0, end - time.monotonic()))
@@ -1030,6 +1079,7 @@ class ManagedDispatcher:
             breaker = self.breakers.for_member(cluster)
             if not breaker.allow():
                 self.breakers.count_shed(cluster)
+                _note_shed_tenants((op,))
                 self.record_error(
                     cluster, CLUSTER_NOT_READY, "member circuit breaker open"
                 )

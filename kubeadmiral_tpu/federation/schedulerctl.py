@@ -29,7 +29,7 @@ from kubeadmiral_tpu.models import profile as PR
 from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.models.ftc import FederatedTypeConfig
 from kubeadmiral_tpu.models.types import parse_resources
-from kubeadmiral_tpu.runtime import pending, slo
+from kubeadmiral_tpu.runtime import pending, slo, tenancy
 from kubeadmiral_tpu.runtime.eventsink import DefederatingRecorderMux
 from kubeadmiral_tpu.runtime.metrics import Metrics
 from kubeadmiral_tpu.runtime.hostbatch import HostBatch
@@ -566,6 +566,17 @@ class SchedulerController:
         self.metrics.counter(
             "scheduler_scheduled_total", len(units), ftc=self.ftc.name
         )
+        # Per-tenant demand attribution (runtime/tenancy.py; no-op
+        # unless a ledger is installed): which tenants are driving the
+        # scheduler — the denominator the fair-admission arbitration
+        # will weigh deferrals and sheds against.
+        if tenancy.active():
+            by_tenant: dict[str, int] = {}
+            for key, _, _, _ in to_schedule:
+                t = tenancy.tenant_of_key(key)
+                by_tenant[t] = by_tenant.get(t, 0) + 1
+            for t, n_objs in by_tenant.items():
+                tenancy.note_scheduled(t, n_objs)
 
         hb = HostBatch(self.host)
         # The engine tick id rides the persist span too, so the event ->

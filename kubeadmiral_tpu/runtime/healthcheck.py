@@ -66,9 +66,11 @@ class HealthServer:
     pprof-analogue ``/debug/*`` routes, the decision-audit routes
     ``/debug/decisions`` / ``/debug/explain`` / ``/debug/drift``
     (runtime/flightrec.py), the member-health route
-    ``/debug/members`` (transport/breaker.py) and the end-to-end SLO
-    route ``/debug/slo`` (runtime/slo.py) — one port for the whole
-    operability surface."""
+    ``/debug/members`` (transport/breaker.py), the end-to-end SLO
+    route ``/debug/slo`` (runtime/slo.py), the telemetry timeline
+    ``/debug/timeline`` (runtime/timeline.py), the tenant attribution
+    route ``/debug/tenants`` (runtime/tenancy.py) and the bare
+    ``/debug`` index — one port for the whole operability surface."""
 
     def __init__(
         self,
@@ -81,6 +83,8 @@ class HealthServer:
         drift=None,
         members=None,
         slo=None,
+        timeline=None,
+        tenants=None,
     ):
         self.registry = registry
         self.metrics = metrics
@@ -89,6 +93,8 @@ class HealthServer:
         self.drift = drift
         self.members = members
         self.slo = slo
+        self.timeline = timeline
+        self.tenants = tenants
         self._host = host
         self._port = port
         self._server: Optional[ThreadingHTTPServer] = None
@@ -106,7 +112,11 @@ class HealthServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 path, _, raw_query = self.path.partition("?")
-                if path.startswith("/debug/") or path == "/metrics":
+                if (
+                    path == "/debug"
+                    or path.startswith("/debug/")
+                    or path == "/metrics"
+                ):
                     # Shared operability routes (profiling.py): metrics
                     # exposition, trace export, profile/stacks/threads.
                     from kubeadmiral_tpu.runtime import profiling
@@ -116,6 +126,7 @@ class HealthServer:
                         metrics=outer.metrics, tracer=outer.tracer,
                         flightrec=outer.flightrec, drift=outer.drift,
                         members=outer.members, slo=outer.slo,
+                        timeline=outer.timeline, tenants=outer.tenants,
                     ):
                         self.send_error(404)
                     return

@@ -309,6 +309,33 @@ KNOBS: dict[str, KnobSpec] = {
     "KT_RESTART_ARTIFACT": KnobSpec(
         "path", "successor.json", _OPS,
         "SIGKILL matrix: successor's convergence artifact path."),
+    # -- telemetry timeline (runtime/timeline.py, ISSUE 16) ---------------
+    "KT_TIMELINE": KnobSpec(
+        "bool", "1", _OBS,
+        "Telemetry timeline sampler (0 removes the thread entirely)."),
+    "KT_TIMELINE_INTERVAL_S": KnobSpec(
+        "float", "1.0", _OBS,
+        "Sampler period of the timeline thread."),
+    "KT_TIMELINE_BYTES": KnobSpec(
+        "int", "2097152", _OBS,
+        "Ring budget; overflow downsamples raw→10s→60s tiers."),
+    # -- tenant attribution (runtime/tenancy.py, ISSUE 16) ----------------
+    "KT_TENANT_LABEL": KnobSpec(
+        "str", "", _OBS,
+        "Metadata label overriding the namespace-derived tenant."),
+    "KT_TENANT_MAX": KnobSpec(
+        "int", "64", _OBS,
+        "Tenant-label cardinality cap (overflow → \"~other\")."),
+    # -- all-stressors soak (bench.py --scenario soak, ISSUE 16) ----------
+    "KT_SOAK_ROUNDS": KnobSpec(
+        "int", "10", _OPS,
+        "Soak: total schedule rounds."),
+    "KT_SOAK_ARRIVALS": KnobSpec(
+        "int", "6", _OPS,
+        "Soak: object arrivals per round."),
+    "KT_SOAK_KILL_ROUND": KnobSpec(
+        "int", "5", _OPS,
+        "Soak: round after which the victim is SIGKILLed."),
 }
 
 

@@ -374,6 +374,54 @@ CATALOG: dict[str, MetricSpec] = {
         "batch-size distribution of the write-path coalescing window "
         "(1 everywhere means KT_WRITE_COALESCE=0 or nothing to "
         "amortize)."),
+    # -- per-tenant attribution (runtime/tenancy.py, ISSUE 16) ----------
+    # The tenant label is namespace-derived (KT_TENANT_LABEL overrides)
+    # and BOUNDED: at most KT_TENANT_MAX distinct values, later
+    # arrivals collapse into "~other" — so these families can never
+    # blow up the registry.  Full report at GET /debug/tenants.
+    "tenant_events_total": MetricSpec(
+        "counter", "events", ("tenant", "result"),
+        "Finalized event→placement-written provenance tokens per "
+        "tenant: good (within the event_to_written_p99 threshold) vs "
+        "bad (breached it) — the per-tenant numerator/denominator of "
+        "the error-budget burn."),
+    "tenant_slo_burn": MetricSpec(
+        "gauge", "ratio", ("tenant",),
+        "Whole-run event_to_written_p99 error-budget burn per tenant "
+        "(bad fraction / allowed bad fraction; 1.0 = spending exactly "
+        "as fast as allowed) — WHICH tenant is burning the budget, "
+        "where slo_burn_rate only says the control plane is."),
+    "tenant_stage_seconds": MetricSpec(
+        "histogram", "seconds", ("tenant", "stage"),
+        "Per-tenant share of the provenance stage decomposition "
+        "(queued/slab/engine/fetch/dispatch/write) — a tenant whose "
+        "latency lives in `write` has slow members, one in `queued` is "
+        "being back-pressured."),
+    "tenant_write_seconds": MetricSpec(
+        "histogram", "seconds", ("tenant",),
+        "Member-write round-trip latency attributed to the written "
+        "ops' tenant (retries included) — member_write_seconds sliced "
+        "by who, not where."),
+    "tenant_shed_writes_total": MetricSpec(
+        "counter", "writes", ("tenant",),
+        "Member writes shed by an open circuit breaker, attributed to "
+        "the shed ops' tenant — whose freshness a degraded member is "
+        "costing."),
+    "tenant_admission_deferrals_total": MetricSpec(
+        "counter", "deferrals", ("tenant",),
+        "Worker-queue admission deferrals (KT_ADMISSION depth gate) "
+        "per tenant of the deferred key — who is driving queue-depth "
+        "backpressure."),
+    "tenant_rows_flushed_total": MetricSpec(
+        "counter", "rows", ("tenant",),
+        "Streaming-slab rows flushed into engine ticks per tenant — "
+        "the scheduling-demand side of the attribution (arrival "
+        "volume, pre-placement)."),
+    "tenant_scheduled_total": MetricSpec(
+        "counter", "objects", ("tenant",),
+        "Objects pushed through the batch scheduler per tenant "
+        "(rescheduling included) — the demand denominator for weighted "
+        "fair admission (ROADMAP item 4)."),
 }
 
 # -- end-to-end SLO catalog ------------------------------------------------

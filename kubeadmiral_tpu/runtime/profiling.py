@@ -52,6 +52,16 @@ main.go:21).  The Python control plane's equivalent serves:
   per-stage event→placement-written percentiles, the slowest-N
   exemplars fully decomposed, freshness gauges, and the burn-rate
   evaluator's red/green objective status.
+* ``GET /debug/timeline`` — the continuous telemetry timeline
+  (runtime/timeline.py): multi-tier downsampled series of every
+  registry counter/gauge plus the sampler's synthesized SLO-burn,
+  breaker, queue-depth, and process gauges; ``?series=`` (comma list
+  of substrings) and ``?tier=`` narrow the payload.
+* ``GET /debug/tenants`` — the per-tenant attribution ledger
+  (runtime/tenancy.py): per-tenant SLO burn, stage latencies, member
+  writes, shed writes, admission deferrals, flushed rows.
+* ``GET /debug`` — the index: every debug provider this process
+  serves, with one-line descriptions.
 
 ``respond_debug`` is the shared route handler: the health server mounts
 it so one port serves livez/readyz/metrics/debug, and
@@ -173,6 +183,34 @@ def handle_debug_path(path: str, query: dict) -> Optional[dict]:
     return None
 
 
+# The /debug index: route -> one-line description.  Kept static (not
+# reflected from the router) so the index documents intent, including
+# query parameters the route dispatch alone can't express.
+DEBUG_INDEX = {
+    "/metrics": "metrics registry, Prometheus text format",
+    "/debug/trace": "reconcile spans + device lanes, Chrome trace JSON"
+    " (?device=0 host only)",
+    "/debug/slo": "end-to-end SLO: stage percentiles, exemplars,"
+    " freshness, burn-rate status",
+    "/debug/timeline": "continuous telemetry timeline, multi-tier"
+    " downsampled series (?series=,&tier=)",
+    "/debug/tenants": "per-tenant attribution: SLO burn, writes, sheds,"
+    " admission deferrals",
+    "/debug/members": "per-member circuit-breaker health and write"
+    " latency reservoirs",
+    "/debug/waterfall": "per-tick device-dispatch waterfall"
+    " (?tick=&ticks=&records=)",
+    "/debug/decisions": "scheduling flight recorder ring summary",
+    "/debug/explain": "per-cluster verdicts for one object"
+    " (?key=<ns/name>)",
+    "/debug/drift": "desired-vs-observed placement drift",
+    "/debug/profile": "sampling profile of every thread"
+    " (?seconds=&mode=jax for device capture)",
+    "/debug/stacks": "current stack of every thread",
+    "/debug/threads": "thread names/ids/daemon flags",
+}
+
+
 def _send(http_handler, body: bytes, content_type: str) -> None:
     http_handler.send_response(200)
     http_handler.send_header("Content-Type", content_type)
@@ -183,7 +221,8 @@ def _send(http_handler, body: bytes, content_type: str) -> None:
 
 def respond_debug(
     http_handler, path: str, raw_query: str, metrics=None, tracer=None,
-    flightrec=None, drift=None, members=None, slo=None,
+    flightrec=None, drift=None, members=None, slo=None, timeline=None,
+    tenants=None,
 ) -> bool:
     """Serve a /metrics or /debug/* route on any BaseHTTPRequestHandler;
     returns False when the path isn't one of ours (caller handles it).
@@ -197,7 +236,16 @@ def respond_debug(
     (a callable returning the drift listing) defaults to the registered
     drift providers (flightrec.drift_report); ``members`` (a callable
     returning the member-health listing) defaults to the aggregated
-    circuit-breaker registries (transport/breaker.members_report)."""
+    circuit-breaker registries (transport/breaker.members_report);
+    ``timeline``/``tenants`` default to the process-wide timeline ring
+    and tenant ledger (both opt-in: 404 when none is installed)."""
+    if path in ("/debug", "/debug/"):
+        _send(
+            http_handler,
+            json.dumps({"endpoints": DEBUG_INDEX}, indent=2).encode(),
+            "application/json",
+        )
+        return True
     if path == "/metrics":
         if metrics is None:
             return False
@@ -235,6 +283,37 @@ def respond_debug(
         _send(
             http_handler,
             json.dumps(recorder.summary()).encode(),
+            "application/json",
+        )
+        return True
+    if path == "/debug/timeline":
+        from kubeadmiral_tpu.runtime import timeline as timeline_mod
+
+        ring = timeline if timeline is not None else timeline_mod.get_default()
+        if ring is None:
+            http_handler.send_error(
+                404, explain="no timeline installed (KT_TIMELINE=0?)"
+            )
+            return True
+        query = {k: v[-1] for k, v in parse_qs(raw_query).items()}
+        doc = ring.to_doc(
+            series=query.get("series") or None,
+            tier=query.get("tier") or None,
+        )
+        _send(http_handler, json.dumps(doc).encode(), "application/json")
+        return True
+    if path == "/debug/tenants":
+        from kubeadmiral_tpu.runtime import tenancy as tenancy_mod
+
+        ledger = tenants if tenants is not None else tenancy_mod.get_default()
+        if ledger is None:
+            http_handler.send_error(
+                404, explain="no tenant ledger installed"
+            )
+            return True
+        _send(
+            http_handler,
+            json.dumps(ledger.summary()).encode(),
             "application/json",
         )
         return True
@@ -284,6 +363,7 @@ class ProfilingServer:
     def __init__(
         self, host: str = "127.0.0.1", port: int = 0, metrics=None,
         tracer=None, flightrec=None, drift=None, members=None, slo=None,
+        timeline=None, tenants=None,
     ):
         self._host = host
         self._port = port
@@ -293,6 +373,8 @@ class ProfilingServer:
         self.drift = drift
         self.members = members
         self.slo = slo
+        self.timeline = timeline
+        self.tenants = tenants
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -312,6 +394,7 @@ class ProfilingServer:
                     metrics=outer.metrics, tracer=outer.tracer,
                     flightrec=outer.flightrec, drift=outer.drift,
                     members=outer.members, slo=outer.slo,
+                    timeline=outer.timeline, tenants=outer.tenants,
                 ):
                     self.send_error(404)
 

@@ -63,6 +63,7 @@ from collections import deque
 from typing import Iterable, Optional, Sequence
 
 from kubeadmiral_tpu.runtime import metric_catalog as MC
+from kubeadmiral_tpu.runtime import tenancy as _tenancy
 from kubeadmiral_tpu.runtime.metrics import Metrics
 
 # Provenance stage vocabulary, in pipeline order (metrics-lint checks it
@@ -115,10 +116,11 @@ class _Pending:
 
     __slots__ = (
         "key", "birth", "wall", "gen", "marks", "expected", "acked",
-        "last_ack",
+        "last_ack", "tenant",
     )
 
-    def __init__(self, key: str, birth: float, gen: Optional[int]):
+    def __init__(self, key: str, birth: float, gen: Optional[int],
+                 tenant: str = ""):
         self.key = key
         self.birth = birth
         self.wall = time.time()
@@ -127,6 +129,7 @@ class _Pending:
         self.expected: Optional[set] = None  # placements sync declared
         self.acked: set = set()
         self.last_ack: Optional[float] = None
+        self.tenant = tenant  # namespace-derived (runtime/tenancy.py)
 
 
 class SLOEvaluator:
@@ -351,6 +354,7 @@ class SLORecorder:
             self.forget(key)
             return
         gen = meta.get("generation")
+        tenant = _tenancy.tenant_of(ns, meta.get("labels"))
         t = self.clock()
         with self._lock:
             if gen is not None:
@@ -359,15 +363,20 @@ class SLORecorder:
                     self.metrics.counter("slo_events_total", result="echo")
                     return
                 self._gen[key] = int(gen)
-            self._mint_locked(key, t, gen)
+            self._mint_locked(key, t, gen, tenant)
 
     def mint(self, key: str, t: Optional[float] = None, gen: Optional[int] = None) -> None:
         if not self.enabled:
             return
         with self._lock:
-            self._mint_locked(key, self.clock() if t is None else t, gen)
+            self._mint_locked(
+                key, self.clock() if t is None else t, gen,
+                _tenancy.tenant_of_key(key),
+            )
 
-    def _mint_locked(self, key: str, t: float, gen: Optional[int]) -> None:
+    def _mint_locked(
+        self, key: str, t: float, gen: Optional[int], tenant: str = ""
+    ) -> None:
         if key in self._pending:
             # Newer intent supersedes the in-flight token: latency is
             # measured from the LAST event that changed the object.
@@ -377,7 +386,7 @@ class SLORecorder:
             return
         else:
             self.metrics.counter("slo_events_total", result="minted")
-        self._pending[key] = _Pending(key, t, gen)
+        self._pending[key] = _Pending(key, t, gen, tenant)
 
     def forget(self, key: str) -> None:
         """Object deleted: its pending token (if any) is void."""
@@ -479,6 +488,13 @@ class SLORecorder:
         )
         m.counter("slo_events_total", result="written")
         self.evaluator.observe("event_to_written_p99", total)
+        # Per-tenant attribution (runtime/tenancy.py; no-op unless a
+        # ledger is installed): the token's namespace-derived tenant
+        # carries the whole stage decomposition.
+        _tenancy.note_event(
+            entry.tenant or _tenancy.tenant_of_key(entry.key),
+            total, stages,
+        )
         exemplar = {
             "key": entry.key,
             "total_s": round(total, 6),

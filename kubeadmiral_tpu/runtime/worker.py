@@ -26,7 +26,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
-from kubeadmiral_tpu.runtime import trace
+from kubeadmiral_tpu.runtime import tenancy, trace
 from kubeadmiral_tpu.runtime.queue import Backoff, DirtyQueue
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
 
@@ -131,6 +131,11 @@ class _WorkerBase:
                     self.metrics.counter(
                         "worker_admission_total", controller=self.name
                     )
+                    # Per-tenant deferral attribution — the data the
+                    # weighted fair-admission item will arbitrate on
+                    # (no-op unless a ledger is installed).
+                    if tenancy.active():
+                        tenancy.note_admission(tenancy.tenant_of_key(key))
         self.queue.add(key, delay)
 
     def _drain(self) -> list[str]:

@@ -48,6 +48,7 @@ from typing import Optional, Sequence
 from kubeadmiral_tpu.models import types as T
 from kubeadmiral_tpu.runtime import lockcheck
 from kubeadmiral_tpu.runtime import slo as SLO
+from kubeadmiral_tpu.runtime import tenancy
 from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.runtime.metrics import Metrics, null_metrics
 
@@ -415,6 +416,20 @@ class StreamingScheduler:
                 m.histogram(
                     "engine_stream_flush_seconds", now - t_flush
                 )
+            # Per-tenant flush accounting (runtime/tenancy.py; no-op
+            # unless a ledger is installed) — outside the slab lock: the
+            # ledger takes its own lock and needs nothing of ours.
+            if tenancy.active():
+                by_tenant: dict[str, int] = {}
+                for ev in drained:
+                    if ev.kind == "capacity":
+                        continue
+                    t_name = tenancy.tenant_of_key(
+                        getattr(ev.payload, "key", "") or ""
+                    )
+                    by_tenant[t_name] = by_tenant.get(t_name, 0) + 1
+                for t_name, rows in by_tenant.items():
+                    tenancy.note_flush(t_name, rows)
         if log.isEnabledFor(logging.DEBUG):
             log.debug(
                 "flush=%d tick=%d trigger=%s events=%d rows=%d "

@@ -1,0 +1,316 @@
+"""Soak observatory (ISSUE 16): telemetry-timeline downsampling
+correctness and byte bound, tenant cardinality cap, the /debug
+endpoint surface, and a fast in-process slice of the all-stressors
+soak (concurrent churn + drift + faults; oracle bit-identity;
+evaluator green outside injection windows)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubeadmiral_tpu.runtime import slo as slo_mod
+from kubeadmiral_tpu.runtime import tenancy, timeline
+from kubeadmiral_tpu.runtime.healthcheck import (
+    HealthCheckRegistry,
+    HealthServer,
+)
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.timeline import RAW_HORIZON_S, Timeline
+
+
+def fetch(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as r:
+        return r.status, r.read()
+
+
+def series_points(doc, tier, key):
+    return doc["tiers"][tier]["series"][key]["points"]
+
+
+class TestTimelineDownsampling:
+    def test_counter_deltas_and_gauge_carry(self):
+        m = Metrics()
+        tl = Timeline(metrics=m, interval_s=1.0)
+        m.counter("worker_reconciles_total", 3)
+        m.store("worker_queue_depth", 7.0)
+        tl.sample_now(now=1.0)
+        m.counter("worker_reconciles_total", 2)
+        tl.sample_now(now=2.0)
+        doc = tl.to_doc()
+        # Counters become per-interval deltas; gauges pass through.
+        assert series_points(
+            doc, "raw", "worker_reconciles_total"
+        ) == [[1.0, 3.0], [2.0, 2.0]]
+        assert series_points(
+            doc, "raw", "worker_queue_depth"
+        ) == [[1.0, 7.0], [2.0, 7.0]]
+
+    def test_counter_never_negative_after_registry_reset(self):
+        # A swapped/reset registry reads LOWER than the previous scrape;
+        # the delta must clamp to 0, never go backwards.
+        m = Metrics()
+        tl = Timeline(metrics=m, interval_s=1.0)
+        m.counter("worker_reconciles_total", 10)
+        tl.sample_now(now=1.0)
+        tl.metrics = Metrics()  # fresh registry: counter reads 0 < 10
+        tl.metrics.counter("worker_reconciles_total", 1)
+        tl.sample_now(now=2.0)
+        pts = series_points(tl.to_doc(), "raw", "worker_reconciles_total")
+        assert all(v >= 0 for _, v in pts), pts
+
+    def test_tier_merge_sums_counters_and_maxes_gauges(self):
+        m = Metrics()
+        tl = Timeline(metrics=m, interval_s=1.0)
+        # Six samples inside one 10s-tier slot, then one far beyond the
+        # raw horizon to force age promotion.
+        for i in range(6):
+            m.counter("worker_reconciles_total", 1)
+            m.store("worker_queue_depth", float(i))  # max = 5
+            tl.sample_now(now=1.0 + i)
+        m.store("worker_queue_depth", 0.0)
+        tl.sample_now(now=RAW_HORIZON_S + 100.0)
+        doc = tl.to_doc(tier="10s")
+        merged = {
+            key: s["points"]
+            for key, s in doc["tiers"]["10s"]["series"].items()
+        }
+        # The six 1-delta samples merged into one bucket: SUM for the
+        # counter, MAX for the gauge — a spike cannot average away.
+        assert merged["worker_reconciles_total"][0][1] == 6.0
+        assert merged["worker_queue_depth"][0][1] == 5.0
+
+    def test_ring_byte_bound(self):
+        m = Metrics()
+        tl = Timeline(metrics=m, interval_s=1.0, max_bytes=20_000)
+        for i in range(500):
+            m.counter("worker_reconciles_total", 1)
+            m.store("worker_queue_depth", float(i % 17))
+            tl.sample_now(now=float(i))
+        doc = tl.to_doc()
+        assert doc["approx_bytes"] <= 20_000, doc["approx_bytes"]
+        assert doc["samples_total"] == 500
+        # Downsampling, not amnesia: the promoted tiers still carry
+        # history (or, at worst, terminal-tier drops were counted).
+        total_buckets = sum(
+            t["buckets"] for t in doc["tiers"].values()
+        )
+        assert total_buckets > 0
+        assert doc["dropped_buckets_total"] >= 0
+
+    def test_disabled_timeline_creates_no_thread(self, monkeypatch):
+        monkeypatch.setenv("KT_TIMELINE", "0")
+        tl = Timeline(metrics=Metrics(), interval_s=0.01)
+        assert tl.start() is False
+        assert tl._thread is None
+        assert tl.sample_now() is False
+        assert tl.to_doc()["enabled"] is False
+
+    def test_sampler_thread_lifecycle(self):
+        m = Metrics()
+        tl = Timeline(metrics=m, interval_s=0.01)
+        assert tl.start() is True
+        try:
+            import time as _time
+
+            deadline = _time.monotonic() + 5.0
+            while _time.monotonic() < deadline:
+                if tl.to_doc()["samples_total"] >= 3:
+                    break
+                _time.sleep(0.01)
+            assert tl.to_doc()["samples_total"] >= 3
+        finally:
+            tl.stop()
+        assert tl._thread is None
+
+
+class TestTenantLedger:
+    def test_cardinality_cap_collapses_to_other(self):
+        ledger = tenancy.TenantLedger(metrics=Metrics(), max_tenants=2)
+        ledger.note_event("alpha", 0.1)
+        ledger.note_event("beta", 0.2)
+        ledger.note_event("gamma", 0.3)   # over the cap -> ~other
+        ledger.note_event("delta", 0.4)   # also ~other
+        doc = ledger.summary()
+        assert sorted(doc["tenants"]) == ["alpha", "beta", tenancy.OTHER]
+        assert doc["overflowed"] is True
+        assert doc["tenants"][tenancy.OTHER]["events"] == 2
+
+    def test_burn_and_breaches(self, monkeypatch):
+        monkeypatch.setenv("KT_SLO_E2E_P99_S", "1.0")
+        ledger = tenancy.TenantLedger(metrics=Metrics())
+        ledger.note_event("t", 0.5)   # good
+        ledger.note_event("t", 2.0)   # breach
+        doc = ledger.summary()["tenants"]["t"]
+        assert doc["events"] == 2 and doc["breaches"] == 1
+        assert doc["slo_burn"] > 1.0  # 50% bad >> allowed bad fraction
+
+    def test_tenant_of_label_override(self, monkeypatch):
+        assert tenancy.tenant_of("ns-a") == "ns-a"
+        assert tenancy.tenant_of("") == tenancy.CLUSTER_SCOPED
+        assert tenancy.tenant_of_key("ns-b/obj") == "ns-b"
+        monkeypatch.setenv("KT_TENANT_LABEL", "team")
+        assert tenancy.tenant_of("ns-a", {"team": "alpha"}) == "alpha"
+        assert tenancy.tenant_of("ns-a", {"other": "x"}) == "ns-a"
+
+
+class TestDebugEndpoints:
+    def test_index_timeline_and_tenants_served(self):
+        m = Metrics()
+        m.counter("worker_reconciles_total", 5)
+        tl = Timeline(metrics=m, interval_s=1.0)
+        tl.sample_now(now=1.0)
+        ledger = tenancy.TenantLedger(metrics=m)
+        ledger.note_event("team-a", 0.1)
+        server = HealthServer(
+            HealthCheckRegistry(), metrics=m, timeline=tl, tenants=ledger
+        )
+        port = server.start()
+        try:
+            status, body = fetch(port, "/debug")
+            assert status == 200
+            endpoints = json.loads(body)["endpoints"]
+            for route in (
+                "/metrics", "/debug/timeline", "/debug/tenants",
+                "/debug/slo", "/debug/members",
+            ):
+                assert route in endpoints, route
+
+            status, body = fetch(port, "/debug/timeline")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["samples_total"] == 1
+            assert "raw" in doc["tiers"] and "60s" in doc["tiers"]
+            assert (
+                "worker_reconciles_total" in doc["tiers"]["raw"]["series"]
+            )
+
+            # ?series= filter narrows, ?tier= selects one tier.
+            status, body = fetch(
+                port, "/debug/timeline?series=reconciles&tier=raw"
+            )
+            doc = json.loads(body)
+            assert list(doc["tiers"]) == ["raw"]
+            assert list(doc["tiers"]["raw"]["series"]) == [
+                "worker_reconciles_total"
+            ]
+
+            status, body = fetch(port, "/debug/tenants")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["tenants"]["team-a"]["events"] == 1
+        finally:
+            server.stop()
+
+    def test_timeline_404_when_not_installed(self):
+        server = HealthServer(HealthCheckRegistry(), metrics=Metrics())
+        port = server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                fetch(port, "/debug/timeline")
+            assert exc.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                fetch(port, "/debug/tenants")
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+
+class TestSoakSlice:
+    """A fast in-process slice of the full soak: arrivals + churn +
+    drift + a flapping and a hard-down member all concurrently, then
+    the two gate properties checked directly."""
+
+    def _run(self, faults, monkeypatch):
+        from kubeadmiral_tpu.testing.soakharness import (
+            SoakHarness,
+            SoakSchedule,
+        )
+
+        monkeypatch.setenv("KT_SLO_FRESHNESS_S", "1.0")
+        monkeypatch.setenv("KT_SLO_WINDOWS_S", "3,10")
+        sched = SoakSchedule(
+            rounds=5, arrivals_per_round=3, churn_per_round=2, members=3,
+            drift_every=2, flap_window=(1, 4), down_window=(2, 4),
+            flap_member_idx=1, down_member_idx=2,
+        )
+        m = Metrics()
+        slo_mod.reset_default()
+        ledger = tenancy.TenantLedger(metrics=m)
+        tenancy.set_default(ledger)
+        tl = Timeline(metrics=m)
+        try:
+            h = SoakHarness(sched, metrics=m)
+            h.attach_timeline(tl)
+            for r in range(sched.rounds):
+                h.run_round(r, faults=faults)
+            h.finish()
+            return h.fingerprint(), h.windows, tl.to_doc(), ledger.summary()
+        finally:
+            tenancy.reset_default()
+            slo_mod.reset_default()
+
+    @pytest.mark.slow
+    def test_oracle_bit_identity_and_green_outside_windows(
+        self, monkeypatch
+    ):
+        from bench import _soak_red_outside
+
+        oracle_fp, _, _, _ = self._run(False, monkeypatch)
+        fp, windows, doc, tenants = self._run(True, monkeypatch)
+
+        # Faults touched only the write path: placements bit-identical.
+        assert fp["hash"] == oracle_fp["hash"]
+        assert fp["placements"] == oracle_fp["placements"]
+        assert fp["objects"] == 5 * 3
+
+        # Both injection windows opened and closed (recovery confirmed).
+        assert {(w["kind"], w["t1"] is not None) for w in windows} == {
+            ("flap", True), ("down", True),
+        }
+
+        # The evaluator was never red outside a declared window.
+        assert _soak_red_outside(doc, windows) == []
+
+        # ... and red INSIDE one: the hard-down member must trip the
+        # freshness objective (otherwise the gate is vacuous).
+        red = [
+            (t, v)
+            for key, s in doc["tiers"]["raw"]["series"].items()
+            if key.startswith("slo_red{")
+            for t, v in s["points"]
+            if v > 0
+        ]
+        assert red, "hard-down member never turned the evaluator red"
+
+        # Every tenant namespace got attributed work.
+        assert set(sched_tenants(tenants)) >= {
+            "team-a", "team-b", "team-c"
+        }
+
+    def test_red_outside_window_is_flagged(self):
+        from bench import _soak_red_outside
+
+        doc = {
+            "tiers": {
+                "raw": {
+                    "series": {
+                        "slo_red{objective=freshness}": {
+                            "kind": "gauge",
+                            "points": [[5.0, 0.0], [10.0, 1.0]],
+                        }
+                    }
+                }
+            }
+        }
+        inside = [{"member": "m", "kind": "down", "t0": 9.0, "t1": 12.0}]
+        outside = [{"member": "m", "kind": "down", "t0": 20.0, "t1": None}]
+        assert _soak_red_outside(doc, inside) == []
+        flagged = _soak_red_outside(doc, outside)
+        assert len(flagged) == 1 and flagged[0]["t"] == 10.0
+
+
+def sched_tenants(tenants_doc):
+    return list((tenants_doc.get("tenants") or {}).keys())

@@ -84,6 +84,10 @@ def load_rounds(root: Path) -> list[dict]:
                 "platform": _platform_key(detail),
                 "value": float(value),
                 "tick_ms": detail.get("tick_ms"),
+                # Gated when present (ISSUE 16): the per-tick obj/s
+                # MEDIAN — robust to one outlier tick (GC pause, first
+                # sub-batch compile) the run-mean value is not.
+                "median": detail.get("objs_per_sec_median"),
                 # Informational fields carried through (never gated, and
                 # absent in pre-packed rounds): the fetch wire format and
                 # per-tick transfer volume of the packed-export work, and
@@ -199,7 +203,32 @@ def gate(rounds: list[dict], tolerance: float) -> int:
             )
             + " — cold/steady informational (drift gated below)"
         )
-    if latest["value"] < floor:
+    median_priors = [
+        r["median"] for r in priors if r.get("median") is not None
+    ]
+    if latest.get("median") is not None and median_priors:
+        # Median-of-rounds gating (ISSUE 16): once both sides carry the
+        # per-tick median, the throughput floor moves to median-vs-
+        # median — one outlier tick can no longer sink or save a round
+        # the way it could skew the run mean.  The mean stays printed
+        # above, informational.
+        best_median = max(median_priors)
+        floor_median = best_median * (1.0 - tolerance)
+        print(
+            f"bench-gate: median objs/s {latest['median']:.1f} vs best "
+            f"prior median {best_median:.1f} (floor {floor_median:.1f}) "
+            f"— gating on MEDIAN; run-mean value is informational"
+        )
+        if latest["median"] < floor_median:
+            print(
+                f"bench-gate: THROUGHPUT REGRESSION (median): "
+                f"{latest['median']:.1f} < {floor_median:.1f} — raise "
+                f"KT_BENCH_GATE_TOL only for an intentional, documented "
+                f"regression",
+                file=sys.stderr,
+            )
+            ok = False
+    elif latest["value"] < floor:
         print(
             f"bench-gate: THROUGHPUT REGRESSION: {latest['value']:.1f} < "
             f"{floor:.1f} — raise KT_BENCH_GATE_TOL only for an "
@@ -785,6 +814,143 @@ def report_e2e_chaos(root: Path) -> None:
         return
 
 
+_SOAK_RE = re.compile(r"^SOAK_r(\d+)\.json$")
+
+
+def gate_soak(root: Path, tolerance: float) -> int:
+    """Gate the all-stressors soak (ISSUE 16, SOAK_r<n>.json from
+    ``bench.py --scenario soak``).
+
+    Two properties fail OUTRIGHT, with or without priors — they are
+    correctness claims, not perf trends:
+
+    * ``oracle_match`` — the post-failover placements must be
+      bit-identical to the uninterrupted oracle run's;
+    * ``red_outside_windows`` — the burn-rate evaluator must never be
+      red outside a declared fault-injection window (evaluated from the
+      recorded telemetry timeline of BOTH the killed victim and the
+      successor).
+
+    Against best prior same-platform rounds: soak obj/s floors at
+    best*(1-tol); event-to-written p99 ceilings at min*(1+tol) plus the
+    same 250ms absolute slack the other latency gates use (the soak's
+    p99 is dominated by fault-window stalls, deliberately).  The first
+    landing trips the loud NOTHING-GATED warning."""
+    rounds = []
+    for path in sorted(root.glob("SOAK_r*.json")):
+        m = _SOAK_RE.match(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench-gate: {path.name}: unreadable ({e})", file=sys.stderr)
+            raise SystemExit(2)
+        parsed = doc.get("parsed") or {}
+        if doc.get("rc", 0) != 0 or parsed.get("value") is None:
+            print(f"bench-gate: skipping {path.name} (failed or no value)")
+            continue
+        detail = parsed.get("detail") or {}
+        rounds.append(
+            {
+                "round": int(m.group(1)),
+                "path": path.name,
+                "metric": parsed.get("metric", ""),
+                "platform": _platform_key(detail),
+                "value": float(parsed["value"]),
+                "oracle_match": detail.get("oracle_match"),
+                "mismatched": detail.get("mismatched_keys") or [],
+                "red_outside": detail.get("red_outside_windows") or [],
+                "p99_ms": detail.get("event_p99_ms"),
+                "restore": detail.get("restore"),
+                "timeline": detail.get("timeline") or {},
+            }
+        )
+    rounds.sort(key=lambda r: r["round"])
+    if not rounds:
+        print("bench-gate: no SOAK_r*.json artifacts; soak not gated")
+        return 0
+    latest = rounds[-1]
+    ok = True
+    if latest["oracle_match"] is not True:
+        print(
+            f"bench-gate: SOAK ORACLE MISMATCH in {latest['path']}: "
+            f"post-failover placements differ from the uninterrupted "
+            f"run ({len(latest['mismatched'])}+ keys, e.g. "
+            f"{latest['mismatched'][:3]}) — scheduling determinism is "
+            f"broken, this fails regardless of priors",
+            file=sys.stderr,
+        )
+        ok = False
+    if latest["red_outside"]:
+        sample = latest["red_outside"][:3]
+        print(
+            f"bench-gate: SOAK EVALUATOR RED OUTSIDE INJECTION WINDOWS "
+            f"in {latest['path']}: {len(latest['red_outside'])} "
+            f"sample(s), e.g. {sample} — fails regardless of priors",
+            file=sys.stderr,
+        )
+        ok = False
+    tl = latest["timeline"]
+    print(
+        f"bench-gate: soak {latest['path']} restore={latest['restore']} "
+        f"timeline samples={tl.get('samples_total')} "
+        f"bytes={tl.get('approx_bytes')} "
+        f"sampler_cost_s={tl.get('sample_seconds_total')} — informational"
+    )
+    priors = [
+        r
+        for r in rounds[:-1]
+        if r["metric"] == latest["metric"]
+        and r["platform"] == latest["platform"]
+    ]
+    if not priors:
+        print(
+            f"bench-gate: WARNING: {latest['path']} ({latest['metric']}, "
+            f"platform={latest['platform']}) has no prior same-platform "
+            f"baseline — soak obj/s and event p99 NOT GATED this round; "
+            f"this artifact becomes the baseline the next round gates "
+            f"against"
+        )
+        return 0 if ok else 1
+    best_value = max(r["value"] for r in priors)
+    floor = best_value * (1.0 - tolerance)
+    print(
+        f"bench-gate: soak objs/s {latest['value']:.1f} vs best prior "
+        f"{best_value:.1f} (floor {floor:.1f})"
+    )
+    if latest["value"] < floor:
+        print(
+            f"bench-gate: SOAK THROUGHPUT REGRESSION: "
+            f"{latest['value']:.1f} < {floor:.1f}",
+            file=sys.stderr,
+        )
+        ok = False
+    prior_p99 = [r["p99_ms"] for r in priors if r.get("p99_ms") is not None]
+    if latest.get("p99_ms") is not None:
+        if prior_p99:
+            ceil = min(prior_p99) * (1.0 + tolerance) + 250.0
+            print(
+                f"bench-gate: soak event_p99={latest['p99_ms']:.1f}ms vs "
+                f"best prior {min(prior_p99):.1f}ms (ceiling {ceil:.1f})"
+            )
+            if latest["p99_ms"] > ceil:
+                print(
+                    f"bench-gate: SOAK LATENCY REGRESSION: event p99 "
+                    f"{latest['p99_ms']:.1f}ms > {ceil:.1f}ms",
+                    file=sys.stderr,
+                )
+                ok = False
+        else:
+            print(
+                f"bench-gate: WARNING: soak event_p99="
+                f"{latest['p99_ms']:.1f}ms has no prior same-platform "
+                f"baseline — not gated this round"
+            )
+    print("bench-gate: soak ok" if ok else "bench-gate: soak FAILED")
+    return 0 if ok else 1
+
+
 def gate_ktlint(root: Path) -> int:
     """Fail when a previously-clean static-analysis rule regresses
     (ISSUE 14).  Every BENCH_r*.json embeds ``detail.ktlint`` — the
@@ -860,9 +1026,13 @@ def main() -> int:
     restart_rc = gate_restart(args.root, args.tolerance)
     census_rc = gate_census(args.root)
     e2e_rc = gate_e2e(args.root, args.tolerance)
+    soak_rc = gate_soak(args.root, args.tolerance)
     ktlint_rc = gate_ktlint(args.root)
     report_e2e_chaos(args.root)
-    return rc or churn_rc or restart_rc or census_rc or e2e_rc or ktlint_rc
+    return (
+        rc or churn_rc or restart_rc or census_rc or e2e_rc or soak_rc
+        or ktlint_rc
+    )
 
 
 if __name__ == "__main__":
