@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-churn bench-gate bench-restart bench-soak bench-e2e bench-e2e-scale graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke
+.PHONY: test test-fast bench bench-churn bench-gate bench-restart bench-soak bench-e2e bench-e2e-scale graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke obs-smoke
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -76,7 +76,16 @@ bench-gate:
 restart-smoke:
 	$(PYTEST_ENV) python -m pytest tests/test_restart.py -q
 
-test: lint metrics-lint restart-smoke
+# Fleet-observatory smoke (tools/obs_smoke.py): a subprocess kwok-farm
+# round with telemetry spill on — assembles the merged cross-process
+# trace and asserts the manager's member-write span has a server-side
+# child from the member process under the same trace id, the fleet
+# scraper merges every member's /metrics, and spill segments survive
+# teardown (see docs/observability.md "Fleet observatory").
+obs-smoke:
+	$(PYTEST_ENV) python tools/obs_smoke.py
+
+test: lint metrics-lint restart-smoke obs-smoke
 	$(PYTEST_ENV) python -m pytest tests/ -q --ignore=tests/test_restart.py
 
 test-fast: lint metrics-lint

@@ -1150,6 +1150,134 @@ def _soak_scheduled(tenants_doc: dict) -> int:
     )
 
 
+def _soak_spiller(workdir: str, instance: str, metrics, tl):
+    """The role's crash-durable telemetry spiller (runtime/telespill.py)
+    over the soak workdir — explicit per-round spill_now, no thread, so
+    what survives a SIGKILL is deterministic: everything through the
+    last completed round.  Returns None when KT_SPILL=0 (the A/B
+    overhead arm — the gate then falls back to the state-file
+    timelines)."""
+    from kubeadmiral_tpu.runtime import telespill
+
+    spiller = telespill.TelemetrySpiller(
+        directory=os.path.join(workdir, "telemetry"),
+        instance=instance, metrics=metrics, timeline=tl, interval_s=0,
+    )
+    return spiller if spiller.enabled else None
+
+
+def _soak_spill_recover(spill_dir: str) -> dict:
+    """Per-instance telemetry recovered from spill segments, re-anchored
+    on the WALL clock: every record envelope carries (wall, mono) at
+    spill time, so each process's monotonic timeline points and fault
+    windows map onto the one clock the merged gate evaluates on.
+
+    {instance: {"series": {key: {"kind", "points": [[wall_t, v]]}},
+                "offset": wall - mono, "records": n,
+                "first_wall": .., "last_wall": ..}}
+    """
+    from kubeadmiral_tpu.runtime import telespill
+
+    instances: dict[str, dict] = {}
+    for rec in telespill.load_dir(spill_dir, quarantine=False):
+        name = rec.get("instance")
+        wall = rec.get("wall")
+        mono = rec.get("mono")
+        if name is None or wall is None:
+            continue
+        inst = instances.setdefault(
+            name,
+            {
+                "series": {}, "offset": None, "records": 0,
+                "first_wall": wall, "last_wall": wall,
+            },
+        )
+        inst["records"] += 1
+        inst["first_wall"] = min(inst["first_wall"], wall)
+        inst["last_wall"] = max(inst["last_wall"], wall)
+        if rec.get("kind") != "timeline" or mono is None:
+            continue
+        offset = wall - mono
+        inst["offset"] = offset
+        for key, series in (rec.get("series") or {}).items():
+            entry = inst["series"].setdefault(
+                key, {"kind": series.get("kind"), "points": []}
+            )
+            for t, v in series.get("points") or ():
+                entry["points"].append([t + offset, v])
+    for inst in instances.values():
+        for entry in inst["series"].values():
+            entry["points"].sort()
+    return instances
+
+
+def _soak_merged_red_outside(
+    spill: dict, victim_windows: list, succ_windows: list
+) -> list:
+    """Red-outside-windows over the ONE merged victim+successor
+    timeline recovered from spill — both processes' slo_red samples and
+    both processes' injection windows on the shared wall clock.  A
+    window the victim died inside (t1 None) closes at the victim's last
+    spill instant: past its death the victim asserts nothing, and the
+    successor's own windows must cover the successor's reds."""
+    victim = spill.get("victim") or {}
+    succ = spill.get("successor") or {}
+    merged: dict[str, dict] = {}
+    for inst in (victim, succ):
+        for key, series in (inst.get("series") or {}).items():
+            entry = merged.setdefault(
+                key, {"kind": series.get("kind"), "points": []}
+            )
+            entry["points"].extend(series["points"])
+    for entry in merged.values():
+        entry["points"].sort()
+    windows = []
+    death = victim.get("last_wall")
+    for w in victim_windows:
+        t1 = w["t1"] if w["t1"] is not None else None
+        windows.append(
+            {
+                "t0": w["t0"] + victim["offset"],
+                "t1": t1 + victim["offset"] if t1 is not None else death,
+            }
+        )
+    for w in succ_windows:
+        windows.append(
+            {
+                "t0": w["t0"] + succ["offset"],
+                "t1": w["t1"] + succ["offset"]
+                if w["t1"] is not None else None,
+            }
+        )
+    doc = {"tiers": {"raw": {"series": merged}}}
+    return _soak_red_outside(doc, windows)
+
+
+# The failover gap (last victim spill -> first successor spill) rides
+# on subprocess spawn + full package import + snapshot restore; 60s is
+# a generous machine-variance bound that still catches a wedged or
+# never-started successor.
+_SOAK_GAP_BOUND_S = 60.0
+
+
+def _soak_failover_gap(spill: dict) -> dict | None:
+    """The observable failover gap, recovered purely from spill: the
+    wall-clock distance between the victim's last surviving record and
+    the successor's first.  None when either side spilled nothing."""
+    victim = spill.get("victim") or {}
+    succ = spill.get("successor") or {}
+    if victim.get("last_wall") is None or succ.get("first_wall") is None:
+        return None
+    gap = succ["first_wall"] - victim["last_wall"]
+    return {
+        "gap_s": round(gap, 3),
+        "bound_s": _SOAK_GAP_BOUND_S,
+        "bounded": 0.0 <= gap <= _SOAK_GAP_BOUND_S,
+        "victim_last_wall": round(victim["last_wall"], 3),
+        "successor_first_wall": round(succ["first_wall"], 3),
+    }
+
+
 def run_soak_scenario() -> None:
     """--scenario soak: the all-stressors-at-once gated soak.
 
@@ -1208,6 +1336,7 @@ def run_soak_scenario() -> None:
         store = SnapshotStore(os.path.join(workdir, "snapshots"), metrics=m)
         SnapshotManager(h.scheduler.engine, store, every=1)
         h.attach_timeline(tl)
+        spiller = _soak_spiller(workdir, "victim", m, tl)
         t0 = time.perf_counter()
         for r in range(sched.kill_round + 1):
             h.run_round(r, faults=True)
@@ -1223,6 +1352,10 @@ def run_soak_scenario() -> None:
             with open(tmp, "w") as fh:
                 json.dump(state, fh)
             os.replace(tmp, state_path)
+            # Crash-durability contract: the spill after round r is what
+            # the SIGKILL below must not be able to take away.
+            if spiller is not None:
+                spiller.spill_now()
         # SIGKILL mid-fault-window: no atexit, no snapshot flush, no
         # window close — the successor and the gate must cope with the
         # state exactly as the last completed round left it.
@@ -1248,10 +1381,21 @@ def run_soak_scenario() -> None:
         mgr = SnapshotManager(h.scheduler.engine, store, every=1)
         restored = mgr.restore()
         h.attach_timeline(tl)
+        spiller = _soak_spiller(workdir, "successor", m, tl)
+        if spiller is not None:
+            # First record at takeover (not after a full round): the
+            # parent's failover gap measures restore time, not round
+            # time on top.
+            tl.sample_now()
+            spiller.spill_now()
         t0 = time.perf_counter()
         for r in range(state["round"] + 1, sched.rounds):
             h.run_round(r, faults=True)
+            if spiller is not None:
+                spiller.spill_now()
         h.finish()
+        if spiller is not None:
+            spiller.stop()  # final spill + segment close
         print(json.dumps({
             "fingerprint": h.fingerprint(),
             "windows": h.windows,
@@ -1307,9 +1451,27 @@ def run_soak_scenario() -> None:
         for k in set(oracle_fp["placements"]) | set(succ_fp["placements"])
         if oracle_fp["placements"].get(k) != succ_fp["placements"].get(k)
     )
-    red_outside = _soak_red_outside(
-        victim["timeline"], victim["windows"]
-    ) + _soak_red_outside(succ["timeline"], succ["windows"])
+    # The gate's red-outside evaluation runs on the ONE merged
+    # victim+successor timeline recovered from the crash-durable spill
+    # (both processes' samples and windows on the shared wall clock) —
+    # the victim's side is what actually survived the SIGKILL, not what
+    # it promised in its state file.  KT_SPILL=0 (the overhead A/B arm)
+    # falls back to the per-process state-file timelines.
+    spill = _soak_spill_recover(os.path.join(workdir, "telemetry"))
+    failover = _soak_failover_gap(spill)
+    if (
+        (spill.get("victim") or {}).get("offset") is not None
+        and (spill.get("successor") or {}).get("offset") is not None
+    ):
+        red_source = "spill-merged"
+        red_outside = _soak_merged_red_outside(
+            spill, victim["windows"], succ["windows"]
+        )
+    else:
+        red_source = "state-fallback"
+        red_outside = _soak_red_outside(
+            victim["timeline"], victim["windows"]
+        ) + _soak_red_outside(succ["timeline"], succ["windows"])
 
     scheduled = _soak_scheduled(victim["tenants"]) + _soak_scheduled(
         succ["tenants"]
@@ -1346,6 +1508,15 @@ def run_soak_scenario() -> None:
             "oracle_match": oracle_match,
             "mismatched_keys": mismatched[:20],
             "red_outside_windows": red_outside,
+            "red_outside_source": red_source,
+            "failover": failover,
+            "spill": {
+                name: {
+                    "records": inst["records"],
+                    "timeline_series": len(inst["series"]),
+                }
+                for name, inst in sorted(spill.items())
+            },
             "windows": {
                 "victim": victim["windows"],
                 "successor": succ["windows"],
@@ -1365,7 +1536,9 @@ def run_soak_scenario() -> None:
         f"# soak: {sched.rounds} rounds (kill@{sched.kill_round}), "
         f"{succ_fp['objects']} objects, {scheduled} scheduled in "
         f"{elapsed:.1f}s -> {rate:.0f} obj/s; oracle_match={oracle_match} "
-        f"red_outside={len(red_outside)} restore={succ['restore']} "
+        f"red_outside={len(red_outside)} ({red_source}) "
+        f"failover_gap={failover['gap_s'] if failover else None}s "
+        f"restore={succ['restore']} "
         f"event_p99={result['detail']['event_p99_ms']}ms",
         file=sys.stderr,
     )

@@ -552,6 +552,34 @@ def main():
     }
     assert member_objects == expected, (member_objects, expected)
     assert propagated  # first object reached its placed members
+    if farm is not None:
+        # Fleet pane over the farm (ISSUE 17): one merged scrape of
+        # every member's /metrics — the round's evidence that the whole
+        # farm was observable, not just reachable.  Sample counts per
+        # instance, not series payloads: a 500-member dump would
+        # dominate the artifact.
+        from kubeadmiral_tpu.runtime import fleetscrape
+
+        pane = fleetscrape.FleetScraper(roster=farm.scrape_roster).scrape()
+        samples = sorted(
+            inst.get("samples", 0) for inst in pane["instances"].values()
+        )
+        result["detail"]["fleet"] = {
+            "instances": len(pane["instances"]),
+            "scrape_errors": pane["scrape_errors"],
+            "scrape_seconds": pane["scrape_seconds"],
+            "down": sorted(
+                name
+                for name, inst in pane["instances"].items()
+                if not inst.get("up")
+            ),
+            "samples_min": samples[0] if samples else 0,
+            "samples_max": samples[-1] if samples else 0,
+            "samples_per_instance": {
+                name: inst.get("samples", 0)
+                for name, inst in sorted(pane["instances"].items())
+            },
+        }
     if CHAOS:
         result["detail"]["chaos"] = run_chaos(fleet, farm, timer, ftc, members)
     print(json.dumps(result))

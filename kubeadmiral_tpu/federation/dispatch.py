@@ -398,6 +398,12 @@ def run_member_batches(
             return True
         return breaker is not None and not breaker.allow(consume_probe=False)
 
+    # Pool threads have no view of the flushing thread's span stack:
+    # capture the open dispatch span here so each chunk's span (and the
+    # traceparent header its HTTP request carries) stays parented under
+    # the flush — without this, pipelined chunks start orphan traces.
+    flush_span = trace.get_default().current()
+
     def run_chunk(chunk: list[dict]) -> list[dict]:
         # In-process stores deliver watch events synchronously on the
         # writing thread: a pipelined chunk thread must count as "own
@@ -410,9 +416,14 @@ def run_member_batches(
         try:
             if blocked():
                 return [_SHED] * len(chunk)
-            res = run_batch_with_retries(
-                client, chunk, deadline, cluster=cluster, breakers=breakers
-            )
+            with trace.get_default().span_from(
+                "dispatch.member_chunk", flush_span,
+                cluster=cluster, ops=len(chunk),
+            ):
+                res = run_batch_with_retries(
+                    client, chunk, deadline, cluster=cluster,
+                    breakers=breakers,
+                )
             _note_chunk(breakers, cluster, len(chunk), res)
             return res
         finally:

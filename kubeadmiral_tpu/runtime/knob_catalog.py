@@ -336,6 +336,26 @@ KNOBS: dict[str, KnobSpec] = {
     "KT_SOAK_KILL_ROUND": KnobSpec(
         "int", "5", _OPS,
         "Soak: round after which the victim is SIGKILLed."),
+    # -- fleet observatory (runtime/telespill.py, runtime/fleetscrape.py,
+    #    ISSUE 17) --------------------------------------------------------
+    "KT_SPILL": KnobSpec(
+        "bool", "1", _OPS,
+        "Crash-durable telemetry spill master switch (0 = no files, no "
+        "spiller thread; the overhead A/B arm)."),
+    "KT_TELEMETRY_DIR": KnobSpec(
+        "path", "", _OPS,
+        "Spill directory; unset disables spilling (like "
+        "KT_SNAPSHOT_DIR for snapshots)."),
+    "KT_SPILL_BYTES": KnobSpec(
+        "int", "8388608", _OPS,
+        "Per-instance spill byte bound; oldest segments pruned past it."),
+    "KT_SPILL_INTERVAL_S": KnobSpec(
+        "float", "1.0", _OPS,
+        "Background spill period (<=0 = explicit spill_now only)."),
+    "KT_FLEET_SCRAPE_S": KnobSpec(
+        "float", "0.0", _OPS,
+        "Fleet-scraper background refresh period (0 = scrape on "
+        "/debug/fleet demand)."),
 }
 
 

@@ -422,6 +422,54 @@ CATALOG: dict[str, MetricSpec] = {
         "Objects pushed through the batch scheduler per tenant "
         "(rescheduling included) — the demand denominator for weighted "
         "fair admission (ROADMAP item 4)."),
+    # -- fleet observatory (ISSUE 17) -----------------------------------
+    # Member-apiserver request accounting (transport/apiserver.py) plus
+    # the crash-durable telemetry spill (runtime/telespill.py) and the
+    # manager-side fleet scraper (runtime/fleetscrape.py) feeding
+    # GET /debug/fleet.  See docs/observability.md § Fleet observatory.
+    "apiserver_requests_total": MetricSpec(
+        "counter", "requests", ("verb",),
+        "Requests served by a member apiserver, by verb (get/list/"
+        "watch/create/update/update_status/delete/batch) — scraped "
+        "from every member's /metrics by the fleet scraper, so the "
+        "merged pane shows who the managers are actually hammering."),
+    "telespill_records_total": MetricSpec(
+        "counter", "records", ("kind",),
+        "Telemetry records spilled to the crash-durable segment log "
+        "(KT_TELEMETRY_DIR), by kind (spans/timeline/flightrec)."),
+    "telespill_bytes_written_total": MetricSpec(
+        "counter", "bytes", (),
+        "Framed bytes appended to spill segments (frame headers "
+        "included) — the spill's disk-rate denominator against "
+        "KT_SPILL_BYTES."),
+    "telespill_segment_rotations_total": MetricSpec(
+        "counter", "rotations", (),
+        "Spill segment files opened (first open included): rotation "
+        "grain is max_bytes/8, so a fast-rotating spill means the "
+        "telemetry volume outruns the byte budget."),
+    "telespill_segments_deleted_total": MetricSpec(
+        "counter", "segments", (),
+        "Oldest spill segments pruned to keep one instance under "
+        "KT_SPILL_BYTES — history lost to the byte bound, visible."),
+    "telespill_quarantined_total": MetricSpec(
+        "counter", "segments", (),
+        "Damaged spill segments renamed *.quarantined on read (bad "
+        "magic, torn frame, CRC mismatch): the fully-framed prefix is "
+        "salvaged, the file is never re-read."),
+    "fleet_scrapes_total": MetricSpec(
+        "counter", "scrapes", (),
+        "Whole-roster fleet scrapes (GET /debug/fleet refreshes plus "
+        "KT_FLEET_SCRAPE_S background refreshes)."),
+    "fleet_scrape_errors_total": MetricSpec(
+        "counter", "errors", (),
+        "Per-instance scrape failures across fleet scrapes (an "
+        "unreachable or non-200 member /metrics) — nonzero means the "
+        "merged pane is PARTIAL, the down members are named in the "
+        "payload."),
+    "fleet_instances": MetricSpec(
+        "gauge", "instances", (),
+        "Roster size of the last fleet scrape (manager's own registry "
+        "included when attached)."),
 }
 
 # -- end-to-end SLO catalog ------------------------------------------------

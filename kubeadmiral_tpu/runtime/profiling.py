@@ -196,6 +196,8 @@ DEBUG_INDEX = {
     " downsampled series (?series=,&tier=)",
     "/debug/tenants": "per-tenant attribution: SLO burn, writes, sheds,"
     " admission deferrals",
+    "/debug/fleet": "fleet-merged telemetry: per-instance metrics +"
+    " scrape health + manager snapshots",
     "/debug/members": "per-member circuit-breaker health and write"
     " latency reservoirs",
     "/debug/waterfall": "per-tick device-dispatch waterfall"
@@ -222,7 +224,7 @@ def _send(http_handler, body: bytes, content_type: str) -> None:
 def respond_debug(
     http_handler, path: str, raw_query: str, metrics=None, tracer=None,
     flightrec=None, drift=None, members=None, slo=None, timeline=None,
-    tenants=None,
+    tenants=None, fleet=None,
 ) -> bool:
     """Serve a /metrics or /debug/* route on any BaseHTTPRequestHandler;
     returns False when the path isn't one of ours (caller handles it).
@@ -237,8 +239,9 @@ def respond_debug(
     drift providers (flightrec.drift_report); ``members`` (a callable
     returning the member-health listing) defaults to the aggregated
     circuit-breaker registries (transport/breaker.members_report);
-    ``timeline``/``tenants`` default to the process-wide timeline ring
-    and tenant ledger (both opt-in: 404 when none is installed)."""
+    ``timeline``/``tenants``/``fleet`` default to the process-wide
+    timeline ring, tenant ledger and fleet scraper (each opt-in: 404
+    when none is installed)."""
     if path in ("/debug", "/debug/"):
         _send(
             http_handler,
@@ -317,6 +320,21 @@ def respond_debug(
             "application/json",
         )
         return True
+    if path == "/debug/fleet":
+        from kubeadmiral_tpu.runtime import fleetscrape
+
+        scraper = fleet if fleet is not None else fleetscrape.get_default()
+        if scraper is None:
+            http_handler.send_error(
+                404, explain="no fleet scraper installed"
+            )
+            return True
+        _send(
+            http_handler,
+            json.dumps(scraper.summary()).encode(),
+            "application/json",
+        )
+        return True
     if path == "/debug/members":
         from kubeadmiral_tpu.transport import breaker as breaker_mod
 
@@ -363,7 +381,7 @@ class ProfilingServer:
     def __init__(
         self, host: str = "127.0.0.1", port: int = 0, metrics=None,
         tracer=None, flightrec=None, drift=None, members=None, slo=None,
-        timeline=None, tenants=None,
+        timeline=None, tenants=None, fleet=None,
     ):
         self._host = host
         self._port = port
@@ -375,6 +393,7 @@ class ProfilingServer:
         self.slo = slo
         self.timeline = timeline
         self.tenants = tenants
+        self.fleet = fleet
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -395,6 +414,7 @@ class ProfilingServer:
                     flightrec=outer.flightrec, drift=outer.drift,
                     members=outer.members, slo=outer.slo,
                     timeline=outer.timeline, tenants=outer.tenants,
+                    fleet=outer.fleet,
                 ):
                     self.send_error(404)
 

@@ -13,6 +13,29 @@ delivery (runtime/informer.py), worker dequeue/reconcile
 Overhead per span is two ``perf_counter`` calls and a deque append, so
 it stays on in production.
 
+Cross-process correlation (the fleet observatory,
+docs/observability.md § Fleet observatory):
+
+* Every root span mints a 128-bit **trace id**; children inherit it, so
+  one scheduling decision's whole span tree shares one trace id.
+* Span ids are globally unique (a per-tracer random 32-bit prefix over
+  a local counter), so two processes' rings can merge without id
+  collisions.
+* :func:`current_traceparent` renders the innermost open span as a
+  W3C-traceparent header value (``00-<trace id>-<span id>-01``); the
+  HTTP client injects it on every request, and
+  :meth:`Tracer.server_span` on the apiserver side adopts the inbound
+  trace id + parent so the server-side span is a true child of the
+  caller's span — across process boundaries.
+* :meth:`Tracer.span_from` parents a span explicitly (the pipelined
+  dispatch chunk threads: work submitted to a pool carries the
+  submitting span along instead of starting an orphan trace).
+* The Chrome export carries ``otherData.wall_epoch`` — the wall-clock
+  instant of this process's perf_counter epoch — so
+  ``tools/trace_assemble.py`` can align per-process traces on one
+  shared timeline (perf_counter epochs alone are incomparable across
+  processes).
+
 Most callers use the module-level default tracer (``trace.span(...)``);
 tests and embedders may construct their own :class:`Tracer`.
 """
@@ -43,16 +66,62 @@ def epoch() -> float:
     return _EPOCH
 
 
+def wall_epoch() -> float:
+    """The wall-clock time (``time.time()``) of :func:`epoch` — the
+    per-process anchor that makes two processes' trace timestamps
+    comparable: ``wall = wall_epoch() + span.start``.  Recomputed from
+    the current clocks on each call (drift between the two clocks over
+    a process lifetime is far below the microsecond resolution of the
+    export)."""
+    return time.time() - (time.perf_counter() - _EPOCH)
+
+
+def _mint_trace_id() -> str:
+    """A fresh 128-bit trace id, lowercase hex (W3C trace-context)."""
+    return os.urandom(16).hex()
+
+
+def format_traceparent(trace_id: str, span_id: int) -> str:
+    """``00-<32 hex trace id>-<16 hex span id>-01`` (W3C traceparent)."""
+    return f"00-{trace_id}-{span_id & ((1 << 64) - 1):016x}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple[str, int]]:
+    """(trace_id, parent span id) from a traceparent header, or None
+    for anything malformed — a bad header degrades to an unparented
+    server span, never an error on the request path."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or parts[0] != "00":
+        return None
+    trace_id, span_hex = parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_hex) != 16:
+        return None
+    try:
+        int(trace_id, 16)
+        span_id = int(span_hex, 16)
+    except ValueError:
+        return None
+    if span_id == 0 or int(trace_id, 16) == 0:
+        return None
+    return trace_id.lower(), span_id
+
+
 class Span:
     __slots__ = (
-        "name", "span_id", "parent_id", "start", "end", "args", "tid",
-        "thread_name",
+        "name", "span_id", "parent_id", "trace_id", "start", "end",
+        "args", "tid", "thread_name",
     )
 
-    def __init__(self, name: str, span_id: int, parent_id: Optional[int], args: dict):
+    def __init__(
+        self, name: str, span_id: int, parent_id: Optional[int],
+        trace_id: str, args: dict,
+    ):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
+        self.trace_id = trace_id
         self.start = time.perf_counter() - _EPOCH
         self.end: Optional[float] = None
         self.args = args
@@ -64,6 +133,10 @@ class Span:
         only at the end of the work)."""
         self.args.update(args)
 
+    def traceparent(self) -> str:
+        """This span as a traceparent header value."""
+        return format_traceparent(self.trace_id, self.span_id)
+
 
 class Tracer:
     def __init__(self, ring: int = DEFAULT_RING):
@@ -72,6 +145,11 @@ class Tracer:
         # of writer threads must not serialize on the tracer.
         self._ring: deque[Span] = deque(maxlen=ring)
         self._ids = itertools.count(1)
+        # Span ids must be unique across every tracer in every process
+        # whose rings may merge into one trace: a random 32-bit prefix
+        # over the local counter keeps ids collision-free without
+        # coordination (and keeps the hot path a counter increment).
+        self._id_base = int.from_bytes(os.urandom(4), "big") << 32
         self._local = threading.local()
 
     def _stack(self) -> list:
@@ -80,11 +158,12 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
-    @contextmanager
-    def span(self, name: str, **args):
+    def _next_id(self) -> int:
+        return self._id_base | next(self._ids)
+
+    def _span_gen(self, name, trace_id, parent_id, args):
         stack = self._stack()
-        parent = stack[-1].span_id if stack else None
-        sp = Span(name, next(self._ids), parent, args)
+        sp = Span(name, self._next_id(), parent_id, trace_id, args)
         stack.append(sp)
         try:
             yield sp
@@ -93,9 +172,54 @@ class Tracer:
             stack.pop()
             self._ring.append(sp)
 
+    @contextmanager
+    def span(self, name: str, **args):
+        stack = self._stack()
+        if stack:
+            trace_id, parent_id = stack[-1].trace_id, stack[-1].span_id
+        else:
+            trace_id, parent_id = _mint_trace_id(), None
+        yield from self._span_gen(name, trace_id, parent_id, args)
+
+    @contextmanager
+    def span_from(self, name: str, parent: Optional[Span], **args):
+        """A span explicitly parented on ``parent`` — for work handed to
+        another thread (pool-submitted dispatch chunks), where the
+        submitting thread's stack is invisible to the worker.  A None
+        parent falls back to :meth:`span` semantics."""
+        if parent is None:
+            with self.span(name, **args) as sp:
+                yield sp
+            return
+        yield from self._span_gen(
+            name, parent.trace_id, parent.span_id, args
+        )
+
+    @contextmanager
+    def server_span(self, name: str, traceparent: Optional[str], **args):
+        """The server half of cross-process propagation: a span adopting
+        the inbound header's trace id with the caller's span as parent,
+        so a member-apiserver write shows up as a child of the manager's
+        dispatch span in the assembled trace.  No/invalid header opens
+        an ordinary (locally rooted) span."""
+        ctx = parse_traceparent(traceparent)
+        if ctx is None:
+            with self.span(name, **args) as sp:
+                yield sp
+            return
+        trace_id, parent_id = ctx
+        args["remote_parent"] = True
+        yield from self._span_gen(name, trace_id, parent_id, args)
+
     def current(self) -> Optional[Span]:
         stack = self._stack()
         return stack[-1] if stack else None
+
+    def current_traceparent(self) -> Optional[str]:
+        """The innermost open span as a traceparent header value, or
+        None when this thread has no open span."""
+        sp = self.current()
+        return sp.traceparent() if sp is not None else None
 
     def clear(self) -> None:
         self._ring.clear()
@@ -105,15 +229,17 @@ class Tracer:
 
     def chrome_trace(self) -> dict:
         """The completed ring as Chrome trace-event JSON: one complete
-        ("X") event per span (ts/dur in microseconds), span/parent ids in
-        args so nesting survives tools that ignore timing, plus
-        thread-name metadata events."""
+        ("X") event per span (ts/dur in microseconds), span/parent/trace
+        ids in args so nesting survives tools that ignore timing, plus
+        thread-name metadata events and the per-process wall-clock
+        anchor (``otherData.wall_epoch``) trace_assemble aligns lanes
+        with."""
         pid = os.getpid()
         events = []
         threads: dict[int, str] = {}
         for sp in self.spans():
             threads.setdefault(sp.tid, sp.thread_name)
-            args = {"span_id": sp.span_id}
+            args = {"span_id": sp.span_id, "trace_id": sp.trace_id}
             if sp.parent_id is not None:
                 args["parent_id"] = sp.parent_id
             args.update(sp.args)
@@ -138,7 +264,11 @@ class Tracer:
                     "args": {"name": tname},
                 }
             )
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"wall_epoch": wall_epoch(), "pid": pid},
+        }
 
     def chrome_trace_json(self) -> str:
         return json.dumps(self.chrome_trace())
@@ -154,3 +284,9 @@ def get_default() -> Tracer:
 def span(name: str, **args):
     """Open a span on the process-default tracer."""
     return _default.span(name, **args)
+
+
+def current_traceparent() -> Optional[str]:
+    """The calling thread's innermost open span on the default tracer,
+    as a traceparent header value (None with no open span)."""
+    return _default.current_traceparent()

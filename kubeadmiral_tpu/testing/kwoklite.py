@@ -106,6 +106,16 @@ class KwokLiteFarm:
                 f"fault control on {name} failed: HTTP {status} {payload}"
             )
 
+    def scrape_roster(self) -> list[tuple[str, str, str | None]]:
+        """(instance, url, admin token) for every provisioned member —
+        the roster the manager-side fleet scraper
+        (runtime/fleetscrape.py) walks for /debug/fleet.  Computed per
+        call: membership changes as members join or die."""
+        return [
+            (name, self._member_urls[name], client._token)
+            for name, client in sorted(self._member_clients.items())
+        ]
+
     def cluster_spec(self, name: str) -> dict:
         """The FederatedCluster spec fields pointing at this member."""
         return {
@@ -133,11 +143,18 @@ class KwokLiteFarm:
             admin_token = self._member_tokens[name]
             url = self._await_member_url(name)
         else:
+            from kubeadmiral_tpu.runtime.metrics import Metrics
+
             admin_token = f"admin-{name}-{pysecrets.token_hex(8)}"
             store = FakeKube(name)
+            # Each member gets its own registry (request counts by
+            # verb at GET /metrics) so the fleet scraper sees the same
+            # per-instance page whether members are threads or
+            # subprocesses.
             server = KubeApiServer(
                 store, admin_token=admin_token, mint_sa_tokens=True,
                 fault_injector=self.faults, fault_name=name,
+                metrics=Metrics(),
             )
             self.member_servers[name] = server
             url = server.url

@@ -13,6 +13,14 @@ KWOK_PORT); once the server is listening, one JSON line {"url": ...} is
 printed to stdout; the process exits when stdin reaches EOF (the parent
 holds the pipe, so farm teardown — or a parent crash — reaps the child
 without pid bookkeeping).
+
+Observability: each member carries its own Metrics registry (request
+counts by verb, served at GET /metrics with the rest of the /debug
+surface) — the per-instance page the manager's fleet scraper merges
+into /debug/fleet — and, when KT_TELEMETRY_DIR is set, a telemetry
+spiller (runtime/telespill.py) persisting the member's span ring (the
+server-side halves of propagated traces) so tools/trace_assemble.py
+can rebuild cross-process traces even after the member dies.
 """
 
 from __future__ import annotations
@@ -23,6 +31,8 @@ import sys
 
 
 def main() -> None:
+    from kubeadmiral_tpu.runtime import telespill
+    from kubeadmiral_tpu.runtime.metrics import Metrics
     from kubeadmiral_tpu.testing.fakekube import FakeKube
     from kubeadmiral_tpu.transport.apiserver import KubeApiServer
     from kubeadmiral_tpu.transport.faults import FaultInjector
@@ -31,17 +41,22 @@ def main() -> None:
     token = os.environ.get("KWOK_TOKEN") or None
     port = int(os.environ.get("KWOK_PORT", "0"))
     store = FakeKube(name)
+    metrics = Metrics()
     # The child's own injector, driven over the wire by the parent's
     # farm.set_fault/clear_fault via POST /faultz — subprocess members
     # are chaos-injectable exactly like in-process ones.
     server = KubeApiServer(
         store, admin_token=token, port=port, mint_sa_tokens=True,
         fault_injector=FaultInjector(), fault_name=name,
+        metrics=metrics,
     )
+    spiller = telespill.TelemetrySpiller(instance=name, metrics=metrics)
+    spiller.start()
     print(json.dumps({"url": server.url}), flush=True)
     try:
         sys.stdin.read()  # block until the parent closes the pipe
     finally:
+        spiller.stop()  # final spill: the ring's tail outlives teardown
         server.close()
 
 

@@ -36,6 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
+from kubeadmiral_tpu.runtime import trace
 from kubeadmiral_tpu.testing.fakekube import (
     ADDED,
     AlreadyExists,
@@ -116,9 +117,14 @@ class KubeApiServer:
         sa_signing_key: Optional[str] = None,
         fault_injector=None,
         fault_name: Optional[str] = None,
+        metrics=None,
     ):
         self.store = store
         self.admin_token = admin_token
+        # Optional per-server registry: request counts by verb, served
+        # at GET /metrics (with the rest of the /debug surface) so the
+        # fleet scraper can aggregate member apiservers too.
+        self.metrics = metrics
         # Fault-injection seam (transport/faults.py): when given, every
         # request and watch stream resolves this member's FaultPolicy
         # first — added latency, injected 500s, severed connections,
@@ -464,6 +470,20 @@ class _Handler(BaseHTTPRequestHandler):
             return False
         return inj.watch_stalled(self.api.fault_name)
 
+    # -- observability ---------------------------------------------------
+    def _count(self, verb: str) -> None:
+        m = self.api.metrics
+        if m is not None:
+            m.counter("apiserver_requests_total", verb=verb)
+
+    def _server_span(self, name: str, **args):
+        """A server-side span in THIS process's ring, adopting the
+        caller's traceparent header when present — the member half of
+        cross-process trace propagation."""
+        return trace.get_default().server_span(
+            name, self.headers.get("traceparent"), **args
+        )
+
     # -- verbs -----------------------------------------------------------
     def do_GET(self):
         if self._fault_gate():
@@ -477,6 +497,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if not self._check_auth():
             return
+        # The /debug surface (and /metrics when a registry was given):
+        # member apiservers expose the same observability routes as the
+        # manager, which is what the fleet scraper aggregates.  Mounted
+        # after auth, before parse_path (which would 404 them).
+        if split.path == "/metrics" or split.path == "/debug" or (
+            split.path.startswith("/debug/")
+        ):
+            from kubeadmiral_tpu.runtime import profiling
+
+            if not profiling.respond_debug(
+                self, split.path, split.query, metrics=self.api.metrics
+            ):
+                self.send_error(404)
+            return
         try:
             parsed, query = self._route()
         except ValueError as e:
@@ -485,10 +519,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if parsed.name is None:
                 if query.get("watch") in ("true", "1"):
+                    self._count("watch")
                     self._serve_watch(parsed.resource, int(query.get("resourceVersion", 0)))
                 else:
+                    self._count("list")
                     self._serve_list(parsed, query)
             else:
+                self._count("get")
                 obj = self.api.store.get(parsed.resource, self._object_key(parsed))
                 self._send_json(200, obj)
         except NotFound as e:
@@ -612,7 +649,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_status(400, "BadRequest", "invalid JSON body")
             return
         if urlsplit(self.path).path == "/batch":
-            self._serve_batch(obj)
+            self._count("batch")
+            with self._server_span(
+                "apiserver.batch", ops=len(obj.get("operations", ()))
+            ):
+                self._serve_batch(obj)
             return
         try:
             parsed, _ = self._route()
@@ -621,8 +662,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if parsed.namespace:
             obj.setdefault("metadata", {}).setdefault("namespace", parsed.namespace)
+        self._count("create")
         try:
-            created = self.api.store.create(parsed.resource, obj)
+            with self._server_span("apiserver.create", resource=parsed.resource):
+                created = self.api.store.create(parsed.resource, obj)
             self._send_json(201, created)
         except AlreadyExists as e:
             self._send_status(409, "AlreadyExists", str(e))
@@ -644,9 +687,17 @@ class _Handler(BaseHTTPRequestHandler):
         store = self.api.store
         try:
             if parsed.subresource == "status":
-                updated = store.update_status(parsed.resource, obj)
+                self._count("update_status")
+                with self._server_span(
+                    "apiserver.update_status", resource=parsed.resource
+                ):
+                    updated = store.update_status(parsed.resource, obj)
             elif parsed.subresource is None:
-                updated = store.update(parsed.resource, obj)
+                self._count("update")
+                with self._server_span(
+                    "apiserver.update", resource=parsed.resource
+                ):
+                    updated = store.update(parsed.resource, obj)
             else:
                 self._send_status(404, "NotFound", f"subresource {parsed.subresource}")
                 return
@@ -666,8 +717,10 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._send_status(404, "NotFound", str(e))
             return
+        self._count("delete")
         try:
-            self.api.store.delete(parsed.resource, self._object_key(parsed))
+            with self._server_span("apiserver.delete", resource=parsed.resource):
+                self.api.store.delete(parsed.resource, self._object_key(parsed))
             self._send_json(200, {"kind": "Status", "status": "Success"})
         except NotFound as e:
             self._send_status(404, "NotFound", str(e))

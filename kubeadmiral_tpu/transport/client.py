@@ -32,6 +32,7 @@ from urllib.parse import urlsplit
 
 from kubeadmiral_tpu.federation import common as C
 from kubeadmiral_tpu.runtime import slo as _slo
+from kubeadmiral_tpu.runtime import trace as _trace
 from kubeadmiral_tpu.testing.fakekube import (
     ADDED,
     DELETED,
@@ -120,6 +121,14 @@ class HttpKube:
         headers = {"Content-Type": "application/json"}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
+        # Cross-process trace propagation: any request issued under an
+        # open span carries it as a W3C traceparent header, so the
+        # server side (transport/apiserver.py) can record a true child
+        # span in ITS ring — one scheduling decision's sync -> member
+        # write is a single parented trace across processes.
+        traceparent = _trace.current_traceparent()
+        if traceparent is not None:
+            headers["traceparent"] = traceparent
         return headers
 
     def _conn(self) -> http.client.HTTPConnection:

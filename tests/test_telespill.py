@@ -1,0 +1,389 @@
+"""Telemetry spill durability (runtime/telespill.py) + cross-process
+trace propagation (runtime/trace.py <-> transport) — the fleet
+observatory's crash-and-correlate contracts:
+
+* CRC-framed segments: torn tails, truncated frames, corrupt payloads
+  and bad magic all quarantine (``*.quarantined``) while every
+  fully-framed prefix record is salvaged;
+* a SIGKILL mid-append loses at most the torn tail — a subprocess
+  killed with a half-written frame yields every completed record;
+* rotation keeps an instance's segments under the byte bound;
+* KT_SPILL=0 leaves ZERO files;
+* traceparent headers parent a server-side apiserver span under the
+  client's span — across a real HTTP hop — and the Chrome export
+  carries the wall-epoch anchor trace_assemble aligns lanes with.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+from kubeadmiral_tpu.runtime import telespill, trace
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.telespill import (
+    MAGIC,
+    SpillWriter,
+    TelemetrySpiller,
+    load_dir,
+    read_segment,
+)
+
+
+def _segments(directory):
+    return sorted(
+        de.name for de in os.scandir(directory)
+        if de.name.endswith(".ktspill")
+    )
+
+
+def _one_segment_path(directory):
+    names = _segments(directory)
+    assert len(names) == 1, names
+    return os.path.join(directory, names[0])
+
+
+class TestSegmentDurability:
+    def test_roundtrip(self, tmp_path):
+        w = SpillWriter(str(tmp_path), instance="a")
+        for i in range(5):
+            assert w.append("spans", {"kind": "spans", "i": i})
+        w.close()
+        records = load_dir(str(tmp_path))
+        assert [r["i"] for r in records] == list(range(5))
+
+    def test_torn_tail_salvages_prefix_and_quarantines(self, tmp_path):
+        w = SpillWriter(str(tmp_path), instance="a")
+        for i in range(3):
+            w.append("spans", {"i": i})
+        w.close()
+        path = _one_segment_path(tmp_path)
+        with open(path, "ab") as fh:
+            fh.write(struct.pack("<II", 100, 0))
+            fh.write(b'{"torn": tr')  # tail cut mid-payload
+        records, damaged = read_segment(path)
+        assert damaged
+        assert [r["i"] for r in records] == [0, 1, 2]
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".quarantined")
+
+    def test_crc_corruption_quarantines(self, tmp_path):
+        w = SpillWriter(str(tmp_path), instance="a")
+        w.append("spans", {"i": 0})
+        w.append("spans", {"i": 1})
+        w.close()
+        path = _one_segment_path(tmp_path)
+        blob = bytearray(open(path, "rb").read())
+        # Flip a byte inside the LAST record's payload: CRC must catch
+        # it, the first record must still load.
+        blob[-2] ^= 0xFF
+        with open(path, "wb") as fh:
+            fh.write(blob)
+        records, damaged = read_segment(path)
+        assert damaged
+        assert [r["i"] for r in records] == [0]
+        assert os.path.exists(path + ".quarantined")
+
+    def test_bad_magic_quarantines_empty(self, tmp_path):
+        path = tmp_path / "spill-x-1-000000.ktspill"
+        path.write_bytes(b"NOTMAGIC" + b"x" * 64)
+        records, damaged = read_segment(str(path))
+        assert damaged and records == []
+        assert os.path.exists(str(path) + ".quarantined")
+
+    def test_quarantined_files_not_reloaded(self, tmp_path):
+        w = SpillWriter(str(tmp_path), instance="a")
+        w.append("spans", {"i": 0})
+        w.close()
+        path = _one_segment_path(tmp_path)
+        with open(path, "ab") as fh:
+            fh.write(b"\x01")  # short frame header: torn
+        assert len(load_dir(str(tmp_path))) == 1  # salvaged + quarantined
+        assert load_dir(str(tmp_path)) == []  # second pass: nothing left
+
+    def test_sigkill_mid_append_recovers_framed_records(self, tmp_path):
+        """A child writes 10 records, starts an 11th frame and SIGKILLs
+        itself mid-payload: the parent must recover exactly the 10."""
+        child = (
+            "import os, signal, struct, sys\n"
+            "from kubeadmiral_tpu.runtime.telespill import SpillWriter\n"
+            "w = SpillWriter(sys.argv[1], instance='victim')\n"
+            "for i in range(10):\n"
+            "    w.append('spans', {'i': i})\n"
+            "w._fh.write(struct.pack('<II', 999, 12345))\n"
+            "w._fh.write(b'{\"half\": ')\n"
+            "w._fh.flush()\n"
+            "os.kill(os.getpid(), signal.SIGKILL)\n"
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", child, str(tmp_path)],
+            env=env, timeout=120, capture_output=True, text=True,
+        )
+        assert proc.returncode == -signal.SIGKILL, proc.stderr
+        records = load_dir(str(tmp_path))
+        assert [r["i"] for r in records] == list(range(10))
+        assert any(
+            n.endswith(".quarantined") for n in os.listdir(tmp_path)
+        )
+
+    def test_rotation_respects_byte_bound(self, tmp_path):
+        w = SpillWriter(
+            str(tmp_path), instance="a",
+            max_bytes=64 << 10, segment_bytes=8 << 10,
+        )
+        payload = {"blob": "x" * 512}
+        for i in range(400):  # ~200 KiB of records through an 8 KiB grain
+            w.append("spans", dict(payload, i=i))
+        w.close()
+        total = sum(
+            os.path.getsize(os.path.join(tmp_path, n))
+            for n in _segments(tmp_path)
+        )
+        # Bound holds up to one segment of slack (the open segment
+        # never deletes itself; pruning runs at rotation).
+        assert total <= (64 << 10) + (8 << 10) + 1024
+        assert len(_segments(tmp_path)) > 1
+        # The NEWEST records survive pruning.
+        records = load_dir(str(tmp_path))
+        assert records and records[-1]["i"] == 399
+
+    def test_kt_spill_off_leaves_zero_files(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KT_SPILL", "0")
+        w = SpillWriter(str(tmp_path / "spill"), instance="a")
+        assert not w.append("spans", {"i": 0})
+        spiller = TelemetrySpiller(
+            directory=str(tmp_path / "spill2"), instance="a"
+        )
+        assert not spiller.start()
+        assert spiller.spill_now() == 0
+        spiller.stop()
+        assert not os.path.exists(tmp_path / "spill")
+        assert not os.path.exists(tmp_path / "spill2")
+
+
+class _NoRecorder:
+    """Flight-recorder stub: spiller tests must not pick up whatever the
+    process-default recorder accumulated in other tests."""
+
+    enabled = False
+
+    def decisions(self):
+        return {}
+
+
+class _NoTimeline:
+    enabled = False
+
+
+class TestTelemetrySpiller:
+    def test_span_delta_spill(self, tmp_path):
+        tracer = trace.Tracer()
+        with tracer.span("tick", n=1):
+            with tracer.span("inner"):
+                pass
+        spiller = TelemetrySpiller(
+            directory=str(tmp_path), instance="mgr", tracer=tracer,
+            timeline=_NoTimeline(), flightrec=_NoRecorder(),
+        )
+        assert spiller.spill_now() == 1
+        # No new spans -> no new records (delta, not dump).
+        assert spiller.spill_now() == 0
+        with tracer.span("tick", n=2):
+            pass
+        assert spiller.spill_now() == 1
+        records = [r for r in load_dir(str(tmp_path)) if r["kind"] == "spans"]
+        names = [s["name"] for r in records for s in r["spans"]]
+        assert names.count("tick") == 2 and "inner" in names
+        env = records[0]
+        assert {"instance", "pid", "wall", "mono", "wall_epoch"} <= set(env)
+        inner = next(
+            s for r in records for s in r["spans"] if s["name"] == "inner"
+        )
+        tick = next(
+            s for r in records for s in r["spans"] if s["name"] == "tick"
+        )
+        assert inner["parent_id"] == tick["span_id"]
+        assert inner["trace_id"] == tick["trace_id"]
+
+    def test_timeline_raw_tier_delta(self, tmp_path):
+        from kubeadmiral_tpu.runtime.timeline import Timeline
+
+        m = Metrics()
+        tl = Timeline(metrics=m, interval_s=0.05)
+        tracer = trace.Tracer()
+        spiller = TelemetrySpiller(
+            directory=str(tmp_path), instance="mgr", tracer=tracer,
+            timeline=tl, flightrec=_NoRecorder(),
+        )
+        m.counter("worker_retries_total", controller="sync")
+        tl.sample_now(now=1.0)
+        assert spiller.spill_now() == 1
+        m.counter("worker_retries_total", controller="sync")
+        tl.sample_now(now=2.0)
+        assert spiller.spill_now() == 1
+        records = [
+            r for r in load_dir(str(tmp_path)) if r["kind"] == "timeline"
+        ]
+        assert len(records) == 2
+        all_points = [
+            p
+            for r in records
+            for s in r["series"].values()
+            for p in s["points"]
+        ]
+        times = sorted(p[0] for p in all_points)
+        # Delta semantics: the second record re-spills nothing from t=1.
+        assert times[0] == 1.0 and times[-1] == 2.0
+        t1_points = [p for p in all_points if p[0] == 1.0]
+        assert len(t1_points) == len(
+            [p for p in all_points if p[0] == 2.0]
+        )
+
+
+class TestTraceParent:
+    def test_format_parse_roundtrip(self):
+        tid = "a" * 32
+        header = trace.format_traceparent(tid, 0x1234)
+        assert header == f"00-{tid}-0000000000001234-01"
+        assert trace.parse_traceparent(header) == (tid, 0x1234)
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            None,
+            "",
+            "garbage",
+            "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+            "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+            "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # short span id
+            "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # zero trace id
+            "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+            "00-" + "z" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+        ],
+    )
+    def test_parse_rejects_malformed(self, bad):
+        assert trace.parse_traceparent(bad) is None
+
+    def test_children_inherit_trace_id(self):
+        tracer = trace.Tracer()
+        with tracer.span("root") as root:
+            assert tracer.current_traceparent() == root.traceparent()
+            with tracer.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+        with tracer.span("other") as other:
+            assert other.trace_id != root.trace_id
+
+    def test_span_from_explicit_parent_across_threads(self):
+        import threading
+
+        tracer = trace.Tracer()
+        seen = {}
+
+        def work(parent):
+            with tracer.span_from("pool-work", parent) as sp:
+                seen["span"] = sp
+
+        with tracer.span("flush") as flush:
+            t = threading.Thread(target=work, args=(flush,))
+            t.start()
+            t.join()
+        assert seen["span"].trace_id == flush.trace_id
+        assert seen["span"].parent_id == flush.span_id
+
+    def test_server_span_adopts_header(self):
+        tracer = trace.Tracer()
+        header = trace.format_traceparent("ab" * 16, 77)
+        with tracer.server_span("apiserver.batch", header) as sp:
+            assert sp.trace_id == "ab" * 16
+            assert sp.parent_id == 77
+            assert sp.args.get("remote_parent") is True
+        with tracer.server_span("apiserver.batch", "garbage") as sp:
+            assert sp.parent_id is None
+
+    def test_chrome_trace_wall_epoch_anchor(self):
+        tracer = trace.Tracer()
+        with tracer.span("a"):
+            pass
+        doc = tracer.chrome_trace()
+        other = doc["otherData"]
+        assert other["pid"] == os.getpid()
+        assert abs(other["wall_epoch"] - trace.wall_epoch()) < 1.0
+        ev = next(e for e in doc["traceEvents"] if e["ph"] == "X")
+        assert ev["args"]["trace_id"]
+        # wall_epoch + ts lands at "now", not 1970 or 0.
+        assert abs(
+            (other["wall_epoch"] + ev["ts"] / 1e6) - time.time()
+        ) < 60.0
+
+
+class TestHttpPropagation:
+    def test_server_side_child_span_over_http(self):
+        from kubeadmiral_tpu.testing.fakekube import FakeKube
+        from kubeadmiral_tpu.transport.apiserver import KubeApiServer
+        from kubeadmiral_tpu.transport.client import HttpKube
+
+        server = KubeApiServer(FakeKube("m0"), metrics=Metrics())
+        client = HttpKube(server.url, name="m0")
+        default_tracer = trace.get_default()
+        before = {sp.span_id for sp in default_tracer.spans()}
+        try:
+            with trace.span("dispatch.member_write", cluster="m0") as mine:
+                client.batch(
+                    [
+                        {
+                            "verb": "create",
+                            "resource": "v1/configmaps",
+                            "object": {
+                                "metadata": {
+                                    "name": "c1", "namespace": "default"
+                                }
+                            },
+                        }
+                    ]
+                )
+            # The server span lands in the ring on the handler thread
+            # AFTER the response bytes flush — poll briefly.
+            deadline = time.monotonic() + 5.0
+            server_spans: list = []
+            while not server_spans and time.monotonic() < deadline:
+                server_spans = [
+                    sp for sp in default_tracer.spans()
+                    if sp.span_id not in before
+                    and sp.name == "apiserver.batch"
+                ]
+                if not server_spans:
+                    time.sleep(0.01)
+            assert server_spans, [
+                sp.name for sp in default_tracer.spans()
+                if sp.span_id not in before
+            ]
+            sp = server_spans[-1]
+            assert sp.trace_id == mine.trace_id
+            assert sp.parent_id == mine.span_id
+            assert sp.args.get("remote_parent") is True
+            assert sp.args.get("ops") == 1
+            # The request verb was counted for the fleet pane.
+            assert server.metrics.counters  # apiserver_requests_total
+        finally:
+            client.close()
+            server.close()
+
+    def test_no_open_span_sends_no_header(self):
+        from kubeadmiral_tpu.transport.client import HttpKube
+
+        client = HttpKube("http://127.0.0.1:1", name="x")
+        assert "traceparent" not in client._headers()
+        with trace.span("outer"):
+            assert "traceparent" in client._headers()

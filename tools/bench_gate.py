@@ -861,6 +861,8 @@ def gate_soak(root: Path, tolerance: float) -> int:
                 "oracle_match": detail.get("oracle_match"),
                 "mismatched": detail.get("mismatched_keys") or [],
                 "red_outside": detail.get("red_outside_windows") or [],
+                "red_source": detail.get("red_outside_source"),
+                "failover": detail.get("failover"),
                 "p99_ms": detail.get("event_p99_ms"),
                 "restore": detail.get("restore"),
                 "timeline": detail.get("timeline") or {},
@@ -891,6 +893,28 @@ def gate_soak(root: Path, tolerance: float) -> int:
             file=sys.stderr,
         )
         ok = False
+    failover = latest.get("failover")
+    if failover is not None:
+        # Spill-recovered failover gap (last victim record -> first
+        # successor record): a correctness bound like oracle_match —
+        # an unbounded gap means the successor never actually picked
+        # the telemetry (and the work) up.
+        if failover.get("bounded") is not True:
+            print(
+                f"bench-gate: SOAK FAILOVER GAP UNBOUNDED in "
+                f"{latest['path']}: gap={failover.get('gap_s')}s "
+                f"(bound {failover.get('bound_s')}s) — fails regardless "
+                f"of priors",
+                file=sys.stderr,
+            )
+            ok = False
+        else:
+            print(
+                f"bench-gate: soak failover gap "
+                f"{failover.get('gap_s')}s (bound "
+                f"{failover.get('bound_s')}s, "
+                f"red source={latest.get('red_source')}) — ok"
+            )
     tl = latest["timeline"]
     print(
         f"bench-gate: soak {latest['path']} restore={latest['restore']} "

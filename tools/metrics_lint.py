@@ -19,7 +19,11 @@ The same walk keeps the decision vocabulary cataloged:
   ``metric_catalog.FLIGHT_RECORDER_FIELDS``;
 * the reason-slug set (``ops.reasons.REASON_NAMES``) must equal
   ``metric_catalog.DECISION_REASONS`` — so the strings /debug/explain
-  serves (and events embed) never drift from docs/observability.md.
+  serves (and events embed) never drift from docs/observability.md;
+* the /debug surface (ISSUE 17): every route the profiling module
+  dispatches ↔ ``profiling.DEBUG_INDEX`` ↔ the docs/observability.md
+  route table, all three ways — the GET /debug discovery index can
+  never under- or over-promise.
 
 Exit status: 0 clean, 1 violations (listed one per line), 2 on a file
 that fails to parse.
@@ -28,6 +32,7 @@ that fails to parse.
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from pathlib import Path
 
@@ -182,8 +187,83 @@ def lint_decision_vocabulary() -> list[str]:
     return errors
 
 
+_ROUTE_RE = re.compile(r"^(/metrics|/debug(?:/[a-z_]+)?)/?$")
+_DOC_ROUTE_RE = re.compile(r"/debug/[a-z_]+|/metrics\b|/debug(?![/a-z])")
+
+
+def lint_debug_index() -> list[str]:
+    """Three-way /debug surface completeness (ISSUE 17): every route the
+    profiling module dispatches must be in DEBUG_INDEX, every
+    DEBUG_INDEX entry must actually be dispatched, and the
+    docs/observability.md route table must name them all — the
+    one-curl discovery surface (GET /debug) can never drift from what
+    is served or from what operators read."""
+    errors: list[str] = []
+    from kubeadmiral_tpu.runtime.profiling import DEBUG_INDEX
+
+    prof = REPO / "kubeadmiral_tpu" / "runtime" / "profiling.py"
+    tree = ast.parse(prof.read_text(), filename=str(prof))
+    served: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not (isinstance(node.left, ast.Name) and node.left.id == "path"):
+            continue
+        for comp in node.comparators:
+            literals = (
+                comp.elts if isinstance(comp, ast.Tuple) else [comp]
+            )
+            for lit in literals:
+                if isinstance(lit, ast.Constant) and isinstance(
+                    lit.value, str
+                ):
+                    m = _ROUTE_RE.match(lit.value)
+                    if m:
+                        served.add(m.group(1))
+    served.discard("/debug")  # the index itself
+
+    index = set(DEBUG_INDEX)
+    for route in sorted(served - index):
+        errors.append(
+            f"kubeadmiral_tpu/runtime/profiling.py: route {route!r} is "
+            f"dispatched but missing from DEBUG_INDEX — the GET /debug "
+            f"index must name every served route"
+        )
+    for route in sorted(index - served):
+        errors.append(
+            f"kubeadmiral_tpu/runtime/profiling.py: DEBUG_INDEX names "
+            f"{route!r} but no dispatch serves it — stale index entry"
+        )
+
+    doc = REPO / "docs" / "observability.md"
+    doc_routes: set[str] = set()
+    in_table = False
+    for line in doc.read_text().splitlines():
+        stripped = line.strip()
+        if stripped.startswith("| Route |"):
+            in_table = True
+            continue
+        if in_table and not stripped.startswith("|"):
+            break
+        if in_table:
+            doc_routes.update(_DOC_ROUTE_RE.findall(stripped))
+    doc_routes.discard("/debug")
+    for route in sorted(index - doc_routes):
+        errors.append(
+            f"docs/observability.md: route table is missing {route!r} "
+            f"(in DEBUG_INDEX) — document the route before it ships"
+        )
+    for route in sorted(doc_routes - index):
+        errors.append(
+            f"docs/observability.md: route table names {route!r} which "
+            f"is not in DEBUG_INDEX — stale docs row"
+        )
+    return errors
+
+
 def main() -> int:
     errors: list[str] = list(lint_decision_vocabulary())
+    errors.extend(lint_debug_index())
     for root in SCAN_ROOTS:
         path = REPO / root
         files = sorted(path.rglob("*.py")) if path.is_dir() else [path]

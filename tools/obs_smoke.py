@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""obs-smoke: the fleet-observatory end-to-end check (`make obs-smoke`).
+
+One subprocess kwok-farm round with telemetry spill on, then assemble
+the merged cross-process trace and assert the propagation contract:
+
+* the manager's ``dispatch.member_write`` span must have a server-side
+  child span recorded in the MEMBER process's ring, under the same
+  trace id, joined across the process boundary by the traceparent
+  header (runtime/trace.py <-> transport/client.py <-> apiserver.py);
+* both processes' spans land on one merged timeline via the wall-epoch
+  anchor (tools/trace_assemble.py);
+* spill segments survive member teardown and carry every fully-framed
+  record (runtime/telespill.py);
+* the fleet scraper merges every member's /metrics page with zero
+  scrape errors (runtime/fleetscrape.py -> GET /debug/fleet).
+
+Runs CPU-only in a few seconds; wired into `make test`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    tmpdir = tempfile.mkdtemp(prefix="kt-obs-smoke-")
+    spill_dir = os.path.join(tmpdir, "telemetry")
+    # Children inherit the env: member subprocesses spill their span
+    # rings (the server-side halves) into the same directory.
+    os.environ["KT_TELEMETRY_DIR"] = spill_dir
+    os.environ.setdefault("KT_SPILL_INTERVAL_S", "0.2")
+
+    from kubeadmiral_tpu.federation import dispatch
+    from kubeadmiral_tpu.runtime import fleetscrape, telespill, trace
+    from kubeadmiral_tpu.testing.kwoklite import KwokLiteFarm
+
+    sys.path.insert(0, str(REPO / "tools"))
+    import trace_assemble
+
+    farm = KwokLiteFarm(member_subprocess=True)
+    failures: list[str] = []
+    try:
+        farm.spawn_members(["m-0", "m-1"])
+        clients = {name: farm.add_member(name) for name in ("m-0", "m-1")}
+
+        # One member-write round per member, exactly the sync dispatch
+        # shape: a dispatch.member_write span over run_member_batches
+        # (whose pipelined chunks and HTTP requests must inherit it).
+        deadline = time.monotonic() + 30.0
+        for name, client in clients.items():
+            ops = [
+                {
+                    "verb": "create",
+                    "resource": "v1/configmaps",
+                    "object": {
+                        "apiVersion": "v1",
+                        "kind": "ConfigMap",
+                        "metadata": {"name": f"cm-{i}", "namespace": "default"},
+                        "data": {"round": str(i)},
+                    },
+                }
+                for i in range(8)
+            ]
+            with trace.span("dispatch.member_write", cluster=name, ops=len(ops)):
+                results = dispatch.run_member_batches(
+                    client, ops, deadline, cluster=name
+                )
+            bad = [r for r in results if r.get("code") not in (200, 201)]
+            if bad:
+                failures.append(f"{name}: {len(bad)} failed writes: {bad[:2]}")
+
+        # Fleet pane: every member's /metrics merges with zero errors.
+        scraper = fleetscrape.FleetScraper(roster=farm.scrape_roster)
+        pane = scraper.scrape()
+        if pane["scrape_errors"]:
+            failures.append(f"fleet scrape errors: {pane}")
+        for name in clients:
+            inst = pane["instances"].get(name) or {}
+            if not inst.get("up") or not inst.get("samples"):
+                failures.append(f"fleet instance {name} not scraped: {inst}")
+
+        # Spill the manager's ring, then give member spillers one
+        # interval to persist their server-side spans.
+        spiller = telespill.TelemetrySpiller(
+            directory=spill_dir, instance="manager"
+        )
+        if spiller.spill_now() <= 0:
+            failures.append("manager spill wrote no records")
+        time.sleep(0.5)
+    finally:
+        farm.close()  # members final-spill on teardown
+
+    merged_path = os.path.join(tmpdir, "merged.trace.json")
+    doc = trace_assemble.assemble([spill_dir])
+    with open(merged_path, "w") as fh:
+        json.dump(doc, fh)
+    summary = doc["summary"]
+
+    if summary["lanes"] < 3:
+        failures.append(
+            f"expected >=3 process lanes (manager + 2 members), got "
+            f"{summary['lanes']}: {summary['events_per_lane']}"
+        )
+    joins = [
+        j
+        for j in summary["join_examples"]
+        if str(j["parent"]).startswith("dispatch.")
+        and str(j["child"]).startswith("apiserver.")
+    ]
+    if summary["cross_process_joins"] < 1 or not joins:
+        failures.append(
+            "no cross-process dispatch->apiserver join in the merged "
+            f"trace: {summary}"
+        )
+
+    if failures:
+        print("obs-smoke FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        print(f"artifacts kept in {tmpdir}", file=sys.stderr)
+        return 1
+    join = joins[0]
+    print(
+        f"obs-smoke: ok — {summary['events']} events, "
+        f"{summary['lanes']} lanes, {summary['cross_process_joins']} "
+        f"cross-process joins (e.g. {join['parent']} -> {join['child']} "
+        f"under trace {join['trace_id'][:8]}...)"
+    )
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""trace_assemble: merge per-process traces into ONE Chrome trace.
+
+Each process exports spans relative to its own perf_counter epoch —
+incomparable across processes — but both the Chrome export
+(``runtime/trace.py chrome_trace()``, ``otherData.wall_epoch``) and
+every telemetry-spill record (``runtime/telespill.py``, ``wall_epoch``
+envelope field) carry the wall-clock instant of that epoch.  This tool
+re-anchors every input on the earliest wall epoch seen, assigns each
+(instance, pid) its own process lane, and emits one merged trace —
+load it in chrome://tracing / ui.perfetto.dev and a manager-side
+``dispatch.member_write`` span sits directly above the member
+process's ``apiserver.batch`` child, joined by trace id.
+
+Inputs (mix freely):
+
+* a telemetry-spill directory (``KT_TELEMETRY_DIR``) — ``spans``
+  records from every instance's segments;
+* a Chrome trace JSON file (a saved ``GET /debug/trace`` payload).
+
+Usage::
+
+    python tools/trace_assemble.py --out merged.trace.json \
+        /tmp/kt-telemetry manager.trace.json
+
+The runbook ("correlate one slow member write across processes") is in
+docs/observability.md § Fleet observatory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _lane_events_from_spill(directory: str) -> list[dict]:
+    """Per-lane raw events from a spill directory's ``spans`` records:
+    each event still in its process's epoch-relative microseconds, but
+    tagged with the lane's wall_epoch + identity for re-anchoring."""
+    from kubeadmiral_tpu.runtime import telespill
+
+    out = []
+    for rec in telespill.load_dir(directory, quarantine=False):
+        if rec.get("kind") != "spans":
+            continue
+        wall_epoch = rec.get("wall_epoch")
+        instance = rec.get("instance") or f"pid{rec.get('pid')}"
+        pid = rec.get("pid")
+        for sp in rec.get("spans") or ():
+            start = sp.get("start")
+            if start is None:
+                continue
+            end = sp.get("end")
+            args = dict(sp.get("args") or {})
+            args["span_id"] = sp.get("span_id")
+            args["trace_id"] = sp.get("trace_id")
+            if sp.get("parent_id") is not None:
+                args["parent_id"] = sp.get("parent_id")
+            out.append(
+                {
+                    "name": sp.get("name"),
+                    "ph": "X",
+                    "ts": round(start * 1e6, 3),
+                    "dur": round(((end or start) - start) * 1e6, 3),
+                    "tid": sp.get("tid", 0),
+                    "args": args,
+                    "_lane": (instance, pid),
+                    "_wall_epoch": wall_epoch,
+                    "_thread_name": sp.get("thread_name"),
+                }
+            )
+    return out
+
+
+def _lane_events_from_trace(path: str) -> list[dict]:
+    with open(path) as fh:
+        doc = json.load(fh)
+    other = doc.get("otherData") or {}
+    wall_epoch = other.get("wall_epoch")
+    pid = other.get("pid")
+    instance = other.get("instance") or os.path.basename(path)
+    out = []
+    thread_names: dict[object, str] = {}
+    for ev in doc.get("traceEvents") or ():
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            thread_names[ev.get("tid")] = (ev.get("args") or {}).get("name")
+            continue
+        if ev.get("ph") != "X":
+            continue
+        out.append(
+            {
+                "name": ev.get("name"),
+                "ph": "X",
+                "ts": ev.get("ts", 0.0),
+                "dur": ev.get("dur", 0.0),
+                "tid": ev.get("tid", 0),
+                "args": dict(ev.get("args") or {}),
+                "_lane": (instance, ev.get("pid", pid)),
+                "_wall_epoch": wall_epoch,
+                "_thread_name": None,
+            }
+        )
+    for ev in out:
+        ev["_thread_name"] = thread_names.get(ev["tid"])
+    return out
+
+
+def assemble(inputs: list[str]) -> dict:
+    """Merge spill directories and Chrome trace files into one trace.
+
+    Lanes without a wall anchor (a pre-anchor trace export) are kept —
+    re-anchored as if their epoch were the base — and counted in
+    ``summary.unanchored_lanes`` so a silently misaligned lane is
+    visible, not invisible."""
+    raw: list[dict] = []
+    for item in inputs:
+        if os.path.isdir(item):
+            raw.extend(_lane_events_from_spill(item))
+        else:
+            raw.extend(_lane_events_from_trace(item))
+    anchors = [
+        ev["_wall_epoch"] for ev in raw if ev["_wall_epoch"] is not None
+    ]
+    base = min(anchors) if anchors else 0.0
+    lanes: dict[tuple, int] = {}
+    lane_anchor: dict[tuple, float] = {}
+    unanchored: set[tuple] = set()
+    events: list[dict] = []
+    thread_names: dict[tuple[int, object], str] = {}
+    for ev in raw:
+        lane = ev.pop("_lane")
+        wall_epoch = ev.pop("_wall_epoch")
+        tname = ev.pop("_thread_name")
+        if lane not in lanes:
+            lanes[lane] = len(lanes) + 1
+            lane_anchor[lane] = wall_epoch if wall_epoch is not None else base
+            if wall_epoch is None:
+                unanchored.add(lane)
+        pid = lanes[lane]
+        shift_us = (lane_anchor[lane] - base) * 1e6
+        ev["pid"] = pid
+        ev["ts"] = round(ev["ts"] + shift_us, 3)
+        events.append(ev)
+        if tname:
+            thread_names.setdefault((pid, ev["tid"]), tname)
+    for lane, pid in lanes.items():
+        instance, real_pid = lane
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"{instance} (pid {real_pid})"},
+            }
+        )
+    for (pid, tid), tname in thread_names.items():
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+        )
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "wall_epoch": base,
+            "lanes": {
+                "/".join(str(p) for p in lane): pid
+                for lane, pid in lanes.items()
+            },
+        },
+    }
+    doc["summary"] = summarize(doc)
+    doc["summary"]["unanchored_lanes"] = sorted(
+        "/".join(str(p) for p in lane) for lane in unanchored
+    )
+    return doc
+
+
+def summarize(doc: dict) -> dict:
+    """Counts + the cross-process parent/child joins: events in lane A
+    whose args.parent_id is the span_id of an event in lane B ≠ A,
+    under the same trace id — the propagation acceptance check."""
+    spans_by_id: dict[tuple, dict] = {}
+    per_lane: dict[int, int] = {}
+    x_events = [ev for ev in doc.get("traceEvents") or () if ev.get("ph") == "X"]
+    for ev in x_events:
+        args = ev.get("args") or {}
+        per_lane[ev.get("pid")] = per_lane.get(ev.get("pid"), 0) + 1
+        if args.get("span_id") is not None and args.get("trace_id"):
+            spans_by_id[(args["trace_id"], args["span_id"])] = ev
+    joins = []
+    for ev in x_events:
+        args = ev.get("args") or {}
+        parent_id = args.get("parent_id")
+        trace_id = args.get("trace_id")
+        if parent_id is None or not trace_id:
+            continue
+        parent = spans_by_id.get((trace_id, parent_id))
+        if parent is None or parent.get("pid") == ev.get("pid"):
+            continue
+        joins.append(
+            {
+                "trace_id": trace_id,
+                "parent": parent.get("name"),
+                "parent_pid": parent.get("pid"),
+                "child": ev.get("name"),
+                "child_pid": ev.get("pid"),
+            }
+        )
+    return {
+        "events": len(x_events),
+        "lanes": len(per_lane),
+        "events_per_lane": per_lane,
+        "cross_process_joins": len(joins),
+        "join_examples": joins[:10],
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "inputs", nargs="+",
+        help="spill directories and/or Chrome trace JSON files",
+    )
+    parser.add_argument(
+        "--out", default="merged.trace.json",
+        help="merged Chrome trace output path",
+    )
+    args = parser.parse_args(argv)
+    doc = assemble(args.inputs)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh)
+    s = doc["summary"]
+    print(
+        f"trace_assemble: {s['events']} events across {s['lanes']} lanes, "
+        f"{s['cross_process_joins']} cross-process joins -> {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
