@@ -7,7 +7,7 @@
 
 PYTEST_ENV = env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE JAX_PLATFORMS=cpu
 
-.PHONY: test test-fast bench bench-churn bench-gate bench-restart bench-soak bench-e2e bench-e2e-scale graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke obs-smoke
+.PHONY: test test-fast bench bench-churn bench-gate bench-restart bench-soak bench-e2e bench-e2e-scale bench-store graft-check graft-dryrun native metrics-lint lint chaos chaos-e2e profile profile-smoke restart-smoke obs-smoke
 
 native: kubeadmiral_tpu/native/libkadmhash.so
 
@@ -16,6 +16,15 @@ kubeadmiral_tpu/native/libkadmhash.so: kubeadmiral_tpu/native/fnvhash.cpp kubead
 
 bench-e2e:
 	$(PYTEST_ENV) python bench_e2e.py
+
+# Store/notify microbench (ISSUE 18): raw in-process store writes/s
+# (direct + columnar batch verbs) and watch fan-out µs/event with a
+# controller-fleet-sized watcher population, both KT_STORE_COALESCE
+# modes side by side.  Save output as BENCH_STORE_rNN.json; bench-gate
+# floors writes/s and ceilings notify latency vs same-platform priors
+# (see docs/operations.md "Store & notify tuning").
+bench-store:
+	$(PYTEST_ENV) python tools/store_bench.py
 
 # End-to-end over a kwok-lite HTTP farm at HUNDREDS of member
 # apiservers (real sockets, auth, watches): the write-path coalescing +

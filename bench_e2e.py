@@ -165,15 +165,23 @@ def run_chaos(fleet, farm, timer, ftc, members) -> dict:
     went_red: set = set()
     oldest_peak = 0.0
     durations = []
+    from kubeadmiral_tpu.federation import dispatch as D
+    from kubeadmiral_tpu.utils.unstructured import copy_json
+
     for r in range(CHAOS_ROUNDS):
-        for i in range(r % 3, min(N_OBJECTS, 120), 3):
+        # One bulk round trip fetches the whole churn slice: the harness
+        # must not serialize per-key on the store it is measuring.
+        churn_keys = [
+            f"default/web-{i:05d}" for i in range(r % 3, min(N_OBJECTS, 120), 3)
+        ]
+        got = D.bulk_get(fleet.host, ftc.source.resource, churn_keys) or {}
+        for obj in got.values():
+            if obj is None:
+                continue
             try:
-                obj = fleet.host.try_get(
-                    ftc.source.resource, f"default/web-{i:05d}"
-                )
-                if obj is not None:
-                    obj["spec"]["replicas"] = (obj["spec"].get("replicas", 1) % 20) + 1
-                    fleet.host.update(ftc.source.resource, obj)
+                obj = copy_json(obj)  # bulk results are read-only views
+                obj["spec"]["replicas"] = (obj["spec"].get("replicas", 1) % 20) + 1
+                fleet.host.update(ftc.source.resource, obj)
             except Exception:
                 pass  # churn races are part of the scenario
         t0 = time.perf_counter()
@@ -447,16 +455,21 @@ def main():
         len(kube.keys(ftc.source.resource)) for kube in members.values()
     )
     expected = 0
-    for key in fleet.host.keys(ftc.federated.resource):
-        fed = fleet.host.get(ftc.federated.resource, key)
+    # Bulk point reads: the verification sweep over every fed object
+    # must not serialize per-key on the store it just measured.
+    from kubeadmiral_tpu.federation import dispatch as D
+
+    fed_keys = fleet.host.keys(ftc.federated.resource)
+    fed_objs = D.bulk_get(fleet.host, ftc.federated.resource, fed_keys) or {}
+    for key in fed_keys:
+        fed = fed_objs.get(key)
+        assert fed is not None, key
         statuses = fed.get("status", {}).get("clusters", [])
         assert statuses and all(c["status"] == "OK" for c in statuses), key
         expected += len(statuses)
     propagated = {
         c["cluster"]
-        for c in fleet.host.get(ftc.federated.resource, "default/web-00000")[
-            "status"
-        ]["clusters"]
+        for c in fed_objs["default/web-00000"]["status"]["clusters"]
     }
 
     stages = {

@@ -21,6 +21,8 @@ types_placements.go, types_overrides.go, types_status.go).
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional
 
 PREFIX = "kubeadmiral.io/"
@@ -192,6 +194,28 @@ def cluster_lifecycle_sig(cluster_obj: dict) -> tuple:
     )
 
 
+# Per-delivery signature memo: the store installs a scope around its
+# watch fan-out so that when several controllers compute the trigger
+# signature of the SAME delivered snapshot (one shared dict per event),
+# the sorted-items hash runs once per object, not once per watcher.
+# Thread-local because fan-out is synchronous on the writing thread and
+# id()-keyed entries are only valid while the delivery pins the object.
+_sig_tls = threading.local()
+
+
+@contextlib.contextmanager
+def sig_memo_scope():
+    """Install a fresh metadata_change_sig memo for one store delivery
+    (nested deliveries — a handler writing mid-fan-out — get their own
+    scope; the outer memo is restored on exit)."""
+    prev = getattr(_sig_tls, "memo", None)
+    _sig_tls.memo = {}
+    try:
+        yield
+    finally:
+        _sig_tls.memo = prev
+
+
 def metadata_change_sig(obj: dict, ignore_annotations: tuple = ()) -> int:
     """Trigger signature of the fields a fed-object watch handler cares
     about: generation (spec changes bump it), labels (policy binding),
@@ -200,6 +224,18 @@ def metadata_change_sig(obj: dict, ignore_annotations: tuple = ()) -> int:
     unchanged, so controllers keeping a key->sig map skip the requeue
     entirely (the reference's schedulingtriggers.go idea applied at the
     watch boundary)."""
+    memo = getattr(_sig_tls, "memo", None)
+    if memo is not None:
+        memo_key = (id(obj), ignore_annotations)
+        sig = memo.get(memo_key)
+        if sig is None:
+            sig = _metadata_change_sig(obj, ignore_annotations)
+            memo[memo_key] = sig
+        return sig
+    return _metadata_change_sig(obj, ignore_annotations)
+
+
+def _metadata_change_sig(obj: dict, ignore_annotations: tuple = ()) -> int:
     md = obj.get("metadata", {})
     ann = md.get("annotations") or {}
     if ignore_annotations:

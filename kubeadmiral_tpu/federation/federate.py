@@ -374,7 +374,10 @@ class FederateController:
             return source
         fins.append(FEDERATE_FINALIZER)
         try:
-            updated = self.host.update(self._source_resource, source)
+            # rv-only consumption: skip the result deep copy.
+            updated = self.host.update(
+                self._source_resource, source, _copy_result=False
+            )
         except (Conflict, NotFound):
             return None
         source["metadata"]["resourceVersion"] = updated["metadata"]["resourceVersion"]
@@ -392,7 +395,10 @@ class FederateController:
                     f for f in fins if f != FEDERATE_FINALIZER
                 ]
                 try:
-                    self.host.update(self._source_resource, source)
+                    # Result discarded: skip the deep copy.
+                    self.host.update(
+                        self._source_resource, source, _copy_result=False
+                    )
                 except (Conflict, NotFound):
                     return Result.retry()
             return Result.ok()
@@ -414,7 +420,10 @@ class FederateController:
     def _create(self, source: dict) -> Result:
         fed_obj = new_federated_object(self.ftc, source)
         try:
-            created = self.host.create(self._fed_resource, fed_obj)
+            # _sync_feedback only reads the created object: no copy needed.
+            created = self.host.create(
+                self._fed_resource, fed_obj, _copy_result=False
+            )
         except Conflict:
             return Result.retry()
         except Exception:
@@ -428,7 +437,10 @@ class FederateController:
         if not update_federated_object(fed_obj, self.ftc, source):
             return self._sync_feedback(source, fed_obj)
         try:
-            updated = self.host.update(self._fed_resource, fed_obj)
+            # rv/generation-only consumption: skip the result deep copy.
+            updated = self.host.update(
+                self._fed_resource, fed_obj, _copy_result=False
+            )
         except (Conflict, NotFound):
             return Result.retry()
         # Server-set fields (rv AND generation — the fedGeneration the

@@ -179,12 +179,14 @@ class OverrideController:
     def _matched_policies(self, fed_obj: dict) -> list[dict]:
         labels = fed_obj["metadata"].get("labels", {}) or {}
         policies = []
+        # View reads: parse_overrides only reads the policy objects.
+        getter = getattr(self.host, "try_get_view", self.host.try_get)
 
         cname = labels.get(CLUSTER_OVERRIDE_POLICY_NAME_LABEL)
         if cname is not None:
             if not cname:
                 raise PolicyResolutionError("policy name cannot be empty")
-            obj = self.host.try_get(CLUSTER_OVERRIDE_POLICIES, cname)
+            obj = getter(CLUSTER_OVERRIDE_POLICIES, cname)
             if obj is None:
                 raise PolicyResolutionError(
                     f"ClusterOverridePolicy {cname} not found"
@@ -204,7 +206,19 @@ class OverrideController:
 
     def _placed_clusters(self, fed_obj: dict) -> list[dict]:
         placed = C.all_placement_clusters(fed_obj)
-        # list_view: read-only matching, no mutation/retention.
+        if getattr(self.host, "local_views", False):
+            getter = self.host.try_get_view
+            # Point view reads per placed cluster: O(placed), not
+            # O(members).  Scanning list_view(FEDERATED_CLUSTERS) here
+            # was the top profile sink at 500 members (every reconcile
+            # walked the whole fleet).
+            out = []
+            for name in sorted(placed):
+                c = getter(C.FEDERATED_CLUSTERS, name)
+                if c is not None:
+                    out.append(c)
+            return out
+        # Remote stores: one LIST round trip beats a GET per cluster.
         return [
             c
             for c in self.host.list_view(C.FEDERATED_CLUSTERS)
@@ -251,7 +265,8 @@ class OverrideController:
             fed_obj, self.name, needs_update, self.ftc.controller_groups
         )
         try:
-            self.host.update(self._fed_resource, fed_obj)
+            # Result discarded: skip the deep copy of the stored node.
+            self.host.update(self._fed_resource, fed_obj, _copy_result=False)
         except Conflict:
             return Result.retry()
         except NotFound:

@@ -1,0 +1,129 @@
+"""Consistent-hash key→shard routing — the shard-router seam.
+
+ROADMAP item 3's second move shards the object space across N engine
+replicas.  This module cuts the seam first, shipped with
+``shard_count=1`` wired in at the informer/worker boundary
+(``runtime/worker.py`` consults :func:`get_default` on every enqueue),
+so standing up replicas later is a knob change, not a re-plumb of the
+intake path.
+
+Routing must be
+
+* **stable across process restarts** — a replica that restarts must
+  route every key exactly where its predecessor did, or two replicas
+  would both (or neither) own an object mid-failover.  Python's builtin
+  ``hash()`` is salted per process, so keys are digested with BLAKE2b;
+* **consistent under resharding** — growing ``shard_count`` from N to
+  N+1 should move ~1/(N+1) of the keys, not reshuffle the world (every
+  moved key costs a relist + re-reconcile on its new owner).  The
+  64-bit digest feeds Lamping–Veach jump consistent hashing, which has
+  exactly that property with zero routing state.
+
+Knobs (resolved once per :class:`ShardMap`, like the admission knobs):
+
+* ``KT_SHARD_COUNT`` — total engine replicas (default 1: this process
+  owns everything and routing is identity);
+* ``KT_SHARD_INDEX`` — this replica's shard (default 0).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Optional
+
+_MASK64 = (1 << 64) - 1
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def jump_hash(key64: int, buckets: int) -> int:
+    """Lamping–Veach jump consistent hash: 64-bit key → bucket in
+    [0, buckets).  Growing ``buckets`` by one moves only ~1/buckets of
+    the keyspace, always onto the NEW bucket."""
+    if buckets <= 1:
+        return 0
+    b, j = -1, 0
+    while j < buckets:
+        b = j
+        key64 = (key64 * 2862933555777941757 + 1) & _MASK64
+        j = int((b + 1) * (float(1 << 31) / float((key64 >> 33) + 1)))
+    return b
+
+
+def key_digest(key: str) -> int:
+    """Process-stable 64-bit digest of an object key (BLAKE2b, not the
+    per-process-salted builtin ``hash``)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "big"
+    )
+
+
+class ShardMap:
+    """key → shard routing for one replica."""
+
+    def __init__(
+        self,
+        shard_count: Optional[int] = None,
+        shard_index: Optional[int] = None,
+    ):
+        count = (
+            _env_int("KT_SHARD_COUNT", 1) if shard_count is None else shard_count
+        )
+        index = (
+            _env_int("KT_SHARD_INDEX", 0) if shard_index is None else shard_index
+        )
+        self.shard_count = max(1, count)
+        self.shard_index = min(max(0, index), self.shard_count - 1)
+
+    def shard_of(self, key: str) -> int:
+        if self.shard_count == 1:
+            return 0
+        return jump_hash(key_digest(key), self.shard_count)
+
+    def owns(self, key: str) -> bool:
+        """Does THIS replica reconcile ``key``?  The single check the
+        informer/worker boundary makes per enqueue; with shard_count=1
+        it is one attribute compare (identity routing)."""
+        if self.shard_count == 1:
+            return True
+        return self.shard_of(key) == self.shard_index
+
+
+# -- process default -------------------------------------------------------
+_default: Optional[ShardMap] = None
+_default_lock = threading.Lock()
+
+
+def get_default() -> ShardMap:
+    global _default
+    m = _default
+    if m is None:
+        with _default_lock:
+            m = _default
+            if m is None:
+                m = _default = ShardMap()
+    return m
+
+
+def set_default(shardmap: ShardMap) -> Optional[ShardMap]:
+    """Install a map as the process default (tests, embedders);
+    returns the previous one."""
+    global _default
+    with _default_lock:
+        prev = _default
+        _default = shardmap
+    return prev
+
+
+def reset_default() -> ShardMap:
+    """Fresh default map (re-reads the KT_SHARD_* environment)."""
+    set_default(ShardMap())
+    return get_default()

@@ -265,7 +265,8 @@ class SyncController:
         # outside the current placement would never be visited for
         # cleanup (federatedinformer.go:151-250).
         self._reattach_members = fleet.watch_members(
-            self._target_resource, self._on_member_event, named=True, replay=True
+            self._target_resource, self._on_member_event, named=True, replay=True,
+            batch=self._on_member_events,
         )
         self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
         self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=True)
@@ -325,6 +326,43 @@ class SyncController:
             ):
                 return  # echo of our own member write
         self.worker.enqueue(key)
+
+    def _on_member_events(self, cluster: str, events: list) -> None:
+        """Coalesced member-watch intake: one committed store flush
+        ``[(event, obj), ...]`` in commit order.  Same decisions as
+        :meth:`_on_member_event` per event, batched where per-event cost
+        was pure overhead: the thread-identity echo check runs once
+        (delivery is synchronous on the writing thread, so it cannot
+        change mid-flush), index maintenance runs under ONE lock hold,
+        and enqueues dedupe into one :meth:`~runtime.worker._WorkerBase.
+        enqueue_many` call."""
+        self.metrics.counter("member_watch_flushes_total", controller=self.worker.name)
+        self.metrics.counter(
+            "member_watch_flush_events_total", len(events), controller=self.worker.name
+        )
+        own_echo = self._is_own_echo()
+        enqueue: dict[str, None] = {}
+        with self._index_lock:
+            for event, obj in events:
+                key = obj_key(obj)
+                if event == DELETED:
+                    held = self._member_index.get(key)
+                    if held is not None:
+                        held.discard(cluster)
+                        if not held:
+                            self._member_index.pop(key, None)
+                    self._own_member_rv.pop((cluster, key), None)
+                    if own_echo:
+                        continue
+                else:
+                    self._member_index.setdefault(key, set()).add(cluster)
+                    if own_echo or self._own_member_rv.get((cluster, key)) == str(
+                        obj.get("metadata", {}).get("resourceVersion", "")
+                    ):
+                        continue
+                enqueue[key] = None
+        if enqueue:
+            self.worker.enqueue_many(enqueue)
 
     def _on_cluster_event(self, event: str, obj: dict) -> None:
         # Cluster lifecycle re-enqueues everything (controller.go:244-260)
@@ -457,6 +495,13 @@ class SyncController:
                 thread_registry=self._flush_threads,
                 breakers=self.breakers,
             )
+            # Tick-level write-ack buffer: on_written callbacks append
+            # (fed_key, cluster) here (list.append from the flush pool's
+            # threads is atomic) and the SLO token closes + member-index
+            # updates settle in ONE batch after the flush, instead of a
+            # lock hold and an SLO round per acked op.
+            acks: list[tuple[str, str]] = []
+            sink.kt_acks = acks
             finishers: list[tuple[str, Callable[..., Result]]] = []
             for key in fed_keys:
                 # Per-key isolation: one poison object backs off alone
@@ -473,6 +518,14 @@ class SyncController:
                 else:
                     finishers.append((key, out))
             sink.flush()
+            # Settle the flush's acks before finishers run: finish()
+            # calls slo.settle, which must observe every placement ack
+            # of its own tick or tokens would finalize short.
+            if acks:
+                with self._index_lock:
+                    for fed_key, cluster in acks:
+                        self._member_index.setdefault(fed_key, set()).add(cluster)
+                slo.written_many(acks)
             hb = HostBatch(self.host)
             for key, finish in finishers:
                 try:
@@ -599,7 +652,11 @@ class SyncController:
                     dirty = True
         if dirty:
             try:
-                updated = self.host.update(self._fed_resource, fed_obj)
+                # rv-only consumption: skip the result deep copy (the
+                # in-process store hands back the immutable node).
+                updated = self.host.update(
+                    self._fed_resource, fed_obj, _copy_result=False
+                )
             except Conflict:
                 return Result.retry()
             except NotFound:
@@ -696,10 +753,18 @@ class SyncController:
         plans_holder: dict[str, R.RolloutPlan] = {}
         fed_key = fed.key
 
+        acks = getattr(sink, "kt_acks", None)
+
         def on_written(cluster: str, obj: dict) -> None:
             self._own_member_rv[(cluster, fed_key)] = str(
                 obj.get("metadata", {}).get("resourceVersion", "")
             )
+            if acks is not None:
+                # Per-op bookkeeping diet: defer the member-index update
+                # and SLO ack to one post-flush batch (reconcile_batch
+                # drains kt_acks right after sink.flush()).
+                acks.append((fed_key, cluster))
+                return
             with self._index_lock:
                 self._member_index.setdefault(fed_key, set()).add(cluster)
             # SLO provenance: a member apiserver acked this placement —

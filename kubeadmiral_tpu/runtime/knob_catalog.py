@@ -254,6 +254,20 @@ KNOBS: dict[str, KnobSpec] = {
     "KT_ADMIT_BATCH": KnobSpec(
         "int", "0", _OPS,
         "Max keys one worker drain hands a tick (0 = unlimited)."),
+    "KT_STORE_COALESCE": KnobSpec(
+        "bool", "1", _OPS,
+        "In-process store: columnar batch commits + one coalesced watch "
+        "notification per committed flush (0 = per-op lock/apply/notify "
+        "— the A/B baseline the coalesced event stream must match "
+        "bit-identically)."),
+    "KT_SHARD_COUNT": KnobSpec(
+        "int", "1", _OPS,
+        "Engine-replica shard count consulted at the informer/worker "
+        "boundary (1 = this process owns every key; routing is "
+        "identity)."),
+    "KT_SHARD_INDEX": KnobSpec(
+        "int", "0", _OPS,
+        "This replica's shard in [0, KT_SHARD_COUNT)."),
     # -- bench / CI drivers (bench.py, bench_e2e.py, tools/) -------------
     "KT_BENCH_GATE_TOL": KnobSpec(
         "float", "0.10", _OPS,

@@ -69,6 +69,15 @@ CATALOG: dict[str, MetricSpec] = {
     "worker_queue_oldest_age_seconds": MetricSpec(
         "gauge", "seconds", ("controller",),
         "Age of the longest-pending key; the first stuck-controller signal."),
+    "member_watch_flushes_total": MetricSpec(
+        "counter", "flushes", ("controller",),
+        "Coalesced member-watch deliveries received by sync "
+        "(KT_STORE_COALESCE): one committed store flush per count."),
+    "member_watch_flush_events_total": MetricSpec(
+        "counter", "events", ("controller",),
+        "Member-watch events carried by coalesced deliveries; divided "
+        "by member_watch_flushes_total this is the store-side "
+        "coalescing factor."),
     # -- XLA scheduling engine (scheduler/engine.py, ops/pipeline.py) ----
     "engine_ticks_total": MetricSpec(
         "counter", "ticks", (),

@@ -452,6 +452,33 @@ class SLORecorder:
             del self._pending[key]
         self._finalize(entry, t)
 
+    def written_many(
+        self, pairs: Iterable[tuple[str, str]], t: Optional[float] = None
+    ) -> None:
+        """Batch of member-write acks — :meth:`written` for a whole sync
+        flush under ONE lock hold (finalizations collected inside,
+        histogram work done outside the lock).  Acks land with one
+        shared timestamp: within a flush the per-op ack spread is
+        bookkeeping skew, not member latency."""
+        if not self.enabled:
+            return
+        if t is None:
+            t = self.clock()
+        done: list[_Pending] = []
+        with self._lock:
+            for key, cluster in pairs:
+                entry = self._pending.get(key)
+                if entry is None:
+                    continue
+                entry.acked.add(cluster)
+                entry.last_ack = t
+                if entry.expected is not None and (entry.expected - entry.acked):
+                    continue
+                del self._pending[key]
+                done.append(entry)
+        for entry in done:
+            self._finalize(entry, t)
+
     def settle(self, key: str) -> None:
         """The sync round for this object ended fully OK.  A token with
         acked writes finalizes at its last ack (partial version-skips
@@ -713,6 +740,12 @@ def written(key: str, cluster: str) -> None:
     rec = _rec()
     if rec is not None:
         rec.written(key, cluster)
+
+
+def written_many(pairs: Iterable[tuple[str, str]]) -> None:
+    rec = _rec()
+    if rec is not None:
+        rec.written_many(pairs)
 
 
 def settle(key: str) -> None:

@@ -95,6 +95,13 @@ class _WorkerBase:
         self._admit_depth = admit_depth()
         self._admit_delay = admit_delay_s()
         self._admit_batch = admit_batch()
+        # Shard routing consulted per enqueue — the informer/worker
+        # boundary is where a replica decides whether a key is its own.
+        # Resolved once like the admission knobs; with KT_SHARD_COUNT=1
+        # (the default) owns() is a single attribute compare.
+        from kubeadmiral_tpu.federation import shardmap
+
+        self._shard = shardmap.get_default()
         # Threads currently inside a reconcile (ident -> depth).  An
         # in-process store delivers watch events synchronously on the
         # writing thread, so an event arriving on one of these threads
@@ -118,6 +125,8 @@ class _WorkerBase:
             self._active[ident] = depth
 
     def enqueue(self, key: str, delay: float = 0.0) -> None:
+        if not self._shard.owns(key):
+            return
         # Queue-depth-driven admission: past KT_ADMIT_DEPTH pending
         # keys, new work coalesces behind a short delay (dedupe by key
         # makes repeated events free) so a flood turns into bigger
@@ -164,6 +173,28 @@ class _WorkerBase:
         return keys
 
     def enqueue_all(self, keys: Iterable[str], delay: float = 0.0) -> None:
+        for k in keys:
+            if self._shard.owns(k):
+                self.queue.add(k, delay)
+
+    def enqueue_many(self, keys: Iterable[str]) -> None:
+        """Batch-event intake: one admission decision for the whole
+        flush (the depth probe and deferral bookkeeping run once, not
+        per key), then per-key adds — the coalesced-delivery analogue
+        of :meth:`enqueue`."""
+        keys = [k for k in keys if self._shard.owns(k)]
+        if not keys:
+            return
+        delay = 0.0
+        if self._admit_depth > 0 and len(self.queue._pending) > self._admit_depth:
+            delay = self._admit_delay
+            if delay > 0.0:
+                self.metrics.counter(
+                    "worker_admission_total", controller=self.name
+                )
+                if tenancy.active():
+                    for k in keys:
+                        tenancy.note_admission(tenancy.tenant_of_key(k))
         for k in keys:
             self.queue.add(k, delay)
 

@@ -89,6 +89,27 @@ class _EventLog:
                 log.evicted = True
             self.cond.notify_all()
 
+    def append_many(self, items: list) -> None:
+        """Append one committed store flush ``[(resource, event, obj,
+        seq), ...]`` under ONE cond hold with ONE wakeup, instead of a
+        lock/notify_all cycle per event (the store-side analogue of the
+        write-coalescing that batches the writes themselves)."""
+        encoded = [
+            (resource, json.dumps({"type": event, "object": obj}).encode() + b"\n", seq)
+            for resource, event, obj, seq in items
+        ]
+        with self.cond:
+            for resource, line, seq in encoded:
+                log = self.logs.setdefault(resource, _ResourceLog())
+                log.seqs.append(seq)
+                log.lines.append(line)
+                if len(log.seqs) > 2 * self.cap:
+                    drop = len(log.seqs) - self.cap
+                    del log.seqs[:drop]
+                    del log.lines[:drop]
+                    log.evicted = True
+            self.cond.notify_all()
+
     def since(self, resource: str, rv: int) -> tuple[Optional[list[bytes]], int]:
         """(lines after rv, latest seq); lines is None when rv is too old
         (already evicted from the log) and the watcher must relist."""
@@ -152,7 +173,7 @@ class KubeApiServer:
 
         for secret in store.list_view(SECRETS):
             self._regrant(secret)
-        store.watch_all(self._on_store_event)
+        store.watch_all(self._on_store_event, batch=self._on_store_events)
 
         class _TrackingServer(ThreadingHTTPServer):
             """Tracks live per-connection sockets so close() can sever
@@ -268,8 +289,23 @@ class KubeApiServer:
         return out
 
     # -- store event feed (runs under the store lock) --------------------
+    def _on_store_events(self, flush: list) -> None:
+        """Coalesced feed of one committed store flush.  Event-log lines
+        land FIRST, all of them, under one cond hold: the SA/secret side
+        effects below write back into the store, and those nested events
+        must append strictly AFTER this flush's lines or per-resource
+        log seqs stop being sorted and watch-resume bisect breaks."""
+        self._log.append_many(flush)
+        for resource, event, obj, _ in flush:
+            if resource in (SECRETS, SERVICE_ACCOUNTS):
+                self._on_credential_event(resource, event, obj)
+
     def _on_store_event(self, resource: str, event: str, obj: dict, seq: int) -> None:
         self._log.append(resource, event, obj, seq)
+        if resource in (SECRETS, SERVICE_ACCOUNTS):
+            self._on_credential_event(resource, event, obj)
+
+    def _on_credential_event(self, resource: str, event: str, obj: dict) -> None:
         if resource == SECRETS:
             self._regrant(obj, deleted=event == "DELETED")
         elif resource == SERVICE_ACCOUNTS:
@@ -543,35 +579,28 @@ class _Handler(BaseHTTPRequestHandler):
         — one entry per operation, order preserved; each operation
         succeeds or fails independently (per-object conflict retry stays
         with the caller)."""
-        store = self.api.store
+        # The store's bulk verb does the work — one columnar lock pass,
+        # one coalesced watch flush (KT_STORE_COALESCE) — and op objects
+        # are adopted by reference, which is safe here because they are
+        # this request's fresh JSON parse.  Result objects are store
+        # views: serialized into the response immediately, never
+        # retained or mutated.  This handler only reshapes the store's
+        # plain results into the wire's Status envelopes.
         results = []
-        for op in body.get("operations", ()):
-            verb = op.get("verb")
-            resource = op.get("resource", "")
-            try:
-                if verb == "create":
-                    # View results (_copy_result=False): serialized into
-                    # the response immediately, never retained or mutated.
-                    results.append({"code": 201, "object": store.create(resource, op["object"], _copy_result=False)})
-                elif verb == "update":
-                    results.append({"code": 200, "object": store.update(resource, op["object"], _copy_result=False)})
-                elif verb == "update_status":
-                    results.append({"code": 200, "object": store.update_status(resource, op["object"], _copy_result=False)})
-                elif verb == "delete":
-                    store.delete(resource, op["key"])
-                    results.append({"code": 200, "status": {"kind": "Status", "status": "Success"}})
-                elif verb == "get":
-                    results.append({"code": 200, "object": store.get(resource, op["key"])})
-                else:
-                    results.append(self._status_entry(400, "BadRequest", f"unknown verb {verb!r}"))
-            except AlreadyExists as e:
-                results.append(self._status_entry(409, "AlreadyExists", str(e)))
-            except Conflict as e:
-                results.append(self._status_entry(409, "Conflict", str(e)))
-            except NotFound as e:
-                results.append(self._status_entry(404, "NotFound", str(e)))
-            except Exception as e:
-                results.append(self._status_entry(400, "BadRequest", str(e)))
+        for entry in self.api.store.batch(body.get("operations", ())):
+            if "object" in entry:
+                results.append(entry)
+            elif entry["code"] == 200:
+                results.append({"code": 200, "status": {"kind": "Status", "status": "Success"}})
+            else:
+                st = entry.get("status", {})
+                results.append(
+                    self._status_entry(
+                        entry["code"],
+                        st.get("reason", "BadRequest"),
+                        st.get("message", ""),
+                    )
+                )
         self._send_json(200, {"results": results})
 
     def _serve_faultz(self, body: dict) -> None:

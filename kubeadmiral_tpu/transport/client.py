@@ -94,6 +94,10 @@ class HttpKube:
     # (_ResourceWatch._dispatch): informers on top must not double-mint.
     _slo_ingress = True
 
+    # Point reads are HTTP round trips here: callers choosing between
+    # per-key view reads and one LIST must take the LIST.
+    local_views = False
+
     def __init__(
         self,
         base_url: str,
@@ -200,7 +204,10 @@ class HttpKube:
             return False
 
     # -- CRUD (the FakeKube seam) ----------------------------------------
-    def create(self, resource: str, obj: dict) -> dict:
+    # ``_copy_result`` mirrors FakeKube's signature so transport-agnostic
+    # callers can opt out of result copies on the in-process store; HTTP
+    # results are fresh JSON parses, so there is never a copy to skip.
+    def create(self, resource: str, obj: dict, _copy_result: bool = True) -> dict:
         meta = obj.get("metadata", {})
         path = resource_to_path(resource, meta.get("namespace") or None)
         status, payload, _ = self._request("POST", path, obj)
@@ -224,14 +231,16 @@ class HttpKube:
     # same round-trip as their copying counterparts.
     try_get_view = try_get
 
-    def update(self, resource: str, obj: dict) -> dict:
+    def update(self, resource: str, obj: dict, _copy_result: bool = True) -> dict:
         key = _obj_key(obj)
         status, payload, _ = self._request("PUT", key_to_path(resource, key), obj)
         if status != 200:
             self._raise_for(status, payload, f"update {resource} {key}")
         return payload
 
-    def update_status(self, resource: str, obj: dict) -> dict:
+    def update_status(
+        self, resource: str, obj: dict, _copy_result: bool = True
+    ) -> dict:
         key = _obj_key(obj)
         path = key_to_path(resource, key, subresource="status")
         status, payload, _ = self._request("PUT", path, obj)
@@ -569,8 +578,13 @@ class HttpFleet:
 
     def watch_members(
         self, resource: str, handler: Handler, named: bool = False,
-        replay: bool = False,
+        replay: bool = False, batch: Optional[Callable] = None,
     ) -> Callable[[], None]:
+        # ``batch`` (the in-process fleet's coalesced-delivery variant)
+        # is accepted for interface parity and unused: HTTP watch
+        # streams deliver per event, so consumers registered against
+        # either fleet shape fall back to their per-event handler here.
+        del batch
         attached: set[str] = set()
         detached: set[str] = set()
         wrapped: dict[str, tuple[HttpKube, Handler]] = {}

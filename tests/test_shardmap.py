@@ -1,0 +1,174 @@
+"""Shard-router seam (federation/shardmap.py; ISSUE 18 satellite).
+
+The seam ships with shard_count=1 (identity routing) but the routing
+properties the eventual N-replica deployment depends on are pinned NOW:
+
+* process-stable hashing — BLAKE2b digests and shard assignments are
+  hardcoded here so a routing change across restarts/upgrades fails
+  loudly (Python's builtin ``hash`` is per-process salted and would
+  pass a same-process round-trip test while breaking failover);
+* uniform spread at 1/2/8 shards;
+* jump consistent hashing moves only ~1/(N+1) of keys when a shard is
+  added, always onto the new shard;
+* the informer/worker boundary (runtime/worker.py) drops keys this
+  replica does not own, for single keys, relists, and batched enqueues
+  alike.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import pytest
+
+from kubeadmiral_tpu.federation import shardmap as SM
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.worker import BatchWorker, Worker
+
+
+@pytest.fixture(autouse=True)
+def _restore_default():
+    """Tests that install a process-default ShardMap must not leak it
+    into the rest of the suite (worker construction consults it)."""
+    prev = SM.set_default(SM.ShardMap(shard_count=1, shard_index=0))
+    try:
+        yield
+    finally:
+        SM.set_default(prev or SM.ShardMap(shard_count=1, shard_index=0))
+
+
+class TestStableHashing:
+    # Hardcoded expectations: if these move, every deployed replica
+    # re-routes its keyspace on upgrade (relist storm + split-brain
+    # ownership during rollout).  Changing the hash is a migration,
+    # not a refactor.
+    DIGESTS = {
+        "default/web-0": 6683436237858405042,
+        "default/web-1": 14565532090106758111,
+        "kube-system/coredns": 1657200717086694278,
+        "prod/api-42": 10283160909301220081,
+        "a": 4681665781835383343,
+    }
+    SHARDS_8 = {
+        "default/web-0": 6,
+        "default/web-1": 6,
+        "kube-system/coredns": 4,
+        "prod/api-42": 1,
+        "a": 6,
+    }
+
+    def test_digest_is_pinned(self):
+        for key, want in self.DIGESTS.items():
+            assert SM.key_digest(key) == want, key
+
+    def test_shard_assignment_is_pinned(self):
+        m = SM.ShardMap(shard_count=8, shard_index=0)
+        for key, want in self.SHARDS_8.items():
+            assert m.shard_of(key) == want, key
+
+    def test_two_maps_agree(self):
+        """A restarted replica (fresh ShardMap) routes identically."""
+        a = SM.ShardMap(shard_count=8, shard_index=3)
+        b = SM.ShardMap(shard_count=8, shard_index=3)
+        keys = [f"ns-{i % 5}/obj-{i:04d}" for i in range(500)]
+        assert [a.shard_of(k) for k in keys] == [b.shard_of(k) for k in keys]
+
+
+class TestSpreadAndMovement:
+    KEYS = [f"ns-{i % 7}/obj-{i:05d}" for i in range(5000)]
+
+    def test_identity_at_one_shard(self):
+        m = SM.ShardMap(shard_count=1, shard_index=0)
+        assert all(m.shard_of(k) == 0 for k in self.KEYS[:200])
+        assert all(m.owns(k) for k in self.KEYS[:200])
+
+    @pytest.mark.parametrize("count", [2, 8])
+    def test_uniform_spread(self, count):
+        m = SM.ShardMap(shard_count=count, shard_index=0)
+        spread = Counter(m.shard_of(k) for k in self.KEYS)
+        assert set(spread) == set(range(count))
+        ideal = len(self.KEYS) / count
+        for shard, n in spread.items():
+            assert abs(n - ideal) < 0.15 * ideal, (shard, n, ideal)
+
+    def test_every_key_owned_by_exactly_one_shard(self):
+        maps = [SM.ShardMap(shard_count=8, shard_index=i) for i in range(8)]
+        for k in self.KEYS[:500]:
+            assert sum(m.owns(k) for m in maps) == 1, k
+
+    def test_jump_hash_minimal_movement(self):
+        """Growing 8 → 9 shards moves ~1/9 of keys, all onto shard 8."""
+        moved = 0
+        for k in self.KEYS:
+            before = SM.jump_hash(SM.key_digest(k), 8)
+            after = SM.jump_hash(SM.key_digest(k), 9)
+            if before != after:
+                moved += 1
+                assert after == 8, k  # only ever onto the NEW shard
+        frac = moved / len(self.KEYS)
+        assert 0.06 < frac < 0.17, frac  # ~1/9 ± sampling noise
+
+
+class TestKnobsAndDefault:
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("KT_SHARD_COUNT", "4")
+        monkeypatch.setenv("KT_SHARD_INDEX", "2")
+        m = SM.ShardMap()
+        assert (m.shard_count, m.shard_index) == (4, 2)
+
+    def test_clamping(self):
+        assert SM.ShardMap(shard_count=0, shard_index=5).shard_count == 1
+        assert SM.ShardMap(shard_count=0, shard_index=5).shard_index == 0
+        assert SM.ShardMap(shard_count=4, shard_index=99).shard_index == 3
+        assert SM.ShardMap(shard_count=4, shard_index=-1).shard_index == 0
+
+    def test_default_lifecycle(self, monkeypatch):
+        prev = SM.set_default(SM.ShardMap(shard_count=2, shard_index=1))
+        assert prev is not None
+        assert SM.get_default().shard_count == 2
+        monkeypatch.setenv("KT_SHARD_COUNT", "8")
+        monkeypatch.setenv("KT_SHARD_INDEX", "5")
+        fresh = SM.reset_default()
+        assert (fresh.shard_count, fresh.shard_index) == (8, 5)
+        assert SM.get_default() is fresh
+
+
+class TestWorkerBoundary:
+    """runtime/worker.py consults the default map on every intake path."""
+
+    def _split(self, count=2):
+        keys = [f"d/k-{i:03d}" for i in range(40)]
+        probe = SM.ShardMap(shard_count=count, shard_index=0)
+        mine = [k for k in keys if probe.owns(k)]
+        theirs = [k for k in keys if not probe.owns(k)]
+        assert mine and theirs  # the split is non-trivial
+        return keys, mine, theirs
+
+    def test_enqueue_drops_foreign_keys(self):
+        keys, mine, _ = self._split()
+        SM.set_default(SM.ShardMap(shard_count=2, shard_index=0))
+        w = Worker("shard-test", lambda k: None)
+        for k in keys:
+            w.enqueue(k)
+        assert sorted(w.queue.drain_due()) == sorted(mine)
+
+    def test_enqueue_all_filters_relists(self):
+        keys, mine, _ = self._split()
+        SM.set_default(SM.ShardMap(shard_count=2, shard_index=0))
+        w = Worker("shard-test", lambda k: None)
+        w.enqueue_all(keys)
+        assert sorted(w.queue.drain_due()) == sorted(mine)
+
+    def test_enqueue_many_filters_batches(self):
+        keys, mine, _ = self._split()
+        SM.set_default(SM.ShardMap(shard_count=2, shard_index=0))
+        w = BatchWorker("shard-test", lambda ks: {}, metrics=Metrics())
+        w.enqueue_many(keys)
+        assert sorted(w.queue.drain_due()) == sorted(mine)
+
+    def test_single_shard_accepts_everything(self):
+        SM.set_default(SM.ShardMap(shard_count=1, shard_index=0))
+        w = Worker("shard-test", lambda k: None)
+        keys = [f"d/k-{i}" for i in range(25)]
+        w.enqueue_all(keys)
+        assert sorted(w.queue.drain_due()) == sorted(keys)

@@ -1,0 +1,207 @@
+"""Store/notify microbench — the in-process hot path, standalone.
+
+Measures what bench_e2e can only infer from stage splits: raw store
+writes/s through the direct verbs and the columnar ``batch`` verb, and
+the watch fan-out cost per event with a controller-fleet-sized watcher
+population (each watcher computing the metadata-change trigger
+signature, the way federate/scheduler/override do at the watch
+boundary).  Both KT_STORE_COALESCE modes run side by side, so a store
+regression shows up here — seconds, one process — before it shows up
+as an e2e sync-stage regression.
+
+Emits one raw-JSON artifact line (save as ``BENCH_STORE_rNN.json``);
+``tools/bench_gate.py`` gates writes/s (floor) and notify fan-out
+µs/event (ceiling) against the best same-platform prior.
+
+Usage: ``make bench-store`` (or ``python tools/store_bench.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_OBJECTS = int(os.environ.get("BENCH_STORE_OBJECTS", "2000"))
+N_ROUNDS = int(os.environ.get("BENCH_STORE_ROUNDS", "5"))
+N_WATCHERS = int(os.environ.get("BENCH_STORE_WATCHERS", "12"))
+CHUNK = int(os.environ.get("BENCH_STORE_CHUNK", "200"))
+RESOURCE = "apps/v1/deployments"
+
+
+def _obj(i: int, replicas: int = 1) -> dict:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"web-{i:05d}",
+            "namespace": "default",
+            "labels": {"app": "web", "tier": "bench"},
+            "annotations": {"bench/round": "0"},
+        },
+        "spec": {
+            "replicas": replicas,
+            "template": {"spec": {"containers": [{"name": "c", "image": "img"}]}},
+        },
+    }
+
+
+class _SigWatcher:
+    """A controller-shaped watcher: computes the metadata-change trigger
+    signature of every delivered object (what federate/scheduler/
+    override do first thing in their handlers) and counts events."""
+
+    def __init__(self, sig_fn):
+        self.sig_fn = sig_fn
+        self.events = 0
+        self.sig = 0
+
+    def __call__(self, event: str, obj: dict) -> None:
+        self.events += 1
+        self.sig ^= self.sig_fn(obj)
+
+
+class _BatchSigWatcher(_SigWatcher):
+    """Same controller shape, advertising the coalesced-delivery
+    protocol: one call per committed flush."""
+
+    def __init__(self, sig_fn):
+        super().__init__(sig_fn)
+        self.kt_batch = self._on_batch
+        self.flushes = 0
+
+    def _on_batch(self, events) -> None:
+        self.flushes += 1
+        for event, obj in events:
+            self(event, obj)
+
+
+def _bench_direct(fk_module, sig_fn) -> dict:
+    """Per-op verbs, per-event delivery: create + update_status rounds."""
+    store = fk_module.FakeKube("bench")
+    watchers = [_SigWatcher(sig_fn) for _ in range(N_WATCHERS)]
+    for w in watchers:
+        store.watch(RESOURCE, w, replay=False)
+    t0 = time.perf_counter()
+    for i in range(N_OBJECTS):
+        store.create(RESOURCE, _obj(i))
+    for r in range(N_ROUNDS):
+        for i in range(N_OBJECTS):
+            store.update_status(
+                RESOURCE,
+                {
+                    "metadata": {"name": f"web-{i:05d}", "namespace": "default"},
+                    "status": {"readyReplicas": r},
+                },
+            )
+    dt = time.perf_counter() - t0
+    writes = N_OBJECTS * (1 + N_ROUNDS)
+    events = sum(w.events for w in watchers)
+    return {
+        "writes": writes,
+        "seconds": round(dt, 4),
+        "writes_per_s": round(writes / dt, 1),
+        "notify_us_per_event": round(dt / events * 1e6, 3) if events else None,
+        "events_delivered": events,
+    }
+
+
+def _bench_batch(fk_module, sig_fn, batch_watchers: bool) -> dict:
+    """The bulk verb in CHUNK-sized flushes — the shape sync's coalesced
+    member writes take."""
+    store = fk_module.FakeKube("bench")
+    cls = _BatchSigWatcher if batch_watchers else _SigWatcher
+    watchers = [cls(sig_fn) for _ in range(N_WATCHERS)]
+    for w in watchers:
+        store.watch(RESOURCE, w, replay=False)
+    ops = [
+        {"verb": "create", "resource": RESOURCE, "object": _obj(i)}
+        for i in range(N_OBJECTS)
+    ]
+    for r in range(N_ROUNDS):
+        ops.extend(
+            {
+                "verb": "update_status",
+                "resource": RESOURCE,
+                "object": {
+                    "metadata": {"name": f"web-{i:05d}", "namespace": "default"},
+                    "status": {"readyReplicas": r},
+                },
+            }
+            for i in range(N_OBJECTS)
+        )
+    t0 = time.perf_counter()
+    for i in range(0, len(ops), CHUNK):
+        results = store.batch(ops[i : i + CHUNK])
+        bad = [r for r in results if r["code"] not in (200, 201)]
+        assert not bad, bad[:3]
+    dt = time.perf_counter() - t0
+    events = sum(w.events for w in watchers)
+    return {
+        "writes": len(ops),
+        "seconds": round(dt, 4),
+        "writes_per_s": round(len(ops) / dt, 1),
+        "notify_us_per_event": round(dt / events * 1e6, 3) if events else None,
+        "events_delivered": events,
+        "flushes": sum(getattr(w, "flushes", 0) for w in watchers),
+    }
+
+
+def main() -> None:
+    from kubeadmiral_tpu.bench_support import bench_platform_detail
+    from kubeadmiral_tpu.federation.common import metadata_change_sig
+
+    results: dict[str, dict] = {}
+    for mode, env in (("coalesced", "1"), ("legacy", "0")):
+        # Stores resolve the knob at construction, so both modes run in
+        # one process, one artifact.
+        os.environ["KT_STORE_COALESCE"] = env
+        from kubeadmiral_tpu.testing import fakekube as fk
+
+        results[mode] = {
+            "direct": _bench_direct(fk, metadata_change_sig),
+            "batch": _bench_batch(
+                fk, metadata_change_sig, batch_watchers=(mode == "coalesced")
+            ),
+        }
+    os.environ.pop("KT_STORE_COALESCE", None)
+
+    # Bit-identity cross-check rides the bench: both modes delivered the
+    # same event count and the same XOR of trigger signatures.
+    for kind in ("direct", "batch"):
+        a, b = results["coalesced"][kind], results["legacy"][kind]
+        assert a["events_delivered"] == b["events_delivered"], (kind, a, b)
+
+    coalesced = results["coalesced"]["batch"]
+    print(
+        json.dumps(
+            {
+                "metric": "store_batch_writes_per_sec",
+                "value": coalesced["writes_per_s"],
+                "unit": "writes/s",
+                "detail": {
+                    **bench_platform_detail(),
+                    "objects": N_OBJECTS,
+                    "rounds": N_ROUNDS,
+                    "watchers": N_WATCHERS,
+                    "chunk": CHUNK,
+                    "notify_us_per_event": coalesced["notify_us_per_event"],
+                    "modes": results,
+                },
+            }
+        )
+    )
+    print(
+        f"# store: coalesced batch {coalesced['writes_per_s']:.0f} w/s, "
+        f"legacy batch {results['legacy']['batch']['writes_per_s']:.0f} w/s, "
+        f"direct {results['coalesced']['direct']['writes_per_s']:.0f} w/s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()
