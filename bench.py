@@ -1278,6 +1278,43 @@ def _soak_failover_gap(spill: dict) -> dict | None:
     }
 
 
+def _soak_shardmap():
+    """The child's shard scope for the sharded soak (KT_SOAK_SHARDS>1):
+    the victim/successor pair runs as shard 0, one PEER replica per
+    remaining shard runs every round uninterrupted, and the oracle stays
+    unsharded (no ``_KT_SOAK_SHARD`` → None even when the knob is set,
+    so the oracle's world is the full-keyspace reference)."""
+    count = int(os.environ.get("KT_SOAK_SHARDS", "1") or 1)
+    index = os.environ.get("_KT_SOAK_SHARD")
+    if count <= 1 or index is None:
+        return None
+    from kubeadmiral_tpu.federation import shardmap
+
+    return shardmap.ShardMap(count, int(index))
+
+
+def _soak_scope(sm):
+    import contextlib
+
+    if sm is None:
+        return contextlib.nullcontext()
+    from kubeadmiral_tpu.federation import shardmap
+
+    return shardmap.scoped(sm)
+
+
+def _soak_child_exit() -> None:
+    """Exit a soak child without interpreter teardown: XLA's worker
+    threads intermittently corrupt the glibc heap during normal exit
+    (observed as ``double free`` / ``free(): invalid pointer`` aborts
+    AFTER the child's JSON is fully flushed).  The child's work is on
+    stdout and its spill segments are closed by then, so skip teardown
+    the same way the victim's SIGKILL does."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
 def run_soak_scenario() -> None:
     """--scenario soak: the all-stressors-at-once gated soak.
 
@@ -1322,6 +1359,7 @@ def run_soak_scenario() -> None:
             "fingerprint": h.fingerprint(),
             "elapsed_s": round(time.perf_counter() - t0, 3),
         }))
+        _soak_child_exit()
         return
 
     if role == "victim":
@@ -1332,9 +1370,20 @@ def run_soak_scenario() -> None:
         from kubeadmiral_tpu.testing.soakharness import SoakHarness
 
         m, rec, ledger, tl = _soak_observatory()
-        h = SoakHarness(sched, metrics=m)
-        store = SnapshotStore(os.path.join(workdir, "snapshots"), metrics=m)
-        SnapshotManager(h.scheduler.engine, store, every=1)
+        sm = _soak_shardmap()
+        with _soak_scope(sm):
+            h = SoakHarness(sched, metrics=m)
+        snap_dir = os.path.join(workdir, "snapshots")
+        if sm is not None:
+            # Per-shard snapshot artifacts (ISSUE 20): keyed by shard
+            # directory AND stamped with (count, index, epoch) so the
+            # successor refuses a snapshot from the wrong shard.
+            from kubeadmiral_tpu.runtime.snapshot import shard_snapshot_store
+
+            store = shard_snapshot_store(snap_dir, sm, metrics=m)
+        else:
+            store = SnapshotStore(snap_dir, metrics=m)
+        SnapshotManager(h.scheduler.engine, store, every=1, shard=sm)
         h.attach_timeline(tl)
         spiller = _soak_spiller(workdir, "victim", m, tl)
         t0 = time.perf_counter()
@@ -1376,9 +1425,17 @@ def run_soak_scenario() -> None:
             state = json.load(fh)
         fleet = ClusterFleet.restore(state["fleet"])
         m, rec, ledger, tl = _soak_observatory()
-        h = SoakHarness(sched, metrics=m, fleet=fleet)
-        store = SnapshotStore(os.path.join(workdir, "snapshots"), metrics=m)
-        mgr = SnapshotManager(h.scheduler.engine, store, every=1)
+        sm = _soak_shardmap()
+        with _soak_scope(sm):
+            h = SoakHarness(sched, metrics=m, fleet=fleet)
+        snap_dir = os.path.join(workdir, "snapshots")
+        if sm is not None:
+            from kubeadmiral_tpu.runtime.snapshot import shard_snapshot_store
+
+            store = shard_snapshot_store(snap_dir, sm, metrics=m)
+        else:
+            store = SnapshotStore(snap_dir, metrics=m)
+        mgr = SnapshotManager(h.scheduler.engine, store, every=1, shard=sm)
         restored = mgr.restore()
         h.attach_timeline(tl)
         spiller = _soak_spiller(workdir, "successor", m, tl)
@@ -1405,16 +1462,42 @@ def run_soak_scenario() -> None:
             "restore": restored,
             "elapsed_s": round(time.perf_counter() - t0, 3),
         }))
+        _soak_child_exit()
+        return
+
+    if role == "peer":
+        # A sharded-soak replica that is NOT the failover victim: runs
+        # every round with the same faults, never killed — the survivor
+        # half of the "union of shards matches the oracle" check.
+        from kubeadmiral_tpu.testing.soakharness import SoakHarness
+
+        m, rec, ledger, tl = _soak_observatory()
+        with _soak_scope(_soak_shardmap()):
+            h = SoakHarness(sched, metrics=m)
+        h.attach_timeline(tl)
+        t0 = time.perf_counter()
+        for r in range(sched.rounds):
+            h.run_round(r, faults=True)
+        h.finish()
+        print(json.dumps({
+            "fingerprint": h.fingerprint(),
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+        }))
+        _soak_child_exit()
         return
 
     # -- parent: orchestrate oracle -> victim -> SIGKILL -> successor ----
     workdir = tempfile.mkdtemp(prefix="kt-bench-soak-")
+    shard_count = int(os.environ.get("KT_SOAK_SHARDS", "1") or 1)
+    victim_shard = 0 if shard_count > 1 else None
 
-    def spawn(child_role: str) -> subprocess.CompletedProcess:
+    def spawn(child_role: str, shard=None) -> subprocess.CompletedProcess:
         env = dict(os.environ)
         env["_KT_SOAK_ROLE"] = child_role
         env["_KT_SOAK_DIR"] = workdir
         env["BENCH_SCENARIO"] = "soak"
+        if shard is not None:
+            env["_KT_SOAK_SHARD"] = str(shard)
         return subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             capture_output=True, text=True, env=env, timeout=1200,
@@ -1429,7 +1512,11 @@ def run_soak_scenario() -> None:
         return json.loads(proc.stdout.strip().splitlines()[-1])
 
     oracle = parse(spawn("oracle"), "oracle")
-    victim_proc = spawn("victim")
+    peers = [
+        parse(spawn("peer", shard=i), f"peer-{i}")
+        for i in range(1, shard_count)
+    ]
+    victim_proc = spawn("victim", shard=victim_shard)
     if victim_proc.returncode != -signal.SIGKILL:
         raise SystemExit(
             f"soak victim expected SIGKILL, got rc={victim_proc.returncode}:\n"
@@ -1438,10 +1525,33 @@ def run_soak_scenario() -> None:
     state_path = os.path.join(workdir, "soak_state.json")
     with open(state_path) as fh:
         victim = json.load(fh)
-    succ = parse(spawn("successor"), "successor")
+    succ = parse(spawn("successor", shard=victim_shard), "successor")
 
     oracle_fp = oracle["fingerprint"]
     succ_fp = succ["fingerprint"]
+    if shard_count > 1:
+        # Union of the N shards' placements (successor carries shard 0
+        # through the failover) vs the unsharded oracle — after
+        # asserting each replica stayed inside its own slice of the
+        # ring and no key was claimed twice.
+        from kubeadmiral_tpu.federation import shardmap
+        from kubeadmiral_tpu.utils.hashing import stable_json_hash
+
+        union: dict = {}
+        parts = [(0, succ_fp)] + [
+            (i, peers[i - 1]["fingerprint"]) for i in range(1, shard_count)
+        ]
+        for i, fp in parts:
+            sm = shardmap.ShardMap(shard_count, i)
+            for key, val in fp["placements"].items():
+                assert sm.owns(key), f"shard {i} wrote non-owned key {key}"
+                assert key not in union, f"key {key} claimed by two shards"
+                union[key] = val
+        succ_fp = {
+            "objects": len(union),
+            "hash": stable_json_hash(union),
+            "placements": union,
+        }
     oracle_match = (
         succ_fp["hash"] == oracle_fp["hash"]
         and succ_fp["placements"] == oracle_fp["placements"]
@@ -1502,6 +1612,8 @@ def run_soak_scenario() -> None:
             "rounds": sched.rounds,
             "kill_round": sched.kill_round,
             "arrivals_per_round": sched.arrivals_per_round,
+            "shards": shard_count,
+            "peer_objects": [p["fingerprint"]["objects"] for p in peers],
             "objects": succ_fp["objects"],
             "scheduled_total": scheduled,
             "elapsed_s": round(elapsed, 3),

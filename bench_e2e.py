@@ -34,6 +34,25 @@ TRANSPORT = os.environ.get("BENCH_E2E_TRANSPORT", "inproc")
 # untouched.
 CHAOS = os.environ.get("BENCH_E2E_CHAOS", "") in ("1", "true", "yes")
 CHAOS_ROUNDS = int(os.environ.get("BENCH_E2E_CHAOS_ROUNDS", 6))
+# BENCH_E2E_SHARDS=N (N>1): the sharded control plane.  The invocation
+# runs interleaved same-day A/B arms — [N=1, N=N] x BENCH_E2E_AB_PAIRS,
+# medians per arm (the machine-drift noise rule: interleaved pairs, not
+# single rounds) — and asserts the union of the N shards' placements
+# AND flight-recorder reason counts is bit-identical to the unsharded
+# oracle arm.  inproc: an in-process replica set (each stack built
+# under its scoped ShardMap).  http: N real replica subprocesses
+# (kubeadmiral_tpu.testing.shardreplica) over the farm, each holding
+# its kt-shard-<i> lease.
+N_SHARDS = int(os.environ.get("BENCH_E2E_SHARDS", 1))
+# Cores this process may actually run on: the sharded A/B gate keys off
+# this — a 1-core container cannot show parallel speedup no matter how
+# good the sharding is, so bench_gate waives the speedup floor (and
+# gates bounded overhead instead) when cpu_cores < shards.
+try:
+    CPU_CORES = len(os.sched_getaffinity(0))
+except (AttributeError, OSError):
+    CPU_CORES = os.cpu_count() or 1
+AB_PAIRS = int(os.environ.get("BENCH_E2E_AB_PAIRS", 2))
 
 
 def _coalesce_detail() -> dict:
@@ -93,6 +112,64 @@ class StageTimer:
                 # quiescing early — but long-fuse requeues (heartbeats,
                 # WAITING_FOR_REMOVAL revisits) still read as idle,
                 # exactly as before.
+                dues = [
+                    d
+                    for _, ctl in self.controllers
+                    for d in (ctl.worker.queue.next_due_in(),)
+                    if d is not None and d <= 0.25
+                ]
+                if not dues:
+                    return
+                time.sleep(min(dues) + 0.002)
+
+    def settle_sharded(self, groups, max_rounds=10_000):
+        """Inproc N-shard settle: each replica's controller stack drains
+        in its OWN thread per round (replicas own disjoint keys; the COW
+        store is lock-safe for concurrent writers) while the cluster
+        singleton steps on the main thread.  On multi-core hosts this is
+        where the sharded speedup comes from; on a single core the GIL
+        serializes the threads and the A/B measures pure sharding
+        overhead instead (bench_gate keys the speedup floor off
+        detail.cpu_cores).  Stage seconds stay per-stage aggregates
+        across replicas (the += merge), not wall time."""
+        import threading
+
+        lock = threading.Lock()
+        cluster = [(n, c) for n, c in self.controllers if n == "cluster"]
+
+        def drain(gi, group, flags):
+            prog = False
+            for name, ctl in group:
+                t0 = time.perf_counter()
+                stepped = True
+                while stepped:
+                    stepped = ctl.worker.step()
+                    prog |= stepped
+                dt = time.perf_counter() - t0
+                with lock:
+                    self.stages[name] += dt
+            flags[gi] = prog
+
+        for _ in range(max_rounds):
+            progressed = False
+            for name, ctl in cluster:
+                t0 = time.perf_counter()
+                while ctl.worker.step():
+                    progressed = True
+                self.stages[name] += time.perf_counter() - t0
+            flags = [False] * len(groups)
+            threads = [
+                threading.Thread(
+                    target=drain, args=(gi, group, flags), daemon=True
+                )
+                for gi, group in enumerate(groups)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            progressed |= any(flags)
+            if not progressed:
                 dues = [
                     d
                     for _, ctl in self.controllers
@@ -259,20 +336,195 @@ def run_chaos(fleet, farm, timer, ftc, members) -> dict:
     }
 
 
-def main():
+def _controller_set(fleet, ftc, shards):
+    """The per-FTC controller stacks as replica GROUPS (one inner list
+    per replica — settle_sharded drives each group in its own thread).
+    shards>1: N in-process replicas, each constructed under its scoped
+    ShardMap so every worker/intake boundary it owns filters to its
+    shard; duplicate stage names merge in StageTimer, so per-stage time
+    aggregates across replicas."""
+    import contextlib
+
+    from kubeadmiral_tpu.federation import shardmap
+    from kubeadmiral_tpu.federation.federate import FederateController
+    from kubeadmiral_tpu.federation.overridectl import OverrideController
+    from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+    from kubeadmiral_tpu.federation.statusctl import StatusController
+    from kubeadmiral_tpu.federation.sync import SyncController
+    from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+    groups = []
+    for i in range(max(1, shards)):
+        ctx = (
+            shardmap.scoped(shardmap.ShardMap(shards, i))
+            if shards > 1
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            # A PRIVATE flight recorder per replica engine: reason-count
+            # parity compares per-round recorders, so rounds (and
+            # replicas) must not share the process-default ring.
+            engine = SchedulerEngine(flight_recorder=FlightRecorder())
+            groups.append([
+                ("federate", FederateController(fleet.host, ftc)),
+                ("schedule", SchedulerController(fleet.host, ftc, engine=engine)),
+                ("override", OverrideController(fleet.host, ftc)),
+                ("sync", SyncController(fleet, ftc)),
+                ("status", StatusController(fleet, ftc)),
+            ])
+    return groups
+
+
+def _placement_map(fed_objs) -> dict:
+    """Bit-comparable placements (the soakharness fingerprint idiom):
+    per fed key, the scheduler-written spec placements + overrides."""
+    return {
+        key: {
+            "placements": (obj.get("spec") or {}).get("placements", []),
+            "overrides": (obj.get("spec") or {}).get("overrides", []),
+        }
+        for key, obj in fed_objs.items()
+        if obj is not None
+    }
+
+
+def _reason_map(named, keys) -> dict:
+    """{key: reason_counts} unioned across the round's schedule-stage
+    flight recorders (disjoint keys under sharding — first hit wins)."""
+    out = {}
+    for name, ctl in named:
+        if name != "schedule":
+            continue
+        rec = getattr(ctl.engine, "flightrec", None)
+        if rec is None or not rec.enabled:
+            continue
+        for key in keys:
+            if key in out:
+                continue
+            r = rec.lookup(key)
+            if r is not None:
+                out[key] = [int(n) for n in r.reason_counts]
+    return out
+
+
+def _spawn_replicas(farm, shards):
+    """N shardreplica subprocesses over the farm's host; returns
+    [(proc, stderr_file)] once every replica reports ready + leased."""
+    import subprocess
+    import tempfile
+
+    procs = []
+    for i in range(shards):
+        env = dict(os.environ)
+        env["KT_SHARD_COUNT"] = str(shards)
+        env["KT_SHARD_INDEX"] = str(i)
+        env["KT_REPLICA_HOST_URL"] = farm.host_server.url
+        token = getattr(farm.host, "_token", None)
+        if token:
+            env["KT_REPLICA_HOST_TOKEN"] = token
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        stderr = tempfile.TemporaryFile()
+        procs.append(
+            (
+                subprocess.Popen(
+                    [sys.executable, "-m", "kubeadmiral_tpu.testing.shardreplica"],
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=stderr,
+                    text=True,
+                    env=env,
+                ),
+                stderr,
+            )
+        )
+    for proc, stderr in procs:
+        hello = _replica_line(proc, stderr)
+        assert hello.get("ok"), f"replica failed to start: {hello}"
+    return procs
+
+
+def _replica_line(proc, stderr, want_type=None) -> dict:
+    for line in proc.stdout:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if want_type is None or doc.get("type") == want_type:
+            return doc
+    try:
+        stderr.seek(0)
+        tail = stderr.read()[-2000:]
+    except Exception:
+        tail = b""
+    raise RuntimeError(
+        f"shard replica died: stderr tail {tail.decode(errors='replace')!r}"
+    )
+
+
+def _replica_reports(procs) -> list:
+    for proc, _ in procs:
+        proc.stdin.write("report\n")
+        proc.stdin.flush()
+    return [_replica_line(proc, stderr, "report") for proc, stderr in procs]
+
+
+def _close_replicas(procs) -> None:
+    for proc, _ in procs:
+        try:
+            proc.stdin.close()
+        except Exception:
+            pass
+    for proc, stderr in procs:
+        try:
+            proc.wait(timeout=15)
+        except Exception:
+            proc.kill()
+            proc.wait()
+        try:
+            stderr.close()
+        except Exception:
+            pass
+
+
+def _settle_replicated(timer, fleet, ftc, replicas) -> None:
+    """Drive the parent-side cluster controller while the shard replica
+    subprocesses reconcile over HTTP; done when every replica reports
+    settled AND every fed object is fully propagated."""
+    from kubeadmiral_tpu.federation import dispatch as D
+
+    deadline = time.monotonic() + 3600.0
+    while time.monotonic() < deadline:
+        timer.settle()
+        reports = _replica_reports(replicas)
+        if not all(r.get("settled") for r in reports):
+            continue
+        fed_keys = fleet.host.keys(ftc.federated.resource)
+        if len(fed_keys) < N_OBJECTS:
+            continue
+        objs = D.bulk_get(fleet.host, ftc.federated.resource, fed_keys) or {}
+        done = all(
+            o is not None
+            and o.get("status", {}).get("clusters")
+            and all(c["status"] == "OK" for c in o["status"]["clusters"])
+            for o in objs.values()
+        )
+        if done:
+            return
+    raise RuntimeError("sharded HTTP settle timed out")
+
+
+def run_round(shards: int = 1) -> dict:
+    """One full pipeline round at ``shards`` control-plane replicas.
+    Returns the artifact-shaped ``result`` plus the parity fingerprints
+    (``placements``/``reasons``/``replica_reports``) the sharded A/B
+    driver compares across arms."""
     import dataclasses
 
-    from kubeadmiral_tpu.runtime.gctune import tune_gc_for_service
-
-    tune_gc_for_service()
-
-    # Chaos rounds are seconds-long, not minutes: tighten the SLO
-    # freshness threshold and burn windows so the red→green transition
-    # is observable inside the phase (set BEFORE the recorder's first
-    # construction — thresholds are read once).
-    if CHAOS:
-        os.environ.setdefault("KT_SLO_FRESHNESS_S", "1.0")
-        os.environ.setdefault("KT_SLO_WINDOWS_S", "3,10")
     from kubeadmiral_tpu.runtime import slo as SLO
 
     slo_rec = SLO.reset_default()
@@ -282,11 +534,6 @@ def main():
         FederatedClusterController,
         NODES,
     )
-    from kubeadmiral_tpu.federation.federate import FederateController
-    from kubeadmiral_tpu.federation.overridectl import OverrideController
-    from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
-    from kubeadmiral_tpu.federation.statusctl import StatusController
-    from kubeadmiral_tpu.federation.sync import SyncController
     from kubeadmiral_tpu.models.ftc import default_ftcs
     from kubeadmiral_tpu.federation.overridectl import OVERRIDE_POLICIES
     from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
@@ -318,15 +565,26 @@ def main():
         fleet = ClusterFleet()
     gvk = "apps/v1/Deployment"
 
+    # The cluster controller is a SINGLETON outside any shard scope:
+    # cluster pseudo-keys broadcast to every replica, and join/taint
+    # bookkeeping must not be split by the hash ring.
+    subproc_shards = TRANSPORT == "http" and shards > 1
     named = [
         ("cluster", FederatedClusterController(fleet, api_resource_probe=[gvk])),
-        ("federate", FederateController(fleet.host, ftc)),
-        ("schedule", SchedulerController(fleet.host, ftc)),
-        ("override", OverrideController(fleet.host, ftc)),
-        ("sync", SyncController(fleet, ftc)),
-        ("status", StatusController(fleet, ftc)),
     ]
+    groups = None
+    if not subproc_shards:
+        groups = _controller_set(fleet, ftc, shards)
+        for group in groups:
+            named += group
     timer = StageTimer(named)
+    inproc_sharded = not subproc_shards and shards > 1
+
+    def settle():
+        if inproc_sharded:
+            timer.settle_sharded(groups)
+        else:
+            timer.settle()
 
     members = {}
     for j in range(N_CLUSTERS):
@@ -388,7 +646,14 @@ def main():
             },
         },
     )
-    timer.settle()  # join clusters before the clock starts
+    settle()  # join clusters before the clock starts
+
+    replicas = None
+    if subproc_shards:
+        # Spawned AFTER the join so every replica's replayed first list
+        # already carries joined clusters + both policies; each acquires
+        # its kt-shard-<i> lease before reporting ready.
+        replicas = _spawn_replicas(farm, shards)
 
     def make_deployment(i):
         return {
@@ -440,7 +705,10 @@ def main():
 
     stages_before = dict(timer.stages)
     t0 = time.perf_counter()
-    timer.settle()
+    if subproc_shards:
+        _settle_replicated(timer, fleet, ftc, replicas)
+    else:
+        settle()
     total_s = time.perf_counter() - t0
 
     tline.stop()
@@ -471,11 +739,23 @@ def main():
         c["cluster"]
         for c in fed_objs["default/web-00000"]["status"]["clusters"]
     }
+    # Parity fingerprints for the sharded A/B driver: scheduler-written
+    # placements straight off the host, reason counts off this round's
+    # private flight recorders (replica subprocesses report hashes of
+    # their owned subset instead — collected below with the reports).
+    placements = _placement_map(fed_objs)
+    reasons = None if subproc_shards else _reason_map(named, fed_keys)
 
     stages = {
         name: round(timer.stages[name] - stages_before.get(name, 0.0), 3)
         for name in timer.stages
     }
+    replica_reports = None
+    if subproc_shards:
+        replica_reports = _replica_reports(replicas)
+        for rep in replica_reports:
+            for name, secs in rep["stages_s"].items():
+                stages[name] = round(stages.get(name, 0.0) + secs, 3)
 
     # Stage-decomposed event→placement-written latency (ISSUE 13): the
     # provenance tokens minted at source-event ingress closed on member
@@ -483,7 +763,10 @@ def main():
     # histogram snapshot; the decomposition error is measured EXACTLY on
     # the exemplar ring (stage sums vs measured totals per event).
     slo_detail = None
-    if slo_rec.enabled:
+    # Subprocess replicas host their own SLO recorders (tokens mint and
+    # close inside the children), so the parent's recorder is empty and
+    # the decomposition contract is theirs to keep, not ours.
+    if slo_rec.enabled and not subproc_shards:
         summary = slo_rec.summary()
         decomp_err = 0.0
         for ex in summary["slowest"]:
@@ -536,6 +819,10 @@ def main():
             # 500-member HTTP round must never gate against (or seed)
             # an in-process 50-member baseline.
             "members": N_CLUSTERS,
+            # ... and now (transport, members, shards): an N=4 sharded
+            # round must never gate against an unsharded baseline.
+            "shards": shards,
+            "cpu_cores": CPU_CORES,
             "write_coalesce": _coalesce_detail(),
             "farm": (
                 ("subprocess" if farm.member_subprocess else "inproc")
@@ -593,12 +880,109 @@ def main():
                 for name, inst in sorted(pane["instances"].items())
             },
         }
-    if CHAOS:
+    if CHAOS and shards == 1:
         result["detail"]["chaos"] = run_chaos(fleet, farm, timer, ftc, members)
-    print(json.dumps(result))
-    print(f"# stages: {stages}", file=sys.stderr)
+    if replicas is not None:
+        _close_replicas(replicas)
     if farm is not None:
         farm.close()
+    print(f"# shards={shards} stages: {stages}", file=sys.stderr)
+    return {
+        "result": result,
+        "placements": placements,
+        "reasons": reasons,
+        "replica_reports": replica_reports,
+    }
+
+
+def _median_idx(values) -> int:
+    order = sorted(range(len(values)), key=lambda i: values[i])
+    return order[len(order) // 2]
+
+
+def main():
+    from kubeadmiral_tpu.runtime.gctune import tune_gc_for_service
+
+    tune_gc_for_service()
+
+    # Chaos rounds are seconds-long, not minutes: tighten the SLO
+    # freshness threshold and burn windows so the red→green transition
+    # is observable inside the phase (set BEFORE the recorder's first
+    # construction — thresholds are read once).
+    if CHAOS:
+        os.environ.setdefault("KT_SLO_FRESHNESS_S", "1.0")
+        os.environ.setdefault("KT_SLO_WINDOWS_S", "3,10")
+
+    if N_SHARDS <= 1:
+        print(json.dumps(run_round(1)["result"]))
+        return
+
+    assert not CHAOS, "chaos is an unsharded mode: run it with BENCH_E2E_SHARDS=1"
+    from kubeadmiral_tpu.federation import shardmap
+    from kubeadmiral_tpu.utils.hashing import stable_json_hash
+
+    # Interleaved same-day A/B arms (the ±12% noise rule): [1, N] per
+    # pair so both arms see the same machine weather, medians per arm so
+    # one noisy round decides nothing.
+    arms = {1: [], N_SHARDS: []}
+    for _pair in range(max(1, AB_PAIRS)):
+        for n in (1, N_SHARDS):
+            arms[n].append(run_round(n))
+
+    # Placement parity: the union of N shards' scheduler output must be
+    # bit-identical to the unsharded oracle.  The pipeline is
+    # deterministic for a fixed world, so this is exact, not
+    # statistical — every round is held to the first oracle round.
+    oracle = arms[1][0]
+    oracle_hash = stable_json_hash(oracle["placements"])
+    for arm_n, rounds in arms.items():
+        for r in rounds:
+            got = stable_json_hash(r["placements"])
+            assert got == oracle_hash, (
+                f"placement parity broken: shards={arm_n} "
+                f"hash {got} != oracle {oracle_hash}"
+            )
+
+    # Reason-count parity: inproc rounds carry the full {key: counts}
+    # map; subprocess replicas report stable hashes of their owned
+    # subset, which the oracle map is re-sliced against (so parity never
+    # ships a 100k-key payload over the pipe).
+    oracle_reasons = oracle["reasons"]
+    reasons_parity = "not-recorded"
+    if oracle_reasons:
+        for r in arms[N_SHARDS]:
+            if r["reasons"] is not None:
+                assert r["reasons"] == oracle_reasons, (
+                    "reason-count parity broken (inproc replica set)"
+                )
+                reasons_parity = "bit-identical"
+            elif r["replica_reports"] is not None:
+                for rep in r["replica_reports"]:
+                    m = shardmap.ShardMap(N_SHARDS, rep["shard"])
+                    subset = {
+                        k: v for k, v in oracle_reasons.items() if m.owns(k)
+                    }
+                    assert rep["reasons_hash"] == stable_json_hash(subset), (
+                        f"reason-count parity broken: shard {rep['shard']} "
+                        f"({rep['reasons_keys']} keys vs oracle {len(subset)})"
+                    )
+                reasons_parity = "bit-identical"
+
+    vals = {n: [r["result"]["value"] for r in rounds] for n, rounds in arms.items()}
+    med1 = sorted(vals[1])[len(vals[1]) // 2]
+    medN = sorted(vals[N_SHARDS])[len(vals[N_SHARDS]) // 2]
+    head = arms[N_SHARDS][_median_idx(vals[N_SHARDS])]["result"]
+    head["detail"]["sharded_ab"] = {
+        "shards": N_SHARDS,
+        "pairs": max(1, AB_PAIRS),
+        "interleaved": True,
+        "cpu_cores": CPU_CORES,
+        "arm_objects_per_sec": {"s1": vals[1], f"s{N_SHARDS}": vals[N_SHARDS]},
+        "arm_medians": {"s1": med1, f"s{N_SHARDS}": medN},
+        "speedup": round(medN / med1, 3) if med1 else None,
+        "parity": {"placements": "bit-identical", "reasons": reasons_parity},
+    }
+    print(json.dumps(head))
 
 
 if __name__ == "__main__":

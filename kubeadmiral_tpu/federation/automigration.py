@@ -143,6 +143,7 @@ class AutoMigrationController:
         )
         self.host.watch(self._fed_resource, self._on_event, replay=True)
         self._reattach = fleet.watch_members(PODS, self._on_member_pod_event)
+        # ktlint: ignore[shard-intake-coverage] broadcast: cluster topology changes reattach member pod watches on every replica; per-key work still routes through the shard-filtered worker
         self.host.watch(C.FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
         if self.pod_informer is not None:
             self.pod_informer.attach()

@@ -91,6 +91,7 @@ class RevisionManager:
         self.host = host
         self._lock = threading.Lock()
         self._by_uid: dict[str, set[str]] = {}
+        # ktlint: ignore[shard-intake-coverage] broadcast index: the revision cache is keyed by owner uid and only read from shard-owned sync reconciles; non-owned rows cost memory, never scheduling work
         host.watch(CONTROLLER_REVISIONS, self._on_revision_event, replay=True)
 
     def _on_revision_event(self, event: str, obj: dict) -> None:

@@ -36,7 +36,9 @@ from kubeadmiral_tpu.runtime.hostbatch import HostBatch
 from kubeadmiral_tpu.runtime.worker import BatchWorker, Result
 from kubeadmiral_tpu.scheduler.engine import ScheduleResult, SchedulerEngine
 from kubeadmiral_tpu.scheduler import webhook as W
-from kubeadmiral_tpu.testing.fakekube import Conflict, FakeKube, NotFound, obj_key
+from kubeadmiral_tpu.testing.fakekube import (
+    Conflict, FakeKube, NotFound, ShardIntake, obj_key,
+)
 from kubeadmiral_tpu.utils.hashing import stable_json_hash
 from kubeadmiral_tpu.utils.unstructured import get_path
 
@@ -137,13 +139,36 @@ class SchedulerController:
         # no-op them anyway, but only after paying a per-key replan
         # check; at e2e scale that recheck WAS a whole extra tick.
         self._event_sigs: dict[str, int] = {}
+        # Per-cluster scheduling-relevant signature (the _clusters_hash
+        # fields + joined-ness): heartbeats and capacity-only status
+        # bumps leave it unchanged and must NOT sweep-enqueue every
+        # object — that sweep was the ~300k-enqueue storm of the PR 18
+        # 10000x500 profile (one full-keyspace enqueue_all per cluster
+        # event, all of them trigger-hash no-ops downstream).
+        self._cluster_sweep_sigs: dict[str, str] = {}
+        # The replica's shard filter, resolved once like the worker's:
+        # non-owned object events are dropped pre-delivery (kt_predicate
+        # runs batch-wise in the store), before they cost a handler
+        # call, a metadata sig, or an enqueue.
+        self._shard = self.worker._shard
 
-        host.watch(self._resource, self._on_object_event, replay=True)
+        host.watch(
+            self._resource,
+            ShardIntake(self._on_object_event, predicate=self._owns_event),
+            replay=True,
+        )
         host.watch(P.PROPAGATION_POLICIES, self._on_policy_event, replay=False)
         host.watch(P.CLUSTER_PROPAGATION_POLICIES, self._on_policy_event, replay=False)
-        host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=False)
+        host.watch(
+            FEDERATED_CLUSTERS,
+            ShardIntake(self._on_cluster_event, batch=self._on_cluster_events),
+            replay=False,
+        )
         host.watch(PR.SCHEDULING_PROFILES, self._on_profile_event, replay=False)
         host.watch(W.SCHEDULER_WEBHOOK_CONFIGS, self._on_webhook_config_event, replay=True)
+
+    def _owns_event(self, event: str, obj: dict) -> bool:
+        return self._shard.owns(obj_key(obj))
 
     # -- event handlers (fan-in to the dirty queue) ----------------------
     def _on_object_event(self, event: str, obj: dict) -> None:
@@ -171,8 +196,9 @@ class SchedulerController:
         # The reconcile path's root span: the watch event that made the
         # object dirty (its tick shows up as a later worker.tick span;
         # the gap between the two is the queue wait, gauged by
-        # worker_queue_wait_seconds).
-        with trace.span(
+        # worker_queue_wait_seconds).  Sampled — per-event spans at e2e
+        # scale only evict each other from the ring (trace.hot_span).
+        with trace.hot_span(
             "informer.event", resource=self._resource, event=event, key=key
         ):
             self.worker.enqueue(key)
@@ -221,10 +247,41 @@ class SchedulerController:
         self.host.scan(P.CLUSTER_PROPAGATION_POLICIES, collect)
         self._enqueue_objects_for_policies(policies)
 
+    def _cluster_sweep_sig(self, obj: dict) -> str:
+        """The scheduling-relevant signature of one cluster: exactly the
+        fields _clusters_hash feeds the trigger hash, plus joined-ness.
+        Anything that leaves it unchanged (heartbeats, capacity status
+        bumps, sync's finalizer writes) cannot change a trigger hash,
+        so sweeping the keyspace for it is pure enqueue-storm."""
+        state = cluster_state_from_object(obj)
+        if state is None:
+            return "unjoined"
+        return self._clusters_hash([state])
+
+    def _on_cluster_events(self, events: list) -> None:
+        """Coalesced cluster intake: flush-level dedup BEFORE the
+        router — one committed flush of K cluster events triggers at
+        most ONE full-keyspace sweep, and none at all when no event
+        changed a scheduling-relevant field
+        (schedulingtriggers.go enqueueFederatedObjectsForCluster, minus
+        the per-heartbeat replay storm)."""
+        sweep = False
+        for event, obj in events:
+            name = obj["metadata"]["name"]
+            if event == "DELETED":
+                self._cluster_sweep_sigs.pop(name, None)
+                sweep = True
+                continue
+            sig = self._cluster_sweep_sig(obj)
+            if self._cluster_sweep_sigs.get(name) != sig:
+                self._cluster_sweep_sigs[name] = sig
+                sweep = True
+        if sweep:
+            self.worker.enqueue_all(self.host.keys(self._resource))
+
     def _on_cluster_event(self, event: str, obj: dict) -> None:
-        # Cluster changes can change every placement
-        # (schedulingtriggers.go enqueueFederatedObjectsForCluster).
-        self.worker.enqueue_all(self.host.keys(self._resource))
+        # Per-event (non-coalesced store) path of the same dedup.
+        self._on_cluster_events([(event, obj)])
 
     def _on_webhook_config_event(self, event: str, obj: dict) -> None:
         """Register/refresh/remove the webhook plugin and reschedule
@@ -900,6 +957,11 @@ class SchedulerController:
     def _deschedule(self, fed_obj: dict) -> Result:
         """No policy bound: clear own placement/overrides and hand off
         downstream (scheduler.go schedule() with nil policy)."""
+        assert self._shard.owns(obj_key(fed_obj)), (
+            f"shard violation: replica {self._shard.shard_index}/"
+            f"{self._shard.shard_count} descheduling non-owned key "
+            f"{obj_key(fed_obj)}"
+        )
         modified = C.set_placement(fed_obj, self.name, set())
         if C.get_overrides(fed_obj, self.name):
             C.set_overrides(fed_obj, self.name, {})
@@ -927,6 +989,14 @@ class SchedulerController:
         hb: HostBatch,
         results: dict,
     ) -> Result:
+        # Disjoint-by-construction guard: a replica persists placements
+        # ONLY for keys its shard owns.  The intake boundary already
+        # filters, so tripping this means a key bypassed the router
+        # (double-scheduling across replicas) — fail loudly.
+        assert self._shard.owns(key), (
+            f"shard violation: replica {self._shard.shard_index}/"
+            f"{self._shard.shard_count} persisting non-owned key {key}"
+        )
         modified = C.set_placement(fed_obj, self.name, outcome.cluster_set)
 
         # Replicas overrides for Divide-mode results (scheduler/util.go:71-110).

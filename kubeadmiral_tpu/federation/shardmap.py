@@ -28,12 +28,19 @@ Knobs (resolved once per :class:`ShardMap`, like the admission knobs):
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
 import threading
-from typing import Optional
+from typing import Iterator, Optional
 
 _MASK64 = (1 << 64) - 1
+
+# Control-plane broadcast keys: pseudo-keys every replica must process
+# regardless of routing (cluster lifecycle is global state — a replica
+# that never sees "cluster::pool-a" would keep planning against a
+# member that left the fleet).  Prefix-matched, not hashed.
+BROADCAST_PREFIXES = ("cluster::",)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -67,12 +74,18 @@ def key_digest(key: str) -> int:
 
 
 class ShardMap:
-    """key → shard routing for one replica."""
+    """key → shard routing for one replica.
+
+    ``epoch`` is the resize generation: a live resize (``resize()``)
+    bumps it, and per-shard snapshot artifacts carry it so a standby
+    never restores placements routed under a different shard layout.
+    """
 
     def __init__(
         self,
         shard_count: Optional[int] = None,
         shard_index: Optional[int] = None,
+        epoch: int = 0,
     ):
         count = (
             _env_int("KT_SHARD_COUNT", 1) if shard_count is None else shard_count
@@ -82,19 +95,55 @@ class ShardMap:
         )
         self.shard_count = max(1, count)
         self.shard_index = min(max(0, index), self.shard_count - 1)
+        self.epoch = int(epoch)
 
     def shard_of(self, key: str) -> int:
         if self.shard_count == 1:
             return 0
+        if key.startswith(BROADCAST_PREFIXES):
+            return self.shard_index
         return jump_hash(key_digest(key), self.shard_count)
 
     def owns(self, key: str) -> bool:
         """Does THIS replica reconcile ``key``?  The single check the
         informer/worker boundary makes per enqueue; with shard_count=1
-        it is one attribute compare (identity routing)."""
+        it is one attribute compare (identity routing).  Broadcast
+        control keys (``cluster::*``) are owned by every replica."""
         if self.shard_count == 1:
             return True
+        if key.startswith(BROADCAST_PREFIXES):
+            return True
         return self.shard_of(key) == self.shard_index
+
+    def resize(self, shard_count: int, shard_index: Optional[int] = None) -> "ShardMap":
+        """The live-resize step: a NEW map at the next epoch.  Jump
+        hashing guarantees N→N+1 moves only ~1/(N+1) of the keyspace
+        (always onto the new shard); callers swap the returned map in
+        atomically (``set_default``) so no key is double-owned — a key
+        is routed by exactly one installed map at any instant."""
+        return ShardMap(
+            shard_count,
+            self.shard_index if shard_index is None else shard_index,
+            epoch=self.epoch + 1,
+        )
+
+    def moved_keys(self, keys, new: "ShardMap") -> list[str]:
+        """Keys of ``keys`` owned HERE under self but not under ``new``
+        — the handoff set a resize must re-enqueue on the new owners."""
+        return [
+            k for k in keys
+            if self.owns(k) and not new.owns(k)
+            and not k.startswith(BROADCAST_PREFIXES)
+        ]
+
+    def describe(self) -> dict:
+        """The /debug/shards ownership block for this replica."""
+        return {
+            "shard_count": self.shard_count,
+            "shard_index": self.shard_index,
+            "epoch": self.epoch,
+            "identity": self.shard_count == 1,
+        }
 
 
 # -- process default -------------------------------------------------------
@@ -127,3 +176,23 @@ def reset_default() -> ShardMap:
     """Fresh default map (re-reads the KT_SHARD_* environment)."""
     set_default(ShardMap())
     return get_default()
+
+
+@contextlib.contextmanager
+def scoped(shardmap: ShardMap) -> Iterator[ShardMap]:
+    """Install ``shardmap`` as the process default for the duration of
+    the block, restoring the previous default on exit.
+
+    This is the in-process replica-set construction seam: workers
+    resolve :func:`get_default` ONCE at construction, so building a
+    replica's whole controller stack inside ``scoped(ShardMap(n, i))``
+    shards every one of its intake boundaries without threading a map
+    through each constructor.  NOT safe for concurrent construction of
+    two replicas on different threads — construct sequentially (they
+    can then RUN concurrently; each holds its own resolved map).
+    """
+    prev = set_default(shardmap)
+    try:
+        yield shardmap
+    finally:
+        set_default(prev if prev is not None else ShardMap())

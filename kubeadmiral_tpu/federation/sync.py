@@ -53,6 +53,7 @@ from kubeadmiral_tpu.testing.fakekube import (
     Conflict,
     FakeKube,
     NotFound,
+    ShardIntake,
     obj_key,
 )
 
@@ -264,12 +265,25 @@ class SyncController:
         # LIST, without which pre-existing managed objects in clusters
         # outside the current placement would never be visited for
         # cleanup (federatedinformer.go:151-250).
+        # The replica's shard filter (resolved once, like the worker's):
+        # non-owned member/fed events are dropped batch-wise BEFORE
+        # delivery — at 500 members a flush fans out to every replica,
+        # and the filter keeps each replica's share of the handler work
+        # at ~1/N instead of N copies of everything.
+        self._shard = self.worker._shard
         self._reattach_members = fleet.watch_members(
             self._target_resource, self._on_member_event, named=True, replay=True,
-            batch=self._on_member_events,
+            batch=self._on_member_events, predicate=self._owns_event,
         )
-        self.host.watch(self._fed_resource, self._on_fed_event, replay=True)
+        self.host.watch(
+            self._fed_resource,
+            ShardIntake(self._on_fed_event, predicate=self._owns_event),
+            replay=True,
+        )
         self.host.watch(FEDERATED_CLUSTERS, self._on_cluster_event, replay=True)
+
+    def _owns_event(self, event: str, obj: dict) -> bool:
+        return self._shard.owns(obj_key(obj))
 
     def watch_owners(self) -> list[object]:
         """Everything holding watch registrations on this controller's
@@ -473,6 +487,16 @@ class SyncController:
                     fed_keys.append(key)
             if not fed_keys:
                 return results
+            # Disjoint-by-construction guard: a replica syncs (and
+            # persists placements/status for) only keys its shard owns;
+            # a non-owned key here bypassed the router.
+            if self._shard.shard_count > 1:
+                for key in fed_keys:
+                    assert self._shard.owns(key), (
+                        f"shard violation: replica "
+                        f"{self._shard.shard_index}/{self._shard.shard_count}"
+                        f" syncing non-owned key {key}"
+                    )
             ctx = _TickClusters(
                 [
                     c

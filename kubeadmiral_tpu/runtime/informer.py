@@ -54,8 +54,10 @@ class Informer:
         if not getattr(self.kube, "_slo_ingress", False):
             slo.ingest(self.kube, self.resource, event, obj)
         # The root span of the reconcile path: handler work (enqueues,
-        # trigger checks) nests under the event that caused it.
-        with trace.span(
+        # trigger checks) nests under the event that caused it.  Sampled
+        # (KT_TRACE_SAMPLE_N): a 300k-event storm must not pay a span
+        # record per event.
+        with trace.hot_span(
             "informer.event", resource=self.resource, event=event, key=key
         ):
             for h in handlers:

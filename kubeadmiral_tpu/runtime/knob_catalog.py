@@ -172,6 +172,11 @@ KNOBS: dict[str, KnobSpec] = {
     "KT_FLIGHTREC_TOPK": KnobSpec(
         "int", "8", _OBS,
         "Per-decision top-K score introspection width."),
+    "KT_TRACE_SAMPLE_N": KnobSpec(
+        "int", "64", _OBS,
+        "Hot-path span sampling: trace 1 in N per-event/per-key spans "
+        "(1 = trace everything, 0 = trace none); ticks and "
+        "once-per-batch spans stay unconditional."),
     "KT_SLO": KnobSpec(
         "bool", "1", _OPS,
         "Provenance-token SLO path master switch."),
@@ -350,6 +355,24 @@ KNOBS: dict[str, KnobSpec] = {
     "KT_SOAK_KILL_ROUND": KnobSpec(
         "int", "5", _OPS,
         "Soak: round after which the victim is SIGKILLed."),
+    "KT_SOAK_SHARDS": KnobSpec(
+        "int", "1", _OPS,
+        "Soak: shard the control plane across N replica processes "
+        "(victim+successor own shard 0; peers own 1..N-1; the oracle "
+        "stays unsharded and the union of shards must match it "
+        "bit-identically)."),
+    # -- sharded control plane replicas (testing/shardreplica.py,
+    #    ISSUE 20) --------------------------------------------------------
+    "KT_REPLICA_HOST_URL": KnobSpec(
+        "str", "", _OPS,
+        "Shard replica subprocess: host apiserver URL to attach to."),
+    "KT_REPLICA_HOST_TOKEN": KnobSpec(
+        "str", "", _OPS,
+        "Shard replica subprocess: bearer token for the host apiserver."),
+    "KT_REPLICA_FTC": KnobSpec(
+        "str", "deployments.apps", _OPS,
+        "Shard replica subprocess: FTC source resource to run the "
+        "controller stack for."),
     # -- fleet observatory (runtime/telespill.py, runtime/fleetscrape.py,
     #    ISSUE 17) --------------------------------------------------------
     "KT_SPILL": KnobSpec(

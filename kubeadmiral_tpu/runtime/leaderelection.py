@@ -21,6 +21,62 @@ LEASES = "coordination.k8s.io/v1/leases"
 DEFAULT_LEASE_SECONDS = 15.0
 
 
+def shard_lease_name(shard_index: int) -> str:
+    """Lease object name for one shard of the sharded control plane.
+    N replicas each run an elector against their own ``kt-shard-<i>``
+    lease, so shard ownership is disjoint by construction: the jump-hash
+    router decides WHICH keys a shard owns, the per-shard lease decides
+    WHICH replica owns the shard."""
+    return f"kt-shard-{shard_index}"
+
+
+def shard_elector(
+    host: FakeKube,
+    identity: str,
+    shard_index: int,
+    **kw,
+) -> LeaderElector:
+    """A LeaderElector over the shard's ``kt-shard-<i>`` lease."""
+    return LeaderElector(
+        host, identity, name=shard_lease_name(shard_index), **kw
+    )
+
+
+def shard_lease_status(
+    host: FakeKube,
+    shard_count: int,
+    namespace: str = "kube-admiral-system",
+    clock: Callable[[], float] = time.monotonic,
+) -> list:
+    """Ownership/freshness of every shard lease, for /debug/shards.
+
+    One row per shard: ``{shard, lease, holder, age_s, fresh}`` where
+    ``holder`` is None when the lease is absent or released and
+    ``fresh`` means the holder renewed within its lease duration (a
+    stale row is a shard whose replica died and whose standby has not
+    taken over yet — exactly the failover gap the soak gate bounds)."""
+    rows = []
+    now = clock()
+    for i in range(shard_count):
+        name = shard_lease_name(i)
+        lease = host.try_get(LEASES, f"{namespace}/{name}") or {}
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity") or None
+        renewed = float(spec.get("renewTime", 0.0))
+        duration = float(spec.get("leaseDurationSeconds", DEFAULT_LEASE_SECONDS))
+        age = now - renewed if holder is not None else None
+        rows.append(
+            {
+                "shard": i,
+                "lease": name,
+                "holder": holder,
+                "age_s": round(age, 3) if age is not None else None,
+                "fresh": holder is not None and age is not None and age <= duration,
+            }
+        )
+    return rows
+
+
 class LeaderElector:
     def __init__(
         self,

@@ -93,6 +93,14 @@ class ControllerManager:
         self.host = fleet.host
         self.metrics = metrics or Metrics()
         self.health = health or HealthCheckRegistry()
+        # The ambient shard identity, captured ONCE like every worker
+        # does (shardmap.scoped() around manager construction shards the
+        # whole controller set).  Drives per-shard snapshot artifacts
+        # and the /debug/shards report; with the default 1-shard map
+        # everything below behaves exactly as before.
+        from kubeadmiral_tpu.federation import shardmap as _shardmap
+
+        self.shard = _shardmap.get_default()
         # ONE pod informer shared by every per-FTC automigration
         # controller: pruned per-cluster pod caches with a bounded
         # cold-LIST semaphore (reference: federatedclient/podinformer.go,
@@ -130,14 +138,25 @@ class ControllerManager:
             from kubeadmiral_tpu.runtime.snapshot import (
                 SnapshotManager,
                 SnapshotStore,
+                shard_snapshot_store,
             )
             from kubeadmiral_tpu.transport import breaker as B
 
+            # Sharded: each replica persists its own keys' working set
+            # into <dir>/shard-<i>/ with the shard identity + ShardMap
+            # epoch in the payload (restore refuses a mismatch).
+            if self.shard.shard_count > 1:
+                store = shard_snapshot_store(
+                    snap_dir, self.shard, metrics=self.metrics
+                )
+            else:
+                store = SnapshotStore(snap_dir, metrics=self.metrics)
             self.snapshots = SnapshotManager(
                 self.engine,
-                SnapshotStore(snap_dir, metrics=self.metrics),
+                store,
                 breakers=B.for_fleet(fleet, metrics=self.metrics),
                 watermark_fn=self._snapshot_watermarks,
+                shard=self.shard if self.shard.shard_count > 1 else None,
             )
         self._enabled = self._resolve_enabled(enabled)
         self._lock = threading.RLock()
@@ -156,6 +175,51 @@ class ControllerManager:
 
         # The FTC watch is the FederatedTypeConfigManager reconcile loop.
         self.host.watch(FEDERATED_TYPE_CONFIGS, self._on_ftc_event, replay=True)
+
+        # /debug/shards provider (last manager wins for the process
+        # default, like the SLO attach above) + the epoch gauge every
+        # scrape carries, so shard-skew triage can correlate per-shard
+        # metrics with the routing generation they were produced under.
+        from kubeadmiral_tpu.runtime import profiling as _profiling
+
+        _profiling.set_shards_provider(self.shard_report)
+        self.metrics.gauge(
+            "shard_epoch", self.shard.epoch, shard=str(self.shard.shard_index)
+        )
+
+    def shard_report(self) -> dict:
+        """The /debug/shards document: this replica's ShardMap identity
+        and epoch, every shard lease's holder + freshness, per-resource
+        owned-key counts (the skew view), and snapshot freshness."""
+        from kubeadmiral_tpu.runtime.leaderelection import shard_lease_status
+
+        report = self.shard.describe()
+        try:
+            report["leases"] = shard_lease_status(
+                self.host, self.shard.shard_count
+            )
+        except Exception:
+            report["leases"] = None  # transport without lease reads
+        owned: dict[str, int] = {}
+        try:
+            with self._lock:
+                resources = sorted(
+                    rt.ftc.federated.resource for rt in self._ftcs.values()
+                )
+            for r in resources:
+                owned[r] = sum(1 for k in self.host.keys(r) if self.shard.owns(k))
+        except Exception:
+            pass
+        report["owned_keys"] = owned
+        report["snapshot"] = (
+            {
+                "dir": self.snapshots.store.dir,
+                "last_result": self.snapshots.last_result,
+            }
+            if self.snapshots is not None
+            else None
+        )
+        return report
 
     @staticmethod
     def _resolve_enabled(enabled: Optional[list[str]]) -> set[str]:

@@ -245,7 +245,9 @@ CATALOG: dict[str, MetricSpec] = {
         "resumed through the drift-gate/sub-batch revalidation), "
         "rejected (config/topology/geometry mismatch -> cold), "
         "quarantined (torn/corrupt/version-mismatched file renamed "
-        "aside, never loaded), skipped (nothing coherent to persist)."),
+        "aside, never loaded), skipped (nothing coherent to persist), "
+        "shard_mismatch (snapshot stamped for a different shard "
+        "identity/epoch than this replica's ShardMap -> cold)."),
     "engine_snapshot_bytes": MetricSpec(
         "gauge", "bytes", (),
         "Payload size of the most recent durable engine snapshot."),
@@ -479,6 +481,13 @@ CATALOG: dict[str, MetricSpec] = {
         "gauge", "instances", (),
         "Roster size of the last fleet scrape (manager's own registry "
         "included when attached)."),
+    # -- sharded control plane (federation/shardmap.py, ISSUE 20) --------
+    "shard_epoch": MetricSpec(
+        "gauge", "epoch", ("shard",),
+        "Routing generation this replica's ShardMap snapshot was built "
+        "under, labeled by shard index — shard-skew triage correlates "
+        "per-shard metrics with the resize epoch they were produced "
+        "under (GET /debug/shards carries the same value)."),
 }
 
 # -- end-to-end SLO catalog ------------------------------------------------

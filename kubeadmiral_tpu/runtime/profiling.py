@@ -78,7 +78,7 @@ import time
 import traceback
 from collections import Counter
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 from urllib.parse import parse_qs, urlsplit
 
 _profile_lock = threading.Lock()
@@ -149,6 +149,22 @@ def collect_threads() -> dict:
     }
 
 
+# Process-default /debug/shards provider (last manager wins, like the
+# SLO recorder and dispatch-ledger attach): a callable returning the
+# shard-ownership report — ShardMap identity/epoch, per-shard lease
+# holder + freshness, owned-key counts.
+_shards_provider: Optional[Callable[[], dict]] = None
+
+
+def set_shards_provider(fn: Optional[Callable[[], dict]]) -> None:
+    global _shards_provider
+    _shards_provider = fn
+
+
+def shards_report() -> Optional[dict]:
+    return _shards_provider() if _shards_provider is not None else None
+
+
 def handle_debug_path(path: str, query: dict) -> Optional[dict]:
     """Route a /debug/* request; None = not a debug path."""
     if path == "/debug/profile":
@@ -206,6 +222,8 @@ DEBUG_INDEX = {
     "/debug/explain": "per-cluster verdicts for one object"
     " (?key=<ns/name>)",
     "/debug/drift": "desired-vs-observed placement drift",
+    "/debug/shards": "sharded control plane: shard ownership, lease"
+    " holders/freshness, epoch, owned-key counts",
     "/debug/profile": "sampling profile of every thread"
     " (?seconds=&mode=jax for device capture)",
     "/debug/stacks": "current stack of every thread",
@@ -339,6 +357,15 @@ def respond_debug(
         from kubeadmiral_tpu.transport import breaker as breaker_mod
 
         report = members() if members is not None else breaker_mod.members_report()
+        _send(http_handler, json.dumps(report).encode(), "application/json")
+        return True
+    if path == "/debug/shards":
+        report = shards_report()
+        if report is None:
+            http_handler.send_error(
+                404, explain="no shard report provider installed"
+            )
+            return True
         _send(http_handler, json.dumps(report).encode(), "application/json")
         return True
     if path in ("/debug/decisions", "/debug/explain", "/debug/drift"):

@@ -73,9 +73,12 @@ def tenant_of(namespace: str, labels: Optional[dict] = None) -> str:
 
 
 def tenant_of_key(key: str) -> str:
-    """Tenant for a "ns/name" worker/stream key (no labels that deep)."""
+    """Tenant for a "ns/name" worker/stream key (no labels that deep).
+    Skips tenant_of()'s KT_TENANT_LABEL env read — a key never carries
+    labels, and this runs per key on the enqueue hot path (the PR 18
+    10000x500 profile surfaced the per-key getenv)."""
     ns, _, rest = key.partition("/")
-    return tenant_of(ns if rest else "")
+    return ns if (rest and ns) else CLUSTER_SCOPED
 
 
 def _env_int(name: str, default: int) -> int:

@@ -286,6 +286,71 @@ def span(name: str, **args):
     return _default.span(name, **args)
 
 
+# -- sampled hot-path spans ---------------------------------------------------
+#
+# Per-event handler spans (informer.event, worker.reconcile) cost two
+# perf_counter calls, a dict build and a deque append PER EVENT — at the
+# 10000x500 e2e scale that is millions of spans whose ring evicts all
+# but the last 16k anyway.  hot_span() keeps 1-in-KT_TRACE_SAMPLE_N of
+# them (default 64; 1 = trace everything, 0 = trace nothing), with a
+# fast no-allocation pass-through for the skipped ones.  Ticks and
+# coarser once-per-batch spans stay unconditional — sampling is only
+# for per-event/per-key fan-out sites.
+
+def _sample_every() -> int:
+    raw = os.environ.get("KT_TRACE_SAMPLE_N", "")
+    try:
+        return int(raw) if raw else 64
+    except ValueError:
+        return 64
+
+
+_sample_n = _sample_every()
+_sample_counter = itertools.count()
+
+
+class _NullSpan:
+    """The skipped-sample stand-in: accepts set() and traceparent()."""
+
+    __slots__ = ()
+
+    def set(self, **args) -> None:
+        pass
+
+    def traceparent(self) -> Optional[str]:  # pragma: no cover - trivial
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+@contextmanager
+def _null_span():
+    yield _NULL_SPAN
+
+
+def reset_sampling() -> int:
+    """Re-read KT_TRACE_SAMPLE_N (tests, embedders); returns the rate."""
+    global _sample_n
+    _sample_n = _sample_every()
+    return _sample_n
+
+
+def hot_span(name: str, **args):
+    """A sampled span for per-event hot paths: records 1 in
+    KT_TRACE_SAMPLE_N calls on the default tracer, a cheap counter
+    bump + no-op context otherwise.  The sampled-in spans keep full
+    parent/trace-id semantics; sampled-out calls leave the thread's
+    span stack untouched (children of a skipped span root normally)."""
+    n = _sample_n
+    if n == 1:
+        return _default.span(name, **args)
+    if n <= 0 or next(_sample_counter) % n:
+        return _null_span()
+    args["sampled_1_in"] = n
+    return _default.span(name, **args)
+
+
 def current_traceparent() -> Optional[str]:
     """The calling thread's innermost open span on the default tracer,
     as a traceparent header value (None with no open span)."""

@@ -193,8 +193,15 @@ class _WorkerBase:
                     "worker_admission_total", controller=self.name
                 )
                 if tenancy.active():
+                    # One ledger call per tenant per flush, not per key:
+                    # note_admission takes the ledger lock, and a 100k-
+                    # key flush was paying it 100k times (PR 18 profile).
+                    counts: dict[str, int] = {}
                     for k in keys:
-                        tenancy.note_admission(tenancy.tenant_of_key(k))
+                        t = tenancy.tenant_of_key(k)
+                        counts[t] = counts.get(t, 0) + 1
+                    for t, n in counts.items():
+                        tenancy.note_admission(t, n)
         for k in keys:
             self.queue.add(k, delay)
 
@@ -241,7 +248,9 @@ class Worker(_WorkerBase):
         ident = self._enter()
         start = time.perf_counter()
         try:
-            with trace.span("worker.reconcile", controller=self.name, key=key):
+            # Sampled: a per-key span at e2e scale is millions of ring
+            # appends that evict each other — keep 1-in-N (trace.py).
+            with trace.hot_span("worker.reconcile", controller=self.name, key=key):
                 with self.metrics.timer(f"{self.name}.latency"):
                     result = self._reconcile(key)
         except Exception:

@@ -195,7 +195,7 @@ class StreamingScheduler:
     # -- event ingestion --------------------------------------------------
     def offer(self, unit: T.SchedulingUnit) -> None:
         """Object add/update (a watch upsert)."""
-        with trace.span("stream.offer", kind="upsert", key=unit.key):
+        with trace.hot_span("stream.offer", kind="upsert", key=unit.key):
             with self._lock:
                 self._pending.append(_Event("upsert", unit, self.clock()))
                 self.events_total["upsert"] += 1
@@ -203,7 +203,7 @@ class StreamingScheduler:
 
     def remove(self, key: str) -> None:
         """Object delete: the row reverts to an inert placeholder."""
-        with trace.span("stream.offer", kind="delete", key=key):
+        with trace.hot_span("stream.offer", kind="delete", key=key):
             with self._lock:
                 self._pending.append(_Event("delete", key, self.clock()))
                 self.events_total["delete"] += 1
@@ -212,7 +212,7 @@ class StreamingScheduler:
     def offer_capacity(self, clusters: Sequence[T.ClusterState]) -> None:
         """Whole-fleet capacity snapshot (cheap: the engine diffs it
         column-wise against the previous view)."""
-        with trace.span("stream.offer", kind="capacity"):
+        with trace.hot_span("stream.offer", kind="capacity"):
             with self._lock:
                 self._pending.append(
                     _Event("capacity", list(clusters), self.clock())
@@ -222,7 +222,7 @@ class StreamingScheduler:
 
     def update_cluster(self, cluster: T.ClusterState) -> None:
         """Single-member capacity update — the common drift event."""
-        with trace.span("stream.offer", kind="capacity", key=cluster.name):
+        with trace.hot_span("stream.offer", kind="capacity", key=cluster.name):
             with self._lock:
                 base = self._pending_clusters_locked()
                 fleet = [

@@ -119,11 +119,18 @@ class _NamedHandler:
     advertise the batch-delivery protocol — ``handler_owner`` keeps
     working through ``func.__self__``."""
 
-    __slots__ = ("func", "cluster", "kt_batch")
+    __slots__ = ("func", "cluster", "kt_batch", "kt_predicate")
 
-    def __init__(self, func: Callable, cluster: str, batch: Optional[Callable]):
+    def __init__(
+        self,
+        func: Callable,
+        cluster: str,
+        batch: Optional[Callable],
+        predicate: Optional[Callable] = None,
+    ):
         self.func = func
         self.cluster = cluster
+        self.kt_predicate = predicate
         if batch is not None:
             self.kt_batch = lambda events: batch(cluster, events)
         else:
@@ -131,6 +138,38 @@ class _NamedHandler:
 
     def __call__(self, event: str, obj: dict) -> None:
         self.func(self.cluster, event, obj)
+
+
+class ShardIntake:
+    """Watch-handler wrapper advertising the pre-delivery protocols a
+    sharded (or flush-coalescing) controller intake needs — bound
+    methods cannot carry ``kt_predicate``/``kt_batch`` attributes, so
+    the wrapper does:
+
+    * ``predicate`` — ``(event, obj) -> bool``, applied by the store
+      batch-wise BEFORE delivery; a replica's shard filter here drops a
+      non-owned event before it costs a handler call, a signature
+      computation or an enqueue;
+    * ``batch`` — ``(events) -> None`` coalesced-flush delivery (one
+      call per committed flush instead of N per-event calls).
+
+    ``handler_owner`` (and thus ``unwatch_owner``) keeps working
+    through ``func.__self__``."""
+
+    __slots__ = ("func", "kt_predicate", "kt_batch")
+
+    def __init__(
+        self,
+        func: Callable,
+        predicate: Optional[Callable] = None,
+        batch: Optional[Callable] = None,
+    ):
+        self.func = func
+        self.kt_predicate = predicate
+        self.kt_batch = batch
+
+    def __call__(self, event: str, obj: dict) -> None:
+        self.func(event, obj)
 
 
 _SCALARS = (str, int, float, bool, type(None))
@@ -739,6 +778,7 @@ class ClusterFleet:
     def watch_members(
         self, resource: str, handler: Handler, named: bool = False,
         replay: bool = False, batch: Optional[Callable] = None,
+        predicate: Optional[Callable] = None,
     ) -> Callable[[], None]:
         """Watch ``resource`` in every current member and return a
         re-attach callable for members added later — the
@@ -747,7 +787,11 @@ class ClusterFleet:
         with ``replay``, existing objects stream through as ADDED (the
         informer's initial LIST); ``batch`` (named fleets only) is the
         coalesced-delivery variant ``(cluster, events)`` a store flushes
-        one committed chunk through instead of per-event calls."""
+        one committed chunk through instead of per-event calls;
+        ``predicate`` (named fleets only) is a pre-delivery
+        ``(event, obj) -> bool`` filter the member store applies before
+        either delivery path — a shard replica drops non-owned member
+        events here, before they cost a handler call."""
         attached: set[str] = set()
         detached: set[str] = set()
         wrapped: dict[str, Handler] = {}
@@ -757,7 +801,7 @@ class ClusterFleet:
                 if name not in attached and name not in detached:
                     attached.add(name)
                     h = (
-                        _NamedHandler(handler, name, batch)
+                        _NamedHandler(handler, name, batch, predicate)
                         if named
                         else handler
                     )

@@ -262,13 +262,20 @@ class SoakHarness:
         with re-aggregated member state at nondeterministic times.  The
         Joined condition and the initial capacity aggregation persist on
         the host objects."""
+        from kubeadmiral_tpu.federation import shardmap
         from kubeadmiral_tpu.federation.clusterctl import (
             FederatedClusterController,
         )
 
-        clusterctl = FederatedClusterController(
-            self.fleet, api_resource_probe=[GVK], metrics=self.metrics
-        )
+        # The join controller is control-plane-GLOBAL even when the
+        # harness itself is built under a shard scope (the sharded
+        # soak): its worker keys are raw cluster names, and a scoped
+        # replica would silently join only the clusters hashing to its
+        # own shard — every replica must see every cluster Joined.
+        with shardmap.scoped(shardmap.ShardMap(1, 0)):
+            clusterctl = FederatedClusterController(
+                self.fleet, api_resource_probe=[GVK], metrics=self.metrics
+            )
         for _ in range(200):
             progressed = False
             while clusterctl.worker.step():

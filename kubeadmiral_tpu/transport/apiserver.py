@@ -58,18 +58,29 @@ HEARTBEAT = b'{"type":"HEARTBEAT"}\n'
 class _ResourceLog:
     """One resource's event history: parallel (seqs, lines) lists with
     front-eviction by compaction, so resume is a bisect + slice instead
-    of an O(cap) scan per watcher wakeup."""
+    of an O(cap) scan per watcher wakeup.  ``lines`` entries are either
+    rendered ``bytes`` or a pending ``(event, obj)`` tuple — see
+    :meth:`_EventLog.since`."""
 
     __slots__ = ("seqs", "lines", "evicted")
 
     def __init__(self):
         self.seqs: list[int] = []
-        self.lines: list[bytes] = []
+        self.lines: list = []
         self.evicted = False
 
 
 class _EventLog:
-    """Per-resource bounded event logs with resourceVersion resume."""
+    """Per-resource bounded event logs with resourceVersion resume.
+
+    Serialization is LAZY: the write path (which runs as a store
+    observer, under the store lock) appends ``(event, obj)`` tuples;
+    a line is rendered to JSON bytes on first watcher read and memoized
+    in place, so a resource nobody watches never pays ``json.dumps`` at
+    all, N watchers of one resource pay it once, and the store lock
+    never holds serialization work.  Safe because the COW store's
+    committed nodes are immutable — the obj captured at append time is
+    the exact state the event described."""
 
     def __init__(self, cap: int = 100_000):
         self.cap = cap
@@ -77,11 +88,10 @@ class _EventLog:
         self.logs: dict[str, _ResourceLog] = {}
 
     def append(self, resource: str, event: str, obj: dict, seq: int) -> None:
-        line = json.dumps({"type": event, "object": obj}).encode() + b"\n"
         with self.cond:
             log = self.logs.setdefault(resource, _ResourceLog())
             log.seqs.append(seq)
-            log.lines.append(line)
+            log.lines.append((event, obj))
             if len(log.seqs) > 2 * self.cap:  # amortized O(1) eviction
                 drop = len(log.seqs) - self.cap
                 del log.seqs[:drop]
@@ -93,16 +103,15 @@ class _EventLog:
         """Append one committed store flush ``[(resource, event, obj,
         seq), ...]`` under ONE cond hold with ONE wakeup, instead of a
         lock/notify_all cycle per event (the store-side analogue of the
-        write-coalescing that batches the writes themselves)."""
-        encoded = [
-            (resource, json.dumps({"type": event, "object": obj}).encode() + b"\n", seq)
-            for resource, event, obj, seq in items
-        ]
+        write-coalescing that batches the writes themselves).  No
+        per-op ``json.dumps`` here — rendering is deferred to first
+        read (one serialization pass per coalesced batch, and only for
+        watched resources)."""
         with self.cond:
-            for resource, line, seq in encoded:
+            for resource, event, obj, seq in items:
                 log = self.logs.setdefault(resource, _ResourceLog())
                 log.seqs.append(seq)
-                log.lines.append(line)
+                log.lines.append((event, obj))
                 if len(log.seqs) > 2 * self.cap:
                     drop = len(log.seqs) - self.cap
                     del log.seqs[:drop]
@@ -112,7 +121,9 @@ class _EventLog:
 
     def since(self, resource: str, rv: int) -> tuple[Optional[list[bytes]], int]:
         """(lines after rv, latest seq); lines is None when rv is too old
-        (already evicted from the log) and the watcher must relist."""
+        (already evicted from the log) and the watcher must relist.
+        Pending entries are rendered here, once, and memoized in place
+        for every later reader."""
         with self.cond:
             log = self.logs.get(resource)
             if log is None or not log.seqs:
@@ -121,7 +132,18 @@ class _EventLog:
             if log.evicted and rv < log.seqs[0] - 1:
                 return None, latest  # history truncated: 410 Gone
             idx = bisect.bisect_right(log.seqs, rv)
-            return log.lines[idx:], latest
+            out = []
+            lines = log.lines
+            for i in range(idx, len(lines)):
+                line = lines[i]
+                if type(line) is not bytes:
+                    line = (
+                        json.dumps({"type": line[0], "object": line[1]}).encode()
+                        + b"\n"
+                    )
+                    lines[i] = line
+                out.append(line)
+            return out, latest
 
 
 class KubeApiServer:

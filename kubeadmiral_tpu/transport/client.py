@@ -357,7 +357,10 @@ class _ResourceWatch:
                 )
                 self._thread.start()
         if replay:
+            pred = getattr(handler, "kt_predicate", None)
             for obj in self.kube.list(self.resource):
+                if pred is not None and not pred(ADDED, obj):
+                    continue
                 handler(ADDED, obj)
 
     def remove(self, handler: Handler) -> None:
@@ -393,6 +396,16 @@ class _ResourceWatch:
         _slo.ingest(self.kube, self.resource, event, obj)
         with self._lock:
             handlers = list(self._handlers)
+        # One metadata_change_sig memo for the whole fan-out (the
+        # in-process store's _deliver_flush_locked does the same): four
+        # controllers watching the fed resource hash the trigger fields
+        # once per event, not once per handler.
+        with C.sig_memo_scope():
+            self._dispatch_handlers(handlers, event, obj, key)
+
+    def _dispatch_handlers(
+        self, handlers: list, event: str, obj: dict, key: str
+    ) -> None:
         for handler in handlers:
             # Isolate handler failures from the reflector loop (client-go
             # informers do the same): one controller's bad handler must
@@ -400,6 +413,12 @@ class _ResourceWatch:
             # resource, and an unhandled exception here would silently
             # end the reflector thread.
             try:
+                # Shard-intake predicate (fakekube._Watch parity): a
+                # replica drops non-owned keys here, before the handler
+                # costs an enqueue.
+                pred = getattr(handler, "kt_predicate", None)
+                if pred is not None and not pred(event, obj):
+                    continue
                 handler(event, obj)
             except Exception:
                 logging.getLogger("kubeadmiral.transport").exception(
@@ -526,6 +545,24 @@ class FederatedClientFactory:
             self._cache.clear()
 
 
+class _PredicatedHandler:
+    """A member-watch handler carrying a shard-intake predicate the
+    reflector consults pre-delivery (fakekube.ShardIntake's transport
+    twin).  ``func`` exposes the underlying bound method so
+    handler_owner() still resolves the owning controller through a
+    functools.partial wrapper."""
+
+    __slots__ = ("_inner", "func", "kt_predicate")
+
+    def __init__(self, inner: Handler, predicate: Callable):
+        self._inner = inner
+        self.func = getattr(inner, "func", inner)
+        self.kt_predicate = predicate
+
+    def __call__(self, event: str, obj: dict) -> None:
+        self._inner(event, obj)
+
+
 class HttpFleet:
     """ClusterFleet interface over HTTP: host client + join-secret-built
     member clients, member watches driven by FederatedCluster state."""
@@ -579,11 +616,15 @@ class HttpFleet:
     def watch_members(
         self, resource: str, handler: Handler, named: bool = False,
         replay: bool = False, batch: Optional[Callable] = None,
+        predicate: Optional[Callable] = None,
     ) -> Callable[[], None]:
         # ``batch`` (the in-process fleet's coalesced-delivery variant)
         # is accepted for interface parity and unused: HTTP watch
         # streams deliver per event, so consumers registered against
         # either fleet shape fall back to their per-event handler here.
+        # ``predicate`` (the shard-intake filter) IS honored: the
+        # per-member reflector consults kt_predicate before delivery,
+        # so a replica never pays an enqueue for a key it doesn't own.
         del batch
         attached: set[str] = set()
         detached: set[str] = set()
@@ -606,6 +647,8 @@ class HttpFleet:
                 attached.add(name)
                 self.members[name] = client
                 h = functools.partial(handler, name) if named else handler
+                if predicate is not None:
+                    h = _PredicatedHandler(h, predicate)
                 wrapped[name] = (client, h)
                 client.watch(resource, h, replay=replay)
             attach.pending = pending

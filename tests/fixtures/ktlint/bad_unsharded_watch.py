@@ -1,0 +1,20 @@
+# ktlint fixture: known-BAD for shard-intake-coverage.
+# A watch handler that mutates shared state directly — no ShardIntake
+# wrap, no predicate=, and no route through the shard-filtered worker:
+# under the sharded control plane every replica would process every
+# key, double-scheduling objects it does not own.
+
+
+class LeakyController:
+    def __init__(self, host, fleet, resource):
+        self.host = host
+        self.cache = {}
+        host.watch(resource, self._on_event, replay=True)
+        fleet.watch_members(resource, self._on_member_event)
+
+    def _on_event(self, event, obj):
+        key = obj["metadata"]["name"]
+        self.cache[key] = obj  # direct mutation, no shard check anywhere
+
+    def _on_member_event(self, event, obj):
+        self.cache.pop(obj["metadata"]["name"], None)

@@ -1,0 +1,51 @@
+# ktlint fixture: known-GOOD twin for shard-intake-coverage.
+# Each intake route the rule accepts: a ShardIntake-wrapped handler
+# (direct and via local alias), a predicate= watch, a worker-routed
+# handler (direct and transitively through a class helper), and a
+# functools.partial-bound worker-routed handler.
+import functools
+
+from kubeadmiral_tpu.federation.shardmap import ShardIntake
+
+
+class RoutedController:
+    def __init__(self, host, fleet, resource, worker):
+        self.host = host
+        self.worker = worker
+        host.watch(resource, ShardIntake(self._on_event), replay=True)
+        intake = ShardIntake(self._on_event, batch=self._on_events)
+        host.watch(resource, intake, replay=False)
+        fleet.watch_members(
+            resource, self._on_member_event, predicate=self._owns_event
+        )
+        host.watch(resource, self._on_direct_event, replay=False)
+        host.watch(resource, self._on_policy_event, replay=False)
+        host.watch(
+            resource,
+            functools.partial(self._on_scoped_event, "leader"),
+            replay=True,
+        )
+
+    def _owns_event(self, event, obj):
+        return True
+
+    def _on_event(self, event, obj):
+        self.worker.enqueue(obj["metadata"]["name"])
+
+    def _on_events(self, events):
+        self.worker.enqueue_many(e[1]["metadata"]["name"] for e in events)
+
+    def _on_member_event(self, event, obj):
+        self.worker.enqueue(obj["metadata"]["name"])
+
+    def _on_direct_event(self, event, obj):
+        self.worker.enqueue(obj["metadata"]["name"])
+
+    def _on_policy_event(self, event, obj):
+        self._requeue_matches(obj)
+
+    def _requeue_matches(self, obj):
+        self.worker.enqueue_all([obj["metadata"]["name"]])
+
+    def _on_scoped_event(self, role, event, obj):
+        self.worker.enqueue(f"{role}|" + obj["metadata"]["name"])

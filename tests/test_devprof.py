@@ -233,7 +233,18 @@ class TestEndpoints:
 
 
 class TestStreamingSpans:
-    def test_offer_flush_spans_connect_to_engine_tick(self):
+    def test_offer_flush_spans_connect_to_engine_tick(self, monkeypatch):
+        # stream.offer spans are sampled 1-in-KT_TRACE_SAMPLE_N in
+        # production; this test asserts each offer's span, so trace all.
+        monkeypatch.setenv("KT_TRACE_SAMPLE_N", "1")
+        trace.reset_sampling()
+        try:
+            self._run_offer_flush_case()
+        finally:
+            monkeypatch.undo()
+            trace.reset_sampling()
+
+    def _run_offer_flush_case(self):
         tracer = trace.get_default()
         tracer.clear()
         units, clusters = make_world(b=32, c=8)

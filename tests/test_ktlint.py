@@ -30,6 +30,8 @@ CASES = [
     ("aot-ledger-coverage", "bad_unwrapped_jit.py", "good_wrapped_jit.py"),
     ("sharding-discipline", "bad_uncontracted_sort.py",
      "good_contracted_sort.py"),
+    ("shard-intake-coverage", "bad_unsharded_watch.py",
+     "good_shard_intake_watch.py"),
     ("donation-discipline", "bad_read_after_donate.py",
      "good_rebound_after_donate.py"),
     ("knob-catalog", "bad_undeclared_knob.py", "good_declared_knob.py"),
@@ -66,6 +68,9 @@ def test_bad_fixtures_trip_for_the_right_reason():
     v, _ = _run_rule("lock-discipline", "bad_offlock_write.py")
     assert any(".append()" in x.message for x in v)
     assert any("rebind" in x.message for x in v)
+    v, _ = _run_rule("shard-intake-coverage", "bad_unsharded_watch.py")
+    assert len(v) == 2  # the watch() and the watch_members() site
+    assert all("ShardIntake" in x.message for x in v)
 
 
 # -- suppressions --------------------------------------------------------
@@ -107,6 +112,9 @@ def test_rules_actually_saw_the_tree():
     stats = {r.id: r.stats for r in rules}
     assert stats["aot-ledger-coverage"]["jit_sites"] >= 40
     assert stats["sharding-discipline"]["sort_sites"] >= 10
+    assert stats["shard-intake-coverage"]["watch_sites"] >= 25
+    assert stats["shard-intake-coverage"]["dropped_at_intake"] >= 4
+    assert stats["shard-intake-coverage"]["worker_routed"] >= 15
     assert stats["donation-discipline"]["dispatch_sites"] >= 10
     assert stats["knob-catalog"]["knob_reads"] >= 60
     assert stats["lock-discipline"]["declared_classes"] >= 5

@@ -46,6 +46,14 @@ _PROM_LINE = re.compile(
 class TestObservabilityEndToEnd:
     def setup_method(self):
         trace.get_default().clear()
+        # Per-event spans (informer.event, worker.reconcile) are sampled
+        # 1-in-KT_TRACE_SAMPLE_N in production; this test asserts the
+        # full reconcile-path span tree, so trace everything.
+        import os
+
+        self._prev_sample = os.environ.get("KT_TRACE_SAMPLE_N")
+        os.environ["KT_TRACE_SAMPLE_N"] = "1"
+        trace.reset_sampling()
         ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
         self.ftc = dataclasses.replace(
             ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
@@ -90,6 +98,15 @@ class TestObservabilityEndToEnd:
                 "spec": {"schedulingMode": "Divide"},
             },
         )
+
+    def teardown_method(self):
+        import os
+
+        if self._prev_sample is None:
+            os.environ.pop("KT_TRACE_SAMPLE_N", None)
+        else:
+            os.environ["KT_TRACE_SAMPLE_N"] = self._prev_sample
+        trace.reset_sampling()
 
     def reconcile_round(self, max_rounds=60):
         controllers = (

@@ -1,0 +1,362 @@
+"""Sharded control plane (ISSUE 20): leader-per-shard leases,
+per-shard snapshot artifacts, the /debug/shards surface, and — the
+core correctness claim — an in-process N-replica set whose unioned
+scheduler output is bit-identical to the unsharded oracle.
+
+The bench tier proves the same properties at 10000x500 scale
+(bench_e2e.py --shards); these tests pin the mechanisms at unit scale
+so a regression fails in seconds, not in a bench round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.request
+
+import pytest
+
+from kubeadmiral_tpu.federation import shardmap as SM
+from kubeadmiral_tpu.runtime.leaderelection import (
+    LEASES,
+    shard_elector,
+    shard_lease_name,
+    shard_lease_status,
+)
+from kubeadmiral_tpu.runtime.metrics import Metrics
+from kubeadmiral_tpu.runtime.snapshot import SnapshotManager, shard_snapshot_store
+from kubeadmiral_tpu.testing.fakekube import ClusterFleet, FakeKube
+
+
+@pytest.fixture(autouse=True)
+def _restore_default_shardmap():
+    prev = SM.set_default(SM.ShardMap(shard_count=1, shard_index=0))
+    try:
+        yield
+    finally:
+        SM.set_default(prev or SM.ShardMap(shard_count=1, shard_index=0))
+
+
+class _Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+class TestShardLeases:
+    def test_disjoint_acquisition(self):
+        """N replicas against N shard leases: each wins its own, nobody
+        wins a lease another replica holds."""
+        host = FakeKube()
+        electors = [
+            shard_elector(host, identity=f"replica-{i}", shard_index=i)
+            for i in range(3)
+        ]
+        assert all(e.try_acquire_or_renew() for e in electors)
+        # Cross-acquisition attempts against a fresh lease all lose.
+        thief = shard_elector(host, identity="thief", shard_index=1)
+        assert not thief.try_acquire_or_renew()
+        holders = {
+            host.get(LEASES, f"kube-admiral-system/{shard_lease_name(i)}")
+            ["spec"]["holderIdentity"]
+            for i in range(3)
+        }
+        assert holders == {"replica-0", "replica-1", "replica-2"}
+
+    def test_failover_to_standby_after_expiry(self):
+        """A killed replica's shard fails over: the standby's elector
+        acquires kt-shard-<i> once the dead holder's lease expires, and
+        never a moment before."""
+        host = FakeKube()
+        clock = _Clock()
+        dead = shard_elector(
+            host, identity="dead", shard_index=0,
+            lease_seconds=15.0, clock=clock,
+        )
+        assert dead.try_acquire_or_renew()
+        standby = shard_elector(
+            host, identity="standby", shard_index=0,
+            lease_seconds=15.0, clock=clock,
+        )
+        clock.now += 10.0  # inside the lease: holder presumed alive
+        assert not standby.try_acquire_or_renew()
+        clock.now += 10.0  # 20s since renew > 15s duration: expired
+        assert standby.try_acquire_or_renew()
+        assert standby.is_leader
+        lease = host.get(
+            LEASES, f"kube-admiral-system/{shard_lease_name(0)}"
+        )
+        assert lease["spec"]["holderIdentity"] == "standby"
+        # The late-returning dead replica observes the loss.
+        assert not dead.try_acquire_or_renew()
+        assert not dead.is_leader
+
+    def test_release_hands_off_immediately(self):
+        host = FakeKube()
+        clock = _Clock()
+        a = shard_elector(host, identity="a", shard_index=2, clock=clock)
+        b = shard_elector(host, identity="b", shard_index=2, clock=clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        a.release()
+        assert b.try_acquire_or_renew()  # no expiry wait after release
+
+    def test_shard_lease_status_rows(self):
+        host = FakeKube()
+        clock = _Clock()
+        e0 = shard_elector(host, identity="r0", shard_index=0, clock=clock)
+        assert e0.try_acquire_or_renew()
+        clock.now += 60.0  # r0 went silent: stale holder
+        rows = shard_lease_status(host, 3, clock=clock)
+        assert [r["shard"] for r in rows] == [0, 1, 2]
+        assert rows[0]["holder"] == "r0"
+        assert rows[0]["age_s"] == 60.0
+        assert rows[0]["fresh"] is False  # past lease duration
+        assert rows[1]["holder"] is None and rows[1]["fresh"] is False
+        e2 = shard_elector(host, identity="r2", shard_index=2, clock=clock)
+        assert e2.try_acquire_or_renew()
+        rows = shard_lease_status(host, 3, clock=clock)
+        assert rows[2]["holder"] == "r2" and rows[2]["fresh"] is True
+
+
+class _StubEngine:
+    """The minimal engine surface SnapshotManager drives."""
+
+    def __init__(self, state=None):
+        self._state = state if state is not None else {"plane": [1, 2, 3]}
+        self.tick_seq = 7
+        self.last_changed = True
+        self.flightrec = None
+        self.staged = None
+
+    def snapshot_state(self):
+        return self._state
+
+    def stage_restore(self, state, assume_fresh=False):
+        self.staged = (state, assume_fresh)
+
+
+class TestPerShardSnapshots:
+    def test_store_keyed_by_shard_directory(self, tmp_path):
+        s0 = shard_snapshot_store(str(tmp_path), SM.ShardMap(2, 0))
+        s1 = shard_snapshot_store(str(tmp_path), SM.ShardMap(2, 1))
+        SnapshotManager(_StubEngine(), s0, every=1, shard=SM.ShardMap(2, 0)).snapshot()
+        SnapshotManager(_StubEngine(), s1, every=1, shard=SM.ShardMap(2, 1)).snapshot()
+        assert (tmp_path / "shard-0").is_dir()
+        assert (tmp_path / "shard-1").is_dir()
+
+    def test_payload_stamped_and_matching_restore_staged(self, tmp_path):
+        shard = SM.ShardMap(2, 0)
+        store = shard_snapshot_store(str(tmp_path), shard)
+        SnapshotManager(_StubEngine(), store, every=1, shard=shard).snapshot()
+        _, payload = store.load_latest()
+        assert payload["shard"] == {
+            "shard_count": 2, "shard_index": 0, "epoch": 0,
+        }
+        successor = _StubEngine(state=None)
+        mgr = SnapshotManager(successor, store, every=1, shard=SM.ShardMap(2, 0))
+        assert mgr.restore() == "staged"
+        assert successor.staged is not None
+
+    @pytest.mark.parametrize(
+        "wrong",
+        [
+            SM.ShardMap(2, 1),                 # another shard's replica
+            SM.ShardMap(4, 0),                 # different shard count
+            SM.ShardMap(2, 0, epoch=1),        # post-resize epoch
+        ],
+        ids=["index", "count", "epoch"],
+    )
+    def test_mismatched_restore_refused_cold(self, tmp_path, wrong):
+        shard = SM.ShardMap(2, 0)
+        metrics = Metrics()
+        store = shard_snapshot_store(str(tmp_path), shard, metrics=metrics)
+        SnapshotManager(_StubEngine(), store, every=1, shard=shard).snapshot()
+        successor = _StubEngine()
+        # Point the mismatched replica at the same directory on purpose:
+        # the payload stamp, not the path layout, is the contract.
+        mgr = SnapshotManager(successor, store, every=1, shard=wrong)
+        assert mgr.restore() == "cold"
+        assert successor.staged is None
+        assert mgr.last_result == "cold"
+
+    def test_unsharded_manager_ignores_stamp(self, tmp_path):
+        from kubeadmiral_tpu.runtime.snapshot import SnapshotStore
+
+        store = SnapshotStore(str(tmp_path))
+        SnapshotManager(_StubEngine(), store, every=1).snapshot()
+        _, payload = store.load_latest()
+        assert payload["shard"] is None
+        assert SnapshotManager(_StubEngine(), store, every=1).restore() == "staged"
+
+
+class TestDebugShards:
+    def test_provider_slot_last_wins(self):
+        from kubeadmiral_tpu.runtime import profiling
+
+        try:
+            profiling.set_shards_provider(lambda: {"a": 1})
+            profiling.set_shards_provider(lambda: {"b": 2})
+            assert profiling.shards_report() == {"b": 2}
+        finally:
+            profiling.set_shards_provider(None)
+        assert profiling.shards_report() is None
+
+    def test_endpoint_serves_report(self):
+        from kubeadmiral_tpu.runtime import profiling
+        from kubeadmiral_tpu.runtime.profiling import ProfilingServer
+
+        server = ProfilingServer()
+        port = server.start()
+        try:
+            url = f"http://127.0.0.1:{port}/debug/shards"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(url, timeout=10)
+            assert e.value.code == 404  # no provider installed yet
+            profiling.set_shards_provider(
+                lambda: {"shard_count": 2, "shard_index": 0, "epoch": 3}
+            )
+            with urllib.request.urlopen(url, timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc == {"shard_count": 2, "shard_index": 0, "epoch": 3}
+        finally:
+            profiling.set_shards_provider(None)
+            server.stop()
+
+    def test_manager_report_shape(self):
+        from kubeadmiral_tpu.runtime.manager import ControllerManager
+
+        with SM.scoped(SM.ShardMap(2, 0)):
+            mgr = ControllerManager(ClusterFleet())
+        try:
+            report = mgr.shard_report()
+        finally:
+            mgr.shutdown()
+        assert report["shard_count"] == 2 and report["shard_index"] == 0
+        assert "epoch" in report and "owned_keys" in report
+        leases = report["leases"]
+        assert leases is None or len(leases) == 2
+
+
+def _stack(fleet, ftc, shard):
+    """One in-process replica's controller stack under its scope, the
+    bench_e2e._controller_set shape at unit scale."""
+    from kubeadmiral_tpu.federation.federate import FederateController
+    from kubeadmiral_tpu.federation.schedulerctl import SchedulerController
+    from kubeadmiral_tpu.federation.sync import SyncController
+    from kubeadmiral_tpu.runtime.flightrec import FlightRecorder
+    from kubeadmiral_tpu.scheduler.engine import SchedulerEngine
+
+    with SM.scoped(shard):
+        engine = SchedulerEngine(flight_recorder=FlightRecorder())
+        return [
+            FederateController(fleet.host, ftc),
+            SchedulerController(fleet.host, ftc, engine=engine),
+            SyncController(fleet, ftc),
+        ]
+
+
+def _settle(stacks):
+    progressed = True
+    while progressed:
+        progressed = False
+        for ctl in stacks:
+            while ctl.worker.step():
+                progressed = True
+
+
+def _world(n_objects=24, n_clusters=4):
+    from kubeadmiral_tpu.federation.clusterctl import (
+        FEDERATED_CLUSTERS,
+        NODES,
+        FederatedClusterController,
+    )
+    from kubeadmiral_tpu.models.ftc import default_ftcs
+    from kubeadmiral_tpu.models.policy import PROPAGATION_POLICIES
+
+    fleet = ClusterFleet()
+    ftc = next(f for f in default_ftcs() if f.name == "deployments.apps")
+    ftc = dataclasses.replace(
+        ftc, controllers=(("kubeadmiral.io/global-scheduler",),)
+    )
+    cluster_ctl = FederatedClusterController(
+        fleet, api_resource_probe=["apps/v1/Deployment"]
+    )
+    for j in range(n_clusters):
+        name = f"m-{j}"
+        member = fleet.add_member(name)
+        member.create(NODES, {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": "n1"}, "spec": {},
+            "status": {
+                "allocatable": {"cpu": f"{8 + 4 * j}", "memory": "64Gi"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        })
+        fleet.host.create(FEDERATED_CLUSTERS, {
+            "apiVersion": "core.kubeadmiral.io/v1alpha1",
+            "kind": "FederatedCluster",
+            "metadata": {"name": name}, "spec": {},
+        })
+    fleet.host.create(PROPAGATION_POLICIES, {
+        "apiVersion": "core.kubeadmiral.io/v1alpha1",
+        "kind": "PropagationPolicy",
+        "metadata": {"name": "pp", "namespace": "default"},
+        "spec": {"schedulingMode": "Divide"},
+    })
+    _settle([cluster_ctl])
+    for i in range(n_objects):
+        fleet.host.create(ftc.source.resource, {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {
+                "name": f"web-{i:03d}", "namespace": "default",
+                "labels": {"kubeadmiral.io/propagation-policy-name": "pp"},
+            },
+            "spec": {
+                "replicas": (i % 5) + 1,
+                "template": {"spec": {"containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": "100m"}}}
+                ]}},
+            },
+        })
+    return fleet, ftc, cluster_ctl
+
+
+def _placements(fleet, ftc):
+    out = {}
+    for key in sorted(fleet.host.keys(ftc.federated.resource)):
+        spec = fleet.host.get(ftc.federated.resource, key).get("spec", {})
+        out[key] = {
+            "placements": spec.get("placements", []),
+            "overrides": spec.get("overrides", []),
+        }
+    return out
+
+
+class TestInprocReplicaSetParity:
+    def test_union_of_two_shards_matches_unsharded_oracle(self):
+        fleet_o, ftc_o, cl_o = _world()
+        _settle([cl_o] + _stack(fleet_o, ftc_o, SM.ShardMap(1, 0)))
+        oracle = _placements(fleet_o, ftc_o)
+        assert oracle and any(v["placements"] for v in oracle.values())
+
+        fleet_s, ftc_s, cl_s = _world()
+        stacks = [cl_s]
+        for i in range(2):
+            stacks += _stack(fleet_s, ftc_s, SM.ShardMap(2, i))
+        _settle(stacks)
+        assert _placements(fleet_s, ftc_s) == oracle
+
+    def test_single_shard_replica_covers_only_its_keys(self):
+        fleet, ftc, cl = _world()
+        _settle([cl] + _stack(fleet, ftc, SM.ShardMap(2, 0)))
+        probe = SM.ShardMap(2, 0)
+        for key, val in _placements(fleet, ftc).items():
+            if probe.owns(key):
+                assert val["placements"], key
+            else:
+                assert not val["placements"], (
+                    f"shard 0 scheduled non-owned key {key}"
+                )

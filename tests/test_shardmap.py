@@ -172,3 +172,65 @@ class TestWorkerBoundary:
         keys = [f"d/k-{i}" for i in range(25)]
         w.enqueue_all(keys)
         assert sorted(w.queue.drain_due()) == sorted(keys)
+
+
+class TestLiveResize:
+    """The 8→9 live resize at the WORKER boundary (ISSUE 20): jump
+    hashing hands ~1/9 of the keyspace to the new shard and nothing
+    else moves, the handoff set re-enqueues on exactly one new owner,
+    and ownership stays a partition (no key double-owned, none lost)
+    in both the old and the new generation."""
+
+    KEYS = [f"ns-{i % 7}/obj-{i:04d}" for i in range(1800)]
+
+    def _drain_partition(self, maps):
+        """Build one worker per map under its scope, feed EVERY key to
+        every worker (the relist/watch firehose), return per-shard
+        drained sets."""
+        drained = []
+        for m in maps:
+            with SM.scoped(m):
+                w = Worker("resize-test", lambda k: None)
+            w.enqueue_all(self.KEYS)
+            drained.append(set(w.queue.drain_due()))
+        return drained
+
+    def test_resize_8_to_9_at_worker_boundary(self):
+        old = [SM.ShardMap(shard_count=8, shard_index=i) for i in range(8)]
+        before = self._drain_partition(old)
+        # Old generation: a partition — every key owned exactly once.
+        assert set().union(*before) == set(self.KEYS)
+        assert sum(len(s) for s in before) == len(self.KEYS)
+
+        new = [m.resize(9) for m in old] + [
+            SM.ShardMap(shard_count=9, shard_index=8, epoch=old[0].epoch + 1)
+        ]
+        assert all(m.epoch == old[0].epoch + 1 for m in new[:8])
+
+        # The handoff set: ~1/9 of keys, pairwise disjoint across old
+        # owners (each moved key re-enqueues from exactly one replica),
+        # and every moved key lands on the NEW shard — jump hashing
+        # never shuffles keys between surviving shards.
+        moved_per_shard = [m.moved_keys(self.KEYS, m.resize(9)) for m in old]
+        moved = [k for ms in moved_per_shard for k in ms]
+        assert len(moved) == len(set(moved))
+        frac = len(moved) / len(self.KEYS)
+        assert 0.5 / 9 < frac < 2.0 / 9, frac
+        assert all(new[8].owns(k) for k in moved)
+
+        after = self._drain_partition(new)
+        # New generation: still a partition.
+        assert set().union(*after) == set(self.KEYS)
+        assert sum(len(s) for s in after) == len(self.KEYS)
+        # Unmoved keys stayed with their shard; the new shard drained
+        # EXACTLY the handoff set — so during the epoch bump a key is
+        # owned by its old shard or the new one, never both.
+        assert after[8] == set(moved)
+        for i in range(8):
+            assert before[i] - set(moved) == after[i]
+
+    def test_broadcast_keys_never_move(self):
+        old = SM.ShardMap(shard_count=8, shard_index=3)
+        keys = ["cluster::m-1", "cluster::m-2", "default/web-1"]
+        assert "cluster::m-1" not in old.moved_keys(keys, old.resize(9))
+        assert "cluster::m-2" not in old.moved_keys(keys, old.resize(9))

@@ -679,7 +679,11 @@ def _e2e_baseline_key(detail: dict, metric: str) -> str:
     if members is None:
         m = re.search(r"_(\d+)x(\d+)", metric)
         members = m.group(2) if m else "unknown"
-    return f"{_platform_key(detail)}/{transport}/m{members}"
+    # Shard-count axis (ISSUE 20): an N=4 sharded control plane must
+    # never gate against (or seed) an unsharded baseline.  Artifacts
+    # predating the field are unsharded by construction → s1.
+    shards = detail.get("shards") or 1
+    return f"{_platform_key(detail)}/{transport}/m{members}/s{shards}"
 
 
 def gate_e2e(root: Path, tolerance: float) -> int:
@@ -729,6 +733,8 @@ def gate_e2e(root: Path, tolerance: float) -> int:
                 "same_day_p99": (detail.get("same_day_ab") or {}).get(
                     "baseline_e2e_p99_ms"
                 ),
+                "shards": detail.get("shards") or 1,
+                "ab": detail.get("sharded_ab"),
             }
         )
     if not rounds:
@@ -741,6 +747,18 @@ def gate_e2e(root: Path, tolerance: float) -> int:
     groups: dict[tuple[str, str], list[dict]] = {}
     for r in rounds:
         groups.setdefault((r["metric"], r["platform"]), []).append(r)
+    # Sharded speedup ladder (ISSUE 20): the latest same-day interleaved
+    # speedup per (metric, key-without-sN, shards).  Speedups compare
+    # safely across days — each one is internally same-day — so N=4 is
+    # held to at least N=2's multiplier even when benched on different
+    # machine weather.
+    ab_speedups: dict[tuple[str, str, int], float] = {}
+    for (metric, platform), group in groups.items():
+        latest = group[-1]
+        ab = latest.get("ab") or {}
+        if ab.get("speedup") is not None:
+            base = re.sub(r"/s\d+$", "", platform)
+            ab_speedups[(metric, base, latest["shards"])] = ab["speedup"]
     ok = True
     for (metric, platform), group in sorted(groups.items()):
         latest = group[-1]
@@ -765,6 +783,102 @@ def gate_e2e(root: Path, tolerance: float) -> int:
                     for stage, spec in latest["stages"].items()
                 )
             )
+        ab = latest.get("ab")
+        if ab:
+            med = ab.get("arm_medians") or {}
+            speedup = ab.get("speedup")
+            parity = ab.get("parity") or {}
+            print(
+                f"bench-gate: e2e sharded A/B [{platform}] arm medians "
+                f"{med} objects/s over {ab.get('pairs')} interleaved "
+                f"pair(s) — speedup {speedup}x, parity {parity}"
+            )
+            # Correctness before speed: the union of N shards' scheduler
+            # output (placements AND flight-recorder reason counts) must
+            # be bit-identical to the unsharded oracle.
+            for dim in ("placements", "reasons"):
+                got = parity.get(dim)
+                if got not in ("bit-identical", "not-recorded"):
+                    print(
+                        f"bench-gate: SHARDED PARITY BROKEN [{platform}]: "
+                        f"{dim} parity is {got!r} — the sharded control "
+                        f"plane diverged from the unsharded oracle",
+                        file=sys.stderr,
+                    )
+                    ok = False
+            # Parallel speedup needs parallel hardware: on a host with
+            # fewer runnable cores than shard replicas (this container
+            # pins to 1), the GIL-threaded replica drains serialize and
+            # N stacks can only cost overhead.  Gate that the overhead
+            # is BOUNDED there (N=2 may not fall below 0.5x, N>2 below
+            # 0.35x) instead of demanding a physically impossible 1.4x;
+            # parity above stays hard either way.
+            cores = ab.get("cpu_cores") or 0
+            starved = cores and cores < latest["shards"]
+            if starved and "/http/" in platform:
+                # Subprocess replicas over the HTTP farm on a starved
+                # host: N whole controller-stack PROCESSES time-share
+                # the core(s) with the farm and the host apiserver, so
+                # even an overhead floor has no stable meaning (a 2x
+                # time-slice tax is the OS scheduler, not the sharding
+                # layer).  Parity above stays the hard gate; throughput
+                # is reported informationally.
+                print(
+                    f"bench-gate: NOTE [{platform}]: host has {cores} "
+                    f"core(s) for {latest['shards']} subprocess shard "
+                    f"replicas + farm — speedup/overhead floors WAIVED "
+                    f"(informational: {speedup}x); parity still "
+                    f"hard-gated"
+                )
+            elif starved:
+                floor = 0.5 if latest["shards"] == 2 else 0.35
+                print(
+                    f"bench-gate: NOTE [{platform}]: host has {cores} "
+                    f"core(s) for {latest['shards']} shard replicas — "
+                    f"parallel speedup floor (1.4x) WAIVED, gating "
+                    f"bounded overhead (floor {floor}x) instead; parity "
+                    f"still hard-gated"
+                )
+                if speedup is not None and speedup < floor:
+                    print(
+                        f"bench-gate: SHARDED OVERHEAD REGRESSION "
+                        f"[{platform}]: N={latest['shards']} delivers "
+                        f"{speedup}x on a {cores}-core host (overhead "
+                        f"floor {floor}x) — replica bookkeeping is "
+                        f"eating more than the core-starved budget",
+                        file=sys.stderr,
+                    )
+                    ok = False
+            elif (
+                speedup is not None
+                and latest["shards"] == 2
+                and speedup < 1.4
+            ):
+                print(
+                    f"bench-gate: SHARDED SPEEDUP REGRESSION [{platform}]: "
+                    f"N=2 delivers {speedup}x over the same-day interleaved "
+                    f"N=1 median (floor 1.4x)",
+                    file=sys.stderr,
+                )
+                ok = False
+            elif speedup is not None and latest["shards"] > 2:
+                base = re.sub(r"/s\d+$", "", platform)
+                s2 = ab_speedups.get((metric, base, 2))
+                if s2 is None:
+                    print(
+                        f"bench-gate: WARNING: {latest['path']} "
+                        f"(key={platform}) has no N=2 round to ladder "
+                        f"against — speedup monotonicity not gated"
+                    )
+                elif speedup < s2:
+                    print(
+                        f"bench-gate: SHARDED SCALING REGRESSION "
+                        f"[{platform}]: N={latest['shards']} speedup "
+                        f"{speedup}x fell below N=2's {s2}x — extra "
+                        f"replicas made the control plane slower",
+                        file=sys.stderr,
+                    )
+                    ok = False
         priors = [r for r in group[:-1] if r.get("p99") is not None]
         if not priors:
             print(
@@ -999,7 +1113,14 @@ def gate_soak(root: Path, tolerance: float) -> int:
                 "round": int(m.group(1)),
                 "path": path.name,
                 "metric": parsed.get("metric", ""),
-                "platform": _platform_key(detail),
+                # Shards fold into the soak baseline key exactly like
+                # the e2e key (ISSUE 20): a 2-replica soak runs two
+                # whole control-plane processes, so its obj/s never
+                # gates against (or seeds) the unsharded baseline.
+                # Pre-sharding artifacts are s1 by construction.
+                "platform": (
+                    f"{_platform_key(detail)}/s{detail.get('shards') or 1}"
+                ),
                 "value": float(parsed["value"]),
                 "oracle_match": detail.get("oracle_match"),
                 "mismatched": detail.get("mismatched_keys") or [],

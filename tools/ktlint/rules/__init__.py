@@ -12,11 +12,13 @@ def all_rules() -> list[Rule]:
     from tools.ktlint.rules.donation import DonationRule
     from tools.ktlint.rules.knobs import KnobCatalogRule
     from tools.ktlint.rules.locks import LockDisciplineRule
+    from tools.ktlint.rules.shard_intake import ShardIntakeRule
     from tools.ktlint.rules.sharding import ShardingRule
 
     return [
         AotLedgerRule(),
         ShardingRule(),
+        ShardIntakeRule(),
         DonationRule(),
         KnobCatalogRule(),
         LockDisciplineRule(),

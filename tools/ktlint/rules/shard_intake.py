@@ -1,0 +1,189 @@
+"""Rule shard-intake-coverage: every watch/enqueue intake site in the
+federation package must consult the ShardMap before a key costs work.
+
+The sharded control plane (ISSUE 20) runs N engine replicas behind the
+jump-hash router; a watch handler that processes keys its replica does
+not own double-schedules objects and breaks the disjoint-placement
+invariant.  The router is consulted at exactly two boundaries, and a
+watch intake site must hit one of them:
+
+* **intake drop** — the handler is wrapped in ``ShardIntake(...)`` (or
+  the watch call carries a ``predicate=``), so non-owned events are
+  dropped before they cost an enqueue; or
+* **worker boundary** — every event the handler accepts is routed
+  through ``worker.enqueue`` / ``enqueue_all`` / ``enqueue_many``,
+  which filter by the replica's ShardMap snapshot.  The routing check
+  is transitive within the handler's class (``_on_policy_event`` →
+  ``_enqueue_objects_for_policies`` → ``enqueue_all`` counts).
+
+A handler that neither drops at intake nor routes through a worker
+mutates shared state for keys the replica does not own; that is either
+a sharding bug or a deliberately control-plane-global (broadcast)
+intake — the latter must carry a written
+``# ktlint: ignore[shard-intake-coverage] <reason>`` documenting the
+broadcast intent, the same way soakharness pins its join controller to
+``ShardMap(1, 0)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.ktlint.engine import Rule, SourceFile, Violation
+from tools.ktlint.rules import _astutil as A
+
+RULE_ID = "shard-intake-coverage"
+
+WATCH_METHODS = ("watch", "watch_members")
+ENQUEUE_METHODS = ("enqueue", "enqueue_all", "enqueue_many")
+
+
+def _is_shard_intake(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and A.terminal_name(node.func) == "ShardIntake"
+
+
+def _routed_methods(cls: ast.ClassDef) -> set[str]:
+    """Methods that (transitively, within the class) route work through
+    a shard-filtered worker enqueue."""
+    meths: dict[str, ast.AST] = {}
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            meths[node.name] = node
+    direct: set[str] = set()
+    calls: dict[str, set[str]] = {}
+    for name, fn in meths.items():
+        out: set[str] = set()
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            func = call.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr in ENQUEUE_METHODS:
+                direct.add(name)
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                out.add(func.attr)
+        calls[name] = out
+    routed = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for name in meths:
+            if name not in routed and calls[name] & routed:
+                routed.add(name)
+                changed = True
+    return routed
+
+
+def _handler_arg(call: ast.Call) -> ast.AST | None:
+    """The handler passed to ``watch(resource, handler, ...)`` /
+    ``watch_members(resource, handler, ...)``."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "handler":
+            return kw.value
+    return None
+
+
+def _aliased_to_intake(handler: ast.Name, call: ast.Call) -> bool:
+    """``intake = ShardIntake(...); host.watch(res, intake)`` — local
+    forward alias inside the same enclosing def."""
+    for fn in A.enclosing_functions(call):
+        for stmt in ast.walk(fn):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not _is_shard_intake(stmt.value):
+                continue
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == handler.id:
+                    return True
+    return False
+
+
+class ShardIntakeRule(Rule):
+    id = RULE_ID
+    doc = (
+        "watch/watch_members intake sites in kubeadmiral_tpu/federation "
+        "must consult the ShardMap: wrap the handler in ShardIntake(...) "
+        "or pass predicate= (intake drop), or route every accepted event "
+        "through the shard-filtered worker enqueue family; "
+        "control-plane-global (broadcast) intakes need a written "
+        "suppression documenting the intent"
+    )
+    roots = ("kubeadmiral_tpu/federation",)
+
+    def check(self, files: list[SourceFile]) -> list[Violation]:
+        violations: list[Violation] = []
+        sites = 0
+        dropped_at_intake = 0
+        worker_routed = 0
+        for f in files:
+            A.annotate_parents(f.tree)
+            routed_by_class: dict[ast.ClassDef, set[str]] = {}
+            for call in ast.walk(f.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                func = call.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr not in WATCH_METHODS:
+                    continue
+                sites += 1
+                if any(kw.arg == "predicate" for kw in call.keywords):
+                    dropped_at_intake += 1
+                    continue
+                handler = _handler_arg(call)
+                if handler is None:
+                    violations.append(Violation(
+                        RULE_ID, f.rel, call.lineno,
+                        f"{func.attr}() intake site has no recognizable "
+                        f"handler argument — pass the handler positionally "
+                        f"(resource, handler) or as handler= so the shard "
+                        f"router coverage can be checked",
+                    ))
+                    continue
+                if _is_shard_intake(handler):
+                    dropped_at_intake += 1
+                    continue
+                if isinstance(handler, ast.Name) and _aliased_to_intake(
+                        handler, call):
+                    dropped_at_intake += 1
+                    continue
+                if (isinstance(handler, ast.Call)
+                        and A.terminal_name(handler.func) == "partial"
+                        and handler.args):
+                    # functools.partial(self._on_x, ...) — the bound
+                    # method is the real handler (follower.py's
+                    # owner-identified handler idiom).
+                    handler = handler.args[0]
+                if (isinstance(handler, ast.Attribute)
+                        and isinstance(handler.value, ast.Name)
+                        and handler.value.id == "self"):
+                    cls = next(
+                        (a for a in A.ancestors(call)
+                         if isinstance(a, ast.ClassDef)), None)
+                    if cls is not None:
+                        routed = routed_by_class.get(cls)
+                        if routed is None:
+                            routed = _routed_methods(cls)
+                            routed_by_class[cls] = routed
+                        if handler.attr in routed:
+                            worker_routed += 1
+                            continue
+                violations.append(Violation(
+                    RULE_ID, f.rel, call.lineno,
+                    f"{func.attr}() handler is not shard-checked: wrap it "
+                    f"in ShardIntake(...) or pass predicate= to drop "
+                    f"non-owned keys at intake, or route every accepted "
+                    f"event through the shard-filtered worker enqueue "
+                    f"family — a replica processing keys it does not own "
+                    f"double-schedules under the sharded control plane; "
+                    f"a deliberately broadcast intake needs "
+                    f"`# ktlint: ignore[{RULE_ID}] <reason>` "
+                    f"(docs/static_analysis.md)",
+                ))
+        self.stats["watch_sites"] = sites
+        self.stats["dropped_at_intake"] = dropped_at_intake
+        self.stats["worker_routed"] = worker_routed
+        return violations
